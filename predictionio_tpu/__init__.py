@@ -1,0 +1,21 @@
+"""predictionio_tpu — a TPU-native machine learning server framework.
+
+A from-scratch re-design of the capabilities of PredictionIO 0.9.7-aml
+(reference: Scala/Spark/MLlib) for TPU hardware: JAX/XLA/pjit for compute,
+columnar host data plane, and a storage-mediated multi-process topology
+(event server / training workflow / deploy server / evaluation).
+
+Layer map (mirrors reference SURVEY.md §1, re-architected):
+  L0/L1  predictionio_tpu.data          event model + storage backends
+  L2     predictionio_tpu.data.api      event ingestion HTTP server
+  L3     predictionio_tpu.controller    DASE user-facing SDK
+  L4     predictionio_tpu.core          typeless runtime base
+  L5     predictionio_tpu.workflow      train / eval / deploy drivers
+  L6     predictionio_tpu.tools         CLI + ops
+  L7     predictionio_tpu.e2           reusable algorithm/eval library
+         predictionio_tpu.models       TPU model kernels (ALS, NB, LR, CCO…)
+         predictionio_tpu.ops          low-level XLA/Pallas ops
+         predictionio_tpu.parallel     mesh/sharding utilities
+"""
+
+__version__ = "0.1.0"

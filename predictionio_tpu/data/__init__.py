@@ -1,0 +1,22 @@
+"""Event model, property aggregation, and storage (layers L0/L1)."""
+
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidation,
+    ValidationError,
+    SET_EVENT,
+    UNSET_EVENT,
+    DELETE_EVENT,
+)
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+
+__all__ = [
+    "Event",
+    "EventValidation",
+    "ValidationError",
+    "DataMap",
+    "PropertyMap",
+    "SET_EVENT",
+    "UNSET_EVENT",
+    "DELETE_EVENT",
+]

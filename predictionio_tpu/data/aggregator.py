@@ -1,0 +1,135 @@
+"""Property aggregation: fold $set/$unset/$delete event streams into
+per-entity PropertyMaps.
+
+Capability parity with the reference's EventOp monoid
+(data/src/main/scala/io/prediction/data/storage/PEventAggregator.scala:85-191
+and LEventAggregator.scala:29-145): per-field last-write-wins by event time,
+$unset removes fields set at or before the unset time, $delete clears the
+entity.
+
+Re-design notes: the reference runs this as a Spark `aggregateByKey` over an
+RDD. Here the fold is a host-side columnar group-by (events are already
+materialized in process or streamed from a backend iterator); the training
+data path that needs device-scale aggregation uses
+predictionio_tpu.data.store.columnar instead.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import DELETE_EVENT, SET_EVENT, UNSET_EVENT, Event
+
+
+@dataclass
+class _Prop:
+    value: object
+    t: _dt.datetime
+
+
+@dataclass
+class EventOp:
+    """Commutative-enough fold state: field → (value, set-time), plus
+    first/last seen times. Mirrors reference EventOp (PEventAggregator.scala:85).
+    """
+
+    set_props: dict[str, _Prop] = field(default_factory=dict)
+    unset_props: dict[str, _dt.datetime] = field(default_factory=dict)
+    delete_entity: Optional[_dt.datetime] = None
+    first_updated: Optional[_dt.datetime] = None
+    last_updated: Optional[_dt.datetime] = None
+
+    @staticmethod
+    def from_event(e: Event) -> "EventOp":
+        op = EventOp(first_updated=e.event_time, last_updated=e.event_time)
+        if e.event == SET_EVENT:
+            op.set_props = {
+                k: _Prop(v, e.event_time) for k, v in e.properties.items()
+            }
+        elif e.event == UNSET_EVENT:
+            op.unset_props = {k: e.event_time for k in e.properties}
+        elif e.event == DELETE_EVENT:
+            op.delete_entity = e.event_time
+        return op
+
+    def merge(self, other: "EventOp") -> "EventOp":
+        """Associative merge; per-field newest event time wins (ties favor
+        `other`, matching the reference's `if (x.t > y.t) x else y`)."""
+        out = EventOp()
+        # set props: per field take newer
+        out.set_props = dict(self.set_props)
+        for k, p in other.set_props.items():
+            mine = out.set_props.get(k)
+            out.set_props[k] = p if (mine is None or not (mine.t > p.t)) else mine
+        # unset: per key take newer time
+        out.unset_props = dict(self.unset_props)
+        for k, t in other.unset_props.items():
+            mine_t = out.unset_props.get(k)
+            out.unset_props[k] = t if (mine_t is None or t >= mine_t) else mine_t
+        # delete: take newer
+        ds = [d for d in (self.delete_entity, other.delete_entity) if d is not None]
+        out.delete_entity = max(ds) if ds else None
+        firsts = [t for t in (self.first_updated, other.first_updated) if t]
+        lasts = [t for t in (self.last_updated, other.last_updated) if t]
+        out.first_updated = min(firsts) if firsts else None
+        out.last_updated = max(lasts) if lasts else None
+        return out
+
+    def to_property_map(self) -> Optional[PropertyMap]:
+        """Resolve the fold: apply delete, then unsets, then surviving sets."""
+        props = self.set_props
+        if self.delete_entity is not None:
+            props = {k: p for k, p in props.items() if p.t > self.delete_entity}
+        live: dict[str, object] = {}
+        for k, p in props.items():
+            unset_t = self.unset_props.get(k)
+            if unset_t is not None and unset_t >= p.t:
+                continue
+            live[k] = p.value
+        if not live:
+            # entity fully deleted / never set → no property map
+            if self.delete_entity is not None and not props:
+                return None
+            if not self.set_props:
+                return None
+        assert self.first_updated is not None and self.last_updated is not None
+        return PropertyMap(live, self.first_updated, self.last_updated)
+
+
+def aggregate_properties(
+    events: Iterable[Event],
+) -> dict[str, PropertyMap]:
+    """Fold a stream of special events into entity_id → PropertyMap.
+
+    Non-special events are ignored (callers filter by entity_type upstream,
+    matching PEvents.aggregateProperties' query of special events only).
+    """
+    ops: dict[str, EventOp] = {}
+    for e in events:
+        if e.event not in (SET_EVENT, UNSET_EVENT, DELETE_EVENT):
+            continue
+        op = EventOp.from_event(e)
+        prev = ops.get(e.entity_id)
+        ops[e.entity_id] = op if prev is None else prev.merge(op)
+    out: dict[str, PropertyMap] = {}
+    for entity_id, op in ops.items():
+        pm = op.to_property_map()
+        if pm is not None:
+            out[entity_id] = pm
+    return out
+
+
+def aggregate_properties_of_entity(
+    events: Iterable[Event],
+) -> Optional[PropertyMap]:
+    """Single-entity variant (reference LEvents.futureAggregatePropertiesOfEntity)."""
+    op: Optional[EventOp] = None
+    for e in events:
+        if e.event not in (SET_EVENT, UNSET_EVENT, DELETE_EVENT):
+            continue
+        nxt = EventOp.from_event(e)
+        op = nxt if op is None else op.merge(nxt)
+    return op.to_property_map() if op is not None else None

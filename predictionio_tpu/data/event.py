@@ -1,0 +1,211 @@
+"""Canonical event record + validation rules.
+
+Capability parity with the reference Event model
+(data/src/main/scala/io/prediction/data/storage/Event.scala:39-163):
+an immutable behavioral-event record with reserved-name validation and
+the special property events $set / $unset / $delete.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import uuid
+from dataclasses import dataclass, field, replace
+from typing import Any, Mapping, Optional
+
+from predictionio_tpu.data.datamap import DataMap, _parse_time
+
+SET_EVENT = "$set"
+UNSET_EVENT = "$unset"
+DELETE_EVENT = "$delete"
+
+UTC = _dt.timezone.utc
+
+
+class ValidationError(ValueError):
+    """Raised for events violating the reserved-name/special-event rules."""
+
+
+def utcnow() -> _dt.datetime:
+    return _dt.datetime.now(tz=UTC)
+
+
+@dataclass(frozen=True)
+class Event:
+    """One behavioral event (reference Event.scala:39-57).
+
+    Fields map 1:1 to the reference record; `properties` is a DataMap.
+    """
+
+    event: str
+    entity_type: str
+    entity_id: str
+    target_entity_type: Optional[str] = None
+    target_entity_id: Optional[str] = None
+    properties: DataMap = field(default_factory=DataMap)
+    event_time: _dt.datetime = field(default_factory=utcnow)
+    tags: tuple[str, ...] = ()
+    pr_id: Optional[str] = None
+    creation_time: _dt.datetime = field(default_factory=utcnow)
+    event_id: Optional[str] = None
+
+    def __post_init__(self):
+        if not isinstance(self.properties, DataMap):
+            object.__setattr__(self, "properties", DataMap(self.properties))
+        if isinstance(self.tags, list):
+            object.__setattr__(self, "tags", tuple(self.tags))
+        for fname in ("event_time", "creation_time"):
+            v = getattr(self, fname)
+            if v.tzinfo is None:
+                object.__setattr__(self, fname, v.replace(tzinfo=UTC))
+        EventValidation.validate(self)
+
+    def with_id(self, event_id: str) -> "Event":
+        return replace(self, event_id=event_id)
+
+    # -- JSON codec (reference EventJson4sSupport.scala:30-236) -----------
+    def to_json_dict(self, with_id: bool = True) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if with_id and self.event_id is not None:
+            out["eventId"] = self.event_id
+        out.update(
+            {
+                "event": self.event,
+                "entityType": self.entity_type,
+                "entityId": self.entity_id,
+            }
+        )
+        if self.target_entity_type is not None:
+            out["targetEntityType"] = self.target_entity_type
+        if self.target_entity_id is not None:
+            out["targetEntityId"] = self.target_entity_id
+        out["properties"] = self.properties.to_dict()
+        out["eventTime"] = _iso(self.event_time)
+        if self.tags:
+            out["tags"] = list(self.tags)
+        if self.pr_id is not None:
+            out["prId"] = self.pr_id
+        out["creationTime"] = _iso(self.creation_time)
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_dict(), separators=(",", ":"))
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any]) -> "Event":
+        try:
+            event = d["event"]
+            entity_type = d["entityType"]
+            entity_id = d["entityId"]
+        except KeyError as e:
+            raise ValidationError(f"field {e.args[0]} is required") from None
+        for req_name, req_val in (
+            ("event", event),
+            ("entityType", entity_type),
+            ("entityId", entity_id),
+        ):
+            if not isinstance(req_val, str) or not req_val:
+                raise ValidationError(f"field {req_name} must be a non-empty string")
+        now = utcnow()
+        return Event(
+            event=event,
+            entity_type=entity_type,
+            entity_id=str(entity_id),
+            target_entity_type=d.get("targetEntityType"),
+            target_entity_id=(
+                str(d["targetEntityId"]) if d.get("targetEntityId") is not None else None
+            ),
+            properties=DataMap(d.get("properties") or {}),
+            event_time=_parse_time(d["eventTime"]) if d.get("eventTime") else now,
+            tags=tuple(d.get("tags") or ()),
+            pr_id=d.get("prId"),
+            creation_time=_parse_time(d["creationTime"]) if d.get("creationTime") else now,
+            event_id=d.get("eventId"),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "Event":
+        d = json.loads(s)
+        if not isinstance(d, dict):
+            raise ValidationError("event JSON must be an object")
+        return Event.from_json_dict(d)
+
+
+def _iso(dt: _dt.datetime) -> str:
+    return dt.astimezone(UTC).isoformat(timespec="milliseconds").replace("+00:00", "Z")
+
+
+def new_event_id() -> str:
+    return uuid.uuid4().hex
+
+
+class EventValidation:
+    """Reserved-name rules (reference Event.scala:65-163).
+
+    - names starting with "$" or "pio_" are reserved
+    - special events: $set, $unset, $delete with their argument constraints
+    - builtin entity types: pio_pr (for prediction feedback events)
+    """
+
+    SPECIAL_EVENTS = frozenset({SET_EVENT, UNSET_EVENT, DELETE_EVENT})
+    BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+
+    @staticmethod
+    def is_reserved_prefix(name: str) -> bool:
+        return name.startswith("$") or name.startswith("pio_")
+
+    @classmethod
+    def is_special_event(cls, name: str) -> bool:
+        return name in cls.SPECIAL_EVENTS
+
+    @classmethod
+    def validate(cls, e: Event) -> None:
+        if not e.event:
+            raise ValidationError("event must not be empty")
+        if not e.entity_type:
+            raise ValidationError("entityType must not be empty")
+        if not e.entity_id:
+            raise ValidationError("entityId must not be empty")
+        if e.target_entity_type is not None and not e.target_entity_type:
+            raise ValidationError("targetEntityType must not be empty string")
+        if e.target_entity_id is not None and not e.target_entity_id:
+            raise ValidationError("targetEntityId must not be empty string")
+        if e.target_entity_type is None and e.target_entity_id is not None:
+            raise ValidationError(
+                "targetEntityType must be specified when targetEntityId is"
+            )
+        if e.target_entity_type is not None and e.target_entity_id is None:
+            raise ValidationError(
+                "targetEntityId must be specified when targetEntityType is"
+            )
+        if cls.is_reserved_prefix(e.event) and not cls.is_special_event(e.event):
+            raise ValidationError(
+                f"event name {e.event!r} uses a reserved prefix ($ or pio_)"
+            )
+        if (
+            cls.is_reserved_prefix(e.entity_type)
+            and e.entity_type not in cls.BUILTIN_ENTITY_TYPES
+        ):
+            raise ValidationError(
+                f"entityType {e.entity_type!r} uses a reserved prefix"
+            )
+        if e.target_entity_type is not None and cls.is_reserved_prefix(
+            e.target_entity_type
+        ) and e.target_entity_type not in cls.BUILTIN_ENTITY_TYPES:
+            raise ValidationError(
+                f"targetEntityType {e.target_entity_type!r} uses a reserved prefix"
+            )
+        if cls.is_special_event(e.event):
+            cls._validate_special(e)
+
+    @classmethod
+    def _validate_special(cls, e: Event) -> None:
+        if e.target_entity_type is not None or e.target_entity_id is not None:
+            raise ValidationError(
+                f"special event {e.event} must not have targetEntity"
+            )
+        if e.event in (UNSET_EVENT,) and e.properties.is_empty:
+            raise ValidationError("$unset must have non-empty properties")
+        if e.event == DELETE_EVENT and not e.properties.is_empty:
+            raise ValidationError("$delete must not have properties")

@@ -1,0 +1,45 @@
+"""Storage abstraction (L0): env-driven registry + pluggable backends.
+
+Reference: data/src/main/scala/io/prediction/data/storage/Storage.scala:114-403.
+"""
+
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    AccessKeys,
+    App,
+    Apps,
+    Channel,
+    Channels,
+    EngineInstance,
+    EngineInstances,
+    EngineManifest,
+    EngineManifests,
+    EvaluationInstance,
+    EvaluationInstances,
+    EventStore,
+    Model,
+    Models,
+    StorageError,
+)
+from predictionio_tpu.data.storage.registry import Storage, StorageConfig
+
+__all__ = [
+    "AccessKey",
+    "AccessKeys",
+    "App",
+    "Apps",
+    "Channel",
+    "Channels",
+    "EngineInstance",
+    "EngineInstances",
+    "EngineManifest",
+    "EngineManifests",
+    "EvaluationInstance",
+    "EvaluationInstances",
+    "EventStore",
+    "Model",
+    "Models",
+    "Storage",
+    "StorageConfig",
+    "StorageError",
+]

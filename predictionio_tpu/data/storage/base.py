@@ -1,0 +1,463 @@
+"""DAO interfaces + metadata records for the three logical repositories
+(METADATA / EVENTDATA / MODELDATA — reference Storage.scala:140-142).
+
+Re-design notes vs the reference:
+- The reference splits event access into LEvents (async single-process DAO,
+  LEvents.scala:37-489) and PEvents (Spark RDD DAO, PEvents.scala:35-182).
+  Here there is ONE `EventStore` interface: a synchronous record API for
+  serving/ingestion plus a columnar batch API (`find_columnar`) that is the
+  TPU-native replacement for the RDD read path — it returns a struct-of-arrays
+  `EventFrame` ready to stage into device HBM.
+- Metadata DAOs keep the reference's shapes (Apps.scala, AccessKeys.scala,
+  Channels.scala, EngineInstances.scala, EvaluationInstances.scala,
+  EngineManifests.scala, Models.scala) as dataclasses.
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime as _dt
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Iterator, Optional, Sequence
+
+from predictionio_tpu.data.aggregator import (
+    aggregate_properties,
+    aggregate_properties_of_entity,
+)
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import (
+    DELETE_EVENT,
+    SET_EVENT,
+    UNSET_EVENT,
+    Event,
+)
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Event store
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class EventQuery:
+    """Filter set shared by every find path (reference LEvents.futureFind:164
+    / PEvents.find:77)."""
+
+    app_id: int
+    channel_id: Optional[int] = None
+    start_time: Optional[_dt.datetime] = None
+    until_time: Optional[_dt.datetime] = None
+    entity_type: Optional[str] = None
+    entity_id: Optional[str] = None
+    event_names: Optional[Sequence[str]] = None
+    target_entity_type: Optional[str] = None  # "" matches None in reference; use MISSING
+    target_entity_id: Optional[str] = None
+    limit: Optional[int] = None
+    reversed: bool = False
+    # tri-state for target filters: None = no filter; NONE_SENTINEL = must be absent
+    filter_target_absent: bool = False
+
+    def matches(self, e: Event) -> bool:
+        if self.start_time is not None and e.event_time < self.start_time:
+            return False
+        if self.until_time is not None and e.event_time >= self.until_time:
+            return False
+        if self.entity_type is not None and e.entity_type != self.entity_type:
+            return False
+        if self.entity_id is not None and e.entity_id != self.entity_id:
+            return False
+        if self.event_names is not None and e.event not in self.event_names:
+            return False
+        if self.filter_target_absent:
+            if e.target_entity_type is not None or e.target_entity_id is not None:
+                return False
+        else:
+            if (
+                self.target_entity_type is not None
+                and e.target_entity_type != self.target_entity_type
+            ):
+                return False
+            if (
+                self.target_entity_id is not None
+                and e.target_entity_id != self.target_entity_id
+            ):
+                return False
+        return True
+
+
+class EventStore(abc.ABC):
+    """Event DAO. One instance serves all (app_id, channel_id) namespaces."""
+
+    # -- lifecycle (reference LEvents.init/remove/close) -------------------
+    @abc.abstractmethod
+    def init_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Create the namespace for an app/channel (idempotent)."""
+
+    @abc.abstractmethod
+    def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Drop all events for an app/channel."""
+
+    def close(self) -> None:
+        pass
+
+    # -- writes ------------------------------------------------------------
+    @abc.abstractmethod
+    def insert(
+        self, event: Event, app_id: int, channel_id: Optional[int] = None
+    ) -> str:
+        """Insert one event; returns assigned event_id."""
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> list[str]:
+        """Batch insert (fork feature: batch events endpoint, RELEASE.md).
+
+        Backends override with a true bulk write when they can.
+        """
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        """Delete by id; returns whether it existed."""
+
+    def write(
+        self, events: Iterable[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> None:
+        """Bulk write path (reference PEvents.write:167)."""
+        self.insert_batch(list(events), app_id, channel_id)
+
+    # -- reads -------------------------------------------------------------
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        ...
+
+    @abc.abstractmethod
+    def find(self, query: EventQuery) -> Iterator[Event]:
+        """Stream events matching the filter, ordered by event_time
+        (reversed=True → descending)."""
+
+    # -- derived reads (shared implementations) ----------------------------
+    def find_single_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        limit: Optional[int] = None,
+        reversed: bool = True,
+    ) -> Iterator[Event]:
+        """Serving-time single-entity lookup (reference LEvents.findSingleEntity:390,
+        default newest-first)."""
+        return self.find(
+            EventQuery(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+                limit=limit,
+                reversed=reversed,
+            )
+        )
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> dict[str, PropertyMap]:
+        """Fold $set/$unset/$delete into entity_id → PropertyMap
+        (reference LEvents.futureAggregateProperties:191 /
+        PEvents.aggregateProperties:103)."""
+        events = self.find(
+            EventQuery(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                event_names=[SET_EVENT, UNSET_EVENT, DELETE_EVENT],
+            )
+        )
+        result = aggregate_properties(events)
+        if required:
+            req = set(required)
+            result = {
+                k: v for k, v in result.items() if req.issubset(v.keyset())
+            }
+        return result
+
+    def aggregate_properties_of_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> Optional[PropertyMap]:
+        """Reference LEvents.futureAggregatePropertiesOfEntity:234."""
+        events = self.find(
+            EventQuery(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=[SET_EVENT, UNSET_EVENT, DELETE_EVENT],
+            )
+        )
+        return aggregate_properties_of_entity(events)
+
+
+# ---------------------------------------------------------------------------
+# Metadata records + DAOs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class App:
+    """Reference Apps.scala:29."""
+
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclass
+class AccessKey:
+    """Reference AccessKeys.scala:31 — key, app, event whitelist."""
+
+    key: str
+    app_id: int
+    events: tuple[str, ...] = ()
+
+
+@dataclass
+class Channel:
+    """Reference Channels.scala:29."""
+
+    id: int
+    name: str
+    app_id: int
+
+    NAME_CONSTRAINT = "must be non-empty, alphanumeric/-/_ only"
+
+    @staticmethod
+    def is_valid_name(s: str) -> bool:
+        return bool(s) and all(c.isalnum() or c in "-_" for c in s)
+
+
+@dataclass
+class EngineInstance:
+    """One train run's full record (reference EngineInstances.scala:43)."""
+
+    id: str
+    status: str  # INIT | TRAINING | COMPLETED | ABORTED
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    mesh_conf: dict[str, Any] = field(default_factory=dict)  # replaces sparkConf
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+
+@dataclass
+class EvaluationInstance:
+    """Reference EvaluationInstances.scala:39."""
+
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclass
+class EngineManifest:
+    """Reference EngineManifests.scala:34 — registered engine build."""
+
+    id: str
+    version: str
+    name: str
+    description: Optional[str] = None
+    files: tuple[str, ...] = ()
+    engine_factory: str = ""
+
+
+@dataclass
+class Model:
+    """Serialized model blob (reference Models.scala:30)."""
+
+    id: str
+    models: bytes
+
+
+class _KeyedDao(abc.ABC):
+    """Minimal CRUD shape shared by metadata DAOs."""
+
+
+class Apps(_KeyedDao):
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]:
+        """Insert; returns assigned id (app.id==0 → auto-assign)."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(_KeyedDao):
+    @abc.abstractmethod
+    def insert(self, k: AccessKey) -> Optional[str]:
+        """Insert; empty key → generate one. Returns the key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, k: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(_KeyedDao):
+    @abc.abstractmethod
+    def insert(self, c: Channel) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(_KeyedDao):
+    @abc.abstractmethod
+    def insert(self, i: EngineInstance) -> str:
+        """Insert; returns assigned id."""
+
+    @abc.abstractmethod
+    def get(self, iid: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, i: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, iid: str) -> bool: ...
+
+
+class EvaluationInstances(_KeyedDao):
+    @abc.abstractmethod
+    def insert(self, i: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, iid: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, i: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, iid: str) -> bool: ...
+
+
+class EngineManifests(_KeyedDao):
+    @abc.abstractmethod
+    def insert(self, m: EngineManifest) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, mid: str, version: str) -> Optional[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def update(self, m: EngineManifest, upsert: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, mid: str, version: str) -> None: ...
+
+
+class Models(_KeyedDao):
+    @abc.abstractmethod
+    def insert(self, m: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, mid: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, mid: str) -> None: ...

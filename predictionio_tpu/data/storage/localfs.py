@@ -1,0 +1,45 @@
+"""Local-filesystem model blob store (reference localfs/LocalFSModels.scala:29:
+model blobs as files under PIO_FS_BASEDIR)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import Model
+
+
+def default_basedir() -> str:
+    return os.environ.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+    )
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self._dir = Path(config.get("PATH") or default_basedir()) / "models"
+        self._dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, mid: str) -> Path:
+        # sanitize: ids are generated hex/tokens; guard path traversal anyway
+        safe = "".join(c for c in mid if c.isalnum() or c in "-_.")
+        return self._dir / f"pio_model_{safe}"
+
+    def insert(self, m: Model) -> None:
+        tmp = self._path(m.id).with_suffix(".tmp")
+        tmp.write_bytes(m.models)
+        tmp.replace(self._path(m.id))
+
+    def get(self, mid: str) -> Optional[Model]:
+        p = self._path(mid)
+        if not p.exists():
+            return None
+        return Model(mid, p.read_bytes())
+
+    def delete(self, mid: str) -> None:
+        p = self._path(mid)
+        if p.exists():
+            p.unlink()

@@ -1,0 +1,320 @@
+"""In-memory storage backend — reference implementation of every DAO
+contract; used for tests and dev (plays the role the reference's stubbed
+in-memory DAOs play in its API specs, e.g. data/.../api/EventServiceSpec).
+
+Thread-safe via a single RLock per store (the event server handles requests
+from a thread pool).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional, Sequence
+
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    EventQuery,
+    Model,
+    StorageError,
+)
+import secrets
+
+
+class MemoryEventStore(base.EventStore):
+    def __init__(self, config: Optional[dict] = None):
+        self._lock = threading.RLock()
+        # (app_id, channel_id) → {event_id: Event}
+        self._ns: dict[tuple[int, Optional[int]], dict[str, Event]] = {}
+
+    def _key(self, app_id: int, channel_id: Optional[int]):
+        return (app_id, channel_id)
+
+    def init_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            self._ns.setdefault(self._key(app_id, channel_id), {})
+        return True
+
+    def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            self._ns.pop(self._key(app_id, channel_id), None)
+        return True
+
+    def _table(self, app_id: int, channel_id: Optional[int]) -> dict[str, Event]:
+        key = self._key(app_id, channel_id)
+        if key not in self._ns:
+            # auto-init like HBase table autocreation in test mode
+            self._ns[key] = {}
+        return self._ns[key]
+
+    def insert(
+        self, event: Event, app_id: int, channel_id: Optional[int] = None
+    ) -> str:
+        with self._lock:
+            eid = event.event_id or new_event_id()
+            self._table(app_id, channel_id)[eid] = event.with_id(eid)
+            return eid
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        with self._lock:
+            return (
+                self._table(app_id, channel_id).pop(event_id, None) is not None
+            )
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        with self._lock:
+            return self._table(app_id, channel_id).get(event_id)
+
+    def find(self, query: EventQuery) -> Iterator[Event]:
+        with self._lock:
+            events = list(self._table(query.app_id, query.channel_id).values())
+        events = [e for e in events if query.matches(e)]
+        events.sort(key=lambda e: (e.event_time, e.event_id or ""), reverse=query.reversed)
+        if query.limit is not None and query.limit >= 0:
+            events = events[: query.limit]
+        return iter(events)
+
+
+class MemoryApps(base.Apps):
+    def __init__(self, config: Optional[dict] = None):
+        self._lock = threading.RLock()
+        self._apps: dict[int, App] = {}
+        self._next = 1
+
+    def insert(self, app: App) -> Optional[int]:
+        with self._lock:
+            if self.get_by_name(app.name) is not None:
+                return None
+            app_id = app.id if app.id > 0 else self._next
+            if app_id in self._apps:
+                return None
+            self._next = max(self._next, app_id) + 1
+            self._apps[app_id] = App(app_id, app.name, app.description)
+            return app_id
+
+    def get(self, app_id: int) -> Optional[App]:
+        return self._apps.get(app_id)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        for a in self._apps.values():
+            if a.name == name:
+                return a
+        return None
+
+    def get_all(self) -> list[App]:
+        return list(self._apps.values())
+
+    def update(self, app: App) -> bool:
+        with self._lock:
+            if app.id not in self._apps:
+                return False
+            self._apps[app.id] = app
+            return True
+
+    def delete(self, app_id: int) -> bool:
+        with self._lock:
+            return self._apps.pop(app_id, None) is not None
+
+
+class MemoryAccessKeys(base.AccessKeys):
+    def __init__(self, config: Optional[dict] = None):
+        self._lock = threading.RLock()
+        self._keys: dict[str, AccessKey] = {}
+
+    def insert(self, k: AccessKey) -> Optional[str]:
+        with self._lock:
+            key = k.key or secrets.token_urlsafe(32)
+            if key in self._keys:
+                return None
+            self._keys[key] = AccessKey(key, k.app_id, tuple(k.events))
+            return key
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return self._keys.get(key)
+
+    def get_all(self) -> list[AccessKey]:
+        return list(self._keys.values())
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return [k for k in self._keys.values() if k.app_id == app_id]
+
+    def update(self, k: AccessKey) -> bool:
+        with self._lock:
+            if k.key not in self._keys:
+                return False
+            self._keys[k.key] = k
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._keys.pop(key, None) is not None
+
+
+class MemoryChannels(base.Channels):
+    def __init__(self, config: Optional[dict] = None):
+        self._lock = threading.RLock()
+        self._channels: dict[int, Channel] = {}
+        self._next = 1
+
+    def insert(self, c: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(c.name):
+            return None
+        with self._lock:
+            for existing in self._channels.values():
+                if existing.app_id == c.app_id and existing.name == c.name:
+                    return None
+            cid = c.id if c.id > 0 else self._next
+            self._next = max(self._next, cid) + 1
+            self._channels[cid] = Channel(cid, c.name, c.app_id)
+            return cid
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return self._channels.get(channel_id)
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return [c for c in self._channels.values() if c.app_id == app_id]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._lock:
+            return self._channels.pop(channel_id, None) is not None
+
+
+class MemoryEngineInstances(base.EngineInstances):
+    def __init__(self, config: Optional[dict] = None):
+        self._lock = threading.RLock()
+        self._instances: dict[str, EngineInstance] = {}
+        self._counter = 0
+
+    def insert(self, i: EngineInstance) -> str:
+        with self._lock:
+            self._counter += 1
+            iid = i.id or f"ei_{self._counter:08d}_{secrets.token_hex(4)}"
+            rec = EngineInstance(**{**i.__dict__, "id": iid})
+            self._instances[iid] = rec
+            return iid
+
+    def get(self, iid: str) -> Optional[EngineInstance]:
+        return self._instances.get(iid)
+
+    def get_all(self) -> list[EngineInstance]:
+        return list(self._instances.values())
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]:
+        out = [
+            i
+            for i in self._instances.values()
+            if i.status == "COMPLETED"
+            and i.engine_id == engine_id
+            and i.engine_version == engine_version
+            and i.engine_variant == engine_variant
+        ]
+        out.sort(key=lambda i: i.start_time, reverse=True)
+        return out
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+    def update(self, i: EngineInstance) -> bool:
+        with self._lock:
+            if i.id not in self._instances:
+                return False
+            self._instances[i.id] = i
+            return True
+
+    def delete(self, iid: str) -> bool:
+        with self._lock:
+            return self._instances.pop(iid, None) is not None
+
+
+class MemoryEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, config: Optional[dict] = None):
+        self._lock = threading.RLock()
+        self._instances: dict[str, EvaluationInstance] = {}
+        self._counter = 0
+
+    def insert(self, i: EvaluationInstance) -> str:
+        with self._lock:
+            self._counter += 1
+            iid = i.id or f"evi_{self._counter:08d}_{secrets.token_hex(4)}"
+            self._instances[iid] = EvaluationInstance(**{**i.__dict__, "id": iid})
+            return iid
+
+    def get(self, iid: str) -> Optional[EvaluationInstance]:
+        return self._instances.get(iid)
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return list(self._instances.values())
+
+    def get_completed(self) -> list[EvaluationInstance]:
+        out = [i for i in self._instances.values() if i.status == "EVALCOMPLETED"]
+        out.sort(key=lambda i: i.start_time, reverse=True)
+        return out
+
+    def update(self, i: EvaluationInstance) -> bool:
+        with self._lock:
+            if i.id not in self._instances:
+                return False
+            self._instances[i.id] = i
+            return True
+
+    def delete(self, iid: str) -> bool:
+        with self._lock:
+            return self._instances.pop(iid, None) is not None
+
+
+class MemoryEngineManifests(base.EngineManifests):
+    def __init__(self, config: Optional[dict] = None):
+        self._lock = threading.RLock()
+        self._manifests: dict[tuple[str, str], EngineManifest] = {}
+
+    def insert(self, m: EngineManifest) -> None:
+        with self._lock:
+            self._manifests[(m.id, m.version)] = m
+
+    def get(self, mid: str, version: str) -> Optional[EngineManifest]:
+        return self._manifests.get((mid, version))
+
+    def get_all(self) -> list[EngineManifest]:
+        return list(self._manifests.values())
+
+    def update(self, m: EngineManifest, upsert: bool = False) -> None:
+        with self._lock:
+            if (m.id, m.version) not in self._manifests and not upsert:
+                raise StorageError(f"manifest {m.id} {m.version} not found")
+            self._manifests[(m.id, m.version)] = m
+
+    def delete(self, mid: str, version: str) -> None:
+        with self._lock:
+            self._manifests.pop((mid, version), None)
+
+
+class MemoryModels(base.Models):
+    def __init__(self, config: Optional[dict] = None):
+        self._lock = threading.RLock()
+        self._models: dict[str, Model] = {}
+
+    def insert(self, m: Model) -> None:
+        with self._lock:
+            self._models[m.id] = m
+
+    def get(self, mid: str) -> Optional[Model]:
+        return self._models.get(mid)
+
+    def delete(self, mid: str) -> None:
+        with self._lock:
+            self._models.pop(mid, None)

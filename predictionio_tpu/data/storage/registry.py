@@ -1,0 +1,251 @@
+"""Storage registry: env/config-driven factory returning DAO singletons.
+
+Capability parity with the reference `Storage` object
+(data/src/main/scala/io/prediction/data/storage/Storage.scala:114-403):
+- sources configured via `PIO_STORAGE_SOURCES_<NAME>_TYPE` (+ per-source
+  settings as further `PIO_STORAGE_SOURCES_<NAME>_<KEY>` vars)
+- repositories via `PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}` with the
+  three logical repos METADATA / EVENTDATA / MODELDATA
+- lazy client/DAO cache; backend lookup by type name
+- `verify_all_data_objects` deep self-check (reference :335, used by
+  `pio status`)
+
+Re-design: instead of JVM reflection over class-name conventions, a plain
+registry dict maps backend type → module path; DAO classes are resolved by
+conventional attribute names and share one client per source.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import importlib
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import StorageError
+
+# repository name → env default source type (reference Storage.scala:140-142)
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+# backend type name → (module, class name prefix)
+BACKENDS: dict[str, tuple[str, str]] = {
+    "memory": ("predictionio_tpu.data.storage.memory", "Memory"),
+    "sqlite": ("predictionio_tpu.data.storage.sqlite", "Sqlite"),
+    "localfs": ("predictionio_tpu.data.storage.localfs", "LocalFS"),
+    "parquetfs": ("predictionio_tpu.data.storage.parquetfs", "ParquetFS"),
+}
+
+# DAO logical names → class suffix
+_DAO_SUFFIXES = {
+    "events": "EventStore",
+    "apps": "Apps",
+    "access_keys": "AccessKeys",
+    "channels": "Channels",
+    "engine_instances": "EngineInstances",
+    "evaluation_instances": "EvaluationInstances",
+    "engine_manifests": "EngineManifests",
+    "models": "Models",
+}
+
+
+@dataclass
+class SourceConfig:
+    name: str
+    type: str
+    settings: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class StorageConfig:
+    """Parsed storage wiring: named sources + repo → source mapping."""
+
+    sources: dict[str, SourceConfig] = field(default_factory=dict)
+    repositories: dict[str, str] = field(default_factory=dict)  # repo → source name
+
+    @staticmethod
+    def from_env(env: Optional[dict[str, str]] = None) -> "StorageConfig":
+        """Parse PIO_STORAGE_* env vars (reference Storage.scala:124-193)."""
+        env = dict(env if env is not None else os.environ)
+        cfg = StorageConfig()
+        src_prefix = "PIO_STORAGE_SOURCES_"
+        for key, val in env.items():
+            if not key.startswith(src_prefix):
+                continue
+            rest = key[len(src_prefix):]
+            if rest.endswith("_TYPE"):
+                name = rest[: -len("_TYPE")]
+                sc = cfg.sources.setdefault(name, SourceConfig(name, val))
+                sc.type = val
+        for key, val in env.items():
+            if not key.startswith(src_prefix):
+                continue
+            rest = key[len(src_prefix):]
+            for name in cfg.sources:
+                if rest.startswith(name + "_") and not rest.endswith("_TYPE"):
+                    cfg.sources[name].settings[rest[len(name) + 1 :]] = val
+        repo_prefix = "PIO_STORAGE_REPOSITORIES_"
+        for repo in REPOSITORIES:
+            source = env.get(f"{repo_prefix}{repo}_SOURCE")
+            if source:
+                cfg.repositories[repo] = source
+        return cfg
+
+    @staticmethod
+    def default_dev(basedir: Optional[str] = None) -> "StorageConfig":
+        """Zero-config dev wiring: sqlite metadata+events, localfs models —
+        the analogue of the reference's pio-env.sh.template defaults."""
+        base_dir = basedir or os.environ.get(
+            "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+        )
+        os.makedirs(base_dir, exist_ok=True)
+        return StorageConfig(
+            sources={
+                "PIOSQLITE": SourceConfig(
+                    "PIOSQLITE", "sqlite", {"PATH": os.path.join(base_dir, "pio.db")}
+                ),
+                "PIOFS": SourceConfig("PIOFS", "localfs", {"PATH": base_dir}),
+            },
+            repositories={
+                "METADATA": "PIOSQLITE",
+                "EVENTDATA": "PIOSQLITE",
+                "MODELDATA": "PIOFS",
+            },
+        )
+
+
+class Storage:
+    """DAO factory bound to a StorageConfig. A process normally uses the
+    singleton via `Storage.get_instance()`; tests construct their own."""
+
+    _instance: Optional["Storage"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, config: Optional[StorageConfig] = None):
+        if config is None:
+            config = StorageConfig.from_env()
+            if not config.repositories:
+                config = StorageConfig.default_dev()
+        self.config = config
+        self._clients: dict[str, Any] = {}
+        self._daos: dict[tuple[str, str], Any] = {}
+        self._lock = threading.RLock()
+
+    # -- singleton --------------------------------------------------------
+    @classmethod
+    def get_instance(cls) -> "Storage":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = Storage()
+            return cls._instance
+
+    @classmethod
+    def set_instance(cls, storage: Optional["Storage"]) -> None:
+        with cls._instance_lock:
+            cls._instance = storage
+
+    # -- resolution -------------------------------------------------------
+    def _source_for_repo(self, repo: str) -> SourceConfig:
+        src_name = self.config.repositories.get(repo)
+        if src_name is None:
+            raise StorageError(
+                f"repository {repo} is not configured "
+                f"(set PIO_STORAGE_REPOSITORIES_{repo}_SOURCE)"
+            )
+        src = self.config.sources.get(src_name)
+        if src is None:
+            raise StorageError(f"storage source {src_name} is not configured")
+        return src
+
+    def _client_key(self, src: SourceConfig) -> str:
+        return src.name
+
+    def _get_dao(self, repo: str, dao: str) -> Any:
+        src = self._source_for_repo(repo)
+        cache_key = (src.name, dao)
+        with self._lock:
+            if cache_key in self._daos:
+                return self._daos[cache_key]
+            backend = BACKENDS.get(src.type)
+            if backend is None:
+                raise StorageError(f"unknown storage backend type {src.type!r}")
+            module_path, prefix = backend
+            module = importlib.import_module(module_path)
+            cls_name = prefix + _DAO_SUFFIXES[dao]
+            cls = getattr(module, cls_name, None)
+            if cls is None:
+                raise StorageError(
+                    f"backend {src.type!r} does not implement {_DAO_SUFFIXES[dao]}"
+                )
+            # share one client across DAOs of the same source when supported
+            kwargs: dict[str, Any] = {"config": dict(src.settings)}
+            client_factory = getattr(module, "_SqliteClient", None)
+            if client_factory is not None and src.type == "sqlite":
+                client = self._clients.get(src.name)
+                if client is None:
+                    client = client_factory(dict(src.settings))
+                    self._clients[src.name] = client
+                kwargs["client"] = client
+            dao_obj = cls(**kwargs)
+            self._daos[cache_key] = dao_obj
+            return dao_obj
+
+    # -- repo getters (reference Storage.scala:360-391) --------------------
+    def get_events(self) -> base.EventStore:
+        return self._get_dao("EVENTDATA", "events")
+
+    def get_meta_data_apps(self) -> base.Apps:
+        return self._get_dao("METADATA", "apps")
+
+    def get_meta_data_access_keys(self) -> base.AccessKeys:
+        return self._get_dao("METADATA", "access_keys")
+
+    def get_meta_data_channels(self) -> base.Channels:
+        return self._get_dao("METADATA", "channels")
+
+    def get_meta_data_engine_instances(self) -> base.EngineInstances:
+        return self._get_dao("METADATA", "engine_instances")
+
+    def get_meta_data_evaluation_instances(self) -> base.EvaluationInstances:
+        return self._get_dao("METADATA", "evaluation_instances")
+
+    def get_meta_data_engine_manifests(self) -> base.EngineManifests:
+        return self._get_dao("METADATA", "engine_manifests")
+
+    def get_model_data_models(self) -> base.Models:
+        return self._get_dao("MODELDATA", "models")
+
+    # -- deep verification (reference Storage.verifyAllDataObjects:335) ----
+    def verify_all_data_objects(self) -> list[str]:
+        """Touch every DAO + write/read/delete a probe event on app 0.
+        Returns a list of human-readable check results; raises on failure."""
+        results = []
+        for getter in (
+            self.get_meta_data_apps,
+            self.get_meta_data_access_keys,
+            self.get_meta_data_channels,
+            self.get_meta_data_engine_instances,
+            self.get_meta_data_evaluation_instances,
+            self.get_meta_data_engine_manifests,
+            self.get_model_data_models,
+        ):
+            dao = getter()
+            results.append(f"OK {type(dao).__name__}")
+        events = self.get_events()
+        events.init_app(0)
+        from predictionio_tpu.data.event import Event
+
+        probe = Event(
+            event="$set", entity_type="storage_probe", entity_id="0",
+            properties={"probe": True},
+        )
+        eid = events.insert(probe, 0)
+        got = events.get(eid, 0)
+        if got is None:
+            raise StorageError("event store probe write/read failed")
+        events.delete(eid, 0)
+        events.remove_app(0)
+        results.append(f"OK {type(events).__name__} (write/read/delete probe)")
+        return results

@@ -1,0 +1,550 @@
+"""SQLite storage backend — the full-stack SQL alternative, capability parity
+with the reference's JDBC backend (data/.../storage/jdbc/: JDBCLEvents.scala,
+JDBCPEvents.scala, JDBCApps, JDBCAccessKeys, JDBCChannels, JDBCEngineInstances,
+JDBCEngineManifests, JDBCEvaluationInstances, JDBCModels).
+
+One events table per (app, channel) — `events_{appId}[_{channelId}]` — matching
+the reference's table-per-namespace layout (JDBCUtils.eventTableName).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import sqlite3
+import threading
+from typing import Iterator, Optional
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    EventQuery,
+    Model,
+    StorageError,
+)
+import secrets
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def _ms(dt: _dt.datetime) -> int:
+    return int(dt.timestamp() * 1000)
+
+
+def _from_ms(ms: int) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(ms / 1000.0, tz=_dt.timezone.utc)
+
+
+class _SqliteClient:
+    """Shared connection wrapper (reference jdbc/StorageClient connection pool)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.path = config.get("PATH", config.get("URL", ":memory:"))
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self.lock = threading.RLock()
+
+    @property
+    def conn(self) -> sqlite3.Connection:
+        return self._conn
+
+
+class SqliteEventStore(base.EventStore):
+    def __init__(self, config: Optional[dict] = None, client: Optional[_SqliteClient] = None):
+        self._client = client or _SqliteClient(config)
+        self._known_tables: set[str] = set()
+
+    def _table_name(self, app_id: int, channel_id: Optional[int]) -> str:
+        return f"events_{app_id}" + (f"_{channel_id}" if channel_id else "")
+
+    def _ensure_table(self, app_id: int, channel_id: Optional[int]) -> str:
+        name = self._table_name(app_id, channel_id)
+        if name in self._known_tables:
+            return name
+        with self._client.lock:
+            self._client.conn.execute(
+                f"""CREATE TABLE IF NOT EXISTS {name} (
+                    id TEXT PRIMARY KEY,
+                    event TEXT NOT NULL,
+                    entityType TEXT NOT NULL,
+                    entityId TEXT NOT NULL,
+                    targetEntityType TEXT,
+                    targetEntityId TEXT,
+                    properties TEXT,
+                    eventTime INTEGER NOT NULL,
+                    tags TEXT,
+                    prId TEXT,
+                    creationTime INTEGER NOT NULL
+                )"""
+            )
+            self._client.conn.execute(
+                f"CREATE INDEX IF NOT EXISTS idx_{name}_time ON {name} (eventTime)"
+            )
+            self._client.conn.execute(
+                f"CREATE INDEX IF NOT EXISTS idx_{name}_entity ON {name} (entityType, entityId)"
+            )
+            self._client.conn.commit()
+        self._known_tables.add(name)
+        return name
+
+    def init_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._ensure_table(app_id, channel_id)
+        return True
+
+    def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        name = self._table_name(app_id, channel_id)
+        with self._client.lock:
+            self._client.conn.execute(f"DROP TABLE IF EXISTS {name}")
+            self._client.conn.commit()
+        self._known_tables.discard(name)
+        return True
+
+    def close(self) -> None:
+        with self._client.lock:
+            self._client.conn.commit()
+
+    def _row(self, event: Event, eid: str) -> tuple:
+        return (
+            eid,
+            event.event,
+            event.entity_type,
+            event.entity_id,
+            event.target_entity_type,
+            event.target_entity_id,
+            json.dumps(event.properties.to_dict(), separators=(",", ":")),
+            _ms(event.event_time),
+            json.dumps(list(event.tags)) if event.tags else None,
+            event.pr_id,
+            _ms(event.creation_time),
+        )
+
+    def insert(
+        self, event: Event, app_id: int, channel_id: Optional[int] = None
+    ) -> str:
+        name = self._ensure_table(app_id, channel_id)
+        eid = event.event_id or new_event_id()
+        with self._client.lock:
+            self._client.conn.execute(
+                f"INSERT OR REPLACE INTO {name} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                self._row(event, eid),
+            )
+            self._client.conn.commit()
+        return eid
+
+    def insert_batch(self, events, app_id, channel_id=None) -> list[str]:
+        name = self._ensure_table(app_id, channel_id)
+        ids = [e.event_id or new_event_id() for e in events]
+        with self._client.lock:
+            self._client.conn.executemany(
+                f"INSERT OR REPLACE INTO {name} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                [self._row(e, eid) for e, eid in zip(events, ids)],
+            )
+            self._client.conn.commit()
+        return ids
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        name = self._ensure_table(app_id, channel_id)
+        with self._client.lock:
+            cur = self._client.conn.execute(
+                f"DELETE FROM {name} WHERE id = ?", (event_id,)
+            )
+            self._client.conn.commit()
+            return cur.rowcount > 0
+
+    @staticmethod
+    def _to_event(row: tuple) -> Event:
+        (
+            eid,
+            event,
+            etype,
+            eidd,
+            tetype,
+            teid,
+            props,
+            etime,
+            tags,
+            pr_id,
+            ctime,
+        ) = row
+        return Event(
+            event=event,
+            entity_type=etype,
+            entity_id=eidd,
+            target_entity_type=tetype,
+            target_entity_id=teid,
+            properties=DataMap(json.loads(props) if props else {}),
+            event_time=_from_ms(etime),
+            tags=tuple(json.loads(tags)) if tags else (),
+            pr_id=pr_id,
+            creation_time=_from_ms(ctime),
+            event_id=eid,
+        )
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        name = self._ensure_table(app_id, channel_id)
+        with self._client.lock:
+            cur = self._client.conn.execute(
+                f"SELECT * FROM {name} WHERE id = ?", (event_id,)
+            )
+            row = cur.fetchone()
+        return self._to_event(row) if row else None
+
+    def find(self, query: EventQuery) -> Iterator[Event]:
+        name = self._ensure_table(query.app_id, query.channel_id)
+        clauses, params = [], []
+        if query.start_time is not None:
+            clauses.append("eventTime >= ?")
+            params.append(_ms(query.start_time))
+        if query.until_time is not None:
+            clauses.append("eventTime < ?")
+            params.append(_ms(query.until_time))
+        if query.entity_type is not None:
+            clauses.append("entityType = ?")
+            params.append(query.entity_type)
+        if query.entity_id is not None:
+            clauses.append("entityId = ?")
+            params.append(query.entity_id)
+        if query.event_names is not None:
+            marks = ",".join("?" for _ in query.event_names)
+            clauses.append(f"event IN ({marks})")
+            params.extend(query.event_names)
+        if query.filter_target_absent:
+            clauses.append("targetEntityType IS NULL AND targetEntityId IS NULL")
+        else:
+            if query.target_entity_type is not None:
+                clauses.append("targetEntityType = ?")
+                params.append(query.target_entity_type)
+            if query.target_entity_id is not None:
+                clauses.append("targetEntityId = ?")
+                params.append(query.target_entity_id)
+        where = ("WHERE " + " AND ".join(clauses)) if clauses else ""
+        order = "DESC" if query.reversed else "ASC"
+        limit = f"LIMIT {int(query.limit)}" if query.limit is not None and query.limit >= 0 else ""
+        sql = f"SELECT * FROM {name} {where} ORDER BY eventTime {order}, id {order} {limit}"
+        with self._client.lock:
+            rows = self._client.conn.execute(sql, params).fetchall()
+        return (self._to_event(r) for r in rows)
+
+
+class _MetaBase:
+    """Shared table bootstrap for sqlite metadata DAOs."""
+
+    DDL: str = ""
+    TABLE: str = ""
+
+    def __init__(self, config: Optional[dict] = None, client: Optional[_SqliteClient] = None):
+        self._client = client or _SqliteClient(config)
+        with self._client.lock:
+            self._client.conn.execute(self.DDL)
+            self._client.conn.commit()
+
+    def _exec(self, sql: str, params=()):
+        with self._client.lock:
+            cur = self._client.conn.execute(sql, params)
+            self._client.conn.commit()
+            return cur
+
+    def _query(self, sql: str, params=()):
+        with self._client.lock:
+            return self._client.conn.execute(sql, params).fetchall()
+
+
+class SqliteApps(_MetaBase, base.Apps):
+    TABLE = "apps"
+    DDL = """CREATE TABLE IF NOT EXISTS apps (
+        id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT UNIQUE NOT NULL,
+        description TEXT)"""
+
+    def insert(self, app: App) -> Optional[int]:
+        try:
+            if app.id > 0:
+                self._exec(
+                    "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                    (app.id, app.name, app.description),
+                )
+                return app.id
+            cur = self._exec(
+                "INSERT INTO apps (name, description) VALUES (?,?)",
+                (app.name, app.description),
+            )
+            return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, app_id: int) -> Optional[App]:
+        rows = self._query("SELECT id, name, description FROM apps WHERE id=?", (app_id,))
+        return App(*rows[0]) if rows else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        rows = self._query("SELECT id, name, description FROM apps WHERE name=?", (name,))
+        return App(*rows[0]) if rows else None
+
+    def get_all(self) -> list[App]:
+        return [App(*r) for r in self._query("SELECT id, name, description FROM apps")]
+
+    def update(self, app: App) -> bool:
+        cur = self._exec(
+            "UPDATE apps SET name=?, description=? WHERE id=?",
+            (app.name, app.description, app.id),
+        )
+        return cur.rowcount > 0
+
+    def delete(self, app_id: int) -> bool:
+        return self._exec("DELETE FROM apps WHERE id=?", (app_id,)).rowcount > 0
+
+
+class SqliteAccessKeys(_MetaBase, base.AccessKeys):
+    TABLE = "accesskeys"
+    DDL = """CREATE TABLE IF NOT EXISTS accesskeys (
+        accesskey TEXT PRIMARY KEY, appid INTEGER NOT NULL, events TEXT)"""
+
+    def insert(self, k: AccessKey) -> Optional[str]:
+        key = k.key or secrets.token_urlsafe(32)
+        try:
+            self._exec(
+                "INSERT INTO accesskeys VALUES (?,?,?)",
+                (key, k.app_id, json.dumps(list(k.events))),
+            )
+            return key
+        except sqlite3.IntegrityError:
+            return None
+
+    @staticmethod
+    def _to_key(row) -> AccessKey:
+        return AccessKey(row[0], row[1], tuple(json.loads(row[2]) if row[2] else []))
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        rows = self._query("SELECT * FROM accesskeys WHERE accesskey=?", (key,))
+        return self._to_key(rows[0]) if rows else None
+
+    def get_all(self) -> list[AccessKey]:
+        return [self._to_key(r) for r in self._query("SELECT * FROM accesskeys")]
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return [
+            self._to_key(r)
+            for r in self._query("SELECT * FROM accesskeys WHERE appid=?", (app_id,))
+        ]
+
+    def update(self, k: AccessKey) -> bool:
+        cur = self._exec(
+            "UPDATE accesskeys SET appid=?, events=? WHERE accesskey=?",
+            (k.app_id, json.dumps(list(k.events)), k.key),
+        )
+        return cur.rowcount > 0
+
+    def delete(self, key: str) -> bool:
+        return self._exec("DELETE FROM accesskeys WHERE accesskey=?", (key,)).rowcount > 0
+
+
+class SqliteChannels(_MetaBase, base.Channels):
+    TABLE = "channels"
+    DDL = """CREATE TABLE IF NOT EXISTS channels (
+        id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT NOT NULL,
+        appid INTEGER NOT NULL, UNIQUE(name, appid))"""
+
+    def insert(self, c: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(c.name):
+            return None
+        try:
+            cur = self._exec(
+                "INSERT INTO channels (name, appid) VALUES (?,?)", (c.name, c.app_id)
+            )
+            return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        rows = self._query("SELECT id, name, appid FROM channels WHERE id=?", (channel_id,))
+        return Channel(*rows[0]) if rows else None
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return [
+            Channel(*r)
+            for r in self._query("SELECT id, name, appid FROM channels WHERE appid=?", (app_id,))
+        ]
+
+    def delete(self, channel_id: int) -> bool:
+        return self._exec("DELETE FROM channels WHERE id=?", (channel_id,)).rowcount > 0
+
+
+class SqliteEngineInstances(_MetaBase, base.EngineInstances):
+    TABLE = "engineinstances"
+    DDL = """CREATE TABLE IF NOT EXISTS engineinstances (
+        id TEXT PRIMARY KEY, status TEXT, startTime INTEGER, endTime INTEGER,
+        engineId TEXT, engineVersion TEXT, engineVariant TEXT, engineFactory TEXT,
+        batch TEXT, env TEXT, meshConf TEXT, dataSourceParams TEXT,
+        preparatorParams TEXT, algorithmsParams TEXT, servingParams TEXT)"""
+
+    _counter = 0
+
+    def insert(self, i: EngineInstance) -> str:
+        SqliteEngineInstances._counter += 1
+        iid = i.id or f"ei_{secrets.token_hex(8)}"
+        self._exec(
+            "INSERT OR REPLACE INTO engineinstances VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                iid, i.status, _ms(i.start_time), _ms(i.end_time), i.engine_id,
+                i.engine_version, i.engine_variant, i.engine_factory, i.batch,
+                json.dumps(i.env), json.dumps(i.mesh_conf), i.data_source_params,
+                i.preparator_params, i.algorithms_params, i.serving_params,
+            ),
+        )
+        return iid
+
+    @staticmethod
+    def _to_instance(r) -> EngineInstance:
+        return EngineInstance(
+            id=r[0], status=r[1], start_time=_from_ms(r[2]), end_time=_from_ms(r[3]),
+            engine_id=r[4], engine_version=r[5], engine_variant=r[6],
+            engine_factory=r[7], batch=r[8], env=json.loads(r[9] or "{}"),
+            mesh_conf=json.loads(r[10] or "{}"), data_source_params=r[11],
+            preparator_params=r[12], algorithms_params=r[13], serving_params=r[14],
+        )
+
+    def get(self, iid: str) -> Optional[EngineInstance]:
+        rows = self._query("SELECT * FROM engineinstances WHERE id=?", (iid,))
+        return self._to_instance(rows[0]) if rows else None
+
+    def get_all(self) -> list[EngineInstance]:
+        return [self._to_instance(r) for r in self._query("SELECT * FROM engineinstances")]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        rows = self._query(
+            """SELECT * FROM engineinstances WHERE status='COMPLETED'
+               AND engineId=? AND engineVersion=? AND engineVariant=?
+               ORDER BY startTime DESC""",
+            (engine_id, engine_version, engine_variant),
+        )
+        return [self._to_instance(r) for r in rows]
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        done = self.get_completed(engine_id, engine_version, engine_variant)
+        return done[0] if done else None
+
+    def update(self, i: EngineInstance) -> bool:
+        if self.get(i.id) is None:
+            return False
+        self.insert(i)
+        return True
+
+    def delete(self, iid: str) -> bool:
+        return self._exec("DELETE FROM engineinstances WHERE id=?", (iid,)).rowcount > 0
+
+
+class SqliteEvaluationInstances(_MetaBase, base.EvaluationInstances):
+    TABLE = "evaluationinstances"
+    DDL = """CREATE TABLE IF NOT EXISTS evaluationinstances (
+        id TEXT PRIMARY KEY, status TEXT, startTime INTEGER, endTime INTEGER,
+        evaluationClass TEXT, engineParamsGeneratorClass TEXT, batch TEXT,
+        env TEXT, evaluatorResults TEXT, evaluatorResultsHTML TEXT,
+        evaluatorResultsJSON TEXT)"""
+
+    def insert(self, i: EvaluationInstance) -> str:
+        iid = i.id or f"evi_{secrets.token_hex(8)}"
+        self._exec(
+            "INSERT OR REPLACE INTO evaluationinstances VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                iid, i.status, _ms(i.start_time), _ms(i.end_time),
+                i.evaluation_class, i.engine_params_generator_class, i.batch,
+                json.dumps(i.env), i.evaluator_results, i.evaluator_results_html,
+                i.evaluator_results_json,
+            ),
+        )
+        return iid
+
+    @staticmethod
+    def _to_instance(r) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0], status=r[1], start_time=_from_ms(r[2]), end_time=_from_ms(r[3]),
+            evaluation_class=r[4], engine_params_generator_class=r[5], batch=r[6],
+            env=json.loads(r[7] or "{}"), evaluator_results=r[8],
+            evaluator_results_html=r[9], evaluator_results_json=r[10],
+        )
+
+    def get(self, iid: str) -> Optional[EvaluationInstance]:
+        rows = self._query("SELECT * FROM evaluationinstances WHERE id=?", (iid,))
+        return self._to_instance(rows[0]) if rows else None
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return [self._to_instance(r) for r in self._query("SELECT * FROM evaluationinstances")]
+
+    def get_completed(self) -> list[EvaluationInstance]:
+        rows = self._query(
+            "SELECT * FROM evaluationinstances WHERE status='EVALCOMPLETED' ORDER BY startTime DESC"
+        )
+        return [self._to_instance(r) for r in rows]
+
+    def update(self, i: EvaluationInstance) -> bool:
+        if self.get(i.id) is None:
+            return False
+        self.insert(i)
+        return True
+
+    def delete(self, iid: str) -> bool:
+        return self._exec("DELETE FROM evaluationinstances WHERE id=?", (iid,)).rowcount > 0
+
+
+class SqliteEngineManifests(_MetaBase, base.EngineManifests):
+    TABLE = "enginemanifests"
+    DDL = """CREATE TABLE IF NOT EXISTS enginemanifests (
+        id TEXT, version TEXT, name TEXT, description TEXT, files TEXT,
+        engineFactory TEXT, PRIMARY KEY (id, version))"""
+
+    def insert(self, m: EngineManifest) -> None:
+        self._exec(
+            "INSERT OR REPLACE INTO enginemanifests VALUES (?,?,?,?,?,?)",
+            (m.id, m.version, m.name, m.description, json.dumps(list(m.files)), m.engine_factory),
+        )
+
+    @staticmethod
+    def _to_manifest(r) -> EngineManifest:
+        return EngineManifest(
+            id=r[0], version=r[1], name=r[2], description=r[3],
+            files=tuple(json.loads(r[4] or "[]")), engine_factory=r[5],
+        )
+
+    def get(self, mid: str, version: str) -> Optional[EngineManifest]:
+        rows = self._query(
+            "SELECT * FROM enginemanifests WHERE id=? AND version=?", (mid, version)
+        )
+        return self._to_manifest(rows[0]) if rows else None
+
+    def get_all(self) -> list[EngineManifest]:
+        return [self._to_manifest(r) for r in self._query("SELECT * FROM enginemanifests")]
+
+    def update(self, m: EngineManifest, upsert: bool = False) -> None:
+        if not upsert and self.get(m.id, m.version) is None:
+            raise StorageError(f"manifest {m.id} {m.version} not found")
+        self.insert(m)
+
+    def delete(self, mid: str, version: str) -> None:
+        self._exec("DELETE FROM enginemanifests WHERE id=? AND version=?", (mid, version))
+
+
+class SqliteModels(_MetaBase, base.Models):
+    TABLE = "models"
+    DDL = "CREATE TABLE IF NOT EXISTS models (id TEXT PRIMARY KEY, models BLOB)"
+
+    def insert(self, m: Model) -> None:
+        self._exec("INSERT OR REPLACE INTO models VALUES (?,?)", (m.id, m.models))
+
+    def get(self, mid: str) -> Optional[Model]:
+        rows = self._query("SELECT id, models FROM models WHERE id=?", (mid,))
+        return Model(rows[0][0], bytes(rows[0][1])) if rows else None
+
+    def delete(self, mid: str) -> None:
+        self._exec("DELETE FROM models WHERE id=?", (mid,))

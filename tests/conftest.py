@@ -1,0 +1,51 @@
+"""Test harness: force an 8-device virtual CPU mesh so multi-chip sharding
+paths are exercised without TPU hardware (reference analogue: Spark
+`local[4]` SharedSparkContext, core/src/test/.../BaseTest.scala:15-55)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """An 8-device 'dp×mp' mesh on the virtual CPU devices."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    with Mesh(devices, ("dp", "mp")) as m:
+        yield m
+
+
+@pytest.fixture()
+def fresh_storage(tmp_path):
+    """A Storage wired to throwaway sqlite+localfs under tmp_path."""
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    cfg = StorageConfig(
+        sources={
+            "TESTSQL": SourceConfig(
+                "TESTSQL", "sqlite", {"PATH": str(tmp_path / "pio.db")}
+            ),
+            "TESTFS": SourceConfig("TESTFS", "localfs", {"PATH": str(tmp_path)}),
+        },
+        repositories={
+            "METADATA": "TESTSQL",
+            "EVENTDATA": "TESTSQL",
+            "MODELDATA": "TESTFS",
+        },
+    )
+    return Storage(cfg)

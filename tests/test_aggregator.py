@@ -1,0 +1,125 @@
+"""$set/$unset/$delete fold semantics
+(reference: LEventAggregatorSpec / PEventAggregatorSpec)."""
+
+import datetime as dt
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.aggregator import (
+    EventOp,
+    aggregate_properties,
+    aggregate_properties_of_entity,
+)
+
+UTC = dt.timezone.utc
+
+
+def T(i: int) -> dt.datetime:
+    return dt.datetime(2024, 1, 1, tzinfo=UTC) + dt.timedelta(minutes=i)
+
+
+def ev(name, eid, props=None, t=0):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=eid,
+        properties=DataMap(props or {}),
+        event_time=T(t),
+    )
+
+
+class TestAggregation:
+    def test_set_merge_last_write_wins(self):
+        events = [
+            ev("$set", "u1", {"a": 1, "b": 2}, t=0),
+            ev("$set", "u1", {"b": 3, "c": 4}, t=1),
+        ]
+        result = aggregate_properties(events)
+        pm = result["u1"]
+        assert pm.to_dict() == {"a": 1, "b": 3, "c": 4}
+        assert pm.first_updated == T(0)
+        assert pm.last_updated == T(1)
+
+    def test_out_of_order_set(self):
+        # older $set arriving later must not clobber newer value
+        events = [
+            ev("$set", "u1", {"a": "new"}, t=5),
+            ev("$set", "u1", {"a": "old", "b": 1}, t=1),
+        ]
+        pm = aggregate_properties(events)["u1"]
+        assert pm.to_dict() == {"a": "new", "b": 1}
+
+    def test_unset(self):
+        events = [
+            ev("$set", "u1", {"a": 1, "b": 2}, t=0),
+            ev("$unset", "u1", {"a": None}, t=1),
+        ]
+        pm = aggregate_properties(events)["u1"]
+        assert pm.to_dict() == {"b": 2}
+
+    def test_unset_then_set_again(self):
+        events = [
+            ev("$set", "u1", {"a": 1}, t=0),
+            ev("$unset", "u1", {"a": None}, t=1),
+            ev("$set", "u1", {"a": 9}, t=2),
+        ]
+        pm = aggregate_properties(events)["u1"]
+        assert pm.to_dict() == {"a": 9}
+
+    def test_delete_entity(self):
+        events = [
+            ev("$set", "u1", {"a": 1}, t=0),
+            ev("$delete", "u1", t=1),
+        ]
+        assert "u1" not in aggregate_properties(events)
+
+    def test_delete_then_set(self):
+        events = [
+            ev("$set", "u1", {"a": 1}, t=0),
+            ev("$delete", "u1", t=1),
+            ev("$set", "u1", {"b": 2}, t=2),
+        ]
+        pm = aggregate_properties(events)["u1"]
+        assert pm.to_dict() == {"b": 2}
+
+    def test_multiple_entities(self):
+        events = [
+            ev("$set", "u1", {"a": 1}, t=0),
+            ev("$set", "u2", {"a": 2}, t=0),
+        ]
+        result = aggregate_properties(events)
+        assert result["u1"].to_dict() == {"a": 1}
+        assert result["u2"].to_dict() == {"a": 2}
+
+    def test_non_special_ignored(self):
+        events = [ev("view", "u1", t=0), ev("$set", "u1", {"a": 1}, t=1)]
+        assert aggregate_properties(events)["u1"].to_dict() == {"a": 1}
+
+    def test_of_entity(self):
+        events = [
+            ev("$set", "u1", {"a": 1}, t=0),
+            ev("$set", "u1", {"b": 2}, t=3),
+        ]
+        pm = aggregate_properties_of_entity(events)
+        assert pm is not None
+        assert pm.to_dict() == {"a": 1, "b": 2}
+        assert pm.last_updated == T(3)
+
+    def test_of_entity_empty(self):
+        assert aggregate_properties_of_entity([]) is None
+
+    def test_merge_associativity(self):
+        ops = [
+            EventOp.from_event(ev("$set", "u", {"a": 1, "b": 1}, t=0)),
+            EventOp.from_event(ev("$unset", "u", {"a": None}, t=1)),
+            EventOp.from_event(ev("$set", "u", {"a": 7}, t=2)),
+            EventOp.from_event(ev("$delete", "u", t=3)),
+            EventOp.from_event(ev("$set", "u", {"z": 9}, t=4)),
+        ]
+        left = ops[0]
+        for o in ops[1:]:
+            left = left.merge(o)
+        right = ops[-1]
+        for o in reversed(ops[:-1]):
+            right = o.merge(right)
+        assert left.to_property_map() == right.to_property_map()
+        assert left.to_property_map().to_dict() == {"z": 9}

@@ -1,0 +1,161 @@
+"""Event model + DataMap + validation tests
+(reference: DataMapSpec, EventJson4sSupport round-trips, EventValidation rules
+in Event.scala:65-163)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import (
+    DataMap,
+    Event,
+    ValidationError,
+)
+from predictionio_tpu.data.datamap import DataMapError
+
+UTC = dt.timezone.utc
+
+
+class TestDataMap:
+    def test_typed_get(self):
+        d = DataMap({"a": 1, "b": 2.5, "c": "x", "d": True, "e": [1, 2], "f": {"g": 1}})
+        assert d.get("a", int) == 1
+        assert d.get("b", float) == 2.5
+        assert d.get("a", float) == 1.0  # int widens to float
+        assert d.get("c", str) == "x"
+        assert d.get("d", bool) is True
+        assert d.get_list("e", int) == [1, 2]
+        assert d.get("f", dict) == {"g": 1}
+
+    def test_missing_and_null(self):
+        d = DataMap({"a": None})
+        with pytest.raises(DataMapError):
+            d.get("missing", int)
+        with pytest.raises(DataMapError):
+            d.get("a", int)
+        assert d.get_opt("missing", int) is None
+        assert d.get_opt("a", int) is None
+        assert d.get_or_else("missing", 7) == 7
+
+    def test_type_mismatch(self):
+        d = DataMap({"a": "notanint", "b": True})
+        with pytest.raises(DataMapError):
+            d.get("a", int)
+        # bool must not silently coerce to int (json4s distinction)
+        with pytest.raises(DataMapError):
+            d.get("b", int)
+
+    def test_merge_remove(self):
+        d1 = DataMap({"a": 1, "b": 2})
+        d2 = DataMap({"b": 3, "c": 4})
+        assert (d1 + d2).to_dict() == {"a": 1, "b": 3, "c": 4}
+        assert (d1 - ["a"]).to_dict() == {"b": 2}
+
+    def test_datetime_parse(self):
+        d = DataMap({"t": "2024-05-01T12:30:00.000Z"})
+        t = d.get_datetime("t")
+        assert t == dt.datetime(2024, 5, 1, 12, 30, tzinfo=UTC)
+
+    def test_extract_dataclass(self):
+        import dataclasses
+
+        @dataclasses.dataclass
+        class P:
+            a: int
+            b: str = "z"
+
+        p = DataMap({"a": 5, "ignored": 1}).extract(P)
+        assert p == P(5, "z")
+
+
+class TestEventJson:
+    def test_round_trip(self):
+        e = Event(
+            event="buy",
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type="item",
+            target_entity_id="i1",
+            properties=DataMap({"price": 9.99}),
+            event_time=dt.datetime(2024, 1, 2, 3, 4, 5, tzinfo=UTC),
+            tags=("a", "b"),
+            pr_id="pr1",
+        )
+        e2 = Event.from_json(e.to_json())
+        assert e2.event == "buy"
+        assert e2.entity_id == "u1"
+        assert e2.target_entity_id == "i1"
+        assert e2.properties.get("price", float) == 9.99
+        assert e2.event_time == e.event_time
+        assert e2.tags == ("a", "b")
+        assert e2.pr_id == "pr1"
+
+    def test_defaults(self):
+        e = Event.from_json('{"event":"view","entityType":"user","entityId":"3"}')
+        assert e.properties.is_empty
+        assert e.event_time.tzinfo is not None
+
+    def test_missing_required(self):
+        with pytest.raises(ValidationError):
+            Event.from_json('{"event":"view","entityType":"user"}')
+        with pytest.raises(ValidationError):
+            Event.from_json('{"entityType":"user","entityId":"3"}')
+
+    def test_timezone_preserved(self):
+        # reference TestEvents includes non-UTC zone cases
+        e = Event.from_json(
+            '{"event":"view","entityType":"u","entityId":"1",'
+            '"eventTime":"2024-01-01T00:00:00.000+08:00"}'
+        )
+        assert e.event_time.utcoffset() == dt.timedelta(hours=8)
+
+
+class TestEventValidation:
+    def test_reserved_event_name(self):
+        with pytest.raises(ValidationError):
+            Event(event="$custom", entity_type="user", entity_id="1")
+        with pytest.raises(ValidationError):
+            Event(event="pio_thing", entity_type="user", entity_id="1")
+
+    def test_reserved_entity_type(self):
+        with pytest.raises(ValidationError):
+            Event(event="view", entity_type="pio_user", entity_id="1")
+        # builtin pio_pr is allowed
+        Event(event="predict", entity_type="pio_pr", entity_id="1")
+
+    def test_special_events_allowed(self):
+        Event(event="$set", entity_type="user", entity_id="1", properties={"a": 1})
+        Event(event="$unset", entity_type="user", entity_id="1", properties={"a": None})
+        Event(event="$delete", entity_type="user", entity_id="1")
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(ValidationError):
+            Event(event="$unset", entity_type="user", entity_id="1")
+
+    def test_delete_forbids_properties(self):
+        with pytest.raises(ValidationError):
+            Event(event="$delete", entity_type="user", entity_id="1", properties={"a": 1})
+
+    def test_special_event_forbids_target(self):
+        with pytest.raises(ValidationError):
+            Event(
+                event="$set",
+                entity_type="user",
+                entity_id="1",
+                target_entity_type="item",
+                target_entity_id="2",
+            )
+
+    def test_target_pairing(self):
+        with pytest.raises(ValidationError):
+            Event(event="view", entity_type="u", entity_id="1", target_entity_id="2")
+        with pytest.raises(ValidationError):
+            Event(event="view", entity_type="u", entity_id="1", target_entity_type="item")
+
+    def test_empty_fields(self):
+        with pytest.raises(ValidationError):
+            Event(event="", entity_type="u", entity_id="1")
+        with pytest.raises(ValidationError):
+            Event(event="view", entity_type="", entity_id="1")
+        with pytest.raises(ValidationError):
+            Event(event="view", entity_type="u", entity_id="")

@@ -1,0 +1,283 @@
+"""Backend-agnostic storage contract suite: every event-store and metadata
+backend must pass the same behaviors (pattern from reference
+LEventsSpec.scala:21 'behave like any LEvents implementation')."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EventQuery,
+    Model,
+)
+from predictionio_tpu.data.storage.memory import (
+    MemoryAccessKeys,
+    MemoryApps,
+    MemoryChannels,
+    MemoryEngineInstances,
+    MemoryEventStore,
+    MemoryModels,
+)
+from predictionio_tpu.data.storage.sqlite import (
+    SqliteAccessKeys,
+    SqliteApps,
+    SqliteChannels,
+    SqliteEngineInstances,
+    SqliteEventStore,
+    SqliteModels,
+)
+from predictionio_tpu.data.storage.localfs import LocalFSModels
+
+UTC = dt.timezone.utc
+APP = 1
+
+
+def T(i):
+    return dt.datetime(2024, 1, 1, tzinfo=UTC) + dt.timedelta(hours=i)
+
+
+def ev(name, eid, t=0, etype="user", **kw):
+    return Event(
+        event=name, entity_type=etype, entity_id=eid, event_time=T(t), **kw
+    )
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def events(request, tmp_path):
+    if request.param == "memory":
+        store = MemoryEventStore()
+    else:
+        store = SqliteEventStore({"PATH": str(tmp_path / "ev.db")})
+    store.init_app(APP)
+    yield store
+    store.remove_app(APP)
+
+
+class TestEventStoreContract:
+    def test_insert_get_delete(self, events):
+        e = ev("view", "u1", t=0)
+        eid = events.insert(e, APP)
+        got = events.get(eid, APP)
+        assert got is not None and got.event == "view" and got.event_id == eid
+        assert events.delete(eid, APP)
+        assert events.get(eid, APP) is None
+        assert not events.delete(eid, APP)
+
+    def test_insert_batch(self, events):
+        ids = events.insert_batch([ev("view", f"u{i}", t=i) for i in range(5)], APP)
+        assert len(set(ids)) == 5
+        found = list(events.find(EventQuery(app_id=APP)))
+        assert len(found) == 5
+
+    def test_time_order_and_reversed(self, events):
+        events.insert_batch([ev("view", "u", t=i) for i in (3, 1, 2)], APP)
+        asc = [e.event_time for e in events.find(EventQuery(app_id=APP))]
+        assert asc == sorted(asc)
+        desc = [e.event_time for e in events.find(EventQuery(app_id=APP, reversed=True))]
+        assert desc == sorted(desc, reverse=True)
+
+    def test_time_range_filter(self, events):
+        events.insert_batch([ev("view", "u", t=i) for i in range(5)], APP)
+        found = list(
+            events.find(EventQuery(app_id=APP, start_time=T(1), until_time=T(3)))
+        )
+        assert [e.event_time for e in found] == [T(1), T(2)]
+
+    def test_entity_and_event_filters(self, events):
+        events.insert(ev("view", "u1"), APP)
+        events.insert(ev("buy", "u1", t=1), APP)
+        events.insert(ev("view", "u2", t=2), APP)
+        events.insert(ev("view", "i1", t=3, etype="item"), APP)
+        assert len(list(events.find(EventQuery(app_id=APP, entity_type="user")))) == 3
+        assert (
+            len(list(events.find(EventQuery(app_id=APP, entity_type="user", entity_id="u1"))))
+            == 2
+        )
+        assert len(list(events.find(EventQuery(app_id=APP, event_names=["buy"])))) == 1
+
+    def test_target_entity_filter(self, events):
+        events.insert(
+            ev("view", "u1", target_entity_type="item", target_entity_id="i1"), APP
+        )
+        events.insert(ev("signup", "u1", t=1), APP)
+        hit = list(
+            events.find(
+                EventQuery(app_id=APP, target_entity_type="item", target_entity_id="i1")
+            )
+        )
+        assert len(hit) == 1 and hit[0].event == "view"
+        absent = list(events.find(EventQuery(app_id=APP, filter_target_absent=True)))
+        assert len(absent) == 1 and absent[0].event == "signup"
+
+    def test_limit(self, events):
+        events.insert_batch([ev("view", "u", t=i) for i in range(10)], APP)
+        assert len(list(events.find(EventQuery(app_id=APP, limit=3)))) == 3
+
+    def test_channel_isolation(self, events):
+        events.init_app(APP, 7)
+        events.insert(ev("view", "u1"), APP)
+        events.insert(ev("view", "u2"), APP, 7)
+        assert len(list(events.find(EventQuery(app_id=APP)))) == 1
+        assert len(list(events.find(EventQuery(app_id=APP, channel_id=7)))) == 1
+        assert (
+            list(events.find(EventQuery(app_id=APP, channel_id=7)))[0].entity_id == "u2"
+        )
+
+    def test_properties_roundtrip(self, events):
+        e = ev("view", "u1", properties=DataMap({"x": [1, "a"], "y": {"n": 2.5}}))
+        eid = events.insert(e, APP)
+        got = events.get(eid, APP)
+        assert got.properties.to_dict() == {"x": [1, "a"], "y": {"n": 2.5}}
+
+    def test_aggregate_properties(self, events):
+        events.insert(
+            ev("$set", "u1", t=0, properties=DataMap({"a": 1})), APP
+        )
+        events.insert(
+            ev("$set", "u1", t=1, properties=DataMap({"b": 2})), APP
+        )
+        events.insert(
+            ev("$set", "u2", t=0, properties=DataMap({"a": 5})), APP
+        )
+        agg = events.aggregate_properties(APP, "user")
+        assert agg["u1"].to_dict() == {"a": 1, "b": 2}
+        assert agg["u2"].to_dict() == {"a": 5}
+        # required-field filter
+        agg2 = events.aggregate_properties(APP, "user", required=["b"])
+        assert set(agg2) == {"u1"}
+
+    def test_find_single_entity_newest_first(self, events):
+        events.insert_batch([ev("view", "u1", t=i) for i in range(3)], APP)
+        got = list(events.find_single_entity(APP, "user", "u1", limit=2))
+        assert len(got) == 2
+        assert got[0].event_time > got[1].event_time
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def meta(request, tmp_path):
+    if request.param == "memory":
+        return {
+            "apps": MemoryApps(),
+            "keys": MemoryAccessKeys(),
+            "channels": MemoryChannels(),
+            "instances": MemoryEngineInstances(),
+            "models": MemoryModels(),
+        }
+    cfg = {"PATH": str(tmp_path / "meta.db")}
+    return {
+        "apps": SqliteApps(cfg),
+        "keys": SqliteAccessKeys(cfg),
+        "channels": SqliteChannels(cfg),
+        "instances": SqliteEngineInstances(cfg),
+        "models": SqliteModels(cfg),
+    }
+
+
+class TestMetadataContract:
+    def test_apps_crud(self, meta):
+        apps = meta["apps"]
+        aid = apps.insert(App(0, "myapp", "desc"))
+        assert aid and aid > 0
+        assert apps.get(aid).name == "myapp"
+        assert apps.get_by_name("myapp").id == aid
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        assert apps.update(App(aid, "renamed", None))
+        assert apps.get_by_name("renamed") is not None
+        assert apps.delete(aid)
+        assert apps.get(aid) is None
+
+    def test_access_keys(self, meta):
+        keys = meta["keys"]
+        k = keys.insert(AccessKey("", 1, ("view", "buy")))
+        assert k and len(k) > 10
+        got = keys.get(k)
+        assert got.app_id == 1 and got.events == ("view", "buy")
+        k2 = keys.insert(AccessKey("fixedkey", 2))
+        assert k2 == "fixedkey"
+        assert {x.key for x in keys.get_by_app_id(1)} == {k}
+        assert keys.delete(k)
+        assert keys.get(k) is None
+
+    def test_channels(self, meta):
+        channels = meta["channels"]
+        cid = channels.insert(Channel(0, "ch-1", 1))
+        assert cid and channels.get(cid).name == "ch-1"
+        assert channels.insert(Channel(0, "bad name!", 1)) is None
+        assert channels.insert(Channel(0, "ch-1", 1)) is None  # dup per app
+        assert channels.insert(Channel(0, "ch-1", 2)) is not None  # other app ok
+        assert [c.id for c in channels.get_by_app_id(1)] == [cid]
+        assert channels.delete(cid)
+
+    def test_engine_instances_lifecycle(self, meta):
+        instances = meta["instances"]
+        base_kwargs = dict(
+            engine_id="eng", engine_version="1", engine_variant="default.json",
+            engine_factory="f",
+        )
+        i1 = instances.insert(
+            EngineInstance(id="", status="INIT", start_time=T(0), end_time=T(0), **base_kwargs)
+        )
+        rec = instances.get(i1)
+        assert rec.status == "INIT"
+        rec.status = "COMPLETED"
+        assert instances.update(rec)
+        i2 = instances.insert(
+            EngineInstance(id="", status="COMPLETED", start_time=T(5), end_time=T(5), **base_kwargs)
+        )
+        latest = instances.get_latest_completed("eng", "1", "default.json")
+        assert latest.id == i2
+        assert len(instances.get_completed("eng", "1", "default.json")) == 2
+        assert instances.get_latest_completed("other", "1", "x") is None
+
+    def test_models_blob(self, meta):
+        models = meta["models"]
+        blob = b"\x00\x01binary\xff" * 100
+        models.insert(Model("m1", blob))
+        assert models.get("m1").models == blob
+        models.insert(Model("m1", b"v2"))  # overwrite
+        assert models.get("m1").models == b"v2"
+        models.delete("m1")
+        assert models.get("m1") is None
+
+
+class TestLocalFSModels:
+    def test_blob_roundtrip(self, tmp_path):
+        store = LocalFSModels({"PATH": str(tmp_path)})
+        store.insert(Model("abc123", b"\x00blob\xff"))
+        assert store.get("abc123").models == b"\x00blob\xff"
+        assert store.get("missing") is None
+        store.delete("abc123")
+        assert store.get("abc123") is None
+
+
+class TestRegistry:
+    def test_env_parse(self):
+        env = {
+            "PIO_STORAGE_SOURCES_MYSQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_MYSQL_PATH": "/tmp/x.db",
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": "/tmp/fs",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MYSQL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MYSQL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+        }
+        from predictionio_tpu.data.storage.registry import StorageConfig
+
+        cfg = StorageConfig.from_env(env)
+        assert cfg.sources["MYSQL"].type == "sqlite"
+        assert cfg.sources["MYSQL"].settings["PATH"] == "/tmp/x.db"
+        assert cfg.repositories["MODELDATA"] == "FS"
+
+    def test_verify_all(self, fresh_storage):
+        results = fresh_storage.verify_all_data_objects()
+        assert len(results) >= 8
+        assert all(r.startswith("OK") for r in results)
+
+    def test_dao_singletons(self, fresh_storage):
+        assert fresh_storage.get_events() is fresh_storage.get_events()
+        assert fresh_storage.get_meta_data_apps() is fresh_storage.get_meta_data_apps()
