@@ -238,6 +238,79 @@ class SqliteEventStore(base.EventStore):
             rows = self._client.conn.execute(sql, params).fetchall()
         return (self._to_event(r) for r in rows)
 
+    def _where(self, query: EventQuery) -> tuple[str, list]:
+        clauses, params = [], []
+        if query.start_time is not None:
+            clauses.append("eventTime >= ?")
+            params.append(_ms(query.start_time))
+        if query.until_time is not None:
+            clauses.append("eventTime < ?")
+            params.append(_ms(query.until_time))
+        if query.entity_type is not None:
+            clauses.append("entityType = ?")
+            params.append(query.entity_type)
+        if query.entity_id is not None:
+            clauses.append("entityId = ?")
+            params.append(query.entity_id)
+        if query.event_names is not None:
+            marks = ",".join("?" for _ in query.event_names)
+            clauses.append(f"event IN ({marks})")
+            params.extend(query.event_names)
+        if query.filter_target_absent:
+            clauses.append("targetEntityType IS NULL AND targetEntityId IS NULL")
+        else:
+            if query.target_entity_type is not None:
+                clauses.append("targetEntityType = ?")
+                params.append(query.target_entity_type)
+            if query.target_entity_id is not None:
+                clauses.append("targetEntityId = ?")
+                params.append(query.target_entity_id)
+        return ("WHERE " + " AND ".join(clauses)) if clauses else "", params
+
+    def find_frame(
+        self,
+        query: EventQuery,
+        value_prop: Optional[str] = None,
+        default_value: float = 1.0,
+    ):
+        """Columnar fast path: SELECT only training-relevant columns straight
+        into arrays, pulling the numeric payload out of the JSON properties
+        with sqlite's json_extract — no per-row Event construction.
+
+        This is the TPU-native analogue of the reference's parallel scan
+        (JDBCPEvents.find → JdbcRDD, JDBCPEvents.scala:66-99)."""
+        import numpy as np
+
+        from predictionio_tpu.data.store.columnar import EventFrame
+
+        name = self._ensure_table(query.app_id, query.channel_id)
+        where, params = self._where(query)
+        value_sel = (
+            f"COALESCE(json_extract(properties, '$.\"{value_prop}\"'), ?)"
+            if value_prop is not None
+            else "?"
+        )
+        sql = (
+            f"SELECT event, entityId, targetEntityId, eventTime, {value_sel} "
+            f"FROM {name} {where} ORDER BY eventTime ASC, id ASC"
+        )
+        with self._client.lock:
+            rows = self._client.conn.execute(sql, [default_value] + params).fetchall()
+        if not rows:
+            return EventFrame.from_columns(
+                [], [], [], np.zeros(0, np.int64), np.zeros(0, np.float32)
+            )
+        ev_names, entity_ids, target_ids, times, values = zip(*rows)
+        return EventFrame.from_columns(
+            ev_names,
+            entity_ids,
+            target_ids,
+            np.asarray(times, dtype=np.int64),
+            np.asarray(values, dtype=np.float32),
+            entity_type=query.entity_type,
+            target_entity_type=query.target_entity_type,
+        )
+
 
 class _MetaBase:
     """Shared table bootstrap for sqlite metadata DAOs."""

@@ -1,0 +1,18 @@
+"""Engine-facing read APIs (L1): event-store facades + columnar loader.
+
+Reference: data/src/main/scala/io/prediction/data/store/ (PEventStore,
+LEventStore) — re-designed with a columnar batch path for TPU staging.
+"""
+
+from predictionio_tpu.data.store.bimap import BiMap, EntityMap
+from predictionio_tpu.data.store.columnar import EventFrame
+from predictionio_tpu.data.store.event_store import EventStoreFacade, LEventStore, PEventStore
+
+__all__ = [
+    "BiMap",
+    "EntityMap",
+    "EventFrame",
+    "EventStoreFacade",
+    "LEventStore",
+    "PEventStore",
+]

@@ -1,0 +1,212 @@
+"""EventFrame — the columnar event batch that replaces the reference's RDD
+read path (PEvents.find → RDD[Event], HBPEvents.scala:84-90) for training.
+
+Design: training-relevant event attributes are interned/packed into dense
+numpy struct-of-arrays on the host, then staged to device HBM in one transfer.
+Everything a DASE DataSource typically derives from raw events — (user, item,
+rating/weight, time) tuples, per-entity property tables — is computed from
+these columns with vectorized ops instead of per-row Python.
+
+Columns:
+  event_code   int32  — index into `event_vocab`
+  entity_idx   int32  — index into `entity_vocab` (per entity TYPE vocabs)
+  target_idx   int32  — index into target entity vocab, -1 when absent
+  time_ms      int64  — event time (epoch millis)
+  value        float32 — numeric payload pulled from a named property (or 1.0)
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.store.bimap import BiMap
+
+
+@dataclass
+class EventFrame:
+    event_code: np.ndarray
+    entity_idx: np.ndarray
+    target_idx: np.ndarray
+    time_ms: np.ndarray
+    value: np.ndarray
+    event_vocab: BiMap  # event name → code
+    entity_vocab: BiMap  # entity id → idx  (single entity_type per frame)
+    target_vocab: BiMap  # target entity id → idx
+    entity_type: Optional[str] = None
+    target_entity_type: Optional[str] = None
+
+    def __len__(self) -> int:
+        return int(self.event_code.shape[0])
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.entity_vocab)
+
+    @property
+    def n_targets(self) -> int:
+        return len(self.target_vocab)
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_events(
+        events: Iterable[Event],
+        value_prop: Optional[str] = None,
+        default_value: float = 1.0,
+        entity_vocab: Optional[BiMap] = None,
+        target_vocab: Optional[BiMap] = None,
+    ) -> "EventFrame":
+        """Pack an event stream into columns. `value_prop` names the property
+        to extract as the float payload (e.g. "rating"); missing → default."""
+        names: list[str] = []
+        entities: list[str] = []
+        targets: list[Optional[str]] = []
+        times: list[int] = []
+        values: list[float] = []
+        etype: Optional[str] = None
+        ttype: Optional[str] = None
+        for e in events:
+            names.append(e.event)
+            entities.append(e.entity_id)
+            targets.append(e.target_entity_id)
+            times.append(int(e.event_time.timestamp() * 1000))
+            if value_prop is not None:
+                v = e.properties.get_opt(value_prop, float)
+                values.append(default_value if v is None else v)
+            else:
+                values.append(default_value)
+            etype = etype or e.entity_type
+            ttype = ttype or e.target_entity_type
+        event_vocab = BiMap.string_int(names)
+        if entity_vocab is None:
+            entity_vocab = BiMap.string_int(entities)
+        if target_vocab is None:
+            target_vocab = BiMap.string_int(t for t in targets if t is not None)
+        return EventFrame(
+            event_code=event_vocab.map_array(names),
+            entity_idx=entity_vocab.map_array(entities),
+            target_idx=np.fromiter(
+                (
+                    target_vocab.get(t, -1) if t is not None else -1
+                    for t in targets
+                ),
+                dtype=np.int32,
+                count=len(targets),
+            ),
+            time_ms=np.asarray(times, dtype=np.int64),
+            value=np.asarray(values, dtype=np.float32),
+            event_vocab=event_vocab,
+            entity_vocab=entity_vocab,
+            target_vocab=target_vocab,
+            entity_type=etype,
+            target_entity_type=ttype,
+        )
+
+    @staticmethod
+    def from_columns(
+        event_names: Sequence[str],
+        entity_ids: Sequence[str],
+        target_ids: Sequence[Optional[str]],
+        time_ms: np.ndarray,
+        values: np.ndarray,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+    ) -> "EventFrame":
+        """Fast path for backends that can produce raw columns without
+        constructing Event objects (e.g. the sqlite SELECT → arrays path)."""
+        event_vocab = BiMap.string_int(event_names)
+        entity_vocab = BiMap.string_int(entity_ids)
+        target_vocab = BiMap.string_int(t for t in target_ids if t is not None)
+        return EventFrame(
+            event_code=event_vocab.map_array(event_names),
+            entity_idx=entity_vocab.map_array(entity_ids),
+            target_idx=np.fromiter(
+                (target_vocab.get(t, -1) if t is not None else -1 for t in target_ids),
+                dtype=np.int32,
+                count=len(target_ids),
+            ),
+            time_ms=np.asarray(time_ms, dtype=np.int64),
+            value=np.asarray(values, dtype=np.float32),
+            event_vocab=event_vocab,
+            entity_vocab=entity_vocab,
+            target_vocab=target_vocab,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+        )
+
+    # -- filters / views ---------------------------------------------------
+    def select(self, mask: np.ndarray) -> "EventFrame":
+        return EventFrame(
+            event_code=self.event_code[mask],
+            entity_idx=self.entity_idx[mask],
+            target_idx=self.target_idx[mask],
+            time_ms=self.time_ms[mask],
+            value=self.value[mask],
+            event_vocab=self.event_vocab,
+            entity_vocab=self.entity_vocab,
+            target_vocab=self.target_vocab,
+            entity_type=self.entity_type,
+            target_entity_type=self.target_entity_type,
+        )
+
+    def where_event(self, *names: str) -> "EventFrame":
+        codes = [self.event_vocab.get(n, -2) for n in names]
+        return self.select(np.isin(self.event_code, codes))
+
+    def where_time(
+        self,
+        start: Optional[_dt.datetime] = None,
+        until: Optional[_dt.datetime] = None,
+    ) -> "EventFrame":
+        mask = np.ones(len(self), dtype=bool)
+        if start is not None:
+            mask &= self.time_ms >= int(start.timestamp() * 1000)
+        if until is not None:
+            mask &= self.time_ms < int(until.timestamp() * 1000)
+        return self.select(mask)
+
+    # -- training-shape exports --------------------------------------------
+    def interactions(
+        self, dedupe: str = "sum"
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(entity_idx, target_idx, value) triples with valid targets, with
+        duplicate (entity,target) pairs combined: "sum" | "max" | "last".
+        This is the COO ratings-matrix export consumed by ALS/CCO."""
+        mask = self.target_idx >= 0
+        rows = self.entity_idx[mask].astype(np.int64)
+        cols = self.target_idx[mask].astype(np.int64)
+        vals = self.value[mask]
+        times = self.time_ms[mask]
+        n_t = max(len(self.target_vocab), int(cols.max()) + 1 if len(cols) else 1)
+        keys = rows * n_t + cols
+        if dedupe == "last":
+            order = np.argsort(times, kind="stable")
+            keys, rows, cols, vals = keys[order], rows[order], cols[order], vals[order]
+            uniq, last_idx = np.unique(keys[::-1], return_index=True)
+            take = len(keys) - 1 - last_idx
+            return (
+                rows[take].astype(np.int32),
+                cols[take].astype(np.int32),
+                vals[take],
+            )
+        uniq, inv = np.unique(keys, return_inverse=True)
+        if dedupe == "sum":
+            agg = np.zeros(len(uniq), dtype=np.float64)
+            np.add.at(agg, inv, vals.astype(np.float64))
+        elif dedupe == "max":
+            agg = np.full(len(uniq), -np.inf)
+            np.maximum.at(agg, inv, vals)
+        else:
+            raise ValueError(f"unknown dedupe mode {dedupe!r}")
+        out_rows = (uniq // n_t).astype(np.int32)
+        out_cols = (uniq % n_t).astype(np.int32)
+        return out_rows, out_cols, agg.astype(np.float32)
+
+    def counts_per_entity(self) -> np.ndarray:
+        out = np.zeros(self.n_entities, dtype=np.int64)
+        np.add.at(out, self.entity_idx[self.entity_idx >= 0], 1)
+        return out

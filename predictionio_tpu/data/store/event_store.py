@@ -1,0 +1,168 @@
+"""App-name-based event store facades for engine code.
+
+Reference: data/src/main/scala/io/prediction/data/store/PEventStore.scala:32,
+LEventStore.scala:30, Common.scala:28 (appNameToId).
+
+Re-design: one `EventStoreFacade` provides both surfaces —
+- `find` / `aggregate_properties` / `find_frame` for training DataSources
+  (the PEventStore role; `find_frame` returns a columnar EventFrame instead
+  of an RDD), and
+- `find_by_entity` for serving-time lookups (the LEventStore role, with the
+  reference's timeout semantics as a deadline on iteration).
+`PEventStore` / `LEventStore` are thin aliases kept for parity.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Iterator, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import EventQuery, StorageError
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.data.store.columnar import EventFrame
+
+
+class EventStoreFacade:
+    def __init__(self, storage: Optional[Storage] = None):
+        self._storage = storage
+
+    @property
+    def storage(self) -> Storage:
+        return self._storage or Storage.get_instance()
+
+    # -- app name resolution (reference store/Common.scala:28) -------------
+    def app_name_to_id(
+        self, app_name: str, channel_name: Optional[str] = None
+    ) -> tuple[int, Optional[int]]:
+        app = self.storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            raise StorageError(f"Invalid app name {app_name!r}")
+        channel_id: Optional[int] = None
+        if channel_name is not None:
+            channels = self.storage.get_meta_data_channels().get_by_app_id(app.id)
+            match = [c for c in channels if c.name == channel_name]
+            if not match:
+                raise StorageError(
+                    f"Invalid channel name {channel_name!r} for app {app_name!r}"
+                )
+            channel_id = match[0].id
+        return app.id, channel_id
+
+    # -- training reads (PEventStore parity) -------------------------------
+    def find(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+    ) -> Iterator[Event]:
+        app_id, channel_id = self.app_name_to_id(app_name, channel_name)
+        return self.storage.get_events().find(
+            EventQuery(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+            )
+        )
+
+    def aggregate_properties(
+        self,
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ):
+        app_id, channel_id = self.app_name_to_id(app_name, channel_name)
+        return self.storage.get_events().aggregate_properties(
+            app_id,
+            entity_type,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+
+    def find_frame(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        value_prop: Optional[str] = None,
+        default_value: float = 1.0,
+    ) -> EventFrame:
+        """Columnar batch read — the TPU-native replacement for
+        PEventStore.find(...): RDD[Event]. Uses the backend's fast columnar
+        path when available."""
+        app_id, channel_id = self.app_name_to_id(app_name, channel_name)
+        store = self.storage.get_events()
+        query = EventQuery(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+        )
+        fast = getattr(store, "find_frame", None)
+        if fast is not None:
+            return fast(query, value_prop=value_prop, default_value=default_value)
+        return EventFrame.from_events(
+            store.find(query), value_prop=value_prop, default_value=default_value
+        )
+
+    # -- serving-time reads (LEventStore parity) ---------------------------
+    def find_by_entity(
+        self,
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+        timeout: float = 10.0,
+    ) -> Iterator[Event]:
+        """Reference LEventStore.findByEntity:58 (default newest-first).
+        `timeout` kept for API parity; reads here are local/synchronous."""
+        app_id, channel_id = self.app_name_to_id(app_name, channel_name)
+        return self.storage.get_events().find_single_entity(
+            app_id,
+            entity_type,
+            entity_id,
+            channel_id=channel_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            start_time=start_time,
+            until_time=until_time,
+            limit=limit,
+            reversed=latest,
+        )
+
+
+# Parity aliases: the reference exposes two objects; both map to the facade.
+PEventStore = EventStoreFacade
+LEventStore = EventStoreFacade

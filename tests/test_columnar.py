@@ -1,0 +1,183 @@
+"""BiMap + EventFrame columnar loader tests
+(reference analogues: BiMapSpec incl. RDD stringLong; the PEvents read path)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import EventQuery
+from predictionio_tpu.data.storage.sqlite import SqliteEventStore
+from predictionio_tpu.data.store.bimap import BiMap, EntityMap
+from predictionio_tpu.data.store.columnar import EventFrame
+
+UTC = dt.timezone.utc
+
+
+def T(i):
+    return dt.datetime(2024, 1, 1, tzinfo=UTC) + dt.timedelta(minutes=i)
+
+
+def rate(u, i, r, t=0):
+    return Event(
+        event="rate",
+        entity_type="user",
+        entity_id=u,
+        target_entity_type="item",
+        target_entity_id=i,
+        properties=DataMap({"rating": r}),
+        event_time=T(t),
+    )
+
+
+class TestBiMap:
+    def test_basic(self):
+        m = BiMap({"a": 1, "b": 2})
+        assert m("a") == 1
+        assert m.inverse()(2) == "b"
+        assert "a" in m and "z" not in m
+        assert m.get("z", -1) == -1
+
+    def test_duplicate_values_rejected(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_string_int(self):
+        m = BiMap.string_int(["x", "y", "x", "z"])
+        assert len(m) == 3
+        assert m("x") == 0 and m("y") == 1 and m("z") == 2
+
+    def test_map_array(self):
+        m = BiMap.string_int(["x", "y"])
+        out = m.map_array(["y", "x", "missing"])
+        np.testing.assert_array_equal(out, [1, 0, -1])
+
+    def test_take(self):
+        m = BiMap.string_int(["a", "b", "c"])
+        assert set(m.take(["a", "c", "zz"]).to_dict()) == {"a", "c"}
+
+    def test_entity_map(self):
+        em = EntityMap({"u1": {"x": 1}, "u2": {"x": 2}})
+        assert em["u1"] == {"x": 1}
+        assert em.entity_of(em.index_of("u2")) == "u2"
+        assert len(em) == 2
+
+
+class TestEventFrame:
+    def test_from_events(self):
+        frame = EventFrame.from_events(
+            [rate("u1", "i1", 4.0), rate("u2", "i1", 3.0, t=1), rate("u1", "i2", 5.0, t=2)],
+            value_prop="rating",
+        )
+        assert len(frame) == 3
+        assert frame.n_entities == 2
+        assert frame.n_targets == 2
+        np.testing.assert_allclose(frame.value, [4.0, 3.0, 5.0])
+        assert frame.entity_type == "user"
+        assert frame.target_entity_type == "item"
+
+    def test_missing_value_prop_default(self):
+        e = Event(
+            event="view", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1", event_time=T(0),
+        )
+        frame = EventFrame.from_events([e], value_prop="rating", default_value=1.5)
+        np.testing.assert_allclose(frame.value, [1.5])
+
+    def test_where_event_and_time(self):
+        events = [
+            rate("u1", "i1", 4.0, t=0),
+            Event(event="view", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i2", event_time=T(1)),
+        ]
+        frame = EventFrame.from_events(events)
+        assert len(frame.where_event("rate")) == 1
+        assert len(frame.where_event("nope")) == 0
+        assert len(frame.where_time(start=T(1))) == 1
+
+    def test_interactions_sum_dedupe(self):
+        frame = EventFrame.from_events(
+            [rate("u1", "i1", 2.0, t=0), rate("u1", "i1", 3.0, t=1), rate("u2", "i2", 1.0, t=2)],
+            value_prop="rating",
+        )
+        rows, cols, vals = frame.interactions(dedupe="sum")
+        got = {(int(r), int(c)): float(v) for r, c, v in zip(rows, cols, vals)}
+        u1, u2 = frame.entity_vocab("u1"), frame.entity_vocab("u2")
+        i1, i2 = frame.target_vocab("i1"), frame.target_vocab("i2")
+        assert got[(u1, i1)] == 5.0
+        assert got[(u2, i2)] == 1.0
+
+    def test_interactions_last_dedupe(self):
+        frame = EventFrame.from_events(
+            [rate("u1", "i1", 2.0, t=0), rate("u1", "i1", 3.0, t=5)],
+            value_prop="rating",
+        )
+        rows, cols, vals = frame.interactions(dedupe="last")
+        assert len(vals) == 1 and vals[0] == 3.0
+
+    def test_events_without_target_excluded(self):
+        events = [
+            rate("u1", "i1", 4.0),
+            Event(event="signup", entity_type="user", entity_id="u3", event_time=T(1)),
+        ]
+        rows, cols, vals = EventFrame.from_events(events).interactions()
+        assert len(rows) == 1
+
+    def test_counts_per_entity(self):
+        frame = EventFrame.from_events(
+            [rate("u1", "i1", 1), rate("u1", "i2", 1, t=1), rate("u2", "i1", 1, t=2)]
+        )
+        counts = frame.counts_per_entity()
+        assert counts[frame.entity_vocab("u1")] == 2
+        assert counts[frame.entity_vocab("u2")] == 1
+
+
+class TestSqliteColumnarPath:
+    def test_find_frame_matches_generic(self, tmp_path):
+        store = SqliteEventStore({"PATH": str(tmp_path / "ev.db")})
+        store.init_app(1)
+        events = [rate(f"u{i%7}", f"i{i%11}", float(i % 5 + 1), t=i) for i in range(100)]
+        store.insert_batch(events, 1)
+        q = EventQuery(app_id=1, event_names=["rate"])
+        fast = store.find_frame(q, value_prop="rating")
+        slow = EventFrame.from_events(store.find(q), value_prop="rating")
+        assert len(fast) == len(slow) == 100
+        np.testing.assert_allclose(np.sort(fast.value), np.sort(slow.value))
+        fr, fc, fv = fast.interactions()
+        sr, sc, sv = slow.interactions()
+        assert fv.sum() == pytest.approx(sv.sum())
+        assert fast.n_entities == 7 and fast.n_targets == 11
+
+    def test_find_frame_empty(self, tmp_path):
+        store = SqliteEventStore({"PATH": str(tmp_path / "ev.db")})
+        store.init_app(1)
+        frame = store.find_frame(EventQuery(app_id=1))
+        assert len(frame) == 0
+
+
+class TestFacade:
+    def test_find_and_frame_by_app_name(self, fresh_storage):
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.data.store.event_store import EventStoreFacade
+
+        apps = fresh_storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "testapp"))
+        store = fresh_storage.get_events()
+        store.init_app(app_id)
+        store.insert_batch([rate("u1", "i1", 4.0), rate("u2", "i2", 2.0, t=1)], app_id)
+
+        facade = EventStoreFacade(fresh_storage)
+        found = list(facade.find("testapp", event_names=["rate"]))
+        assert len(found) == 2
+        frame = facade.find_frame("testapp", event_names=["rate"], value_prop="rating")
+        assert len(frame) == 2
+        by_entity = list(facade.find_by_entity("testapp", "user", "u1"))
+        assert len(by_entity) == 1
+
+    def test_unknown_app(self, fresh_storage):
+        from predictionio_tpu.data.storage.base import StorageError
+        from predictionio_tpu.data.store.event_store import EventStoreFacade
+
+        with pytest.raises(StorageError):
+            EventStoreFacade(fresh_storage).find("nope")
