@@ -1,0 +1,4 @@
+"""TPU model kernels — the in-tree replacements for the MLlib algorithms the
+reference's engine templates delegate to (SURVEY.md §2.9/§2.11): ALS
+(implicit + explicit), classification (Naive Bayes / logistic regression),
+item-similarity, cross-occurrence (CCO), Markov chain."""

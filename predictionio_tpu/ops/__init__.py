@@ -1,0 +1,6 @@
+"""Low-level XLA/Pallas ops shared by model kernels."""
+
+from predictionio_tpu.ops.segment import edge_matvec, segment_sum, weighted_edge_sum
+from predictionio_tpu.ops.topk import masked_top_k
+
+__all__ = ["edge_matvec", "segment_sum", "weighted_edge_sum", "masked_top_k"]

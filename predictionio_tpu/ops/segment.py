@@ -1,0 +1,111 @@
+"""Segment reductions over interaction edge lists.
+
+These are the workhorse ops of the sparse-factorization kernels (ALS, CCO):
+training data is a COO edge list (src_idx, dst_idx, weight) and every
+normal-equation product reduces per-edge contributions into per-row sums.
+On TPU these lower to gathers + sorted segment scatter-adds that XLA fuses
+with the surrounding elementwise work; the factor-matrix contractions stay
+dense for the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    indices_are_sorted: bool = False,
+) -> jax.Array:
+    """Thin wrapper over jax.ops.segment_sum (kept as the single call site so
+    a Pallas implementation can swap in without touching model code)."""
+    return jax.ops.segment_sum(
+        data,
+        segment_ids,
+        num_segments=num_segments,
+        indices_are_sorted=indices_are_sorted,
+    )
+
+
+def weighted_edge_sum(
+    factors: jax.Array,  # (N_src, K)
+    src_idx: jax.Array,  # (E,) int — rows of `factors` per edge
+    dst_idx: jax.Array,  # (E,) int — output row per edge
+    weights: jax.Array,  # (E,)
+    num_dst: int,
+    indices_are_sorted: bool = False,
+) -> jax.Array:
+    """out[d] = Σ_{edges e with dst_idx[e]==d} weights[e] * factors[src_idx[e]].
+
+    The right-hand-side builder of the ALS normal equations: b_u = Σ c_ui y_i.
+    """
+    gathered = factors[src_idx] * weights[:, None]
+    return segment_sum(gathered, dst_idx, num_dst, indices_are_sorted)
+
+
+def edge_matvec(
+    factors: jax.Array,  # (N_src, K) — the fixed side's factors (e.g. Y)
+    v: jax.Array,  # (N_dst, K) — the vector being multiplied (per dst row)
+    src_idx: jax.Array,  # (E,)
+    dst_idx: jax.Array,  # (E,)
+    weights: jax.Array,  # (E,) — per-edge scalar weight
+    num_dst: int,
+    indices_are_sorted: bool = False,
+) -> jax.Array:
+    """out[d] = Σ_e w_e * y_{src_e} (y_{src_e} · v_d)   for edges of d.
+
+    The matrix-free normal-equation matvec: applies the per-row Gram
+    correction Σ w y yᵀ without materializing any k×k matrices — per edge
+    only a scalar inner product and a scaled gather, then a segment reduce.
+    This keeps memory O(E·K) and lets CG solve all rows' systems batched.
+    """
+    y_e = factors[src_idx]  # (E, K)
+    s = jnp.sum(y_e * v[dst_idx], axis=-1)  # (E,)
+    return segment_sum(y_e * (weights * s)[:, None], dst_idx, num_dst, indices_are_sorted)
+
+
+def f32_gram(a: jax.Array) -> jax.Array:
+    """aᵀa at full float32 precision — CG needs exact Gram matrices; the
+    TPU default (bf16 MXU passes) loses enough precision to stall
+    convergence on ill-conditioned normal equations."""
+    return jax.lax.dot_general(
+        a, a,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def batched_cg(
+    matvec,
+    b: jax.Array,
+    x0: jax.Array,
+    iterations: int,
+    eps: float = 1e-12,
+) -> jax.Array:
+    """Batched conjugate gradient: solves A_i x_i = b_i for every row i with
+    a shared matvec that applies all A_i at once. Fixed iteration count —
+    compiler-friendly (no data-dependent control flow under jit). Rows whose
+    residual has reached float32 noise are frozen via `where` (iterating CG
+    past convergence amplifies rounding error instead of reducing it)."""
+    r0 = b - matvec(x0)
+    rs0 = jnp.sum(r0 * r0, axis=-1)
+    tol = jnp.maximum(rs0, 1.0) * 1e-12  # relative f32 floor
+
+    def body(_, state):
+        x, r, p, rs = state
+        live = rs > tol
+        ap = matvec(p)
+        alpha = jnp.where(live, rs / (jnp.sum(p * ap, axis=-1) + eps), 0.0)
+        x = x + alpha[:, None] * p
+        r = r - alpha[:, None] * ap
+        rs_new = jnp.where(live, jnp.sum(r * r, axis=-1), rs)
+        beta = jnp.where(live, rs_new / (rs + eps), 0.0)
+        p = jnp.where(live[:, None], r + beta[:, None] * p, p)
+        return x, r, p, rs_new
+
+    state = (x0, r0, r0, rs0)
+    x, *_ = jax.lax.fori_loop(0, iterations, body, state)
+    return x

@@ -1,0 +1,28 @@
+"""Top-k scoring with exclusion masks — the serving-side ranking op.
+
+Replaces the reference templates' host-side `.top(num)(Ordering)` over
+score arrays (e.g. examples/.../ALSAlgorithm.scala predict top-N) with a
+device `lax.top_k` over masked score vectors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def masked_top_k(
+    scores: jax.Array,  # (..., N)
+    k: int,
+    exclude_mask: Optional[jax.Array] = None,  # (..., N) bool — True = exclude
+) -> tuple[jax.Array, jax.Array]:
+    """Return (values, indices) of the top-k scores, with excluded positions
+    pushed to -inf (they can still appear if fewer than k valid entries —
+    callers filter on value > NEG_INF/2)."""
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask, NEG_INF, scores)
+    return jax.lax.top_k(scores, k)

@@ -4,12 +4,29 @@ paths are exercised without TPU hardware (reference analogue: Spark
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# If a TPU PJRT plugin was registered at interpreter start (sitecustomize),
+# drop its factory so lazy backend init can never dial TPU hardware from a
+# unit test — tests must be hermetic CPU-only.
+try:  # pragma: no cover - depends on host environment
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+    # sitecustomize may have imported jax before this file ran, freezing
+    # jax_platforms at the env value; force it back to cpu.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,225 @@
+"""ALS kernel tests: solver correctness (vs direct normal-equation solves),
+reconstruction quality, persistence, top-k serving, and sharded training on
+the virtual 8-device mesh."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.store.bimap import BiMap
+from predictionio_tpu.models import als
+
+
+def make_synthetic(n_users=60, n_items=40, rank=5, density=0.3, seed=0, implicit=False):
+    rng = np.random.default_rng(seed)
+    true_u = rng.normal(size=(n_users, rank)).astype(np.float32)
+    true_i = rng.normal(size=(n_items, rank)).astype(np.float32)
+    mask = rng.random((n_users, n_items)) < density
+    rows, cols = np.nonzero(mask)
+    scores = np.sum(true_u[rows] * true_i[cols], axis=-1)
+    if implicit:
+        vals = (scores > 0).astype(np.float32) * 2.0
+        keep = vals > 0
+        rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    else:
+        vals = scores + rng.normal(scale=0.01, size=scores.shape).astype(np.float32)
+    return rows.astype(np.int32), cols.astype(np.int32), vals.astype(np.float32)
+
+
+class TestExplicitALS:
+    def test_reconstruction(self):
+        rows, cols, vals = make_synthetic()
+        params = als.ALSParams(
+            rank=5, iterations=30, lambda_=0.01, implicit_prefs=False, cg_iterations=6
+        )
+        model = als.train(rows, cols, vals, 60, 40, params)
+        pred = als.score_pairs(model, rows, cols)
+        rmse = float(np.sqrt(np.mean((pred - vals) ** 2)))
+        # data std is ~2.2; ALS convergence speed on this random-Gaussian
+        # problem is init-dependent (exact-solve numpy ALS lands between
+        # 0.1 and 0.4 after 30 sweeps depending on seed) — assert the fit
+        # is far below the mean-predictor baseline
+        baseline = float(np.std(vals))
+        assert rmse < 0.25 * baseline, f"RMSE {rmse} vs baseline {baseline}"
+
+    def test_reconstruction_easy(self):
+        # low-rank, dense sampling: must fit to near the noise floor
+        rows, cols, vals = make_synthetic(
+            n_users=30, n_items=20, rank=2, density=0.7, seed=3
+        )
+        params = als.ALSParams(
+            rank=2, iterations=20, lambda_=0.005, implicit_prefs=False, cg_iterations=4
+        )
+        model = als.train(rows, cols, vals, 30, 20, params)
+        pred = als.score_pairs(model, rows, cols)
+        rmse = float(np.sqrt(np.mean((pred - vals) ** 2)))
+        assert rmse < 0.05, f"RMSE too high: {rmse}"
+
+    def test_half_step_matches_direct_solve(self):
+        """One explicit half-step must equal the closed-form per-user solve
+        (Yᵀ_obs Y_obs + λ n_u I)⁻¹ Yᵀ_obs r."""
+        rng = np.random.default_rng(1)
+        n_users, n_items, k = 8, 12, 4
+        rows = np.repeat(np.arange(n_users), 3).astype(np.int32)
+        cols = rng.integers(0, n_items, len(rows)).astype(np.int32)
+        vals = rng.normal(size=len(rows)).astype(np.float32)
+        Y = rng.normal(size=(n_items, k)).astype(np.float32)
+        lam = 0.1
+
+        import jax.numpy as jnp
+        from predictionio_tpu.models.als import _half_step_explicit
+
+        order = np.argsort(rows, kind="stable")
+        deg = np.bincount(rows, minlength=n_users).astype(np.float32)
+        got = np.asarray(
+            _half_step_explicit(
+                jnp.asarray(Y),
+                jnp.asarray(cols[order]),
+                jnp.asarray(rows[order]),
+                jnp.asarray(vals[order]),
+                jnp.ones(len(rows), jnp.float32),
+                jnp.asarray(deg),
+                jnp.zeros((n_users, k), jnp.float32),
+                lam,
+                cg_iterations=30,
+            )
+        )
+        for u in range(n_users):
+            sel = rows == u
+            Yu, ru = Y[cols[sel]], vals[sel]
+            A = Yu.T @ Yu + lam * max(sel.sum(), 1) * np.eye(k, dtype=np.float32)
+            expect = np.linalg.solve(A, Yu.T @ ru)
+            np.testing.assert_allclose(got[u], expect, rtol=1e-3, atol=1e-4)
+
+
+class TestImplicitALS:
+    def test_half_step_matches_direct_solve(self):
+        """One implicit half-step must equal (YᵀY + Yᵀ(Cu−I)Y + λI)⁻¹ YᵀCu·1."""
+        rng = np.random.default_rng(2)
+        n_users, n_items, k = 6, 10, 3
+        rows = np.repeat(np.arange(n_users), 4).astype(np.int32)
+        cols = rng.integers(0, n_items, len(rows)).astype(np.int32)
+        # dedupe pairs to keep the direct solve simple
+        keep = np.unique(rows.astype(np.int64) * n_items + cols, return_index=True)[1]
+        rows, cols = rows[keep], cols[keep]
+        conf = (1.0 + 2.0 * rng.random(len(rows))).astype(np.float32)
+        Y = rng.normal(size=(n_items, k)).astype(np.float32)
+        lam = 0.05
+
+        import jax.numpy as jnp
+        from predictionio_tpu.models.als import _half_step_implicit
+
+        order = np.argsort(rows, kind="stable")
+        got = np.asarray(
+            _half_step_implicit(
+                jnp.asarray(Y),
+                jnp.asarray(cols[order]),
+                jnp.asarray(rows[order]),
+                jnp.asarray(conf[order]),
+                jnp.ones(len(rows), jnp.float32),
+                jnp.zeros((n_users, k), jnp.float32),
+                lam,
+                cg_iterations=30,
+            )
+        )
+        G = Y.T @ Y
+        for u in range(n_users):
+            sel = rows == u
+            Yu, cu = Y[cols[sel]], conf[sel]
+            A = G + Yu.T @ ((cu - 1.0)[:, None] * Yu) + lam * np.eye(k, dtype=np.float32)
+            b = Yu.T @ cu
+            expect = np.linalg.solve(A, b)
+            np.testing.assert_allclose(got[u], expect, rtol=1e-3, atol=1e-4)
+
+    def test_implicit_ranking_quality(self):
+        rows, cols, vals = make_synthetic(implicit=True, density=0.4)
+        params = als.ALSParams(rank=8, iterations=10, lambda_=0.01, alpha=2.0)
+        model = als.train(rows, cols, vals, 60, 40, params)
+        # observed items should outscore unobserved on average
+        obs = als.score_pairs(model, rows, cols).mean()
+        rng = np.random.default_rng(5)
+        rnd_r = rng.integers(0, 60, 500)
+        rnd_c = rng.integers(0, 40, 500)
+        seen = set(zip(rows.tolist(), cols.tolist()))
+        unseen = [(r, c) for r, c in zip(rnd_r, rnd_c) if (r, c) not in seen]
+        un_r = np.array([r for r, _ in unseen])
+        un_c = np.array([c for _, c in unseen])
+        uns = als.score_pairs(model, un_r, un_c).mean()
+        assert obs > uns + 0.2, f"observed {obs} vs unseen {uns}"
+
+
+class TestServing:
+    def _model(self):
+        rows, cols, vals = make_synthetic(implicit=True, density=0.4)
+        model = als.train(
+            rows, cols, vals, 60, 40,
+            als.ALSParams(rank=8, iterations=8),
+            user_vocab=BiMap.string_int([f"u{i}" for i in range(60)]),
+            item_vocab=BiMap.string_int([f"i{i}" for i in range(40)]),
+        )
+        return model, rows, cols
+
+    def test_recommend_shapes_and_exclusion(self):
+        model, rows, cols = self._model()
+        vals_, idx = als.recommend(model, np.array([0, 1, 2]), 5)
+        assert vals_.shape == (3, 5) and idx.shape == (3, 5)
+        # exclusion: ban user 0's top item and verify it no longer appears
+        banned = int(idx[0, 0])
+        mask = np.zeros((3, 40), dtype=bool)
+        mask[0, banned] = True
+        _, idx2 = als.recommend(model, np.array([0, 1, 2]), 5, exclude_mask=mask)
+        assert banned not in idx2[0]
+
+    def test_similar_items_excludes_self(self):
+        model, *_ = self._model()
+        vals_, idx = als.similar_items(model, np.array([3, 4]), 5)
+        assert 3 not in idx[0] and 4 not in idx[1]
+        assert np.all(vals_ <= 1.0 + 1e-5)
+
+    def test_persistence_roundtrip(self):
+        model, *_ = self._model()
+        blob = model.to_bytes()
+        loaded = als.ALSFactors.from_bytes(blob)
+        np.testing.assert_array_equal(loaded.user_factors, model.user_factors)
+        np.testing.assert_array_equal(loaded.item_factors, model.item_factors)
+        assert loaded.user_vocab("u7") == model.user_vocab("u7")
+        assert loaded.params == model.params
+
+
+class TestShardedALS:
+    def test_mesh_train_matches_single_device(self, mesh8):
+        rows, cols, vals = make_synthetic(density=0.35)
+        params = als.ALSParams(
+            rank=4, iterations=5, implicit_prefs=False, cg_iterations=5
+        )
+        single = als.train(rows, cols, vals, 60, 40, params)
+        sharded = als.train(rows, cols, vals, 60, 40, params, mesh=mesh8)
+        # reduction order differs across shards, so factors drift over
+        # sweeps — assert both runs fit the data equally well
+        rmse_single = np.sqrt(
+            np.mean((als.score_pairs(single, rows, cols) - vals) ** 2)
+        )
+        rmse_sharded = np.sqrt(
+            np.mean((als.score_pairs(sharded, rows, cols) - vals) ** 2)
+        )
+        assert abs(rmse_single - rmse_sharded) < 0.05 * max(rmse_single, 1e-3)
+
+    def test_mesh_single_sweep_exact(self, mesh8):
+        # one sweep: sharded result differs only by reduction order
+        rows, cols, vals = make_synthetic(density=0.35)
+        params = als.ALSParams(
+            rank=4, iterations=1, implicit_prefs=False, cg_iterations=5
+        )
+        single = als.train(rows, cols, vals, 60, 40, params)
+        sharded = als.train(rows, cols, vals, 60, 40, params, mesh=mesh8)
+        np.testing.assert_allclose(
+            single.user_factors, sharded.user_factors, rtol=1e-3, atol=1e-4
+        )
+
+    def test_mesh_train_with_padding(self, mesh8):
+        # edge count not divisible by device count exercises the pad path
+        rows, cols, vals = make_synthetic(density=0.3, seed=7)
+        n = (len(rows) // 8) * 8 + 3
+        rows, cols, vals = rows[:n], cols[:n], vals[:n]
+        params = als.ALSParams(rank=4, iterations=3, implicit_prefs=False)
+        model = als.train(rows, cols, vals, 60, 40, params, mesh=mesh8)
+        assert np.all(np.isfinite(model.user_factors))
