@@ -1,0 +1,104 @@
+"""Headline benchmark: implicit-ALS training throughput (events/sec/chip).
+
+Workload mirrors the reference's north-star config (BASELINE.json): the
+scala-parallel-recommendation template's MLlib ALS at its MovieLens
+quickstart hyperparameters (rank 10, 20 iterations, lambda 0.01 —
+examples/scala-parallel-recommendation/*/engine.json) on a MovieLens-100K
+shaped interaction set (100k events, 943 users, 1682 items).
+
+The reference publishes no numbers (BASELINE.md), so `vs_baseline` is
+measured live against a plain-numpy per-row Cholesky ALS — the honest
+stand-in for the reference's single-process `local`-mode Spark run — on the
+same data, extrapolated from 2 iterations.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_EVENTS = 100_000
+N_USERS = 943
+N_ITEMS = 1682
+RANK = 10
+ITERATIONS = 20
+LAMBDA = 0.01
+ALPHA = 1.0
+
+
+def make_data(seed: int = 0):
+    rng = np.random.RandomState(seed)
+    # zipf-ish popularity so degree distribution resembles MovieLens
+    user_p = rng.dirichlet(np.full(N_USERS, 0.3))
+    item_p = rng.dirichlet(np.full(N_ITEMS, 0.3))
+    rows = rng.choice(N_USERS, N_EVENTS, p=user_p).astype(np.int32)
+    cols = rng.choice(N_ITEMS, N_EVENTS, p=item_p).astype(np.int32)
+    vals = rng.randint(1, 6, N_EVENTS).astype(np.float32)
+    return rows, cols, vals
+
+
+def bench_tpu(rows, cols, vals) -> float:
+    """events/sec for the full 20-iteration jitted train (post-compile)."""
+    from predictionio_tpu.models import als
+
+    params = als.ALSParams(
+        rank=RANK, iterations=ITERATIONS, lambda_=LAMBDA, alpha=ALPHA,
+        implicit_prefs=True,
+    )
+    als.train(rows, cols, vals, N_USERS, N_ITEMS, params)  # compile + warmup
+    t0 = time.perf_counter()
+    als.train(rows, cols, vals, N_USERS, N_ITEMS, params)
+    dt = time.perf_counter() - t0
+    return N_EVENTS * ITERATIONS / dt
+
+
+def bench_numpy_baseline(rows, cols, vals, sample_iters: int = 2) -> float:
+    """Reference-style single-process CPU ALS: per-row k×k normal equations
+    solved one row at a time (the shape of MLlib's local-mode compute),
+    timed over `sample_iters` alternating iterations."""
+    rng = np.random.RandomState(3)
+    uf = rng.standard_normal((N_USERS, RANK)).astype(np.float32) / np.sqrt(RANK)
+    itf = rng.standard_normal((N_ITEMS, RANK)).astype(np.float32) / np.sqrt(RANK)
+    conf = 1.0 + ALPHA * vals
+
+    def half_step(fixed, src, dst, c, n_dst):
+        gram = fixed.T @ fixed + LAMBDA * np.eye(RANK, dtype=np.float32)
+        out = np.empty((n_dst, RANK), dtype=np.float32)
+        order = np.argsort(dst, kind="stable")
+        ds, ss, cs = dst[order], src[order], c[order]
+        bounds = np.searchsorted(ds, np.arange(n_dst + 1))
+        for d in range(n_dst):
+            lo, hi = bounds[d], bounds[d + 1]
+            y = fixed[ss[lo:hi]]
+            cw = cs[lo:hi]
+            a = gram + y.T @ ((cw - 1.0)[:, None] * y)
+            b = y.T @ cw
+            out[d] = np.linalg.solve(a, b)
+        return out
+
+    t0 = time.perf_counter()
+    for _ in range(sample_iters):
+        uf = half_step(itf, cols, rows, conf, N_USERS)
+        itf = half_step(uf, rows, cols, conf, N_ITEMS)
+    dt = time.perf_counter() - t0
+    return N_EVENTS * sample_iters / dt
+
+
+def main():
+    rows, cols, vals = make_data()
+    value = bench_tpu(rows, cols, vals)
+    baseline = bench_numpy_baseline(rows, cols, vals)
+    print(json.dumps({
+        "metric": "als_implicit_train_throughput",
+        "value": round(value, 1),
+        "unit": "events/sec/chip",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

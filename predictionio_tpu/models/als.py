@@ -16,8 +16,9 @@ TPU-first design (NOT a port of MLlib's block-partitioned shuffle ALS):
   `lax.fori_loop`; edges are pre-sorted per side on the host so segment
   reductions take the sorted fast path.
 - Multi-chip: edges are sharded over the mesh's data axis; factor matrices
-  are replicated. GSPMD turns the segment-sum scatters into local partial
-  sums + an ICI all-reduce — the TPU-native analogue of MLlib's shuffle
+  are row-sharded over the model axis (replicated when mp == 1). GSPMD
+  turns the segment-sum scatters into local partial sums + ICI
+  all-reduce/all-gather — the TPU-native analogue of MLlib's shuffle
   (see parallel/mesh.py for mesh construction).
 
 Implicit objective (Hu-Koren-Volinsky): confidence c = 1 + alpha·r,
@@ -163,6 +164,7 @@ def _half_step_explicit(
     jax.jit,
     static_argnames=(
         "n_users", "n_items", "rank", "iterations", "implicit", "cg_iterations",
+        "mesh",
     ),
 )
 def _train_jit(
@@ -186,12 +188,34 @@ def _train_jit(
     alpha: float,
     cg_iterations: int,
     seed: int,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ):
+    if mesh is not None:
+        from predictionio_tpu.parallel.mesh import MODEL_AXIS, factor_sharding, replicated
+
+        sh = (
+            factor_sharding(mesh)
+            if mesh.shape.get(MODEL_AXIS, 1) > 1
+            else replicated(mesh)
+        )
+
+        def shard_factors(f):
+            return jax.lax.with_sharding_constraint(f, sh)
+
+    else:
+
+        def shard_factors(f):
+            return f
+
     ku, ki = jax.random.split(jax.random.PRNGKey(seed))
     # signed gaussian init scaled by 1/sqrt(rank); an all-positive init
     # (as some ALS impls use) starts near rank-1 and converges far slower
-    uf = jax.random.normal(ku, (n_users, rank), jnp.float32) / jnp.sqrt(rank)
-    itf = jax.random.normal(ki, (n_items, rank), jnp.float32) / jnp.sqrt(rank)
+    uf = shard_factors(
+        jax.random.normal(ku, (n_users, rank), jnp.float32) / jnp.sqrt(rank)
+    )
+    itf = shard_factors(
+        jax.random.normal(ki, (n_items, rank), jnp.float32) / jnp.sqrt(rank)
+    )
 
     if implicit:
         u_w = 1.0 + alpha * u_val
@@ -199,24 +223,24 @@ def _train_jit(
 
         def body(_, fs):
             uf, itf = fs
-            uf = _half_step_implicit(
+            uf = shard_factors(_half_step_implicit(
                 itf, u_src, u_dst, u_w, u_ok, uf, lam, cg_iterations
-            )
-            itf = _half_step_implicit(
+            ))
+            itf = shard_factors(_half_step_implicit(
                 uf, i_src, i_dst, i_w, i_ok, itf, lam, cg_iterations
-            )
+            ))
             return uf, itf
 
     else:
 
         def body(_, fs):
             uf, itf = fs
-            uf = _half_step_explicit(
+            uf = shard_factors(_half_step_explicit(
                 itf, u_src, u_dst, u_val, u_ok, user_deg, uf, lam, cg_iterations
-            )
-            itf = _half_step_explicit(
+            ))
+            itf = shard_factors(_half_step_explicit(
                 uf, i_src, i_dst, i_val, i_ok, item_deg, itf, lam, cg_iterations
-            )
+            ))
             return uf, itf
 
     uf, itf = jax.lax.fori_loop(0, iterations, body, (uf, itf))
@@ -236,9 +260,10 @@ def train(
 ) -> ALSFactors:
     """Train factors from a COO interaction list.
 
-    When `mesh` is given, edge arrays are sharded over its first axis and
-    GSPMD inserts the ICI all-reduces for the segment sums; factors stay
-    replicated (they are small relative to edges).
+    When `mesh` is given, edge arrays are sharded over its first (data)
+    axis and GSPMD inserts the ICI all-reduces for the segment sums;
+    factor matrices are row-sharded over the model axis when it has more
+    than one device, else replicated.
     """
     rows = np.asarray(rows, dtype=np.int32)
     cols = np.asarray(cols, dtype=np.int32)
@@ -277,14 +302,14 @@ def train(
         seed=params.seed,
     )
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from predictionio_tpu.parallel.mesh import edge_sharding, replicated
 
-        edge_sh = NamedSharding(mesh, P(mesh.axis_names[0]))
-        rep_sh = NamedSharding(mesh, P())
+        edge_sh = edge_sharding(mesh)
+        rep_sh = replicated(mesh)
         device_args = [
             jax.device_put(a, edge_sh) for a in args[:8]
         ] + [jax.device_put(a, rep_sh) for a in args[8:]]
-        uf, itf = _train_jit(*device_args, **kwargs)
+        uf, itf = _train_jit(*device_args, mesh=mesh, **kwargs)
     else:
         uf, itf = _train_jit(*args, **kwargs)
     uf, itf = np.asarray(uf), np.asarray(itf)

@@ -1,0 +1,27 @@
+"""Mesh construction + sharding utilities (TPU-native distribution layer).
+
+Replaces the reference's reliance on Spark's executor topology (external
+spark-core dependency, build.sbt:39; RDD partitioning in HBPEvents.scala:84-90
+and PEventAggregator.scala:192-207) with explicit `jax.sharding.Mesh` axes and
+GSPMD-inserted ICI collectives.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshConf,
+    edge_sharding,
+    factor_sharding,
+    make_mesh,
+    replicated,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "MeshConf",
+    "edge_sharding",
+    "factor_sharding",
+    "make_mesh",
+    "replicated",
+]

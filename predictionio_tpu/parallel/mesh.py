@@ -1,0 +1,103 @@
+"""Device mesh construction and canonical shardings.
+
+The framework's parallelism model (SURVEY.md §2.12 mapping):
+- **dp** (data axis): interaction edge lists, event batches, eval query
+  batches are sharded here. Segment-sums over sharded edges become local
+  partial reductions + an ICI all-reduce (GSPMD) — the TPU-native analogue
+  of Spark's `aggregateByKey` shuffle (reference PEventAggregator.scala:192).
+- **mp** (model axis): large factor/embedding matrices are row-sharded here
+  (the analogue of the reference's RDD-backed PAlgorithm models, e.g. ALS
+  user/product factor RDDs, PAlgorithm.scala:73-90).
+
+Engines declare how much of each axis they want via `MeshConf` (the
+engine.json `mesh` key — the re-design of the reference's `sparkConf`
+pass-through, WorkflowUtils.extractSparkConf:316).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "mp"
+
+
+@dataclass(frozen=True)
+class MeshConf:
+    """Mesh wiring parsed from an engine variant's `mesh` JSON object.
+
+    `dp`/`mp` of -1 mean "fill with whatever devices remain" (at most one
+    axis may be -1). `devices` of 0 means all visible devices.
+    """
+
+    dp: int = -1
+    mp: int = 1
+    devices: int = 0
+
+    @staticmethod
+    def from_json(obj: Optional[dict]) -> "MeshConf":
+        obj = obj or {}
+        return MeshConf(
+            dp=int(obj.get("dp", -1)),
+            mp=int(obj.get("mp", 1)),
+            devices=int(obj.get("devices", 0)),
+        )
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        devs = list(devices if devices is not None else jax.devices())
+        n = self.devices or len(devs)
+        if n > len(devs):
+            raise ValueError(
+                f"mesh config requests {n} devices but only {len(devs)} visible"
+            )
+        devs = devs[:n]
+        dp, mp = self.dp, self.mp
+        if dp == -1 and mp == -1:
+            raise ValueError("at most one mesh axis may be -1")
+        if dp == -1:
+            dp = n // mp
+        if mp == -1:
+            mp = n // dp
+        if dp * mp != n:
+            raise ValueError(f"mesh {dp}x{mp} does not cover {n} devices")
+        return Mesh(np.array(devs).reshape(dp, mp), (DATA_AXIS, MODEL_AXIS))
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    mp: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Default mesh: a (dp, mp) grid over the first `n_devices` devices.
+
+    `mp` defaults to 2 when the device count is even (so model-axis sharding
+    paths are exercised), else 1. On a single chip this degenerates to a
+    1x1 mesh, and every sharded program is trivially valid.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices but only {len(devs)} visible")
+    if mp is None:
+        mp = 2 if n % 2 == 0 and n > 1 else 1
+    return MeshConf(dp=-1, mp=mp).build(devs[:n])
+
+
+def edge_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-edge/per-example arrays: split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def factor_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (N, K) parameter matrices: rows split over the model
+    axis, feature dim replicated."""
+    return NamedSharding(mesh, P(MODEL_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
