@@ -1,0 +1,75 @@
+"""L3 — user-facing DASE SDK (reference core/src/main/scala/io/prediction/controller/)."""
+
+from predictionio_tpu.controller.dase import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    Preparator,
+    Serving,
+)
+from predictionio_tpu.controller.engine import (
+    Engine,
+    EngineFactory,
+    EngineParams,
+    SimpleEngine,
+    resolve_engine,
+)
+from predictionio_tpu.controller.params import (
+    EmptyParams,
+    ParamsError,
+    extract_params,
+    load_symbol,
+    params_class_of,
+    params_to_json,
+)
+from predictionio_tpu.controller.persistent import (
+    LocalFileSystemPersistentModel,
+    PersistentModel,
+    RetrainOnDeploy,
+    deserialize_models,
+    load_persistent_model,
+    serialize_models,
+)
+from predictionio_tpu.core.base import (
+    PersistentModelManifest,
+    RuntimeContext,
+    SanityCheck,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+)
+
+__all__ = [
+    "Algorithm",
+    "AverageServing",
+    "DataSource",
+    "EmptyParams",
+    "Engine",
+    "EngineFactory",
+    "EngineParams",
+    "FirstServing",
+    "IdentityPreparator",
+    "LocalFileSystemPersistentModel",
+    "ParamsError",
+    "Preparator",
+    "PersistentModel",
+    "PersistentModelManifest",
+    "RetrainOnDeploy",
+    "RuntimeContext",
+    "SanityCheck",
+    "Serving",
+    "SimpleEngine",
+    "StopAfterPrepareInterruption",
+    "StopAfterReadInterruption",
+    "WorkflowParams",
+    "deserialize_models",
+    "extract_params",
+    "load_persistent_model",
+    "load_symbol",
+    "params_class_of",
+    "params_to_json",
+    "resolve_engine",
+    "serialize_models",
+]

@@ -1,0 +1,71 @@
+"""User-facing DASE base classes + stock servings/preparators.
+
+Reference L3 (core/src/main/scala/io/prediction/controller/): PDataSource/
+LDataSource (PDataSource.scala:35, LDataSource.scala:35), PPreparator/
+LPreparator/IdentityPreparator (IdentityPreparator.scala:31), PAlgorithm/
+P2LAlgorithm/LAlgorithm (PAlgorithm.scala:44, P2LAlgorithm.scala:43,
+LAlgorithm.scala:42), LServing/LFirstServing/LAverageServing
+(LServing.scala:27, LFirstServing.scala:25, LAverageServing.scala:25).
+
+The P/L split collapses here (see core/base.py docstring); one class per
+stage. Templates subclass these four.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from predictionio_tpu.core.base import (
+    A,
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    EI,
+    M,
+    P,
+    PD,
+    Q,
+    RuntimeContext,
+    TD,
+)
+
+
+class DataSource(BaseDataSource[TD, EI, Q, A]):
+    """Subclass and implement `read_training` (+ `read_eval` for tuning)."""
+
+
+class Preparator(BasePreparator[TD, PD]):
+    """Subclass and implement `prepare`."""
+
+
+class IdentityPreparator(BasePreparator[TD, TD]):
+    """Pass-through TD→PD (reference IdentityPreparator.scala:31)."""
+
+    def prepare(self, ctx: RuntimeContext, td: TD) -> TD:
+        return td
+
+
+class Algorithm(BaseAlgorithm[PD, M, Q, P]):
+    """Subclass and implement `train` + `predict` (and override
+    `batch_predict` with a device-batched version where eval throughput
+    matters)."""
+
+
+class Serving(BaseServing[Q, P]):
+    """Subclass and implement `serve`; override `supplement` to enrich
+    queries before prediction (reference LServing.scala:27)."""
+
+
+class FirstServing(Serving[Q, P]):
+    """Serve the first algorithm's prediction (reference LFirstServing.scala:25)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(Serving[Q, float]):
+    """Average of numeric predictions (reference LAverageServing.scala:25)."""
+
+    def serve(self, query: Q, predictions: Sequence[float]) -> float:
+        return sum(predictions) / len(predictions)

@@ -1,0 +1,114 @@
+"""Typed params + engine-factory resolution.
+
+The re-design of the reference's JVM-reflection ergonomics (SURVEY.md §7
+"hard parts"): engine.json names classes as import-path strings and carries
+per-stage params objects; here params are dataclasses validated on
+extraction (replacing the json4s/Gson dual stack of
+workflow/JsonExtractor.scala:34-164 and WorkflowUtils.extractParams:132),
+and classes resolve via `load_symbol` (replacing WorkflowUtils.getEngine:62
+class-vs-object reflection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import inspect
+import json
+import typing
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams:
+    """Reference controller package object `EmptyParams` (package.scala:105)."""
+
+
+class ParamsError(ValueError):
+    pass
+
+
+def load_symbol(path: str) -> Any:
+    """Resolve "pkg.module.Symbol" (or "pkg.module:Symbol") to the object."""
+    if ":" in path:
+        mod_name, _, sym = path.partition(":")
+    else:
+        mod_name, _, sym = path.rpartition(".")
+    if not mod_name:
+        raise ParamsError(f"not an importable path: {path!r}")
+    try:
+        mod = importlib.import_module(mod_name)
+    except ImportError as e:
+        raise ParamsError(f"cannot import module {mod_name!r} for {path!r}: {e}")
+    try:
+        return getattr(mod, sym)
+    except AttributeError:
+        raise ParamsError(f"module {mod_name!r} has no symbol {sym!r}")
+
+
+def params_class_of(cls: type) -> Optional[type]:
+    """The Params dataclass a controller class's constructor expects, from
+    the first non-self parameter's annotation (the Python analogue of
+    Doer's constructor-signature reflection, AbstractDoer.scala:32)."""
+    try:
+        hints = typing.get_type_hints(cls.__init__)
+        sig = inspect.signature(cls.__init__)
+    except (TypeError, ValueError, NameError):
+        return None
+    for name, p in sig.parameters.items():
+        if name == "self":
+            continue
+        if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY):
+            ann = hints.get(name, p.annotation)
+            if isinstance(ann, type) and dataclasses.is_dataclass(ann):
+                return ann
+        break
+    return None
+
+
+def extract_params(cls: Optional[type], obj: Any) -> Any:
+    """Build a params dataclass from a JSON object, strictly: unknown keys
+    are errors (the reference validates params JSON against the class via
+    Gson/json4s — WorkflowUtils.extractParams:132 'must be valid to your
+    Params class'), missing keys fall back to dataclass defaults.
+    """
+    if cls is None or cls is EmptyParams:
+        if obj not in (None, {}, []):
+            raise ParamsError(f"params given but no params class declared: {obj!r}")
+        return EmptyParams()
+    if obj is None:
+        obj = {}
+    if not isinstance(obj, dict):
+        raise ParamsError(f"params for {cls.__name__} must be an object, got {obj!r}")
+    if not dataclasses.is_dataclass(cls):
+        raise ParamsError(f"params class {cls.__name__} must be a dataclass")
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(obj) - names
+    if unknown:
+        raise ParamsError(
+            f"unknown params for {cls.__name__}: {sorted(unknown)} "
+            f"(valid: {sorted(names)})"
+        )
+    missing = [
+        f.name
+        for f in dataclasses.fields(cls)
+        if f.name not in obj
+        and f.default is dataclasses.MISSING
+        and f.default_factory is dataclasses.MISSING
+    ]
+    if missing:
+        raise ParamsError(f"missing required params for {cls.__name__}: {missing}")
+    try:
+        return cls(**obj)
+    except TypeError as e:
+        raise ParamsError(f"invalid params for {cls.__name__}: {e}")
+
+
+def params_to_json(params: Any) -> str:
+    """Serialize a params dataclass for metadata records (EngineInstance
+    rows store per-stage params JSON, EngineInstances.scala:43)."""
+    if params is None or isinstance(params, EmptyParams):
+        return "{}"
+    if dataclasses.is_dataclass(params):
+        return json.dumps(dataclasses.asdict(params), sort_keys=True)
+    return json.dumps(params, sort_keys=True)

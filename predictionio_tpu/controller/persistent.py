@@ -1,0 +1,106 @@
+"""Model persistence: automatic blob serialization + user-managed models.
+
+Reference: 3-mode persistence decided per-algo by
+BaseAlgorithm.makePersistentModel (BaseAlgorithm.scala:96-112) —
+(a) automatic Kryo blob into MODELDATA (CoreWorkflow.scala:73-79),
+(b) user-managed PersistentModel.save + reflective loader
+    (PersistentModel.scala:51,94; WorkflowUtils.getPersistentModel:352),
+(c) Unit ⇒ retrain-on-deploy (Engine.scala:208-226).
+
+Here (a) uses pickle (model leaves are numpy arrays — device arrays must
+be pulled host-side by the algorithm before returning its model), (b) is a
+`PersistentModel` subclass with save/load classmethod, (c) is a model of
+`None` or a non-picklable model.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from predictionio_tpu.core.base import PersistentModelManifest
+from predictionio_tpu.controller.params import load_symbol
+
+
+@dataclass(frozen=True)
+class RetrainOnDeploy:
+    """Marker stored for models that cannot/should not be serialized —
+    deploy re-runs read→prepare→train (reference Engine.scala:208-226)."""
+
+    algo_index: int
+
+
+class PersistentModel:
+    """User-managed persistence (reference PersistentModel.scala:51,94).
+
+    Subclasses set PERSISTENT = True, implement `save` returning True when
+    stored, and a `load(model_id, params)` classmethod."""
+
+    PERSISTENT = True
+
+    def save(self, model_id: str, params: Any) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def load(cls, model_id: str, params: Any) -> "PersistentModel":
+        raise NotImplementedError
+
+
+class LocalFileSystemPersistentModel(PersistentModel):
+    """Pickle-to-PIO_FS_BASEDIR convenience base (reference
+    LocalFileSystemPersistentModel.scala:40,57)."""
+
+    @staticmethod
+    def _path(model_id: str) -> str:
+        base = os.environ.get(
+            "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+        )
+        os.makedirs(base, exist_ok=True)
+        return os.path.join(base, f"pm-{model_id}.pkl")
+
+    def save(self, model_id: str, params: Any) -> bool:
+        with open(self._path(model_id), "wb") as f:
+            pickle.dump(self, f)
+        return True
+
+    @classmethod
+    def load(cls, model_id: str, params: Any):
+        with open(cls._path(model_id), "rb") as f:
+            return pickle.load(f)
+
+
+def serialize_models(models: list[Any]) -> bytes:
+    """Pickle the per-algo model list for MODELDATA. Non-picklable models
+    degrade to RetrainOnDeploy markers (reference mode (c))."""
+    out: list[Any] = []
+    for i, m in enumerate(models):
+        if m is None:
+            out.append(RetrainOnDeploy(algo_index=i))
+            continue
+        if isinstance(m, PersistentModelManifest):
+            out.append(m)
+            continue
+        try:
+            pickle.dumps(m)
+            out.append(m)
+        except Exception:
+            out.append(RetrainOnDeploy(algo_index=i))
+    return pickle.dumps(out)
+
+
+def deserialize_models(blob: bytes) -> list[Any]:
+    return pickle.loads(blob)
+
+
+def load_persistent_model(
+    manifest: PersistentModelManifest, model_id: str, params: Any
+) -> Any:
+    """Reflectively re-load a user-persisted model (reference
+    SparkWorkflowUtils.getPersistentModel, WorkflowUtils.scala:352)."""
+    cls = load_symbol(manifest.class_name)
+    loader: Optional[Any] = getattr(cls, "load", None)
+    if loader is None:
+        raise TypeError(f"{manifest.class_name} has no load() classmethod")
+    return loader(model_id, params)

@@ -1,0 +1,35 @@
+"""L4 — typeless runtime base (reference core/src/main/scala/io/prediction/core/)."""
+
+from predictionio_tpu.core.base import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BaseEngine,
+    BaseEvaluator,
+    BaseEvaluatorResult,
+    BasePreparator,
+    BaseServing,
+    PersistentModelManifest,
+    RuntimeContext,
+    SanityCheck,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+    doer,
+)
+
+__all__ = [
+    "BaseAlgorithm",
+    "BaseDataSource",
+    "BaseEngine",
+    "BaseEvaluator",
+    "BaseEvaluatorResult",
+    "BasePreparator",
+    "BaseServing",
+    "PersistentModelManifest",
+    "RuntimeContext",
+    "SanityCheck",
+    "StopAfterPrepareInterruption",
+    "StopAfterReadInterruption",
+    "WorkflowParams",
+    "doer",
+]

@@ -1,0 +1,9 @@
+"""L8 — engine templates (the product surface of reference examples/).
+
+Each subpackage is a complete DASE engine a user can train/deploy/eval:
+  recommendation     — ALS personal recommendations (scala-parallel-recommendation)
+  similarproduct     — item-item similarity on ALS factors (scala-parallel-similarproduct)
+  classification     — NaiveBayes / logistic regression (scala-parallel-classification)
+  ecommerce          — ALS + serving-time business-rule filters
+                       (scala-parallel-ecommercerecommendation)
+"""

@@ -1,0 +1,23 @@
+from predictionio_tpu.engines.recommendation.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Query,
+    RecommendationDataSource,
+    RecommendationEngine,
+    TrainingData,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "DataSourceParams",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "RecommendationDataSource",
+    "RecommendationEngine",
+    "TrainingData",
+]

@@ -1,0 +1,328 @@
+"""Recommendation engine template: implicit/explicit ALS → top-N items.
+
+Reference: examples/scala-parallel-recommendation (4 variants — DataSource
+reads "rate"/"buy" events, custom-query/src/main/scala/DataSource.scala:24-80;
+ALSAlgorithm.scala:50-120 delegates to MLlib ALS, predict = factor
+dot-products + top-N; Serving = first).
+
+TPU re-design: the DataSource reads one columnar EventFrame (no RDD), the
+algorithm trains with models/als.py's batched-CG XLA program on ctx.mesh,
+and the model keeps item factors device-resident so serving is one
+matmul+top-k program per query batch.
+
+Eval support mirrors the template's query/actual protocol: hold out each
+fold's events per user; Query carries the user, Actual the held-out item
+set (rated >= goal threshold).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+)
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.data.store.event_store import EventStoreFacade
+from predictionio_tpu.models import als
+
+
+# -- query/result (reference Engine.scala of the template) ------------------
+
+
+@dataclass
+class Query:
+    user: str
+    num: int = 10
+    # filter-by-category variant surface
+    categories: Optional[list[str]] = None
+    whitelist: Optional[list[str]] = None
+    blacklist: Optional[list[str]] = None
+
+
+@dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass
+class PredictedResult:
+    item_scores: list[ItemScore] = field(default_factory=list)
+
+
+@dataclass
+class ActualResult:
+    """Held-out relevant items for eval."""
+
+    items: list[str] = field(default_factory=list)
+
+
+# -- data source ------------------------------------------------------------
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str
+    event_names: tuple[str, ...] = ("rate", "buy")
+    rate_event: str = "rate"  # carries a "rating" property; others weight 1.0
+    eval_k: int = 0  # >0 enables read_eval with k folds
+    goal_threshold: float = 4.0  # rating >= threshold counts as relevant
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    rows: np.ndarray  # user idx per interaction
+    cols: np.ndarray  # item idx
+    vals: np.ndarray  # rating / implicit weight
+    n_users: int
+    n_items: int
+    user_vocab: object  # BiMap str → int
+    item_vocab: object
+
+    def sanity_check(self) -> None:
+        if len(self.rows) == 0:
+            raise ValueError(
+                "no interaction events found (check appName/eventNames)"
+            )
+        if not np.isfinite(self.vals).all():
+            raise ValueError("non-finite interaction values")
+
+
+@dataclass
+class EvalInfo:
+    fold: int
+
+
+class RecommendationDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def _frame(self, ctx: RuntimeContext):
+        store = EventStoreFacade(ctx.storage)
+        frame = store.find_frame(
+            app_name=self.params.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.event_names),
+            value_prop="rating",
+            default_value=1.0,
+        )
+        # only the rate event carries a rating payload; every other
+        # interaction type ("buy", "view"…) weighs 1.0 even if it happens
+        # to have a "rating" property (reference custom-query DataSource
+        # maps rate→rating, others→1)
+        rate_code = frame.event_vocab.get(self.params.rate_event, -2)
+        import dataclasses as _dc
+
+        return _dc.replace(
+            frame,
+            value=np.where(frame.event_code == rate_code, frame.value, 1.0).astype(
+                np.float32
+            ),
+        )
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        frame = self._frame(ctx)
+        rows, cols, vals = frame.interactions(dedupe="sum")
+        return TrainingData(
+            rows=rows,
+            cols=cols,
+            vals=vals,
+            n_users=frame.n_entities,
+            n_items=frame.n_targets,
+            user_vocab=frame.entity_vocab,
+            item_vocab=frame.target_vocab,
+        )
+
+    def read_eval(self, ctx: RuntimeContext):
+        """k-fold split by interaction index (reference e2
+        CrossValidation.splitData:21 — fold = idx mod k)."""
+        k = self.params.eval_k
+        if k <= 0:
+            raise ValueError("eval requires datasource params eval_k > 0")
+        frame = self._frame(ctx)
+        rows, cols, vals = frame.interactions(dedupe="sum")
+        idx = np.arange(len(rows))
+        inv_user = frame.entity_vocab.inverse()
+        inv_item = frame.target_vocab.inverse()
+        out = []
+        for fold in range(k):
+            test_mask = idx % k == fold
+            td = TrainingData(
+                rows=rows[~test_mask],
+                cols=cols[~test_mask],
+                vals=vals[~test_mask],
+                n_users=frame.n_entities,
+                n_items=frame.n_targets,
+                user_vocab=frame.entity_vocab,
+                item_vocab=frame.target_vocab,
+            )
+            qa = []
+            t_rows, t_cols, t_vals = (
+                rows[test_mask], cols[test_mask], vals[test_mask],
+            )
+            for u in np.unique(t_rows):
+                m = (t_rows == u) & (t_vals >= self.params.goal_threshold)
+                relevant = [inv_item(int(c)) for c in t_cols[m]]
+                if relevant:
+                    qa.append(
+                        (Query(user=inv_user(int(u))), ActualResult(relevant))
+                    )
+            out.append((td, EvalInfo(fold=fold), qa))
+        return out
+
+
+# -- algorithm --------------------------------------------------------------
+
+
+@dataclass
+class ALSAlgorithmParams:
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    implicit_prefs: bool = True
+    cg_iterations: int = 3
+    seed: int = 3
+
+
+class ALSModel:
+    """Trained factors + device-resident item factors for serving
+    (reference template ALSModel.scala persists factor RDDs; here the
+    serving-side copy lives in HBM across queries)."""
+
+    def __init__(self, factors: als.ALSFactors):
+        self.factors = factors
+        self._item_factors_device = None
+
+    # device cache is serving state, not part of the pickled model
+    def __getstate__(self):
+        return {"factors": self.factors}
+
+    def __setstate__(self, state):
+        self.factors = state["factors"]
+        self._item_factors_device = None
+
+    def item_factors_device(self):
+        if self._item_factors_device is None:
+            import jax.numpy as jnp
+
+            self._item_factors_device = jnp.asarray(self.factors.item_factors)
+        return self._item_factors_device
+
+
+class ALSAlgorithm(Algorithm):
+    def __init__(self, params: ALSAlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> ALSModel:
+        factors = als.train(
+            pd.rows,
+            pd.cols,
+            pd.vals,
+            pd.n_users,
+            pd.n_items,
+            als.ALSParams(
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                lambda_=self.params.lambda_,
+                alpha=self.params.alpha,
+                implicit_prefs=self.params.implicit_prefs,
+                cg_iterations=self.params.cg_iterations,
+                seed=self.params.seed,
+            ),
+            user_vocab=pd.user_vocab,
+            item_vocab=pd.item_vocab,
+            mesh=ctx.mesh,
+        )
+        return ALSModel(factors)
+
+    # -- serving -----------------------------------------------------------
+    def _exclusion_mask(
+        self, model: ALSModel, queries: Sequence[Query]
+    ) -> Optional[np.ndarray]:
+        """White/black-list filters → per-query item mask (True = exclude)."""
+        if not any(q.whitelist or q.blacklist for q in queries):
+            return None
+        vocab = model.factors.item_vocab
+        n_items = model.factors.item_factors.shape[0]
+        mask = np.zeros((len(queries), n_items), dtype=bool)
+        for qi, q in enumerate(queries):
+            if q.whitelist is not None:
+                mask[qi, :] = True
+                for it in q.whitelist:
+                    ix = vocab.get(it)
+                    if ix is not None:
+                        mask[qi, ix] = False
+            if q.blacklist:
+                for it in q.blacklist:
+                    ix = vocab.get(it)
+                    if ix is not None:
+                        mask[qi, ix] = True
+        return mask
+
+    def _predict_batch(
+        self, model: ALSModel, queries: Sequence[Query]
+    ) -> list[PredictedResult]:
+        vocab = model.factors.user_vocab
+        known = [(i, vocab.get(q.user)) for i, q in enumerate(queries)]
+        known_ix = [(i, u) for i, u in known if u is not None]
+        results: list[PredictedResult] = [PredictedResult() for _ in queries]
+        if not known_ix:
+            return results
+        k = max(q.num for q in queries)
+        k = min(k, model.factors.item_factors.shape[0])
+        user_rows = np.array([u for _, u in known_ix], dtype=np.int64)
+        full_mask = self._exclusion_mask(model, queries)
+        sub_mask = (
+            full_mask[[i for i, _ in known_ix]] if full_mask is not None else None
+        )
+        scores, items = als.recommend(
+            model.factors,
+            user_rows,
+            k,
+            exclude_mask=sub_mask,
+            item_factors_device=model.item_factors_device(),
+        )
+        inv = model.factors.item_vocab.inverse()
+        from predictionio_tpu.ops.topk import NEG_INF
+
+        for row, (qi, _u) in enumerate(known_ix):
+            n = min(queries[qi].num, k)
+            item_scores = [
+                ItemScore(item=inv(int(ix)), score=float(s))
+                for s, ix in zip(scores[row][:n], items[row][:n])
+                if s > NEG_INF / 2
+            ]
+            results[qi] = PredictedResult(item_scores=item_scores)
+        return results
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        return self._predict_batch(model, [query])[0]
+
+    def batch_predict(self, ctx, model: ALSModel, queries):
+        preds = self._predict_batch(model, [q for _, q in queries])
+        return [(qx, p) for (qx, _q), p in zip(queries, preds)]
+
+
+# -- engine factory ---------------------------------------------------------
+
+
+class RecommendationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            RecommendationDataSource,
+            IdentityPreparator,
+            {"als": ALSAlgorithm},
+            FirstServing,
+        )

@@ -1,0 +1,9 @@
+"""L5 — workflow drivers (reference core/src/main/scala/io/prediction/workflow/)."""
+
+from predictionio_tpu.workflow.core import (
+    load_variant,
+    run_train,
+    runtime_context_from_variant,
+)
+
+__all__ = ["load_variant", "run_train", "runtime_context_from_variant"]

@@ -1,0 +1,206 @@
+"""Train workflow driver: variant JSON → engine → models → MODELDATA.
+
+Reference: CreateWorkflow.main (CreateWorkflow.scala:133) +
+CoreWorkflow.runTrain (CoreWorkflow.scala:42-99). The Spark driver process
+becomes a plain function call (the CLI spawns it in-process or as a child
+python, not via spark-submit); the SparkContext becomes a RuntimeContext
+carrying the storage registry and an optional device mesh built from the
+variant's `mesh` config (the re-design of `sparkConf` pass-through,
+WorkflowUtils.extractSparkConf:316).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import uuid
+from typing import Any, Optional
+
+from predictionio_tpu.controller.engine import EngineParams, resolve_engine
+from predictionio_tpu.controller.params import load_symbol, params_to_json
+from predictionio_tpu.controller.persistent import serialize_models
+from predictionio_tpu.core.base import RuntimeContext, WorkflowParams
+from predictionio_tpu.data.storage.base import EngineInstance, Model
+from predictionio_tpu.data.storage.registry import Storage
+
+log = logging.getLogger(__name__)
+
+
+def _stage_json(stage: tuple[str, Any]) -> str:
+    """Persist a (stage-name, params) pair — the name matters: deploy must
+    rebind the same named class the train run used (the reference stores
+    name+params per stage, EngineInstances.scala:43)."""
+    name, params = stage
+    return json.dumps(
+        {"name": name, "params": json.loads(params_to_json(params))},
+        sort_keys=True,
+    )
+
+
+def load_variant(path: str) -> dict:
+    """Load an engine variant JSON file (engine.json)."""
+    with open(path) as f:
+        variant = json.load(f)
+    for key in ("id", "engineFactory"):
+        if key not in variant:
+            raise ValueError(f"engine variant is missing {key!r} ({path})")
+    return variant
+
+
+def runtime_context_from_variant(
+    storage: Storage,
+    variant: dict,
+    mode: str = "train",
+    workflow_params: Optional[WorkflowParams] = None,
+    use_mesh: bool = True,
+) -> RuntimeContext:
+    mesh = None
+    if use_mesh and variant.get("mesh"):
+        from predictionio_tpu.parallel.mesh import MeshConf
+
+        mesh = MeshConf.from_json(variant["mesh"]).build()
+    return RuntimeContext(
+        storage=storage,
+        mesh=mesh,
+        mode=mode,
+        workflow_params=workflow_params or WorkflowParams(),
+    )
+
+
+def run_train(
+    storage: Storage,
+    variant: dict,
+    workflow_params: Optional[WorkflowParams] = None,
+    engine_params: Optional[EngineParams] = None,
+    engine_id: Optional[str] = None,
+    engine_version: str = "0",
+) -> EngineInstance:
+    """The whole `pio train` data path (reference call stack SURVEY.md §3.1):
+    resolve factory → params from variant → EngineInstance INIT row →
+    engine.train → serializable models → MODELDATA blob → COMPLETED.
+
+    Returns the COMPLETED EngineInstance row.
+    """
+    wp = workflow_params or WorkflowParams()
+    engine = resolve_engine(load_symbol(variant["engineFactory"]))
+    if engine_params is None:
+        engine_params = engine.params_from_variant_json(variant)
+
+    instances = storage.get_meta_data_engine_instances()
+    now = _dt.datetime.now(_dt.timezone.utc)
+    instance = EngineInstance(
+        id=str(uuid.uuid4()),
+        status="INIT",
+        start_time=now,
+        end_time=now,
+        engine_id=engine_id or variant["id"],
+        engine_version=engine_version,
+        engine_variant=variant["id"],
+        engine_factory=variant["engineFactory"],
+        batch=wp.batch,
+        data_source_params=_stage_json(engine_params.data_source_params),
+        preparator_params=_stage_json(engine_params.preparator_params),
+        algorithms_params=json.dumps(
+            [
+                {"name": name, "params": json.loads(params_to_json(p))}
+                for name, p in engine_params.algorithm_params_list
+            ]
+        ),
+        serving_params=_stage_json(engine_params.serving_params),
+        mesh_conf=variant.get("mesh") or {},
+    )
+    instance_id = instances.insert(instance)
+    instance.id = instance_id
+
+    ctx = runtime_context_from_variant(storage, variant, "train", wp)
+    try:
+        instance.status = "TRAINING"
+        instances.update(instance)
+        models = engine.train(ctx, engine_params)
+        if wp.save_model:
+            serializable = engine.make_serializable_models(
+                ctx, models, engine_params, instance_id
+            )
+            storage.get_model_data_models().insert(
+                Model(id=instance_id, models=serialize_models(serializable))
+            )
+        instance.status = "COMPLETED"
+        instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+        instances.update(instance)
+        log.info("training completed: instance %s", instance_id)
+        return instance
+    except Exception:
+        instance.status = "ABORTED"
+        instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+        instances.update(instance)
+        raise
+
+
+def prepare_deploy_models(
+    storage: Storage,
+    instance: EngineInstance,
+    engine: Any = None,
+    engine_params: Optional[EngineParams] = None,
+    use_mesh: bool = True,
+) -> tuple[Any, EngineParams, list[Any]]:
+    """Re-hydrate a COMPLETED instance's models for serving (reference
+    CreateServer.createServerActorWithEngine:206 → Engine.prepareDeploy:196).
+
+    When `use_mesh` and the train run recorded a mesh config, the deploy
+    context rebuilds it — so retrain-on-deploy models retrain with the
+    same sharding the train run used.
+
+    Returns (engine, engine_params, models)."""
+    if engine is None:
+        engine = resolve_engine(load_symbol(instance.engine_factory))
+    if engine_params is None:
+        engine_params = engine_instance_to_engine_params(engine, instance)
+    blob = storage.get_model_data_models().get(instance.id)
+    if blob is None:
+        raise RuntimeError(f"no model blob stored for instance {instance.id}")
+    from predictionio_tpu.controller.persistent import deserialize_models
+
+    persisted = deserialize_models(blob.models)
+    mesh = None
+    if use_mesh and instance.mesh_conf:
+        from predictionio_tpu.parallel.mesh import MeshConf
+
+        mesh = MeshConf.from_json(instance.mesh_conf).build()
+    ctx = RuntimeContext(storage=storage, mesh=mesh, mode="serve")
+    models = engine.prepare_deploy(
+        ctx, engine_params, persisted, instance_id=instance.id
+    )
+    return engine, engine_params, models
+
+
+def _stage_from_json(raw: str) -> Optional[dict]:
+    """Invert _stage_json → a variant stage object, or None when empty."""
+    if not raw or raw == "{}":
+        return None
+    obj = json.loads(raw)
+    if "name" not in obj:  # legacy bare-params form
+        return {"params": obj} if obj else None
+    if not obj["name"] and not obj.get("params"):
+        return None
+    return {"name": obj["name"], "params": obj.get("params") or None}
+
+
+def engine_instance_to_engine_params(engine: Any, instance: EngineInstance) -> EngineParams:
+    """Rebuild EngineParams from the name+params JSON recorded on the
+    instance row (reference Engine.engineInstanceToEngineParams:419)."""
+    variant = {
+        "id": instance.engine_variant,
+        "engineFactory": instance.engine_factory,
+    }
+    for key, raw in (
+        ("datasource", instance.data_source_params),
+        ("preparator", instance.preparator_params),
+        ("serving", instance.serving_params),
+    ):
+        stage = _stage_from_json(raw)
+        if stage is not None:
+            variant[key] = stage
+    if instance.algorithms_params:
+        variant["algorithms"] = json.loads(instance.algorithms_params)
+    return engine.params_from_variant_json(variant)

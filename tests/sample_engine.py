@@ -1,0 +1,251 @@
+"""Deterministic id-stamping fake engines for workflow tests.
+
+The key test pattern of the reference (core/src/test/scala/io/prediction/
+controller/SampleEngine.scala, 472 LoC): every DASE stage stamps its params
+id into the objects flowing through, so tests assert the exact data path
+without any real ML.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    EngineFactory,
+    Engine,
+    FirstServing,
+    LocalFileSystemPersistentModel,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+
+
+# -- data carriers ----------------------------------------------------------
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    id: int
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise ValueError(f"training data {self.id} is dirty")
+
+
+@dataclass
+class PreparedData:
+    td_id: int
+    p_id: int
+
+
+@dataclass
+class EvalInfo:
+    id: int
+
+
+@dataclass
+class Query:
+    q: int
+    supplemented: bool = False
+
+
+@dataclass
+class Actual:
+    q: int
+
+
+@dataclass
+class Prediction:
+    q: int
+    algo_id: int
+    td_id: int
+    p_id: int
+    supplemented: bool = False
+
+
+# -- params -----------------------------------------------------------------
+
+
+@dataclass
+class DSP:
+    id: int = 0
+    error: bool = False
+
+
+@dataclass
+class PP:
+    id: int = 0
+
+
+@dataclass
+class AP:
+    id: int = 0
+
+
+# -- stages -----------------------------------------------------------------
+
+
+class DataSource0(DataSource):
+    def __init__(self, params: DSP):
+        self.params = params
+
+    def read_training(self, ctx):
+        return TrainingData(id=self.params.id, error=self.params.error)
+
+    def read_eval(self, ctx):
+        return [
+            (
+                TrainingData(id=self.params.id),
+                EvalInfo(id=s),
+                [(Query(q=10 * s + i), Actual(q=10 * s + i)) for i in range(3)],
+            )
+            for s in range(2)
+        ]
+
+
+class Preparator0(Preparator):
+    def __init__(self, params: PP):
+        self.params = params
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(td_id=td.id, p_id=self.params.id)
+
+
+@dataclass
+class Model0:
+    algo_id: int
+    td_id: int
+    p_id: int
+
+
+class Algo0(Algorithm):
+    def __init__(self, params: AP):
+        self.params = params
+
+    def train(self, ctx, pd: PreparedData) -> Model0:
+        return Model0(algo_id=self.params.id, td_id=pd.td_id, p_id=pd.p_id)
+
+    def predict(self, model: Model0, query: Query) -> Prediction:
+        return Prediction(
+            q=query.q,
+            algo_id=model.algo_id,
+            td_id=model.td_id,
+            p_id=model.p_id,
+            supplemented=query.supplemented,
+        )
+
+
+class Algo1(Algo0):
+    """Same behavior, distinct class for multi-algo binding tests."""
+
+
+class NoParamsAlgo(Algorithm):
+    """Zero-arg constructor → Doer's no-params path."""
+
+    def train(self, ctx, pd: PreparedData) -> Model0:
+        return Model0(algo_id=-1, td_id=pd.td_id, p_id=pd.p_id)
+
+    def predict(self, model, query):
+        return Prediction(
+            q=query.q, algo_id=-1, td_id=model.td_id, p_id=model.p_id
+        )
+
+
+@dataclass
+class PersistentModel0(LocalFileSystemPersistentModel):
+    """User-managed persistence path (PersistentModelManifest mode)."""
+
+    algo_id: int = 0
+    td_id: int = 0
+    p_id: int = 0
+
+
+class PersistentAlgo(Algorithm):
+    def __init__(self, params: AP):
+        self.params = params
+
+    def train(self, ctx, pd: PreparedData) -> PersistentModel0:
+        return PersistentModel0(
+            algo_id=self.params.id, td_id=pd.td_id, p_id=pd.p_id
+        )
+
+    def predict(self, model, query):
+        return Prediction(
+            q=query.q, algo_id=model.algo_id, td_id=model.td_id, p_id=model.p_id
+        )
+
+
+class UnserializableModel:
+    """Defeats pickle → RetrainOnDeploy path."""
+
+    def __init__(self, algo_id, td_id, p_id):
+        self.algo_id, self.td_id, self.p_id = algo_id, td_id, p_id
+        self.closure = lambda: None  # not picklable
+
+    def __reduce__(self):
+        raise pickle.PicklingError("deliberately unserializable")
+
+
+class UnserializableAlgo(Algorithm):
+    def __init__(self, params: AP):
+        self.params = params
+
+    def train(self, ctx, pd: PreparedData):
+        return UnserializableModel(self.params.id, pd.td_id, pd.p_id)
+
+    def predict(self, model, query):
+        return Prediction(
+            q=query.q, algo_id=model.algo_id, td_id=model.td_id, p_id=model.p_id
+        )
+
+
+class SupplementServing(Serving):
+    """Stamps supplement + serves first prediction."""
+
+    def supplement(self, query: Query) -> Query:
+        return Query(q=query.q, supplemented=True)
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class SumServing(Serving):
+    """Combines multi-algo predictions: sums algo ids."""
+
+    def serve(self, query, predictions):
+        p = predictions[0]
+        return Prediction(
+            q=p.q,
+            algo_id=sum(x.algo_id for x in predictions),
+            td_id=p.td_id,
+            p_id=p.p_id,
+            supplemented=p.supplemented,
+        )
+
+
+# -- engines ----------------------------------------------------------------
+
+
+class Engine0Factory(EngineFactory):
+    def apply(self):
+        return Engine(
+            DataSource0,
+            Preparator0,
+            {"algo0": Algo0, "algo1": Algo1, "noparams": NoParamsAlgo},
+            {"": FirstServing, "sum": SumServing, "supp": SupplementServing},
+        )
+
+
+class PersistentEngineFactory(EngineFactory):
+    def apply(self):
+        return Engine(DataSource0, Preparator0, PersistentAlgo, FirstServing)
+
+
+class UnserializableEngineFactory(EngineFactory):
+    def apply(self):
+        return Engine(DataSource0, Preparator0, UnserializableAlgo, FirstServing)

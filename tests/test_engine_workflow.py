@@ -1,0 +1,244 @@
+"""Controller + workflow tests via the id-stamping fake engine zoo
+(pattern: reference EngineTest.scala / EngineWorkflowTest.scala)."""
+
+import dataclasses
+
+import pytest
+
+from predictionio_tpu.controller import (
+    EmptyParams,
+    EngineParams,
+    ParamsError,
+    RuntimeContext,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+    extract_params,
+    params_class_of,
+    resolve_engine,
+)
+from predictionio_tpu.controller.persistent import RetrainOnDeploy
+from predictionio_tpu.core.base import PersistentModelManifest
+from predictionio_tpu.workflow.core import (
+    engine_instance_to_engine_params,
+    prepare_deploy_models,
+    run_train,
+)
+
+import sample_engine as se
+
+
+def make_ep(algos=(("algo0", se.AP(id=3)),), serving=("", EmptyParams())):
+    return EngineParams(
+        data_source_params=("", se.DSP(id=1)),
+        preparator_params=("", se.PP(id=2)),
+        algorithm_params_list=tuple(algos),
+        serving_params=serving,
+    )
+
+
+def engine0():
+    return resolve_engine(se.Engine0Factory)
+
+
+class TestParamsExtraction:
+    def test_params_class_of(self):
+        assert params_class_of(se.Algo0) is se.AP
+        assert params_class_of(se.NoParamsAlgo) is None
+
+    def test_strict_unknown_key(self):
+        with pytest.raises(ParamsError, match="unknown params"):
+            extract_params(se.AP, {"id": 1, "bogus": 2})
+
+    def test_defaults_fill_missing(self):
+        p = extract_params(se.DSP, {"id": 5})
+        assert p == se.DSP(id=5, error=False)
+
+    def test_variant_json_roundtrip(self):
+        variant = {
+            "id": "v1",
+            "engineFactory": "sample_engine.Engine0Factory",
+            "datasource": {"params": {"id": 1}},
+            "preparator": {"params": {"id": 2}},
+            "algorithms": [
+                {"name": "algo0", "params": {"id": 3}},
+                {"name": "algo1", "params": {"id": 4}},
+            ],
+            "serving": {"name": "sum"},
+        }
+        ep = engine0().params_from_variant_json(variant)
+        assert ep.data_source_params == ("", se.DSP(id=1))
+        assert ep.preparator_params == ("", se.PP(id=2))
+        assert ep.algorithm_params_list == (
+            ("algo0", se.AP(id=3)),
+            ("algo1", se.AP(id=4)),
+        )
+        assert ep.serving_params[0] == "sum"
+
+    def test_variant_unbound_algo_name(self):
+        variant = {
+            "id": "v1",
+            "engineFactory": "x",
+            "algorithms": [{"name": "missing", "params": {}}],
+        }
+        with pytest.raises(ParamsError, match="not bound"):
+            engine0().params_from_variant_json(variant)
+
+
+class TestEngineTrain:
+    def test_id_stamping_through_pipeline(self):
+        models = engine0().train(RuntimeContext(), make_ep())
+        assert models == [se.Model0(algo_id=3, td_id=1, p_id=2)]
+
+    def test_multi_algo(self):
+        ep = make_ep(algos=(("algo0", se.AP(id=3)), ("algo1", se.AP(id=7))))
+        models = engine0().train(RuntimeContext(), ep)
+        assert [m.algo_id for m in models] == [3, 7]
+
+    def test_noparams_doer_path(self):
+        ep = make_ep(algos=(("noparams", EmptyParams()),))
+        models = engine0().train(RuntimeContext(), ep)
+        assert models[0].algo_id == -1
+
+    def test_stop_after_read(self):
+        ctx = RuntimeContext(workflow_params=WorkflowParams(stop_after_read=True))
+        with pytest.raises(StopAfterReadInterruption):
+            engine0().train(ctx, make_ep())
+
+    def test_stop_after_prepare(self):
+        ctx = RuntimeContext(workflow_params=WorkflowParams(stop_after_prepare=True))
+        with pytest.raises(StopAfterPrepareInterruption):
+            engine0().train(ctx, make_ep())
+
+    def test_sanity_check_dirty_data_raises(self):
+        ep = dataclasses.replace(
+            make_ep(), data_source_params=("", se.DSP(id=1, error=True))
+        )
+        with pytest.raises(ValueError, match="dirty"):
+            engine0().train(RuntimeContext(), ep)
+
+    def test_sanity_check_skipped(self):
+        ep = dataclasses.replace(
+            make_ep(), data_source_params=("", se.DSP(id=1, error=True))
+        )
+        ctx = RuntimeContext(workflow_params=WorkflowParams(skip_sanity_check=True))
+        models = engine0().train(ctx, ep)
+        assert models[0].td_id == 1
+
+
+class TestEngineEval:
+    def test_eval_serving_and_supplement(self):
+        ep = make_ep(serving=("supp", EmptyParams()))
+        results = engine0().eval(RuntimeContext(), ep)
+        assert len(results) == 2  # two eval sets from DataSource0
+        ei, qpa = results[0]
+        assert ei.id == 0
+        q, p, a = qpa[0]
+        assert q.q == a.q == p.q
+        assert p.supplemented  # supplement ran before predict
+        assert (p.td_id, p.p_id, p.algo_id) == (1, 2, 3)
+
+    def test_eval_multi_algo_sum_serving(self):
+        ep = make_ep(
+            algos=(("algo0", se.AP(id=3)), ("algo1", se.AP(id=7))),
+            serving=("sum", EmptyParams()),
+        )
+        results = engine0().eval(RuntimeContext(), ep)
+        _, qpa = results[0]
+        assert qpa[0][1].algo_id == 10
+
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": "sample_engine.Engine0Factory",
+    "datasource": {"params": {"id": 1}},
+    "preparator": {"params": {"id": 2}},
+    "algorithms": [{"name": "algo0", "params": {"id": 3}}],
+    "serving": {},
+}
+
+
+class TestRunTrain:
+    def test_lifecycle_and_model_blob(self, fresh_storage):
+        inst = run_train(fresh_storage, VARIANT)
+        assert inst.status == "COMPLETED"
+        stored = fresh_storage.get_meta_data_engine_instances().get(inst.id)
+        assert stored is not None and stored.status == "COMPLETED"
+        latest = fresh_storage.get_meta_data_engine_instances().get_latest_completed(
+            "default", "0", "default"
+        )
+        assert latest is not None and latest.id == inst.id
+
+        engine, ep, models = prepare_deploy_models(fresh_storage, stored)
+        assert models == [se.Model0(algo_id=3, td_id=1, p_id=2)]
+        assert ep.algorithm_params_list == (("algo0", se.AP(id=3)),)
+
+    def test_aborted_on_failure(self, fresh_storage):
+        bad = dict(VARIANT, datasource={"params": {"id": 1, "error": True}})
+        with pytest.raises(ValueError, match="dirty"):
+            run_train(fresh_storage, bad)
+        rows = fresh_storage.get_meta_data_engine_instances().get_all()
+        assert [r.status for r in rows] == ["ABORTED"]
+
+    def test_engine_instance_params_roundtrip(self, fresh_storage):
+        inst = run_train(fresh_storage, VARIANT)
+        stored = fresh_storage.get_meta_data_engine_instances().get(inst.id)
+        ep = engine_instance_to_engine_params(engine0(), stored)
+        assert ep.data_source_params == ("", se.DSP(id=1))
+        assert ep.algorithm_params_list == (("algo0", se.AP(id=3)),)
+
+    def test_named_serving_survives_roundtrip(self, fresh_storage):
+        """Deploy must rebind the same named Serving class the train run
+        used — not silently fall back to the ''-named binding."""
+        variant = dict(VARIANT, serving={"name": "sum"})
+        inst = run_train(fresh_storage, variant)
+        stored = fresh_storage.get_meta_data_engine_instances().get(inst.id)
+        ep = engine_instance_to_engine_params(engine0(), stored)
+        assert ep.serving_params[0] == "sum"
+        serving = engine0().make_serving(ep)
+        assert type(serving).__name__ == "SumServing"
+
+
+class TestPersistenceMatrix:
+    def test_persistent_model_manifest(self, fresh_storage, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path / "pm"))
+        variant = {
+            "id": "pm",
+            "engineFactory": "sample_engine.PersistentEngineFactory",
+            "datasource": {"params": {"id": 1}},
+            "preparator": {"params": {"id": 2}},
+            "algorithms": [{"name": "", "params": {"id": 9}}],
+        }
+        inst = run_train(fresh_storage, variant)
+        from predictionio_tpu.controller.persistent import deserialize_models
+
+        blob = fresh_storage.get_model_data_models().get(inst.id)
+        persisted = deserialize_models(blob.models)
+        assert isinstance(persisted[0], PersistentModelManifest)
+        assert persisted[0].class_name.endswith("PersistentModel0")
+
+        _, _, models = prepare_deploy_models(
+            fresh_storage, fresh_storage.get_meta_data_engine_instances().get(inst.id)
+        )
+        assert models[0] == se.PersistentModel0(algo_id=9, td_id=1, p_id=2)
+
+    def test_unserializable_model_retrains_on_deploy(self, fresh_storage):
+        variant = {
+            "id": "un",
+            "engineFactory": "sample_engine.UnserializableEngineFactory",
+            "datasource": {"params": {"id": 1}},
+            "preparator": {"params": {"id": 2}},
+            "algorithms": [{"name": "", "params": {"id": 5}}],
+        }
+        inst = run_train(fresh_storage, variant)
+        from predictionio_tpu.controller.persistent import deserialize_models
+
+        blob = fresh_storage.get_model_data_models().get(inst.id)
+        persisted = deserialize_models(blob.models)
+        assert persisted == [RetrainOnDeploy(algo_index=0)]
+
+        _, _, models = prepare_deploy_models(
+            fresh_storage, fresh_storage.get_meta_data_engine_instances().get(inst.id)
+        )
+        assert isinstance(models[0], se.UnserializableModel)
+        assert (models[0].algo_id, models[0].td_id) == (5, 1)

@@ -1,0 +1,145 @@
+"""End-to-end: events → ALS recommendation engine → train → deploy → predict.
+
+The zero→aha loop of the reference (quickstart: app new → import events →
+train → deploy → query), minus HTTP (covered by server tests)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.engines.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    Query,
+    RecommendationDataSource,
+)
+from predictionio_tpu.workflow.core import prepare_deploy_models, run_train
+
+VARIANT = {
+    "id": "recommendation-test",
+    "engineFactory": "predictionio_tpu.engines.recommendation.RecommendationEngine",
+    "datasource": {
+        "params": {"app_name": "testapp", "event_names": ["rate", "buy"]}
+    },
+    "algorithms": [
+        {
+            "name": "als",
+            "params": {
+                "rank": 8,
+                "num_iterations": 8,
+                "implicit_prefs": True,
+                "lambda_": 0.05,
+            },
+        }
+    ],
+}
+
+
+@pytest.fixture()
+def seeded_storage(fresh_storage):
+    """Two user cohorts with disjoint item-group preferences."""
+    apps = fresh_storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="testapp"))
+    events = fresh_storage.get_events()
+    events.init_app(app_id)
+    rng = np.random.RandomState(7)
+    batch = []
+    for u in range(10):
+        group = u % 2
+        for _ in range(30):
+            item = rng.randint(0, 4) + group * 4  # items 0-3 vs 4-7
+            batch.append(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{item}",
+                    properties={"rating": float(rng.randint(3, 6))},
+                )
+            )
+        # a couple of weak cross-group "buy" events (weight 1.0)
+        batch.append(
+            Event(
+                event="buy",
+                entity_type="user",
+                entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{(1 - group) * 4}",
+            )
+        )
+    events.insert_batch(batch, app_id)
+    return fresh_storage
+
+
+def test_train_deploy_predict(seeded_storage):
+    inst = run_train(seeded_storage, VARIANT)
+    assert inst.status == "COMPLETED"
+
+    stored = seeded_storage.get_meta_data_engine_instances().get(inst.id)
+    engine, ep, models = prepare_deploy_models(seeded_storage, stored)
+    algo = engine.make_algorithms(ep)[0]
+    serving = engine.make_serving(ep)
+
+    # cohort-0 user should rank cohort-0 items (i0-i3) on top
+    q = serving.supplement(Query(user="u0", num=4))
+    pred = serving.serve(q, [algo.predict(models[0], q)])
+    assert len(pred.item_scores) == 4
+    top_items = {s.item for s in pred.item_scores}
+    assert len(top_items & {"i0", "i1", "i2", "i3"}) >= 3, top_items
+    scores = [s.score for s in pred.item_scores]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_unknown_user_empty_result(seeded_storage):
+    inst = run_train(seeded_storage, VARIANT)
+    stored = seeded_storage.get_meta_data_engine_instances().get(inst.id)
+    engine, ep, models = prepare_deploy_models(seeded_storage, stored)
+    algo = engine.make_algorithms(ep)[0]
+    pred = algo.predict(models[0], Query(user="nobody", num=5))
+    assert pred.item_scores == []
+
+
+def test_whitelist_blacklist(seeded_storage):
+    inst = run_train(seeded_storage, VARIANT)
+    stored = seeded_storage.get_meta_data_engine_instances().get(inst.id)
+    engine, ep, models = prepare_deploy_models(seeded_storage, stored)
+    algo = engine.make_algorithms(ep)[0]
+
+    wl = algo.predict(models[0], Query(user="u0", num=8, whitelist=["i5", "i6"]))
+    assert {s.item for s in wl.item_scores} <= {"i5", "i6"}
+
+    bl = algo.predict(models[0], Query(user="u0", num=8, blacklist=["i0", "i1"]))
+    assert not ({"i0", "i1"} & {s.item for s in bl.item_scores})
+
+
+def test_batch_predict_matches_single(seeded_storage):
+    inst = run_train(seeded_storage, VARIANT)
+    stored = seeded_storage.get_meta_data_engine_instances().get(inst.id)
+    engine, ep, models = prepare_deploy_models(seeded_storage, stored)
+    algo = engine.make_algorithms(ep)[0]
+    queries = [(i, Query(user=f"u{i}", num=3)) for i in range(4)]
+    batch = dict(algo.batch_predict(RuntimeContext(), models[0], queries))
+    for i, q in queries:
+        single = algo.predict(models[0], q)
+        assert [s.item for s in batch[i].item_scores] == [
+            s.item for s in single.item_scores
+        ]
+
+
+def test_read_eval_folds(seeded_storage):
+    ds = RecommendationDataSource(
+        DataSourceParams(app_name="testapp", eval_k=3, goal_threshold=4.0)
+    )
+    ctx = RuntimeContext(storage=seeded_storage)
+    sets = ds.read_eval(ctx)
+    assert len(sets) == 3
+    total_train = sum(len(td.rows) for td, _, _ in sets)
+    full = ds.read_training(ctx)
+    assert total_train == 2 * len(full.rows)  # each fold holds out 1/3
+    for td, ei, qa in sets:
+        assert len(qa) > 0
+        for q, a in qa:
+            assert a.items  # only users with relevant held-out items
