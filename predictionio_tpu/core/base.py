@@ -100,6 +100,7 @@ def doer(cls: type, params: Any) -> Any:
         for name, p in sig.parameters.items()
         if name != "self"
         and p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)
+        and p.default is p.empty  # defaulted args don't want a Params object
     )
     if n_required >= 1:
         return cls(params)

@@ -1,0 +1,5 @@
+"""L2 — Event Server: HTTP ingestion API (reference data/src/main/scala/io/prediction/data/api/)."""
+
+from predictionio_tpu.data.api.server import EventServer, EventServerConfig
+
+__all__ = ["EventServer", "EventServerConfig"]

@@ -1,0 +1,48 @@
+"""Event-server plugin SPI.
+
+Reference: data/.../api/EventServerPlugin.scala:18-30 — two kinds:
+`inputblocker` (synchronous; may reject an event by raising) and
+`inputsniffer` (async observer; failures must not affect ingestion).
+ServiceLoader discovery becomes an explicit registry list (plus optional
+entry-point-style `load_symbol` names in config)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+log = logging.getLogger(__name__)
+
+INPUT_BLOCKER = "inputblocker"
+INPUT_SNIFFER = "inputsniffer"
+
+
+class EventServerPlugin(Protocol):
+    plugin_name: str
+    plugin_type: str  # INPUT_BLOCKER | INPUT_SNIFFER
+
+    def process(self, event_json: dict, context: dict) -> None:
+        """Blockers raise to reject; sniffers observe."""
+
+
+class PluginContext:
+    def __init__(self, plugins: list = ()):  # type: ignore[assignment]
+        self.blockers = [
+            p for p in plugins if getattr(p, "plugin_type", "") == INPUT_BLOCKER
+        ]
+        self.sniffers = [
+            p for p in plugins if getattr(p, "plugin_type", "") == INPUT_SNIFFER
+        ]
+
+    def run_blockers(self, event_json: dict, context: dict) -> None:
+        """Any raise rejects the event (reference EventServer.scala:273-277)."""
+        for p in self.blockers:
+            p.process(event_json, context)
+
+    def run_sniffers(self, event_json: dict, context: dict) -> None:
+        """Observer failures are logged, never propagated."""
+        for p in self.sniffers:
+            try:
+                p.process(event_json, context)
+            except Exception:
+                log.exception("input sniffer %s failed", getattr(p, "plugin_name", p))

@@ -1,0 +1,386 @@
+"""Event Server: threaded HTTP ingestion endpoint on :7070.
+
+Reference: data/.../api/EventServer.scala:52-640 (spray route). Routes:
+  GET    /                       → {"status": "alive"}
+  POST   /events.json            → 201 {"eventId"} (auth, whitelist, plugins)
+  GET    /events.json            → query events (time/entity/event filters)
+  GET    /events/<id>.json       → one event
+  DELETE /events/<id>.json       → delete
+  POST   /batch/events.json      → ≤50 events, per-event statuses
+  GET    /stats.json             → hourly counters (when stats enabled)
+  POST/GET /webhooks/<name>.json → JSON connectors
+  POST/GET /webhooks/<name>.form → form connectors
+
+Auth (reference withAccessKey EventServer.scala:90-128): `accessKey` query
+param or HTTP Basic username; `channel` query param selects a channel.
+The actor-per-request model becomes a threaded stdlib HTTP server — state
+shared through the storage layer, matching the reference's process
+discipline."""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import json
+import logging
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+from predictionio_tpu.data.api.plugins import PluginContext
+from predictionio_tpu.data.api.stats import Stats
+from predictionio_tpu.data.api.webhooks import (
+    FORM_CONNECTORS,
+    JSON_CONNECTORS,
+    ConnectorException,
+)
+from predictionio_tpu.data.event import Event, EventValidation, ValidationError
+from predictionio_tpu.data.storage.base import EventQuery
+from predictionio_tpu.data.storage.registry import Storage
+
+log = logging.getLogger(__name__)
+
+MAX_EVENTS_PER_BATCH = 50  # reference EventServer.scala:68
+
+
+@dataclass
+class EventServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 7070
+    stats: bool = False
+    plugins: list = field(default_factory=list)
+
+
+@dataclass
+class AuthData:
+    """Reference EventServer.scala AuthData (appId, channelId, events)."""
+
+    app_id: int
+    channel_id: Optional[int]
+    events: tuple[str, ...]  # allowed event names; empty = all
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _parse_iso(s: str) -> _dt.datetime:
+    t = _dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return t
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "_Server"  # type: ignore[assignment]
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    def _respond(self, status: int, body: Any) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> bytes:
+        # body is drained eagerly in _route; an unread body would desync
+        # HTTP/1.1 keep-alive (the next request would parse it as a
+        # request line)
+        return self._raw_body
+
+    def _json_body(self) -> Any:
+        try:
+            return json.loads(self._body().decode() or "null")
+        except json.JSONDecodeError as e:
+            raise _HttpError(400, f"invalid JSON: {e}")
+
+    def _form_body(self) -> dict[str, str]:
+        return dict(parse_qsl(self._body().decode(), keep_blank_values=True))
+
+    # -- auth (reference EventServer.scala:90-128) -------------------------
+    def _auth(self, query: dict[str, str]) -> AuthData:
+        key = query.get("accessKey")
+        if not key:
+            header = self.headers.get("Authorization", "")
+            if header.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(header[6:]).decode()
+                    key = decoded.split(":", 1)[0]
+                except Exception:
+                    key = None
+        if not key:
+            raise _HttpError(401, "Missing accessKey.")
+        access_key = self.server.storage.get_meta_data_access_keys().get(key)
+        if access_key is None:
+            raise _HttpError(401, "Invalid accessKey.")
+        channel_id: Optional[int] = None
+        channel = query.get("channel")
+        if channel:
+            channels = self.server.storage.get_meta_data_channels().get_by_app_id(
+                access_key.app_id
+            )
+            match = [c for c in channels if c.name == channel]
+            if not match:
+                raise _HttpError(401, "Invalid channel.")
+            channel_id = match[0].id
+        return AuthData(
+            app_id=access_key.app_id,
+            channel_id=channel_id,
+            events=tuple(access_key.events),
+        )
+
+    # -- event insert core -------------------------------------------------
+    def _insert_event(self, auth: AuthData, obj: dict) -> str:
+        try:
+            event = Event.from_json_dict(obj)
+            EventValidation.validate(event)
+        except ValidationError as e:
+            raise _HttpError(400, str(e))
+        if auth.events and event.event not in auth.events:
+            raise _HttpError(
+                403, f"{event.event!r} events are not allowed"
+            )
+        ctx = {"appId": auth.app_id, "channelId": auth.channel_id}
+        try:
+            self.server.plugin_context.run_blockers(obj, ctx)
+        except Exception as e:
+            raise _HttpError(403, f"event rejected: {e}")
+        event_id = self.server.storage.get_events().insert(
+            event, auth.app_id, auth.channel_id
+        )
+        self.server.plugin_context.run_sniffers(obj, ctx)
+        if self.server.stats is not None:
+            self.server.stats.update(auth.app_id, 201, event)
+        return event_id
+
+    # -- routes ------------------------------------------------------------
+    def _route(self, method: str) -> None:
+        length = int(self.headers.get("Content-Length") or 0)
+        self._raw_body = self.rfile.read(length) if length else b""
+        url = urlsplit(self.path)
+        query = dict(parse_qsl(url.query))
+        path = url.path.rstrip("/") or "/"
+        try:
+            if path == "/" and method == "GET":
+                self._respond(200, {"status": "alive"})
+            elif path == "/events.json":
+                auth = self._auth(query)
+                if method == "POST":
+                    self._post_event(auth)
+                elif method == "GET":
+                    self._get_events(auth, query)
+                else:
+                    raise _HttpError(405, "method not allowed")
+            elif path.startswith("/events/") and path.endswith(".json"):
+                auth = self._auth(query)
+                event_id = path[len("/events/"):-len(".json")]
+                if method == "GET":
+                    self._get_event(auth, event_id)
+                elif method == "DELETE":
+                    self._delete_event(auth, event_id)
+                else:
+                    raise _HttpError(405, "method not allowed")
+            elif path == "/batch/events.json" and method == "POST":
+                self._post_batch(self._auth(query))
+            elif path == "/stats.json" and method == "GET":
+                auth = self._auth(query)
+                if self.server.stats is None:
+                    raise _HttpError(
+                        404, "To see stats, launch Event Server with --stats"
+                    )
+                self._respond(200, self.server.stats.get(auth.app_id))
+            elif path.startswith("/webhooks/"):
+                self._webhooks(method, path, query)
+            else:
+                raise _HttpError(404, "Not Found")
+        except _HttpError as e:
+            self._respond(e.status, {"message": e.message})
+        except Exception:
+            log.exception("internal error on %s %s", method, self.path)
+            self._respond(500, {"message": "internal server error"})
+
+    def _post_event(self, auth: AuthData) -> None:
+        obj = self._json_body()
+        if not isinstance(obj, dict):
+            raise _HttpError(400, "event JSON must be an object")
+        event_id = self._insert_event(auth, obj)
+        self._respond(201, {"eventId": event_id})
+
+    def _post_batch(self, auth: AuthData) -> None:
+        """Per-event statuses; oversize batch rejected whole (reference
+        EventServer.scala:374-440)."""
+        objs = self._json_body()
+        if not isinstance(objs, list):
+            raise _HttpError(400, "batch events must be a JSON array")
+        if len(objs) > MAX_EVENTS_PER_BATCH:
+            raise _HttpError(
+                400,
+                f"Batch request must have less than or equal to "
+                f"{MAX_EVENTS_PER_BATCH} events",
+            )
+        results = []
+        for obj in objs:
+            try:
+                if not isinstance(obj, dict):
+                    raise _HttpError(400, "event JSON must be an object")
+                event_id = self._insert_event(auth, obj)
+                results.append({"status": 201, "eventId": event_id})
+            except _HttpError as e:
+                results.append({"status": e.status, "message": e.message})
+        self._respond(200, results)
+
+    def _get_event(self, auth: AuthData, event_id: str) -> None:
+        event = self.server.storage.get_events().get(
+            event_id, auth.app_id, auth.channel_id
+        )
+        if event is None:
+            raise _HttpError(404, "Not Found")
+        self._respond(200, event.to_json_dict())
+
+    def _delete_event(self, auth: AuthData, event_id: str) -> None:
+        found = self.server.storage.get_events().delete(
+            event_id, auth.app_id, auth.channel_id
+        )
+        if not found:
+            raise _HttpError(404, "Not Found")
+        self._respond(200, {"message": "Found"})
+
+    def _get_events(self, auth: AuthData, query: dict[str, str]) -> None:
+        """Reference GET /events.json filters (EventServer.scala:300-372)."""
+        try:
+            limit = int(query.get("limit", 20))
+            q = EventQuery(
+                app_id=auth.app_id,
+                channel_id=auth.channel_id,
+                start_time=(
+                    _parse_iso(query["startTime"]) if "startTime" in query else None
+                ),
+                until_time=(
+                    _parse_iso(query["untilTime"]) if "untilTime" in query else None
+                ),
+                entity_type=query.get("entityType"),
+                entity_id=query.get("entityId"),
+                event_names=[query["event"]] if "event" in query else None,
+                target_entity_type=query.get("targetEntityType"),
+                target_entity_id=query.get("targetEntityId"),
+                limit=None if limit < 0 else limit,
+                reversed=query.get("reversed") == "true",
+            )
+        except (ValueError, KeyError) as e:
+            raise _HttpError(400, f"invalid query parameter: {e}")
+        events = [e.to_json_dict() for e in self.server.storage.get_events().find(q)]
+        if not events:
+            raise _HttpError(404, "Not Found")
+        self._respond(200, events)
+
+    def _webhooks(self, method: str, path: str, query: dict[str, str]) -> None:
+        """Reference api/Webhooks.scala:37-77."""
+        rest = path[len("/webhooks/"):]
+        if rest.endswith(".json"):
+            name, form = rest[: -len(".json")], False
+        elif rest.endswith(".form"):
+            name, form = rest[: -len(".form")], True
+        else:
+            raise _HttpError(404, "Not Found")
+        auth = self._auth(query)
+        registry = FORM_CONNECTORS if form else JSON_CONNECTORS
+        connector = registry.get(name)
+        if method == "GET":
+            # existence check (reference getJson/getForm)
+            if connector is None:
+                raise _HttpError(404, f"webhook connection for {name} is not supported")
+            self._respond(200, {})
+            return
+        if method != "POST":
+            raise _HttpError(405, "method not allowed")
+        if connector is None:
+            raise _HttpError(404, f"webhook connection for {name} is not supported")
+        try:
+            if form:
+                event_json = connector.to_event_json_from_form(self._form_body())
+            else:
+                payload = self._json_body()
+                if not isinstance(payload, dict):
+                    raise _HttpError(400, "webhook payload must be a JSON object")
+                event_json = connector.to_event_json(payload)
+        except ConnectorException as e:
+            raise _HttpError(400, str(e))
+        event_json = {k: v for k, v in event_json.items() if v is not None}
+        event_id = self._insert_event(auth, event_json)
+        self._respond(201, {"eventId": event_id})
+
+    # -- verb dispatch -----------------------------------------------------
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, storage: Storage, config: EventServerConfig):
+        super().__init__(addr, _Handler)
+        self.storage = storage
+        self.stats = Stats() if config.stats else None
+        self.plugin_context = PluginContext(config.plugins)
+
+
+class EventServer:
+    """Process wrapper: start/stop the ingestion HTTP server (reference
+    EventServerActor + Run, EventServer.scala:580-640)."""
+
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        config: Optional[EventServerConfig] = None,
+    ):
+        self.storage = storage or Storage.get_instance()
+        self.config = config or EventServerConfig()
+        self._server: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None, "server not started"
+        return self._server.server_address[1]
+
+    def start(self) -> int:
+        """Bind and serve in a background thread; returns the bound port
+        (config.port=0 → ephemeral, for tests)."""
+        self._server = _Server(
+            (self.config.ip, self.config.port), self.storage, self.config
+        )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="event-server", daemon=True
+        )
+        self._thread.start()
+        log.info("Event Server listening on %s:%s", self.config.ip, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def serve_forever(self) -> None:
+        """Foreground mode for the CLI `eventserver` command."""
+        self.start()
+        assert self._thread is not None
+        self._thread.join()

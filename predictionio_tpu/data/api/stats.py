@@ -1,0 +1,72 @@
+"""Per-app ingestion counters in hourly buckets.
+
+Reference: data/.../api/Stats.scala:48 (KV of (status, event, entityType) →
+count per hour) + StatsActor.scala:33 Bookkeeping:28. Actor mailbox becomes
+a lock."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from predictionio_tpu.data.event import Event
+
+
+@dataclass(frozen=True)
+class KV:
+    status: int
+    event: str
+    entity_type: str
+
+
+@dataclass
+class HourlyStats:
+    counts: dict[KV, int] = field(default_factory=lambda: defaultdict(int))
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (app_id, hour_iso) → HourlyStats
+        self._buckets: dict[tuple[int, str], HourlyStats] = {}
+        self.start_time = _dt.datetime.now(_dt.timezone.utc)
+
+    @staticmethod
+    def _hour(t: _dt.datetime) -> str:
+        return t.astimezone(_dt.timezone.utc).strftime("%Y-%m-%dT%H")
+
+    def update(self, app_id: int, status: int, event: Event) -> None:
+        kv = KV(status=status, event=event.event, entity_type=event.entity_type)
+        key = (app_id, self._hour(_dt.datetime.now(_dt.timezone.utc)))
+        with self._lock:
+            bucket = self._buckets.setdefault(key, HourlyStats())
+            bucket.counts[kv] += 1
+
+    def get(self, app_id: int) -> dict:
+        """All hourly buckets for an app, JSON-shaped (reference
+        /stats.json, EventServer.scala:441-467)."""
+        with self._lock:
+            out = []
+            for (aid, hour), bucket in sorted(self._buckets.items()):
+                if aid != app_id:
+                    continue
+                out.append(
+                    {
+                        "hour": hour,
+                        "counts": [
+                            {
+                                "status": kv.status,
+                                "event": kv.event,
+                                "entityType": kv.entity_type,
+                                "count": n,
+                            }
+                            for kv, n in sorted(
+                                bucket.counts.items(),
+                                key=lambda it: (it[0].status, it[0].event),
+                            )
+                        ],
+                    }
+                )
+            return {"appId": app_id, "startTime": self.start_time.isoformat(), "hours": out}

@@ -88,6 +88,9 @@ class TrainingData(SanityCheck):
     n_items: int
     user_vocab: object  # BiMap str → int
     item_vocab: object
+    # item row → category set, from item $set properties (reference
+    # filter-by-category variant reads categories in its DataSource)
+    item_categories: Optional[list[frozenset]] = None
 
     def sanity_check(self) -> None:
         if len(self.rows) == 0:
@@ -131,6 +134,23 @@ class RecommendationDataSource(DataSource):
             ),
         )
 
+    def _item_categories(
+        self, ctx: RuntimeContext, item_vocab
+    ) -> Optional[list[frozenset]]:
+        store = EventStoreFacade(ctx.storage)
+        props = store.aggregate_properties(
+            app_name=self.params.app_name, entity_type="item"
+        )
+        if not props:
+            return None
+        out: list[frozenset] = [frozenset()] * len(item_vocab)
+        for item_id, pmap in props.items():
+            row = item_vocab.get(item_id)
+            if row is not None:
+                cats = pmap.get_opt("categories", list) or []
+                out[row] = frozenset(cats)
+        return out
+
     def read_training(self, ctx: RuntimeContext) -> TrainingData:
         frame = self._frame(ctx)
         rows, cols, vals = frame.interactions(dedupe="sum")
@@ -142,6 +162,7 @@ class RecommendationDataSource(DataSource):
             n_items=frame.n_targets,
             user_vocab=frame.entity_vocab,
             item_vocab=frame.target_vocab,
+            item_categories=self._item_categories(ctx, frame.target_vocab),
         )
 
     def read_eval(self, ctx: RuntimeContext):
@@ -201,16 +222,22 @@ class ALSModel:
     (reference template ALSModel.scala persists factor RDDs; here the
     serving-side copy lives in HBM across queries)."""
 
-    def __init__(self, factors: als.ALSFactors):
+    def __init__(
+        self,
+        factors: als.ALSFactors,
+        item_categories: Optional[list[frozenset]] = None,
+    ):
         self.factors = factors
+        self.item_categories = item_categories
         self._item_factors_device = None
 
     # device cache is serving state, not part of the pickled model
     def __getstate__(self):
-        return {"factors": self.factors}
+        return {"factors": self.factors, "item_categories": self.item_categories}
 
     def __setstate__(self, state):
         self.factors = state["factors"]
+        self.item_categories = state.get("item_categories")
         self._item_factors_device = None
 
     def item_factors_device(self):
@@ -245,25 +272,42 @@ class ALSAlgorithm(Algorithm):
             item_vocab=pd.item_vocab,
             mesh=ctx.mesh,
         )
-        return ALSModel(factors)
+        return ALSModel(factors, item_categories=pd.item_categories)
 
     # -- serving -----------------------------------------------------------
     def _exclusion_mask(
         self, model: ALSModel, queries: Sequence[Query]
     ) -> Optional[np.ndarray]:
-        """White/black-list filters → per-query item mask (True = exclude)."""
-        if not any(q.whitelist or q.blacklist for q in queries):
+        """Category/white/black-list filters → per-query item mask
+        (True = exclude)."""
+        if not any(q.whitelist or q.blacklist or q.categories for q in queries):
             return None
         vocab = model.factors.item_vocab
         n_items = model.factors.item_factors.shape[0]
         mask = np.zeros((len(queries), n_items), dtype=bool)
         for qi, q in enumerate(queries):
+            # three independent exclusions, OR-ed (an item must pass ALL
+            # configured filters, matching the reference variant semantics)
+            if q.categories:
+                if model.item_categories is None:
+                    raise ValueError(
+                        "query filters by categories but no item category "
+                        "properties were found at train time"
+                    )
+                wanted = set(q.categories)
+                no_overlap = np.fromiter(
+                    (not (cats & wanted) for cats in model.item_categories),
+                    dtype=bool,
+                    count=n_items,
+                )
+                mask[qi] |= no_overlap
             if q.whitelist is not None:
-                mask[qi, :] = True
+                not_listed = np.ones(n_items, dtype=bool)
                 for it in q.whitelist:
                     ix = vocab.get(it)
                     if ix is not None:
-                        mask[qi, ix] = False
+                        not_listed[ix] = False
+                mask[qi] |= not_listed
             if q.blacklist:
                 for it in q.blacklist:
                     ix = vocab.get(it)

@@ -20,7 +20,12 @@ from typing import Any, Optional
 from predictionio_tpu.controller.engine import EngineParams, resolve_engine
 from predictionio_tpu.controller.params import load_symbol, params_to_json
 from predictionio_tpu.controller.persistent import serialize_models
-from predictionio_tpu.core.base import RuntimeContext, WorkflowParams
+from predictionio_tpu.core.base import (
+    RuntimeContext,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+)
 from predictionio_tpu.data.storage.base import EngineInstance, Model
 from predictionio_tpu.data.storage.registry import Storage
 
@@ -117,7 +122,16 @@ def run_train(
     try:
         instance.status = "TRAINING"
         instances.update(instance)
-        models = engine.train(ctx, engine_params)
+        try:
+            models = engine.train(ctx, engine_params)
+        except (StopAfterReadInterruption, StopAfterPrepareInterruption) as e:
+            # intentional debug stop-points, not failures (reference
+            # CoreWorkflow.scala:88-93 logs "Training interrupted")
+            log.info("training interrupted by %s", type(e).__name__)
+            instance.status = "INTERRUPTED"
+            instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+            instances.update(instance)
+            return instance
         if wp.save_model:
             serializable = engine.make_serializable_models(
                 ctx, models, engine_params, instance_id

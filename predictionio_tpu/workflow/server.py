@@ -1,0 +1,377 @@
+"""Deploy server: REST query serving from TPU-resident model state.
+
+Reference: core/.../workflow/CreateServer.scala:80-713 — MasterActor
+(bind/stop/reload orchestration :277), ServerActor spray route :402:
+POST /queries.json (:490) does extract → supplement → per-algo predictBase
+→ serve → JSON (:499-525), feedback loop (:534-596), plugin chain
+(:598-601), request bookkeeping (:603-610), HTML status page (:461-489),
+/reload hot-swap (:337-358), /stop.
+
+Re-design: the actor system becomes a threaded HTTP server sharing an
+atomically-swapped `EngineRuntime` reference — queries in flight keep the
+old runtime during /reload (the MasterActor hot-swap semantic), and model
+arrays stay device-resident across queries."""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from predictionio_tpu.controller.params import ParamsError, extract_params
+from predictionio_tpu.data.storage.base import EngineInstance
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.workflow.core import prepare_deploy_models
+
+log = logging.getLogger(__name__)
+
+OUTPUT_BLOCKER = "outputblocker"
+OUTPUT_SNIFFER = "outputsniffer"
+
+
+@dataclass
+class QueryServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    # feedback loop (reference CreateServer.scala:534-596)
+    feedback: bool = False
+    event_server_url: Optional[str] = None  # e.g. http://127.0.0.1:7070
+    access_key: Optional[str] = None
+    plugins: list = field(default_factory=list)
+
+
+@dataclass
+class EngineRuntime:
+    """Everything needed to answer queries; swapped atomically on /reload."""
+
+    instance: EngineInstance
+    engine: Any
+    engine_params: Any
+    algorithms: list[Any]
+    models: list[Any]
+    serving: Any
+    query_class: Optional[type]
+    started_at: _dt.datetime = field(
+        default_factory=lambda: _dt.datetime.now(_dt.timezone.utc)
+    )
+
+
+def build_runtime(storage: Storage, instance: EngineInstance) -> EngineRuntime:
+    """Re-hydrate a COMPLETED instance into a servable runtime (reference
+    createServerActorWithEngine, CreateServer.scala:206)."""
+    engine, engine_params, models = prepare_deploy_models(storage, instance)
+    algorithms = engine.make_algorithms(engine_params)
+    serving = engine.make_serving(engine_params)
+    query_class = algorithms[0].query_class() if algorithms else None
+    return EngineRuntime(
+        instance=instance,
+        engine=engine,
+        engine_params=engine_params,
+        algorithms=algorithms,
+        models=models,
+        serving=serving,
+        query_class=query_class,
+    )
+
+
+def latest_completed_runtime(
+    storage: Storage, engine_id: str, engine_version: str, variant_id: str
+) -> EngineRuntime:
+    instance = storage.get_meta_data_engine_instances().get_latest_completed(
+        engine_id, engine_version, variant_id
+    )
+    if instance is None:
+        raise RuntimeError(
+            f"no COMPLETED engine instance for {engine_id}/{engine_version}/"
+            f"{variant_id} — run train first"
+        )
+    return build_runtime(storage, instance)
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: _to_jsonable(v) for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    if hasattr(obj, "item") and not isinstance(obj, (str, bytes)):
+        try:
+            return obj.item()  # numpy scalar → python
+        except Exception:
+            pass
+    return obj
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "_Server"  # type: ignore[assignment]
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    def _respond(
+        self, status: int, body: Any, content_type: str = "application/json"
+    ) -> None:
+        data = (
+            body.encode()
+            if isinstance(body, str)
+            else json.dumps(body).encode()
+        )
+        self.send_response(status)
+        self.send_header("Content-Type", f"{content_type}; charset=UTF-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                self._respond(200, self.server.owner.status_html(), "text/html")
+            elif path == "/reload":
+                self.server.owner.reload()
+                self._respond(200, {"message": "Reload successful"})
+            elif path == "/stop":
+                self._respond(200, {"message": "Shutting down"})
+                threading.Thread(
+                    target=self.server.owner.stop, daemon=True
+                ).start()
+            else:
+                self._respond(404, {"message": "Not Found"})
+        except Exception as e:
+            log.exception("GET %s failed", path)
+            self._respond(500, {"message": str(e)})
+
+    def do_POST(self):
+        # drain the body up front — responding with it unread would desync
+        # HTTP/1.1 keep-alive connections
+        length = int(self.headers.get("Content-Length") or 0)
+        self._raw_body = self.rfile.read(length) if length else b""
+        path = self.path.split("?")[0].rstrip("/")
+        if path == "/queries.json":
+            self._queries()
+        elif path == "/reload":
+            try:
+                self.server.owner.reload()
+                self._respond(200, {"message": "Reload successful"})
+            except Exception as e:
+                log.exception("reload failed")
+                self._respond(500, {"message": str(e)})
+        else:
+            self._respond(404, {"message": "Not Found"})
+
+    def _queries(self):
+        """The serving hot path (reference CreateServer.scala:490-613)."""
+        owner = self.server.owner
+        t0 = time.perf_counter()
+        try:
+            raw = self._raw_body.decode()
+            try:
+                query_json = json.loads(raw or "null")
+            except json.JSONDecodeError as e:
+                raise _HttpError(400, f"invalid query JSON: {e}")
+            if not isinstance(query_json, dict):
+                raise _HttpError(400, "query must be a JSON object")
+
+            rt = owner.runtime  # snapshot — /reload swaps atomically
+            try:
+                query = (
+                    extract_params(rt.query_class, query_json)
+                    if rt.query_class is not None
+                    else query_json
+                )
+            except ParamsError as e:
+                raise _HttpError(400, str(e))
+
+            supplemented = rt.serving.supplement(query)
+            predictions = [
+                algo.predict(model, supplemented)
+                for algo, model in zip(rt.algorithms, rt.models)
+            ]
+            prediction = rt.serving.serve(supplemented, predictions)
+            result = _to_jsonable(prediction)
+
+            for plugin in owner.output_blockers:
+                result = plugin.process(query_json, result, {})
+
+            owner.bookkeep(time.perf_counter() - t0)
+            owner.feedback_async(query_json, result)
+            for plugin in owner.output_sniffers:
+                try:
+                    plugin.process(query_json, result, {})
+                except Exception:
+                    log.exception("output sniffer failed")
+            self._respond(200, result)
+        except _HttpError as e:
+            self._respond(e.status, {"message": e.message})
+        except Exception as e:
+            log.exception("query failed")
+            self._respond(500, {"message": str(e)})
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    owner: "QueryServer"
+
+
+class QueryServer:
+    """Deploy-server process: serves one engine variant's latest model."""
+
+    def __init__(
+        self,
+        storage: Storage,
+        runtime: EngineRuntime,
+        config: Optional[QueryServerConfig] = None,
+    ):
+        self.storage = storage
+        self.runtime = runtime
+        self.config = config or QueryServerConfig()
+        self.output_blockers = [
+            p for p in self.config.plugins
+            if getattr(p, "plugin_type", "") == OUTPUT_BLOCKER
+        ]
+        self.output_sniffers = [
+            p for p in self.config.plugins
+            if getattr(p, "plugin_type", "") == OUTPUT_SNIFFER
+        ]
+        self._server: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+        # bookkeeping (reference CreateServer.scala:418-420, 603-610)
+        self._lock = threading.Lock()
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def port(self) -> int:
+        assert self._server is not None, "server not started"
+        return self._server.server_address[1]
+
+    def start(self) -> int:
+        self._server = _Server((self.config.ip, self.config.port), _Handler)
+        self._server.owner = self
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="query-server", daemon=True
+        )
+        self._thread.start()
+        log.info(
+            "Query server for engine %s listening on %s:%s",
+            self.runtime.instance.engine_id, self.config.ip, self.port,
+        )
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def serve_forever(self) -> None:
+        self.start()
+        assert self._thread is not None
+        self._thread.join()
+
+    # -- reload (reference MasterActor ReloadServer, CreateServer.scala:337) --
+    def reload(self) -> None:
+        """Hot-swap to the latest COMPLETED instance; in-flight queries keep
+        the old runtime snapshot."""
+        inst = self.runtime.instance
+        new_runtime = latest_completed_runtime(
+            self.storage, inst.engine_id, inst.engine_version, inst.engine_variant
+        )
+        self.runtime = new_runtime  # atomic reference swap
+
+    # -- bookkeeping -------------------------------------------------------
+    def bookkeep(self, seconds: float) -> None:
+        with self._lock:
+            n = self.request_count
+            self.avg_serving_sec = (self.avg_serving_sec * n + seconds) / (n + 1)
+            self.request_count = n + 1
+            self.last_serving_sec = seconds
+
+    # -- feedback loop (reference CreateServer.scala:534-596) --------------
+    def feedback_async(self, query_json: dict, result: Any) -> None:
+        if not self.config.feedback:
+            return
+        if not (self.config.event_server_url and self.config.access_key):
+            log.warning("feedback enabled but event server url/key missing")
+            return
+
+        def post():
+            try:
+                pr_id = (
+                    result.get("pr_id")
+                    if isinstance(result, dict) and result.get("pr_id")
+                    else self.runtime.instance.id
+                )
+                event = {
+                    "event": "predict",
+                    "entityType": "pio_pr",
+                    "entityId": pr_id,
+                    "properties": {"query": query_json, "prediction": result},
+                    "prId": pr_id,
+                }
+                url = (
+                    f"{self.config.event_server_url}/events.json"
+                    f"?accessKey={self.config.access_key}"
+                )
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(event).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=10).read()
+            except Exception:
+                log.exception("feedback event POST failed")
+
+        threading.Thread(target=post, daemon=True).start()
+
+    # -- status page (reference CreateServer.scala:461-489 Twirl html) -----
+    def status_html(self) -> str:
+        rt = self.runtime
+        inst = rt.instance
+        with self._lock:
+            count, avg, last = (
+                self.request_count, self.avg_serving_sec, self.last_serving_sec,
+            )
+        algo_rows = "".join(
+            f"<tr><td>{type(a).__name__}</td><td>{name}</td>"
+            f"<td><code>{params!r}</code></td></tr>"
+            for a, (name, params) in zip(
+                rt.algorithms, rt.engine_params.algorithm_params_list
+            )
+        )
+        return f"""<!DOCTYPE html><html><head><title>{inst.engine_id} — predictionio_tpu</title></head>
+<body>
+<h1>Engine {inst.engine_id} ({inst.engine_variant})</h1>
+<table>
+<tr><td>Instance</td><td>{inst.id}</td></tr>
+<tr><td>Factory</td><td>{inst.engine_factory}</td></tr>
+<tr><td>Trained</td><td>{inst.end_time}</td></tr>
+<tr><td>Serving since</td><td>{rt.started_at}</td></tr>
+<tr><td>Requests</td><td>{count}</td></tr>
+<tr><td>Average serve time</td><td>{avg * 1000:.3f} ms</td></tr>
+<tr><td>Last serve time</td><td>{last * 1000:.3f} ms</td></tr>
+</table>
+<h2>Algorithms</h2>
+<table><tr><th>class</th><th>name</th><th>params</th></tr>{algo_rows}</table>
+<p><a href="/reload">reload model</a></p>
+</body></html>"""

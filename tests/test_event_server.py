@@ -1,0 +1,274 @@
+"""Black-box HTTP tests of the Event Server (ports of reference
+data/src/test/.../api/EventServiceSpec.scala + shell tests data/test.sh)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api.plugins import INPUT_BLOCKER
+from predictionio_tpu.data.api.server import EventServer, EventServerConfig
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+
+def req(port, path, method="GET", body=None, form=False):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = None
+    headers = {}
+    if body is not None:
+        if form:
+            from urllib.parse import urlencode
+
+            data = urlencode(body).encode()
+            headers["Content-Type"] = "application/x-www-form-urlencoded"
+        else:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+    r = urllib.request.Request(url, data=data, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode() or "null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "null")
+
+
+class RejectBlocker:
+    plugin_name = "reject-spam"
+    plugin_type = INPUT_BLOCKER
+
+    def process(self, event_json, context):
+        if event_json.get("event") == "spam":
+            raise ValueError("spam is blocked")
+
+
+@pytest.fixture()
+def server(fresh_storage):
+    apps = fresh_storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="srvapp"))
+    fresh_storage.get_events().init_app(app_id)
+    keys = fresh_storage.get_meta_data_access_keys()
+    keys.insert(AccessKey(key="KEY", app_id=app_id, events=()))
+    keys.insert(AccessKey(key="RATEONLY", app_id=app_id, events=("rate",)))
+    ch_id = fresh_storage.get_meta_data_channels().insert(
+        Channel(id=0, name="ch1", app_id=app_id)
+    )
+    fresh_storage.get_events().init_app(app_id, ch_id)
+    srv = EventServer(
+        fresh_storage,
+        EventServerConfig(ip="127.0.0.1", port=0, stats=True, plugins=[RejectBlocker()]),
+    )
+    port = srv.start()
+    yield port
+    srv.stop()
+
+
+EVENT = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.5},
+}
+
+
+def test_status_alive(server):
+    status, body = req(server, "/")
+    assert (status, body) == (200, {"status": "alive"})
+
+
+def test_auth_required_and_invalid(server):
+    status, body = req(server, "/events.json", "POST", EVENT)
+    assert status == 401
+    status, body = req(server, "/events.json?accessKey=WRONG", "POST", EVENT)
+    assert status == 401
+    assert "Invalid accessKey" in body["message"]
+
+
+def test_insert_get_delete_roundtrip(server):
+    status, body = req(server, "/events.json?accessKey=KEY", "POST", EVENT)
+    assert status == 201
+    eid = body["eventId"]
+
+    status, body = req(server, f"/events/{eid}.json?accessKey=KEY")
+    assert status == 200
+    assert body["event"] == "rate" and body["entityId"] == "u1"
+    assert body["properties"] == {"rating": 4.5}
+
+    status, body = req(server, f"/events/{eid}.json?accessKey=KEY", "DELETE")
+    assert (status, body) == (200, {"message": "Found"})
+    status, _ = req(server, f"/events/{eid}.json?accessKey=KEY")
+    assert status == 404
+
+
+def test_reserved_event_name_rejected(server):
+    bad = dict(EVENT, event="$asdf")
+    status, body = req(server, "/events.json?accessKey=KEY", "POST", bad)
+    assert status == 400
+    assert "reserved" in body["message"]
+
+
+def test_malformed_json_rejected(server):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{server}/events.json?accessKey=KEY",
+        data=b"{not json",
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(r, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_event_whitelist(server):
+    status, _ = req(server, "/events.json?accessKey=RATEONLY", "POST", EVENT)
+    assert status == 201
+    buy = dict(EVENT, event="buy")
+    status, body = req(server, "/events.json?accessKey=RATEONLY", "POST", buy)
+    assert status == 403
+    assert "not allowed" in body["message"]
+
+
+def test_channel_routing(server):
+    status, body = req(server, "/events.json?accessKey=KEY&channel=ch1", "POST", EVENT)
+    assert status == 201
+    # event lives in the channel namespace, not the default one
+    status, body = req(server, "/events.json?accessKey=KEY&channel=ch1")
+    assert status == 200 and len(body) == 1
+    status, body = req(server, "/events.json?accessKey=KEY&channel=nope", "POST", EVENT)
+    assert status == 401
+    assert "Invalid channel" in body["message"]
+
+
+def test_batch_mixed_and_limit(server):
+    batch = [EVENT, dict(EVENT, event="$bad"), dict(EVENT, entityId="")]
+    status, body = req(server, "/batch/events.json?accessKey=KEY", "POST", batch)
+    assert status == 200
+    assert [r["status"] for r in body] == [201, 400, 400]
+    assert "eventId" in body[0]
+
+    status, body = req(
+        server, "/batch/events.json?accessKey=KEY", "POST", [EVENT] * 51
+    )
+    assert status == 400
+    assert "less than or equal to 50" in body["message"]
+
+
+def test_get_events_filters(server):
+    for i in range(5):
+        req(
+            server,
+            "/events.json?accessKey=KEY",
+            "POST",
+            dict(EVENT, entityId=f"u{i}", event="view" if i % 2 else "rate"),
+        )
+    status, body = req(server, "/events.json?accessKey=KEY&event=rate")
+    assert status == 200
+    assert all(e["event"] == "rate" for e in body)
+    status, body = req(server, "/events.json?accessKey=KEY&limit=2")
+    assert len(body) == 2
+    status, body = req(server, "/events.json?accessKey=KEY&entityId=u3")
+    assert len(body) == 1 and body[0]["entityId"] == "u3"
+    status, _ = req(server, "/events.json?accessKey=KEY&entityId=ghost")
+    assert status == 404
+
+
+def test_stats(server):
+    req(server, "/events.json?accessKey=KEY", "POST", EVENT)
+    status, body = req(server, "/stats.json?accessKey=KEY")
+    assert status == 200
+    counts = body["hours"][0]["counts"]
+    assert any(c["event"] == "rate" and c["count"] >= 1 for c in counts)
+
+
+def test_input_blocker(server):
+    spam = dict(EVENT, event="spam")
+    status, body = req(server, "/events.json?accessKey=KEY", "POST", spam)
+    assert status == 403
+    assert "spam is blocked" in body["message"]
+    # and the event is NOT stored
+    status, _ = req(server, "/events.json?accessKey=KEY&event=spam")
+    assert status == 404
+
+
+def test_keepalive_error_then_success(server):
+    """An error response must drain the request body — otherwise the next
+    request on the same keep-alive connection desyncs."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server, timeout=10)
+    body = json.dumps(EVENT)
+    conn.request(
+        "POST", "/events.json?accessKey=WRONG", body=body,
+        headers={"Content-Type": "application/json"},
+    )
+    r1 = conn.getresponse()
+    r1.read()
+    assert r1.status == 401
+    conn.request(
+        "POST", "/events.json?accessKey=KEY", body=body,
+        headers={"Content-Type": "application/json"},
+    )
+    r2 = conn.getresponse()
+    out = json.loads(r2.read().decode())
+    assert r2.status == 201, out
+    conn.close()
+
+
+def test_webhooks_examplejson(server):
+    payload = {
+        "type": "userActionItem",
+        "userId": "as34smg4",
+        "itemId": "kfjd312bc",
+        "timestamp": "2026-01-02T00:30:12.984Z",
+        "properties": {"context": "mobile"},
+    }
+    status, body = req(
+        server, "/webhooks/examplejson.json?accessKey=KEY", "POST", payload
+    )
+    assert status == 201
+    eid = body["eventId"]
+    status, body = req(server, f"/events/{eid}.json?accessKey=KEY")
+    assert body["event"] == "userActionItem"
+    assert body["targetEntityId"] == "kfjd312bc"
+
+    # existence check + unknown connector
+    status, body = req(server, "/webhooks/examplejson.json?accessKey=KEY")
+    assert (status, body) == (200, {})
+    status, _ = req(server, "/webhooks/nope.json?accessKey=KEY")
+    assert status == 404
+
+
+def test_webhooks_segmentio(server):
+    payload = {
+        "type": "track",
+        "userId": "user123",
+        "event": "Signed Up",
+        "properties": {"plan": "Pro"},
+        "timestamp": "2026-02-23T22:28:55.111Z",
+    }
+    status, body = req(
+        server, "/webhooks/segmentio.json?accessKey=KEY", "POST", payload
+    )
+    assert status == 201
+    status, body = req(server, f"/events/{body['eventId']}.json?accessKey=KEY")
+    assert body["event"] == "track"
+    assert body["properties"]["event"] == "Signed Up"
+
+
+def test_webhooks_mailchimp_form(server):
+    form = {
+        "type": "subscribe",
+        "fired_at": "2026-02-23 21:35:57",
+        "data[id]": "8a25ff1d98",
+        "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com",
+    }
+    status, body = req(
+        server, "/webhooks/mailchimp.form?accessKey=KEY", "POST", form, form=True
+    )
+    assert status == 201
+    status, body = req(server, f"/events/{body['eventId']}.json?accessKey=KEY")
+    assert body["event"] == "subscribe"
+    assert body["entityId"] == "8a25ff1d98"

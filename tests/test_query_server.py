@@ -1,0 +1,183 @@
+"""Deploy-server HTTP tests: query serving, hot reload, feedback loop."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.api.server import EventServer, EventServerConfig
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import AccessKey, App
+from predictionio_tpu.workflow.core import run_train
+from predictionio_tpu.workflow.server import (
+    QueryServer,
+    QueryServerConfig,
+    latest_completed_runtime,
+)
+
+VARIANT = {
+    "id": "qsrv",
+    "engineFactory": "predictionio_tpu.engines.recommendation.RecommendationEngine",
+    "datasource": {"params": {"app_name": "qapp"}},
+    "algorithms": [
+        {"name": "als", "params": {"rank": 8, "num_iterations": 6}}
+    ],
+}
+
+
+def seed(storage, n_users=8, seed=0):
+    apps = storage.get_meta_data_apps()
+    app = apps.get_by_name("qapp")
+    app_id = app.id if app else apps.insert(App(id=0, name="qapp"))
+    events = storage.get_events()
+    events.init_app(app_id)
+    rng = np.random.RandomState(seed)
+    batch = []
+    for u in range(n_users):
+        for _ in range(20):
+            i = rng.randint(0, 5) + (u % 2) * 5
+            batch.append(
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties={"rating": 5.0},
+                )
+            )
+    events.insert_batch(batch, app_id)
+    return app_id
+
+
+def post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "null")
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=15
+    ) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture()
+def served(fresh_storage):
+    seed(fresh_storage)
+    run_train(fresh_storage, VARIANT)
+    runtime = latest_completed_runtime(fresh_storage, "qsrv", "0", "qsrv")
+    srv = QueryServer(
+        fresh_storage, runtime, QueryServerConfig(ip="127.0.0.1", port=0)
+    )
+    port = srv.start()
+    yield fresh_storage, srv, port
+    srv.stop()
+
+
+def test_queries(served):
+    _, srv, port = served
+    status, body = post(port, "/queries.json", {"user": "u0", "num": 3})
+    assert status == 200
+    assert len(body["item_scores"]) == 3
+    items = {s["item"] for s in body["item_scores"]}
+    assert items <= {f"i{i}" for i in range(5)}  # cohort-0 items
+
+    # unknown user → 200 with empty result (graceful)
+    status, body = post(port, "/queries.json", {"user": "ghost"})
+    assert status == 200 and body["item_scores"] == []
+
+
+def test_query_validation(served):
+    _, _, port = served
+    status, body = post(port, "/queries.json", {"user": "u0", "bogus": 1})
+    assert status == 400
+    assert "unknown params" in body["message"]
+
+    status, body = post(port, "/queries.json", [1, 2])
+    assert status == 400
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json", data=b"{nope",
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=15)
+    assert ei.value.code == 400
+
+
+def test_status_page_and_bookkeeping(served):
+    _, srv, port = served
+    post(port, "/queries.json", {"user": "u0"})
+    post(port, "/queries.json", {"user": "u1"})
+    status, html = get(port, "/")
+    assert status == 200
+    assert "qsrv" in html and "Requests" in html
+    assert srv.request_count == 2
+    assert srv.avg_serving_sec > 0
+
+
+def test_hot_reload_swaps_to_latest(served):
+    storage, srv, port = served
+    first_id = srv.runtime.instance.id
+    # new data + retrain → new COMPLETED instance
+    seed(storage, seed=1)
+    run_train(storage, VARIANT)
+    status, body = get(port, "/reload")
+    assert status == 200
+    assert srv.runtime.instance.id != first_id
+    status, body = post(port, "/queries.json", {"user": "u0", "num": 2})
+    assert status == 200 and len(body["item_scores"]) == 2
+
+
+def test_feedback_loop(fresh_storage):
+    app_id = seed(fresh_storage)
+    fresh_storage.get_meta_data_access_keys().insert(
+        AccessKey(key="FB", app_id=app_id, events=())
+    )
+    es = EventServer(
+        fresh_storage, EventServerConfig(ip="127.0.0.1", port=0)
+    )
+    es_port = es.start()
+    run_train(fresh_storage, VARIANT)
+    runtime = latest_completed_runtime(fresh_storage, "qsrv", "0", "qsrv")
+    srv = QueryServer(
+        fresh_storage,
+        runtime,
+        QueryServerConfig(
+            ip="127.0.0.1",
+            port=0,
+            feedback=True,
+            event_server_url=f"http://127.0.0.1:{es_port}",
+            access_key="FB",
+        ),
+    )
+    port = srv.start()
+    try:
+        status, _ = post(port, "/queries.json", {"user": "u0"})
+        assert status == 200
+        deadline = time.time() + 10
+        found = []
+        while time.time() < deadline and not found:
+            found = list(
+                fresh_storage.get_events().find_single_entity(
+                    app_id, "pio_pr", runtime.instance.id,
+                    event_names=["predict"],
+                )
+            )
+            time.sleep(0.1)
+        assert found, "feedback predict event never arrived"
+        props = found[0].properties
+        assert props.get_opt("query", dict) == {"user": "u0"}
+    finally:
+        srv.stop()
+        es.stop()

@@ -115,6 +115,38 @@ def test_whitelist_blacklist(seeded_storage):
     assert not ({"i0", "i1"} & {s.item for s in bl.item_scores})
 
 
+def test_categories_filter(seeded_storage):
+    # tag items with category $set properties, retrain, filter
+    app_id = seeded_storage.get_meta_data_apps().get_by_name("testapp").id
+    events = seeded_storage.get_events()
+    for i in range(8):
+        events.insert(
+            Event(
+                event="$set",
+                entity_type="item",
+                entity_id=f"i{i}",
+                properties={"categories": ["even" if i % 2 == 0 else "odd"]},
+            ),
+            app_id,
+        )
+    inst = run_train(seeded_storage, VARIANT)
+    stored = seeded_storage.get_meta_data_engine_instances().get(inst.id)
+    engine, ep, models = prepare_deploy_models(seeded_storage, stored)
+    algo = engine.make_algorithms(ep)[0]
+
+    pred = algo.predict(models[0], Query(user="u0", num=8, categories=["even"]))
+    items = {s.item for s in pred.item_scores}
+    assert items and all(int(it[1:]) % 2 == 0 for it in items), items
+
+    # categories AND blacklist compose
+    pred = algo.predict(
+        models[0],
+        Query(user="u0", num=8, categories=["even"], blacklist=["i0"]),
+    )
+    items = {s.item for s in pred.item_scores}
+    assert "i0" not in items and all(int(it[1:]) % 2 == 0 for it in items)
+
+
 def test_batch_predict_matches_single(seeded_storage):
     inst = run_train(seeded_storage, VARIANT)
     stored = seeded_storage.get_meta_data_engine_instances().get(inst.id)
