@@ -90,7 +90,17 @@ class PersistentModelManifest:
 
 def doer(cls: type, params: Any) -> Any:
     """Instantiate a controller class: with its Params if the constructor
-    takes one, else zero-arg (reference Doer.apply, AbstractDoer.scala:32-66)."""
+    declares one, else zero-arg (reference Doer.apply, AbstractDoer.scala:32-66).
+
+    The decision mirrors the reference's constructor-type check: a first
+    positional parameter ANNOTATED as a params dataclass receives the
+    params object (defaulted or not); a required positional without such
+    an annotation also receives it (duck-typed templates); a constructor
+    with only defaulted non-params arguments is called zero-arg."""
+    from predictionio_tpu.controller.params import params_class_of
+
+    if params_class_of(cls) is not None:
+        return cls(params)
     try:
         sig = inspect.signature(cls.__init__)
     except (TypeError, ValueError):
@@ -100,7 +110,7 @@ def doer(cls: type, params: Any) -> Any:
         for name, p in sig.parameters.items()
         if name != "self"
         and p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)
-        and p.default is p.empty  # defaulted args don't want a Params object
+        and p.default is p.empty
     )
     if n_required >= 1:
         return cls(params)

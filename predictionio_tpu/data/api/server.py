@@ -21,12 +21,9 @@ from __future__ import annotations
 
 import base64
 import datetime as _dt
-import json
 import logging
-import threading
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Optional
 from urllib.parse import parse_qsl, urlsplit
 
 from predictionio_tpu.data.api.plugins import PluginContext
@@ -39,6 +36,12 @@ from predictionio_tpu.data.api.webhooks import (
 from predictionio_tpu.data.event import Event, EventValidation, ValidationError
 from predictionio_tpu.data.storage.base import EventQuery
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.utils.http import (
+    HttpError as _HttpError,
+    JsonHandler,
+    ServerProcess,
+    ThreadedServer,
+)
 
 log = logging.getLogger(__name__)
 
@@ -62,13 +65,6 @@ class AuthData:
     events: tuple[str, ...]  # allowed event names; empty = all
 
 
-class _HttpError(Exception):
-    def __init__(self, status: int, message: str):
-        super().__init__(message)
-        self.status = status
-        self.message = message
-
-
 def _parse_iso(s: str) -> _dt.datetime:
     t = _dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
     if t.tzinfo is None:
@@ -76,33 +72,8 @@ def _parse_iso(s: str) -> _dt.datetime:
     return t
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHandler):
     server: "_Server"  # type: ignore[assignment]
-    protocol_version = "HTTP/1.1"
-
-    # -- plumbing ----------------------------------------------------------
-    def log_message(self, fmt, *args):  # route through logging, not stderr
-        log.debug("%s " + fmt, self.address_string(), *args)
-
-    def _respond(self, status: int, body: Any) -> None:
-        data = json.dumps(body).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=UTF-8")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
-    def _body(self) -> bytes:
-        # body is drained eagerly in _route; an unread body would desync
-        # HTTP/1.1 keep-alive (the next request would parse it as a
-        # request line)
-        return self._raw_body
-
-    def _json_body(self) -> Any:
-        try:
-            return json.loads(self._body().decode() or "null")
-        except json.JSONDecodeError as e:
-            raise _HttpError(400, f"invalid JSON: {e}")
 
     def _form_body(self) -> dict[str, str]:
         return dict(parse_qsl(self._body().decode(), keep_blank_values=True))
@@ -165,8 +136,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes ------------------------------------------------------------
     def _route(self, method: str) -> None:
-        length = int(self.headers.get("Content-Length") or 0)
-        self._raw_body = self.rfile.read(length) if length else b""
+        self._drain_body()
         url = urlsplit(self.path)
         query = dict(parse_qsl(url.query))
         path = url.path.rstrip("/") or "/"
@@ -313,7 +283,9 @@ class _Handler(BaseHTTPRequestHandler):
                 if not isinstance(payload, dict):
                     raise _HttpError(400, "webhook payload must be a JSON object")
                 event_json = connector.to_event_json(payload)
-        except ConnectorException as e:
+        except (ConnectorException, KeyError) as e:
+            # KeyError backstops third-party connectors that index payload
+            # fields directly — a malformed payload is a 400, not a 500
             raise _HttpError(400, str(e))
         event_json = {k: v for k, v in event_json.items() if v is not None}
         event_id = self._insert_event(auth, event_json)
@@ -330,10 +302,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._route("DELETE")
 
 
-class _Server(ThreadingHTTPServer):
-    daemon_threads = True
-    allow_reuse_address = True
-
+class _Server(ThreadedServer):
     def __init__(self, addr, storage: Storage, config: EventServerConfig):
         super().__init__(addr, _Handler)
         self.storage = storage
@@ -341,46 +310,23 @@ class _Server(ThreadingHTTPServer):
         self.plugin_context = PluginContext(config.plugins)
 
 
-class EventServer:
+class EventServer(ServerProcess):
     """Process wrapper: start/stop the ingestion HTTP server (reference
-    EventServerActor + Run, EventServer.scala:580-640)."""
+    EventServerActor + Run, EventServer.scala:580-640). config.port=0
+    binds an ephemeral port (tests)."""
+
+    _name = "event-server"
 
     def __init__(
         self,
         storage: Optional[Storage] = None,
         config: Optional[EventServerConfig] = None,
     ):
+        super().__init__()
         self.storage = storage or Storage.get_instance()
         self.config = config or EventServerConfig()
-        self._server: Optional[_Server] = None
-        self._thread: Optional[threading.Thread] = None
 
-    @property
-    def port(self) -> int:
-        assert self._server is not None, "server not started"
-        return self._server.server_address[1]
-
-    def start(self) -> int:
-        """Bind and serve in a background thread; returns the bound port
-        (config.port=0 → ephemeral, for tests)."""
-        self._server = _Server(
+    def _make_server(self) -> _Server:
+        return _Server(
             (self.config.ip, self.config.port), self.storage, self.config
         )
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="event-server", daemon=True
-        )
-        self._thread.start()
-        log.info("Event Server listening on %s:%s", self.config.ip, self.port)
-        return self.port
-
-    def stop(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
-
-    def serve_forever(self) -> None:
-        """Foreground mode for the CLI `eventserver` command."""
-        self.start()
-        assert self._thread is not None
-        self._thread.join()

@@ -38,26 +38,26 @@ class ExampleJsonConnector:
     def to_event_json(self, payload: dict) -> dict:
         try:
             typ = payload["type"]
-        except KeyError:
-            raise ConnectorException("missing 'type' in payload")
-        if typ == "userAction":
-            return {
-                "event": payload["type"],
-                "entityType": "user",
-                "entityId": str(payload["userId"]),
-                "properties": payload.get("properties", {}),
-                "eventTime": payload.get("timestamp"),
-            }
-        if typ == "userActionItem":
-            return {
-                "event": payload["type"],
-                "entityType": "user",
-                "entityId": str(payload["userId"]),
-                "targetEntityType": "item",
-                "targetEntityId": str(payload["itemId"]),
-                "properties": payload.get("properties", {}),
-                "eventTime": payload.get("timestamp"),
-            }
+            if typ == "userAction":
+                return {
+                    "event": payload["type"],
+                    "entityType": "user",
+                    "entityId": str(payload["userId"]),
+                    "properties": payload.get("properties", {}),
+                    "eventTime": payload.get("timestamp"),
+                }
+            if typ == "userActionItem":
+                return {
+                    "event": payload["type"],
+                    "entityType": "user",
+                    "entityId": str(payload["userId"]),
+                    "targetEntityType": "item",
+                    "targetEntityId": str(payload["itemId"]),
+                    "properties": payload.get("properties", {}),
+                    "eventTime": payload.get("timestamp"),
+                }
+        except KeyError as e:
+            raise ConnectorException(f"missing {e.args[0]!r} in payload")
         raise ConnectorException(f"cannot process payload type {typ!r}")
 
 
@@ -67,23 +67,21 @@ class ExampleFormConnector:
     def to_event_json_from_form(self, form: Mapping[str, str]) -> dict:
         try:
             typ = form["type"]
-        except KeyError:
-            raise ConnectorException("missing 'type' in form data")
-        if typ == "userAction":
-            props = {}
-            if "context" in form:
-                props["context"] = form["context"]
-            if "anotherProperty1" in form:
-                props["anotherProperty1"] = form["anotherProperty1"]
-            if "anotherProperty2" in form:
-                props["anotherProperty2"] = form["anotherProperty2"]
-            return {
-                "event": typ,
-                "entityType": "user",
-                "entityId": form["userId"],
-                "properties": props,
-                "eventTime": form.get("timestamp"),
-            }
+            if typ == "userAction":
+                props = {
+                    k: form[k]
+                    for k in ("context", "anotherProperty1", "anotherProperty2")
+                    if k in form
+                }
+                return {
+                    "event": typ,
+                    "entityType": "user",
+                    "entityId": form["userId"],
+                    "properties": props,
+                    "eventTime": form.get("timestamp"),
+                }
+        except KeyError as e:
+            raise ConnectorException(f"missing {e.args[0]!r} in form data")
         raise ConnectorException(f"cannot process form type {typ!r}")
 
 

@@ -1,0 +1,20 @@
+"""L7 — reusable algorithm/eval library (reference e2/src/main/scala/io/prediction/e2/)."""
+
+from predictionio_tpu.e2.naive_bayes import (
+    CategoricalNaiveBayes,
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+)
+from predictionio_tpu.e2.markov_chain import MarkovChain, MarkovChainModel
+from predictionio_tpu.e2.vectorizer import BinaryVectorizer
+from predictionio_tpu.e2.cross_validation import split_data
+
+__all__ = [
+    "BinaryVectorizer",
+    "CategoricalNaiveBayes",
+    "CategoricalNaiveBayesModel",
+    "LabeledPoint",
+    "MarkovChain",
+    "MarkovChainModel",
+    "split_data",
+]

@@ -31,6 +31,7 @@ from predictionio_tpu.controller import (
     IdentityPreparator,
     SanityCheck,
 )
+from predictionio_tpu.controller.metrics import OptionAverageMetric
 from predictionio_tpu.core.base import RuntimeContext
 from predictionio_tpu.data.store.event_store import EventStoreFacade
 from predictionio_tpu.models import als
@@ -77,6 +78,11 @@ class DataSourceParams:
     rate_event: str = "rate"  # carries a "rating" property; others weight 1.0
     eval_k: int = 0  # >0 enables read_eval with k folds
     goal_threshold: float = 4.0  # rating >= threshold counts as relevant
+    eval_num: int = 20  # top-N requested per eval query (≥ the metric's k)
+    # read item $set properties for category filtering (the reference keeps
+    # this in a separate filter-by-category variant; off by default so the
+    # plain variant pays no extra event-store scan)
+    read_item_categories: bool = False
 
 
 @dataclass
@@ -137,6 +143,8 @@ class RecommendationDataSource(DataSource):
     def _item_categories(
         self, ctx: RuntimeContext, item_vocab
     ) -> Optional[list[frozenset]]:
+        if not self.params.read_item_categories:
+            return None
         store = EventStoreFacade(ctx.storage)
         props = store.aggregate_properties(
             app_name=self.params.app_name, entity_type="item"
@@ -197,7 +205,13 @@ class RecommendationDataSource(DataSource):
                 relevant = [inv_item(int(c)) for c in t_cols[m]]
                 if relevant:
                     qa.append(
-                        (Query(user=inv_user(int(u))), ActualResult(relevant))
+                        (
+                            Query(
+                                user=inv_user(int(u)),
+                                num=self.params.eval_num,
+                            ),
+                            ActualResult(relevant),
+                        )
                     )
             out.append((td, EvalInfo(fold=fold), qa))
         return out
@@ -357,6 +371,26 @@ class ALSAlgorithm(Algorithm):
     def batch_predict(self, ctx, model: ALSModel, queries):
         preds = self._predict_batch(model, [q for _, q in queries])
         return [(qx, p) for (qx, _q), p in zip(queries, preds)]
+
+
+# -- evaluation -------------------------------------------------------------
+
+
+class PrecisionAtK(OptionAverageMetric):
+    """|top-k ∩ relevant| / k, averaged over users with relevant items
+    (the standard tuning metric for the recommendation template)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def header(self) -> str:
+        return f"Precision@{self.k}"
+
+    def calculate_one(self, q: Query, p: PredictedResult, a: ActualResult):
+        if not a.items:
+            return None
+        top = {s.item for s in p.item_scores[: self.k]}
+        return len(top & set(a.items)) / self.k
 
 
 # -- engine factory ---------------------------------------------------------

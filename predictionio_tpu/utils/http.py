@@ -1,0 +1,107 @@
+"""Shared threaded-HTTP plumbing for the framework's server processes.
+
+Both the Event Server (data/api/server.py) and the deploy query server
+(workflow/server.py) are stdlib ThreadingHTTPServer processes with the
+same needs: JSON responses, eager body drain (an unread POST body desyncs
+HTTP/1.1 keep-alive — the next request parses it as a request line),
+routed logging, and a start/stop/port lifecycle."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+
+class HttpError(Exception):
+    """Raise inside a handler to produce a JSON error response."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Base handler: drains the body before dispatch, JSON helpers."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    def handle_one_request(self):
+        self._raw_body = b""
+        super().handle_one_request()
+
+    def _drain_body(self) -> None:
+        length = int(self.headers.get("Content-Length") or 0)
+        self._raw_body = self.rfile.read(length) if length else b""
+
+    def _body(self) -> bytes:
+        return self._raw_body
+
+    def _json_body(self) -> Any:
+        try:
+            return json.loads(self._body().decode() or "null")
+        except json.JSONDecodeError as e:
+            raise HttpError(400, f"invalid JSON: {e}")
+
+    def _respond(
+        self, status: int, body: Any, content_type: str = "application/json"
+    ) -> None:
+        data = (
+            body.encode() if isinstance(body, str) else json.dumps(body).encode()
+        )
+        self.send_response(status)
+        self.send_header("Content-Type", f"{content_type}; charset=UTF-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class ThreadedServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ServerProcess:
+    """start/stop/port lifecycle shared by server processes. Subclasses
+    implement `_make_server() -> ThreadedServer` and set `_name`."""
+
+    _name = "http-server"
+
+    def __init__(self):
+        self._server: Optional[ThreadedServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _make_server(self) -> ThreadedServer:
+        raise NotImplementedError
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None, "server not started"
+        return self._server.server_address[1]
+
+    def start(self) -> int:
+        self._server = self._make_server()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=self._name, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def serve_forever(self) -> None:
+        self.start()
+        assert self._thread is not None
+        self._thread.join()

@@ -1,0 +1,78 @@
+"""Evaluation workflow: grid batch-eval → evaluator → EvaluationInstance.
+
+Reference: CoreWorkflow.runEvaluation (CoreWorkflow.scala:101-163) +
+EvaluationWorkflow.runEvaluation (EvaluationWorkflow.scala:29-41) +
+CreateWorkflow eval branch (CreateWorkflow.scala:253-272)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import uuid
+from typing import Any, Optional, Sequence
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.controller.evaluation import Evaluation
+from predictionio_tpu.core.base import RuntimeContext, WorkflowParams
+from predictionio_tpu.data.storage.base import EvaluationInstance
+from predictionio_tpu.data.storage.registry import Storage
+
+log = logging.getLogger(__name__)
+
+
+def run_evaluation(
+    storage: Storage,
+    evaluation: Evaluation,
+    engine_params_list: Optional[Sequence[EngineParams]] = None,
+    workflow_params: Optional[WorkflowParams] = None,
+    mesh: Any = None,
+) -> tuple[EvaluationInstance, Any]:
+    """Evaluate every grid point and store the evaluator's rendered results.
+
+    Returns (EVALCOMPLETED instance row, evaluator result)."""
+    wp = workflow_params or WorkflowParams()
+    engine = evaluation.get_engine()
+    evaluator = evaluation.get_evaluator()
+    if engine_params_list is None:
+        engine_params_list = getattr(evaluation, "engine_params_list", None)
+    if not engine_params_list:
+        raise ValueError(
+            "no engine params to evaluate — pass engine_params_list or use "
+            "an EngineParamsGenerator"
+        )
+
+    instances = storage.get_meta_data_evaluation_instances()
+    now = _dt.datetime.now(_dt.timezone.utc)
+    instance = EvaluationInstance(
+        id=str(uuid.uuid4()),
+        status="INIT",
+        start_time=now,
+        end_time=now,
+        evaluation_class=type(evaluation).__module__
+        + "."
+        + type(evaluation).__qualname__,
+        batch=wp.batch,
+    )
+    instance_id = instances.insert(instance)
+    instance.id = instance_id
+
+    ctx = RuntimeContext(storage=storage, mesh=mesh, mode="eval", workflow_params=wp)
+    try:
+        instance.status = "EVALRUNNING"
+        instances.update(instance)
+        engine_eval_data = engine.batch_eval(ctx, list(engine_params_list))
+        result = evaluator.evaluate(ctx, evaluation, engine_eval_data, wp)
+        if not getattr(result, "no_save", False):
+            instance.evaluator_results = result.to_one_liner()
+            instance.evaluator_results_html = result.to_html()
+            instance.evaluator_results_json = result.to_json()
+        instance.status = "EVALCOMPLETED"
+        instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+        instances.update(instance)
+        log.info("evaluation completed: %s — %s", instance_id, result.to_one_liner())
+        return instance, result
+    except Exception:
+        instance.status = "EVALABORTED"
+        instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+        instances.update(instance)
+        raise

@@ -22,12 +22,17 @@ import threading
 import time
 import urllib.request
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from predictionio_tpu.controller.params import ParamsError, extract_params
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.utils.http import (
+    HttpError as _HttpError,
+    JsonHandler,
+    ServerProcess,
+    ThreadedServer,
+)
 from predictionio_tpu.workflow.core import prepare_deploy_models
 
 log = logging.getLogger(__name__)
@@ -110,35 +115,11 @@ def _to_jsonable(obj: Any) -> Any:
     return obj
 
 
-class _HttpError(Exception):
-    def __init__(self, status: int, message: str):
-        super().__init__(message)
-        self.status = status
-        self.message = message
-
-
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHandler):
     server: "_Server"  # type: ignore[assignment]
-    protocol_version = "HTTP/1.1"
-
-    def log_message(self, fmt, *args):
-        log.debug("%s " + fmt, self.address_string(), *args)
-
-    def _respond(
-        self, status: int, body: Any, content_type: str = "application/json"
-    ) -> None:
-        data = (
-            body.encode()
-            if isinstance(body, str)
-            else json.dumps(body).encode()
-        )
-        self.send_response(status)
-        self.send_header("Content-Type", f"{content_type}; charset=UTF-8")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
 
     def do_GET(self):
+        self._drain_body()
         path = self.path.split("?")[0].rstrip("/") or "/"
         try:
             if path == "/":
@@ -158,10 +139,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(500, {"message": str(e)})
 
     def do_POST(self):
-        # drain the body up front — responding with it unread would desync
-        # HTTP/1.1 keep-alive connections
-        length = int(self.headers.get("Content-Length") or 0)
-        self._raw_body = self.rfile.read(length) if length else b""
+        self._drain_body()
         path = self.path.split("?")[0].rstrip("/")
         if path == "/queries.json":
             self._queries()
@@ -224,14 +202,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(500, {"message": str(e)})
 
 
-class _Server(ThreadingHTTPServer):
-    daemon_threads = True
-    allow_reuse_address = True
+class _Server(ThreadedServer):
     owner: "QueryServer"
 
 
-class QueryServer:
+class QueryServer(ServerProcess):
     """Deploy-server process: serves one engine variant's latest model."""
+
+    _name = "query-server"
 
     def __init__(
         self,
@@ -239,6 +217,7 @@ class QueryServer:
         runtime: EngineRuntime,
         config: Optional[QueryServerConfig] = None,
     ):
+        super().__init__()
         self.storage = storage
         self.runtime = runtime
         self.config = config or QueryServerConfig()
@@ -250,43 +229,16 @@ class QueryServer:
             p for p in self.config.plugins
             if getattr(p, "plugin_type", "") == OUTPUT_SNIFFER
         ]
-        self._server: Optional[_Server] = None
-        self._thread: Optional[threading.Thread] = None
         # bookkeeping (reference CreateServer.scala:418-420, 603-610)
         self._lock = threading.Lock()
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
 
-    # -- lifecycle ---------------------------------------------------------
-    @property
-    def port(self) -> int:
-        assert self._server is not None, "server not started"
-        return self._server.server_address[1]
-
-    def start(self) -> int:
-        self._server = _Server((self.config.ip, self.config.port), _Handler)
-        self._server.owner = self
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="query-server", daemon=True
-        )
-        self._thread.start()
-        log.info(
-            "Query server for engine %s listening on %s:%s",
-            self.runtime.instance.engine_id, self.config.ip, self.port,
-        )
-        return self.port
-
-    def stop(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
-
-    def serve_forever(self) -> None:
-        self.start()
-        assert self._thread is not None
-        self._thread.join()
+    def _make_server(self) -> _Server:
+        server = _Server((self.config.ip, self.config.port), _Handler)
+        server.owner = self
+        return server
 
     # -- reload (reference MasterActor ReloadServer, CreateServer.scala:337) --
     def reload(self) -> None:

@@ -1,0 +1,123 @@
+"""e2 library tests (ports of reference CategoricalNaiveBayesTest,
+MarkovChainTest, BinaryVectorizerTest, CrossValidationTest)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    LabeledPoint,
+    MarkovChain,
+    split_data,
+)
+
+
+# fixture modeled on reference NaiveBayesFixture (sunny/rainy play tennis)
+POINTS = [
+    LabeledPoint("yes", ("sunny", "hot")),
+    LabeledPoint("yes", ("sunny", "mild")),
+    LabeledPoint("yes", ("overcast", "mild")),
+    LabeledPoint("no", ("rainy", "hot")),
+]
+
+
+class TestCategoricalNaiveBayes:
+    def test_priors_and_likelihoods(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.priors["yes"] == pytest.approx(math.log(3 / 4))
+        assert model.priors["no"] == pytest.approx(math.log(1 / 4))
+        assert model.likelihoods["yes"][0]["sunny"] == pytest.approx(
+            math.log(2 / 3)
+        )
+        assert model.likelihoods["no"][1]["hot"] == pytest.approx(math.log(1.0))
+
+    def test_predict(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.predict(("sunny", "mild")) == "yes"
+        assert model.predict(("rainy", "hot")) == "no"
+
+    def test_log_score_unknown_label_and_default(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.log_score(LabeledPoint("maybe", ("sunny", "hot"))) is None
+        # unseen feature value → -inf without a default
+        s = model.log_score(LabeledPoint("yes", ("foggy", "hot")))
+        assert s == float("-inf")
+        # with a default likelihood: min of knowns minus 1
+        s = model.log_score(
+            LabeledPoint("yes", ("foggy", "hot")),
+            default_likelihood=lambda ls: min(ls) - 1.0,
+        )
+        assert s is not None and s > float("-inf")
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            CategoricalNaiveBayes.train([])
+
+
+class TestMarkovChain:
+    def test_row_normalized_topn(self):
+        # transitions: 0→1 ×3, 0→2 ×1, 1→2 ×2
+        model = MarkovChain.train(
+            np.array([0, 0, 1]), np.array([1, 2, 2]), np.array([3, 1, 2]),
+            n_states=3, top_n=2,
+        )
+        assert model.transition[0] == pytest.approx([0, 0.75, 0.25])
+        assert model.transition[1] == pytest.approx([0, 0, 1.0])
+        assert model.transition[2] == pytest.approx([0, 0, 0])  # unseen row
+
+    def test_topn_prunes(self):
+        model = MarkovChain.train(
+            np.array([0, 0, 0]), np.array([0, 1, 2]), np.array([5, 3, 1]),
+            n_states=3, top_n=2,
+        )
+        # smallest entry (0→2) pruned, rest renormalized
+        assert model.transition[0] == pytest.approx([5 / 8, 3 / 8, 0])
+
+    def test_predict(self):
+        model = MarkovChain.train(
+            np.array([0, 1]), np.array([1, 0]), np.array([1, 1]),
+            n_states=2, top_n=2,
+        )
+        out = model.predict(np.array([1.0, 0.0]))
+        assert out == pytest.approx([0.0, 1.0])
+
+
+class TestBinaryVectorizer:
+    def test_fit_and_encode(self):
+        maps = [{"color": "red", "size": "L"}, {"color": "blue", "size": "L"}]
+        vec = BinaryVectorizer.fit(maps, ["color", "size"])
+        assert vec.num_features == 3  # (color,red),(color,blue),(size,L)
+        v = vec.to_binary({"color": "red", "size": "L"})
+        assert v.sum() == 2.0
+        # unseen value and unindexed property are ignored
+        v2 = vec.to_binary({"color": "green", "weight": "9"})
+        assert v2.sum() == 0.0
+
+    def test_property_restriction(self):
+        vec = BinaryVectorizer.fit([{"a": "1", "b": "2"}], ["a"])
+        assert set(vec.index) == {("a", "1")}
+
+    def test_to_matrix(self):
+        maps = [{"a": "1"}, {"a": "2"}]
+        vec = BinaryVectorizer.fit(maps, ["a"])
+        m = vec.to_matrix(maps)
+        assert m.shape == (2, 2)
+        assert m.sum() == 2.0
+
+
+class TestSplitData:
+    def test_folds_partition(self):
+        data = list(range(10))
+        folds = split_data(3, data)
+        assert len(folds) == 3
+        for train, test in folds:
+            assert sorted(train + test) == data
+        all_test = [x for _, test in folds for x in test]
+        assert sorted(all_test) == data  # each element tested exactly once
+
+    def test_bad_k(self):
+        with pytest.raises(ValueError):
+            split_data(0, [1])

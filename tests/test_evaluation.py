@@ -1,0 +1,202 @@
+"""Metric family, MetricEvaluator, FastEvalEngine, eval workflow tests
+(ports of reference MetricTest / MetricEvaluatorTest / FastEvalEngineTest /
+EvaluationTest)."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.controller import EmptyParams, EngineParams, RuntimeContext
+from predictionio_tpu.controller.evaluation import (
+    Evaluation,
+    MetricEvaluator,
+)
+from predictionio_tpu.controller.fast_eval import FastEvalEngine
+from predictionio_tpu.controller.metrics import (
+    AverageMetric,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.core.base import WorkflowParams
+from predictionio_tpu.workflow.evaluation import run_evaluation
+
+import sample_engine as se
+
+
+# QPA data: q stamps flow from the fake engines; here metrics just see ints
+def eval_data(*sets):
+    """sets of [(q, p, a)] where each is an int triple."""
+    return [(se.EvalInfo(id=i), list(s)) for i, s in enumerate(sets)]
+
+
+class DiffMetric(AverageMetric):
+    """|p - a| as error (lower is better)."""
+
+    higher_is_better = False
+
+    def calculate_one(self, q, p, a):
+        return abs(p - a)
+
+
+class MatchMetric(OptionAverageMetric):
+    def calculate_one(self, q, p, a):
+        if a is None:
+            return None
+        return 1.0 if p == a else 0.0
+
+
+class TestMetrics:
+    DATA = eval_data([(1, 2, 2), (2, 4, 2)], [(3, 6, 6)])
+
+    def test_average(self):
+        class M(AverageMetric):
+            def calculate_one(self, q, p, a):
+                return p
+
+        assert M().calculate(RuntimeContext(), self.DATA) == pytest.approx(4.0)
+
+    def test_option_average_skips_none(self):
+        data = eval_data([(1, 5, 5), (2, 5, None), (3, 5, 3)])
+        assert MatchMetric().calculate(RuntimeContext(), data) == pytest.approx(0.5)
+
+    def test_stdev(self):
+        class M(StdevMetric):
+            def calculate_one(self, q, p, a):
+                return p
+
+        assert M().calculate(RuntimeContext(), self.DATA) == pytest.approx(
+            1.632993, abs=1e-5
+        )
+
+    def test_sum_and_zero(self):
+        class M(SumMetric):
+            def calculate_one(self, q, p, a):
+                return p
+
+        assert M().calculate(RuntimeContext(), self.DATA) == 12.0
+        assert ZeroMetric().calculate(RuntimeContext(), self.DATA) == 0.0
+
+    def test_nan_never_wins(self):
+        """A grid point whose metric is NaN (no defined scores) must lose
+        to any real score — regardless of position in the grid."""
+        data_nan = eval_data([(1, 5, None)])
+        data_real = eval_data([(1, 5, 5), (2, 5, 3)])
+        m = MatchMetric()
+        nan_score = m.calculate(RuntimeContext(), data_nan)
+        real_score = m.calculate(RuntimeContext(), data_real)
+        assert m.compare(real_score, nan_score) > 0
+        assert m.compare(nan_score, real_score) < 0
+        assert m.compare(nan_score, nan_score) == 0
+
+    def test_compare_direction(self):
+        m = DiffMetric()
+        assert m.compare(0.1, 0.5) > 0  # lower error is better
+        class Up(AverageMetric):
+            def calculate_one(self, q, p, a):
+                return p
+
+        assert Up().compare(0.5, 0.1) > 0
+
+
+def ep_with_algo(algo_id: int) -> EngineParams:
+    return EngineParams(
+        data_source_params=("", se.DSP(id=1)),
+        preparator_params=("", se.PP(id=2)),
+        algorithm_params_list=(("algo0", se.AP(id=algo_id)),),
+        serving_params=("", EmptyParams()),
+    )
+
+
+class AlgoIdMetric(AverageMetric):
+    """Scores the algo_id stamped into predictions — deterministic ranking
+    of grid points."""
+
+    def calculate_one(self, q, p, a):
+        return p.algo_id
+
+
+class TestMetricEvaluator:
+    def test_picks_best_and_writes_best_json(self, tmp_path):
+        engine = se.Engine0Factory().apply()
+        grid = [ep_with_algo(i) for i in (1, 5, 3)]
+        ctx = RuntimeContext()
+        data = engine.batch_eval(ctx, grid)
+        out = tmp_path / "best.json"
+        evaluator = MetricEvaluator(
+            AlgoIdMetric(), [ZeroMetric()], output_path=str(out)
+        )
+        result = evaluator.evaluate(ctx, None, data, WorkflowParams())
+        assert result.best_index == 1
+        assert result.best_score.score == 5.0
+        assert "AlgoIdMetric" in result.to_one_liner()
+        best = json.loads(out.read_text())
+        assert best["algorithms"][0]["params"]["id"] == 5
+        parsed = json.loads(result.to_json())
+        assert parsed["bestScore"] == 5.0
+        assert len(parsed["scores"]) == 3
+
+
+class TestEvaluationWorkflow:
+    def test_run_evaluation_lifecycle(self, fresh_storage):
+        class MyEval(Evaluation):
+            engine = se.Engine0Factory().apply()
+            metric = AlgoIdMetric()
+
+        inst, result = run_evaluation(
+            fresh_storage, MyEval(), [ep_with_algo(i) for i in (2, 7)]
+        )
+        assert inst.status == "EVALCOMPLETED"
+        stored = fresh_storage.get_meta_data_evaluation_instances().get(inst.id)
+        assert stored.status == "EVALCOMPLETED"
+        assert "7.0" in stored.evaluator_results
+        assert json.loads(stored.evaluator_results_json)["bestScore"] == 7.0
+        completed = (
+            fresh_storage.get_meta_data_evaluation_instances().get_completed()
+        )
+        assert [c.id for c in completed] == [inst.id]
+
+    def test_no_grid_raises(self, fresh_storage):
+        class MyEval(Evaluation):
+            engine = se.Engine0Factory().apply()
+            metric = AlgoIdMetric()
+
+        with pytest.raises(ValueError, match="no engine params"):
+            run_evaluation(fresh_storage, MyEval())
+
+
+class TestFastEvalEngine:
+    def make(self):
+        from predictionio_tpu.controller import FirstServing
+
+        return FastEvalEngine(
+            se.DataSource0,
+            se.Preparator0,
+            {"algo0": se.Algo0, "algo1": se.Algo1},
+            {"": FirstServing, "sum": se.SumServing},
+        )
+
+    def test_prefix_computation_counts(self):
+        engine = self.make()
+        ctx = RuntimeContext()
+        # 3 grid points: same DS+prep, two distinct algo params
+        grid = [ep_with_algo(1), ep_with_algo(1), ep_with_algo(2)]
+        results = engine.batch_eval(ctx, grid)
+        assert len(results) == 3
+        # datasource read ONCE, preparator ran ONCE, algorithms trained
+        # once per distinct params (2) — not once per grid point (3)
+        assert engine.compute_counts == {
+            "datasource": 1,
+            "preparator": 1,
+            "algorithms": 2,
+        }
+
+    def test_fast_eval_matches_plain_engine(self):
+        fast = self.make()
+        plain = se.Engine0Factory().apply()
+        ctx = RuntimeContext()
+        ep = ep_with_algo(4)
+        r_fast = fast.eval(ctx, ep)
+        r_plain = plain.eval(ctx, ep)
+        assert r_fast == r_plain
