@@ -12,9 +12,11 @@ class-vs-object reflection).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import importlib
 import inspect
 import json
+import types as _types
 import typing
 from typing import Any, Optional
 
@@ -98,10 +100,66 @@ def extract_params(cls: Optional[type], obj: Any) -> Any:
     ]
     if missing:
         raise ParamsError(f"missing required params for {cls.__name__}: {missing}")
+    hints = _class_hints(cls)
+    for key, val in obj.items():
+        ann = hints.get(key)
+        if ann is not None and not _value_matches(val, ann):
+            raise ParamsError(
+                f"param {key!r} of {cls.__name__} expects {_ann_name(ann)}, "
+                f"got {type(val).__name__} ({val!r})"
+            )
     try:
         return cls(**obj)
     except TypeError as e:
         raise ParamsError(f"invalid params for {cls.__name__}: {e}")
+
+
+@functools.lru_cache(maxsize=256)
+def _class_hints(cls: type) -> dict:
+    """Resolved annotations per class, cached — extract_params runs on the
+    query-serving hot path and hints never change."""
+    try:
+        return typing.get_type_hints(cls)
+    except (TypeError, NameError):
+        return {}
+
+
+def _ann_name(ann: Any) -> str:
+    return getattr(ann, "__name__", None) or str(ann)
+
+
+def _value_matches(val: Any, ann: Any) -> bool:
+    """Shallow JSON-shape check of a value against a dataclass field
+    annotation — enough to turn a wrong-typed query field into a 400
+    instead of a deep kernel crash. Unknown annotation forms pass."""
+    origin = typing.get_origin(ann)
+    if ann is Any or ann is inspect.Parameter.empty:
+        return True
+    if origin is typing.Union or origin is _types.UnionType:  # X | Y too
+        return any(_value_matches(val, a) for a in typing.get_args(ann))
+    if ann is type(None):
+        return val is None
+    if origin in (list, tuple, set):
+        if not isinstance(val, (list, tuple)):
+            return False
+        args = [a for a in typing.get_args(ann) if a is not Ellipsis]
+        if args:
+            elem = args[0]
+            return all(_value_matches(v, elem) for v in val)
+        return True
+    if origin is dict:
+        return isinstance(val, dict)
+    if ann is float:
+        return isinstance(val, (int, float)) and not isinstance(val, bool)
+    if ann is int:
+        return isinstance(val, int) and not isinstance(val, bool)
+    if ann is bool:
+        return isinstance(val, bool)
+    if ann is str:
+        return isinstance(val, str)
+    if isinstance(ann, type) and dataclasses.is_dataclass(ann):
+        return isinstance(val, (dict, ann))
+    return True
 
 
 def params_to_json(params: Any) -> str:

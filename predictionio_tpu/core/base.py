@@ -140,6 +140,18 @@ class BasePreparator(Generic[TD, PD]):
 class BaseAlgorithm(Generic[PD, M, Q, P]):
     """Reference BaseAlgorithm.scala:55-123."""
 
+    # serving-time context injected by the deploy server so predict() can
+    # read the event store live (the reference reaches the same state via
+    # the global Storage singleton behind LEventStore — LEventStore.scala:32)
+    _serving_ctx: Optional[RuntimeContext] = None
+
+    def set_serving_context(self, ctx: RuntimeContext) -> None:
+        self._serving_ctx = ctx
+
+    @property
+    def serving_context(self) -> RuntimeContext:
+        return self._serving_ctx if self._serving_ctx is not None else RuntimeContext(mode="serve")
+
     def train(self, ctx: RuntimeContext, pd: PD) -> M:
         raise NotImplementedError
 

@@ -1,0 +1,19 @@
+from predictionio_tpu.engines.classification.engine import (
+    ClassificationDataSource,
+    ClassificationEngine,
+    DataSourceParams,
+    LogisticRegressionAlgorithm,
+    NaiveBayesAlgorithm,
+    PredictedResult,
+    Query,
+)
+
+__all__ = [
+    "ClassificationDataSource",
+    "ClassificationEngine",
+    "DataSourceParams",
+    "LogisticRegressionAlgorithm",
+    "NaiveBayesAlgorithm",
+    "PredictedResult",
+    "Query",
+]

@@ -1,0 +1,247 @@
+"""Classification engine template: entity properties → label prediction.
+
+Reference: examples/scala-parallel-classification (add-algorithm,
+custom-attributes variants) — DataSource aggregates entity properties with
+required attributes into LabeledPoints (add-algorithm/src/main/scala/
+DataSource.scala:34-55), NaiveBayesAlgorithm.scala delegates to MLlib NB
+(lambda param), add-algorithm shows a second algorithm selected via
+engine.json; Query carries the attribute values, PredictedResult the label.
+
+TPU re-design: the property aggregation produces one dense (N, D) feature
+matrix staged to device; NB is a single segment-sum program and LR a
+jitted GD loop (models/classify.py). Both algorithms batch-predict eval
+queries in one device call."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+)
+from predictionio_tpu.controller.metrics import AverageMetric
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.data.store.event_store import EventStoreFacade
+from predictionio_tpu.e2.cross_validation import split_data
+from predictionio_tpu.models import classify
+
+
+@dataclass
+class Query:
+    features: list[float] = field(default_factory=list)
+
+
+@dataclass
+class PredictedResult:
+    label: str
+
+
+@dataclass
+class ActualResult:
+    label: str
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str
+    entity_type: str = "user"
+    attrs: tuple[str, ...] = ("attr0", "attr1", "attr2")
+    label_attr: str = "plan"
+    eval_k: int = 0
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    features: np.ndarray  # (N, D) float32
+    labels: np.ndarray  # (N,) int32
+    label_vocab: tuple[str, ...]  # class index → label string
+
+    def sanity_check(self) -> None:
+        if len(self.features) == 0:
+            raise ValueError("no labeled entities found")
+        if len(self.label_vocab) < 2:
+            raise ValueError(
+                f"need ≥2 classes, found {list(self.label_vocab)}"
+            )
+
+
+@dataclass
+class EvalInfo:
+    fold: int
+
+
+class ClassificationDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def _read_all(self, ctx: RuntimeContext) -> TrainingData:
+        store = EventStoreFacade(ctx.storage)
+        props = store.aggregate_properties(
+            app_name=self.params.app_name,
+            entity_type=self.params.entity_type,
+            required=[*self.params.attrs, self.params.label_attr],
+        )
+        rows = []
+        labels = []
+        for _entity, pmap in sorted(props.items()):
+            rows.append(
+                [float(pmap.get_opt(a, float) or 0.0) for a in self.params.attrs]
+            )
+            labels.append(str(pmap.get_opt(self.params.label_attr, str)))
+        vocab = tuple(sorted(set(labels)))
+        index = {lb: i for i, lb in enumerate(vocab)}
+        return TrainingData(
+            features=np.asarray(rows, dtype=np.float32),
+            labels=np.asarray([index[lb] for lb in labels], dtype=np.int32),
+            label_vocab=vocab,
+        )
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        return self._read_all(ctx)
+
+    def read_eval(self, ctx: RuntimeContext):
+        if self.params.eval_k <= 0:
+            raise ValueError("eval requires datasource params eval_k > 0")
+        td = self._read_all(ctx)
+        idx = list(range(len(td.labels)))
+        out = []
+        for fold, (train_ix, test_ix) in enumerate(
+            split_data(self.params.eval_k, idx)
+        ):
+            tr = TrainingData(
+                features=td.features[train_ix],
+                labels=td.labels[train_ix],
+                label_vocab=td.label_vocab,
+            )
+            qa = [
+                (
+                    Query(features=td.features[i].tolist()),
+                    ActualResult(label=td.label_vocab[td.labels[i]]),
+                )
+                for i in test_ix
+            ]
+            out.append((tr, EvalInfo(fold=fold), qa))
+        return out
+
+
+# -- algorithms -------------------------------------------------------------
+
+
+@dataclass
+class NBModel:
+    model: classify.NaiveBayesModel
+    label_vocab: tuple[str, ...]
+
+
+@dataclass
+class NaiveBayesParams:
+    lambda_: float = 1.0
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    """Reference NaiveBayesAlgorithm.scala (MLlib NB, lambda smoothing)."""
+
+    def __init__(self, params: NaiveBayesParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> NBModel:
+        return NBModel(
+            model=classify.train_naive_bayes(
+                pd.features, pd.labels, len(pd.label_vocab), self.params.lambda_
+            ),
+            label_vocab=pd.label_vocab,
+        )
+
+    def predict(self, model: NBModel, query: Query) -> PredictedResult:
+        cls = int(model.model.predict(np.asarray(query.features))[0])
+        return PredictedResult(label=model.label_vocab[cls])
+
+    def batch_predict(self, ctx, model: NBModel, queries):
+        x = np.asarray([q.features for _, q in queries], dtype=np.float32)
+        classes = model.model.predict(x)
+        return [
+            (qx, PredictedResult(label=model.label_vocab[int(c)]))
+            for (qx, _q), c in zip(queries, classes)
+        ]
+
+
+@dataclass
+class LRModel:
+    model: classify.LogisticRegressionModel
+    label_vocab: tuple[str, ...]
+
+
+@dataclass
+class LogisticRegressionParams:
+    iterations: int = 200
+    lr: float = 0.5
+    l2: float = 1e-4
+
+
+class LogisticRegressionAlgorithm(Algorithm):
+    """The template's second algorithm (the reference add-algorithm variant
+    adds RandomForest; here the TPU-friendly second model is softmax LR)."""
+
+    def __init__(self, params: LogisticRegressionParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> LRModel:
+        return LRModel(
+            model=classify.train_logistic_regression(
+                pd.features,
+                pd.labels,
+                len(pd.label_vocab),
+                iterations=self.params.iterations,
+                lr=self.params.lr,
+                l2=self.params.l2,
+            ),
+            label_vocab=pd.label_vocab,
+        )
+
+    def predict(self, model: LRModel, query: Query) -> PredictedResult:
+        cls = int(model.model.predict(np.asarray(query.features))[0])
+        return PredictedResult(label=model.label_vocab[cls])
+
+    def batch_predict(self, ctx, model: LRModel, queries):
+        x = np.asarray([q.features for _, q in queries], dtype=np.float32)
+        classes = model.model.predict(x)
+        return [
+            (qx, PredictedResult(label=model.label_vocab[int(c)]))
+            for (qx, _q), c in zip(queries, classes)
+        ]
+
+
+# -- evaluation -------------------------------------------------------------
+
+
+class Accuracy(AverageMetric):
+    """Fraction of correct label predictions (the template's quickstart
+    eval metric)."""
+
+    def calculate_one(self, q: Query, p: PredictedResult, a: ActualResult):
+        return 1.0 if p.label == a.label else 0.0
+
+
+# -- engine factory ---------------------------------------------------------
+
+
+class ClassificationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            ClassificationDataSource,
+            IdentityPreparator,
+            {
+                "naive": NaiveBayesAlgorithm,
+                "logreg": LogisticRegressionAlgorithm,
+            },
+            FirstServing,
+        )

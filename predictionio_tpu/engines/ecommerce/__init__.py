@@ -1,0 +1,21 @@
+from predictionio_tpu.engines.ecommerce.engine import (
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    ECommerceEngine,
+    ECommerceDataSource,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Query,
+)
+
+__all__ = [
+    "DataSourceParams",
+    "ECommAlgorithm",
+    "ECommAlgorithmParams",
+    "ECommerceDataSource",
+    "ECommerceEngine",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+]

@@ -1,0 +1,21 @@
+from predictionio_tpu.engines.similarproduct.engine import (
+    ALSSimilarAlgorithm,
+    DataSourceParams,
+    ItemScore,
+    LikeAlgorithm,
+    PredictedResult,
+    Query,
+    SimilarProductDataSource,
+    SimilarProductEngine,
+)
+
+__all__ = [
+    "ALSSimilarAlgorithm",
+    "DataSourceParams",
+    "ItemScore",
+    "LikeAlgorithm",
+    "PredictedResult",
+    "Query",
+    "SimilarProductDataSource",
+    "SimilarProductEngine",
+]

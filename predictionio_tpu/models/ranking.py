@@ -1,0 +1,36 @@
+"""Shared host-side ranking helpers for serving paths.
+
+The similarproduct and ecommerce templates rank a per-item score vector
+after applying host-built business-rule masks. For single-query serving on
+small-to-medium catalogs the host argpartition beats a device round trip
+(the axon-tunnel dispatch dominates); models/als.py's jitted `recommend`/
+`similar_items` remain the batched device path the recommendation engine
+uses. One NEG_INF convention, one implementation."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from predictionio_tpu.ops.topk import NEG_INF
+
+
+def l2_normalize(factors: np.ndarray) -> np.ndarray:
+    """Row-normalize a factor matrix for cosine scoring."""
+    return factors / (np.linalg.norm(factors, axis=-1, keepdims=True) + 1e-9)
+
+
+def top_k_indices(scores: np.ndarray, k: int) -> np.ndarray:
+    """Indices of the k best scores, sorted descending, masked entries
+    (≤ NEG_INF/2) dropped."""
+    k = min(k, len(scores))
+    if k <= 0:
+        return np.empty(0, dtype=np.int64)
+    top = np.argpartition(-scores, k - 1)[:k]
+    top = top[np.argsort(-scores[top])]
+    return top[scores[top] > NEG_INF / 2]
+
+
+def exclusion_scores(
+    scores: np.ndarray, excluded: np.ndarray
+) -> np.ndarray:
+    return np.where(excluded, NEG_INF, scores)

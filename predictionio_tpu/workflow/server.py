@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from predictionio_tpu.controller.params import ParamsError, extract_params
+from predictionio_tpu.core.base import RuntimeContext
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.utils.http import (
@@ -74,6 +75,9 @@ def build_runtime(storage: Storage, instance: EngineInstance) -> EngineRuntime:
     engine, engine_params, models = prepare_deploy_models(storage, instance)
     algorithms = engine.make_algorithms(engine_params)
     serving = engine.make_serving(engine_params)
+    serving_ctx = RuntimeContext(storage=storage, mode="serve")
+    for algo in algorithms:
+        algo.set_serving_context(serving_ctx)
     query_class = algorithms[0].query_class() if algorithms else None
     return EngineRuntime(
         instance=instance,
@@ -177,11 +181,16 @@ class _Handler(JsonHandler):
                 raise _HttpError(400, str(e))
 
             supplemented = rt.serving.supplement(query)
-            predictions = [
-                algo.predict(model, supplemented)
-                for algo, model in zip(rt.algorithms, rt.models)
-            ]
-            prediction = rt.serving.serve(supplemented, predictions)
+            try:
+                predictions = [
+                    algo.predict(model, supplemented)
+                    for algo, model in zip(rt.algorithms, rt.models)
+                ]
+                prediction = rt.serving.serve(supplemented, predictions)
+            except ValueError as e:
+                # algorithms raise ValueError for query-level contract
+                # violations (e.g. category filter without category data)
+                raise _HttpError(400, str(e))
             result = _to_jsonable(prediction)
 
             for plugin in owner.output_blockers:

@@ -54,6 +54,23 @@ class TestParamsExtraction:
         p = extract_params(se.DSP, {"id": 5})
         assert p == se.DSP(id=5, error=False)
 
+    def test_value_type_validation(self):
+        import dataclasses as dc
+
+        @dc.dataclass
+        class Q:
+            n: int = 1
+            maybe: int | None = None  # PEP 604 union must be enforced too
+            tags: list[str] = dc.field(default_factory=list)
+
+        with pytest.raises(ParamsError, match="expects int"):
+            extract_params(Q, {"n": "five"})
+        with pytest.raises(ParamsError, match="expects"):
+            extract_params(Q, {"maybe": "five"})
+        with pytest.raises(ParamsError, match="expects list"):
+            extract_params(Q, {"tags": "a"})
+        assert extract_params(Q, {"maybe": 3, "tags": ["a"]}) == Q(1, 3, ["a"])
+
     def test_variant_json_roundtrip(self):
         variant = {
             "id": "v1",

@@ -1,0 +1,381 @@
+"""End-to-end tests for the classification, similarproduct, and ecommerce
+engine templates (the remaining reference examples/ families)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EmptyParams, EngineParams, RuntimeContext
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.workflow.core import prepare_deploy_models, run_train
+
+
+def make_app(storage, name):
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name=name))
+    storage.get_events().init_app(app_id)
+    return app_id
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def classify_storage(fresh_storage):
+    """Two Gaussian-ish blobs: plan 'premium' has high attrs, 'free' low."""
+    app_id = make_app(fresh_storage, "clsapp")
+    rng = np.random.RandomState(3)
+    events = []
+    for i in range(60):
+        premium = i % 2 == 0
+        base = 8.0 if premium else 2.0
+        events.append(
+            Event(
+                event="$set",
+                entity_type="user",
+                entity_id=f"u{i}",
+                properties={
+                    "attr0": float(base + rng.rand() * 2),
+                    "attr1": float(base + rng.rand() * 2),
+                    "attr2": float(rng.rand()),  # noise
+                    "plan": "premium" if premium else "free",
+                },
+            )
+        )
+    fresh_storage.get_events().insert_batch(events, app_id)
+    return fresh_storage
+
+
+CLS_VARIANT = {
+    "id": "cls",
+    "engineFactory": "predictionio_tpu.engines.classification.ClassificationEngine",
+    "datasource": {
+        "params": {"app_name": "clsapp", "label_attr": "plan"}
+    },
+    "algorithms": [{"name": "naive", "params": {"lambda_": 1.0}}],
+}
+
+
+class TestClassification:
+    def test_naive_bayes_end_to_end(self, classify_storage):
+        inst = run_train(classify_storage, CLS_VARIANT)
+        assert inst.status == "COMPLETED"
+        engine, ep, models = prepare_deploy_models(classify_storage, inst)
+        algo = engine.make_algorithms(ep)[0]
+        from predictionio_tpu.engines.classification import Query
+
+        assert algo.predict(models[0], Query([9.0, 9.0, 0.5])).label == "premium"
+        assert algo.predict(models[0], Query([2.0, 2.5, 0.5])).label == "free"
+
+    def test_logreg_variant(self, classify_storage):
+        variant = dict(
+            CLS_VARIANT,
+            algorithms=[{"name": "logreg", "params": {"iterations": 300}}],
+        )
+        inst = run_train(classify_storage, variant)
+        engine, ep, models = prepare_deploy_models(classify_storage, inst)
+        algo = engine.make_algorithms(ep)[0]
+        from predictionio_tpu.engines.classification import Query
+
+        assert algo.predict(models[0], Query([9.0, 9.0, 0.5])).label == "premium"
+        assert algo.predict(models[0], Query([2.0, 2.0, 0.5])).label == "free"
+
+    def test_eval_accuracy(self, classify_storage):
+        from predictionio_tpu.controller import Evaluation
+        from predictionio_tpu.engines.classification import ClassificationEngine
+        from predictionio_tpu.engines.classification.engine import (
+            Accuracy,
+            DataSourceParams,
+            NaiveBayesParams,
+        )
+        from predictionio_tpu.workflow.evaluation import run_evaluation
+
+        dsp = DataSourceParams(app_name="clsapp", label_attr="plan", eval_k=3)
+        grid = [
+            EngineParams(
+                data_source_params=("", dsp),
+                preparator_params=("", EmptyParams()),
+                algorithm_params_list=(("naive", NaiveBayesParams(lambda_=lam)),),
+                serving_params=("", EmptyParams()),
+            )
+            for lam in (0.5, 2.0)
+        ]
+
+        class ClsEval(Evaluation):
+            engine = ClassificationEngine().apply()
+            metric = Accuracy()
+
+        inst, result = run_evaluation(classify_storage, ClsEval(), grid)
+        assert inst.status == "EVALCOMPLETED"
+        # multinomial NB discriminates proportions, not magnitudes, so the
+        # scale-separated blobs cap out below perfect — well above chance
+        assert result.best_score.score > 0.75
+
+
+# ---------------------------------------------------------------------------
+# similarproduct
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def similar_storage(fresh_storage):
+    """Items 0-4 co-viewed by even users, 5-9 by odd users; likes mirror."""
+    app_id = make_app(fresh_storage, "simapp")
+    rng = np.random.RandomState(11)
+    events = []
+    for u in range(20):
+        group = u % 2
+        for _ in range(25):
+            i = rng.randint(0, 5) + group * 5
+            events.append(
+                Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                )
+            )
+        events.append(
+            Event(
+                event="like", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{group * 5}",
+            )
+        )
+        events.append(
+            Event(
+                event="dislike", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{(1 - group) * 5}",
+            )
+        )
+    fresh_storage.get_events().insert_batch(events, app_id)
+    return fresh_storage
+
+
+SIM_VARIANT = {
+    "id": "sim",
+    "engineFactory": "predictionio_tpu.engines.similarproduct.SimilarProductEngine",
+    "datasource": {"params": {"app_name": "simapp"}},
+    "algorithms": [
+        {"name": "als", "params": {"rank": 4, "num_iterations": 10}}
+    ],
+}
+
+
+class TestSimilarProduct:
+    def test_similar_items_same_group(self, similar_storage):
+        inst = run_train(similar_storage, SIM_VARIANT)
+        engine, ep, models = prepare_deploy_models(similar_storage, inst)
+        algo = engine.make_algorithms(ep)[0]
+        from predictionio_tpu.engines.similarproduct import Query
+
+        pred = algo.predict(models[0], Query(items=["i0", "i1"], num=3))
+        assert len(pred.item_scores) == 3
+        items = {s.item for s in pred.item_scores}
+        assert "i0" not in items and "i1" not in items  # query items excluded
+        # co-view structure dominates: top-3 mostly from the same group
+        assert len(items & {"i2", "i3", "i4"}) >= 2, items
+
+    def test_unknown_items_empty(self, similar_storage):
+        inst = run_train(similar_storage, SIM_VARIANT)
+        engine, ep, models = prepare_deploy_models(similar_storage, inst)
+        algo = engine.make_algorithms(ep)[0]
+        from predictionio_tpu.engines.similarproduct import Query
+
+        assert algo.predict(models[0], Query(items=["nope"])).item_scores == []
+
+    def test_multi_algo_sum_serving(self, similar_storage):
+        variant = dict(
+            SIM_VARIANT,
+            algorithms=[
+                {"name": "als", "params": {"rank": 8, "num_iterations": 8}},
+                {"name": "like", "params": {"rank": 4, "num_iterations": 6}},
+            ],
+            serving={"name": "sum"},
+        )
+        inst = run_train(similar_storage, variant)
+        engine, ep, models = prepare_deploy_models(similar_storage, inst)
+        algos = engine.make_algorithms(ep)
+        serving = engine.make_serving(ep)
+        from predictionio_tpu.engines.similarproduct import Query
+
+        q = Query(items=["i0"], num=4)
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        combined = serving.serve(q, preds)
+        assert len(combined.item_scores) == 4
+        assert type(serving).__name__ == "SumScoreServing"
+
+
+# ---------------------------------------------------------------------------
+# ecommerce
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def ecomm_storage(fresh_storage):
+    app_id = make_app(fresh_storage, "ecapp")
+    rng = np.random.RandomState(13)
+    events = []
+    for i in range(8):
+        events.append(
+            Event(
+                event="$set", entity_type="item", entity_id=f"i{i}",
+                properties={"categories": ["tools" if i < 4 else "toys"]},
+            )
+        )
+    for u in range(12):
+        group = u % 2
+        for _ in range(20):
+            i = rng.randint(0, 4) + group * 4
+            events.append(
+                Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                )
+            )
+    fresh_storage.get_events().insert_batch(events, app_id)
+    return fresh_storage, app_id
+
+
+EC_VARIANT = {
+    "id": "ec",
+    "engineFactory": "predictionio_tpu.engines.ecommerce.ECommerceEngine",
+    "datasource": {"params": {"app_name": "ecapp"}},
+    "algorithms": [
+        {
+            "name": "ecomm",
+            "params": {
+                "app_name": "ecapp",
+                "rank": 8,
+                "num_iterations": 8,
+                "unseen_only": False,
+            },
+        }
+    ],
+}
+
+
+def deploy(storage, variant):
+    inst = run_train(storage, variant)
+    engine, ep, models = prepare_deploy_models(storage, inst)
+    algo = engine.make_algorithms(ep)[0]
+    algo.set_serving_context(RuntimeContext(storage=storage, mode="serve"))
+    return algo, models[0]
+
+
+class TestECommerce:
+    def test_basic_recommendation(self, ecomm_storage):
+        storage, _ = ecomm_storage
+        algo, model = deploy(storage, EC_VARIANT)
+        from predictionio_tpu.engines.ecommerce import Query
+
+        pred = algo.predict(model, Query(user="u0", num=4))
+        items = {s.item for s in pred.item_scores}
+        assert len(items & {"i0", "i1", "i2", "i3"}) >= 3
+
+    def test_category_filter(self, ecomm_storage):
+        storage, _ = ecomm_storage
+        algo, model = deploy(storage, EC_VARIANT)
+        from predictionio_tpu.engines.ecommerce import Query
+
+        pred = algo.predict(model, Query(user="u0", num=8, categories=["toys"]))
+        items = {s.item for s in pred.item_scores}
+        assert items and items <= {"i4", "i5", "i6", "i7"}
+
+    def test_unseen_only_filters_seen(self, ecomm_storage):
+        storage, _ = ecomm_storage
+        variant = dict(EC_VARIANT)
+        variant["algorithms"] = [
+            {
+                "name": "ecomm",
+                "params": dict(
+                    EC_VARIANT["algorithms"][0]["params"], unseen_only=True
+                ),
+            }
+        ]
+        algo, model = deploy(storage, variant)
+        from predictionio_tpu.engines.ecommerce import Query
+
+        # u0 has seen a subset of i0-i3; those must not be recommended
+        seen = algo._seen_items(algo.serving_context, "u0")
+        assert seen  # fixture guarantees views
+        pred = algo.predict(model, Query(user="u0", num=8))
+        items = {s.item for s in pred.item_scores}
+        assert not (items & seen)
+
+    def test_unavailable_items_constraint(self, ecomm_storage):
+        storage, app_id = ecomm_storage
+        storage.get_events().insert(
+            Event(
+                event="$set", entity_type="constraint",
+                entity_id="unavailableItems",
+                properties={"items": ["i0", "i1"]},
+            ),
+            app_id,
+        )
+        algo, model = deploy(storage, EC_VARIANT)
+        from predictionio_tpu.engines.ecommerce import Query
+
+        pred = algo.predict(model, Query(user="u0", num=8))
+        items = {s.item for s in pred.item_scores}
+        assert not (items & {"i0", "i1"})
+
+    def test_unknown_user_falls_back_to_recent_views(self, ecomm_storage):
+        storage, app_id = ecomm_storage
+        # train FIRST; the new user's views arrive after the model is built
+        # (the realistic cold-start window the reference handles)
+        algo, model = deploy(storage, EC_VARIANT)
+        storage.get_events().insert_batch(
+            [
+                Event(
+                    event="view", entity_type="user", entity_id="newbie",
+                    target_entity_type="item", target_entity_id="i5",
+                ),
+                Event(
+                    event="view", entity_type="user", entity_id="newbie",
+                    target_entity_type="item", target_entity_id="i6",
+                ),
+            ],
+            app_id,
+        )
+        from predictionio_tpu.engines.ecommerce import Query
+
+        pred = algo.predict(model, Query(user="newbie", num=3))
+        items = {s.item for s in pred.item_scores}
+        # similar to toys group, basis items excluded
+        assert items and "i5" not in items and "i6" not in items
+        assert len(items & {"i4", "i7"}) >= 1, items
+
+    def test_batch_predict_honors_eval_ctx(self, ecomm_storage):
+        """Eval must measure the same live filters the deploy server
+        applies — batch_predict threads the eval ctx into the store reads."""
+        storage, _ = ecomm_storage
+        variant = dict(EC_VARIANT)
+        variant["algorithms"] = [
+            {
+                "name": "ecomm",
+                "params": dict(
+                    EC_VARIANT["algorithms"][0]["params"], unseen_only=True
+                ),
+            }
+        ]
+        inst = run_train(storage, variant)
+        engine, ep, models = prepare_deploy_models(storage, inst)
+        algo = engine.make_algorithms(ep)[0]
+        # note: NO set_serving_context — the ctx comes from the caller
+        from predictionio_tpu.engines.ecommerce import Query
+
+        ctx = RuntimeContext(storage=storage, mode="eval")
+        preds = dict(
+            algo.batch_predict(ctx, models[0], [(0, Query(user="u0", num=8))])
+        )
+        seen = algo._seen_items(ctx, "u0")
+        items = {s.item for s in preds[0].item_scores}
+        assert seen and not (items & seen)
+
+    def test_totally_unknown_user_empty(self, ecomm_storage):
+        storage, _ = ecomm_storage
+        algo, model = deploy(storage, EC_VARIANT)
+        from predictionio_tpu.engines.ecommerce import Query
+
+        assert algo.predict(model, Query(user="ghost")).item_scores == []
