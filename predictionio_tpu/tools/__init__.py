@@ -1,0 +1,1 @@
+"""L6 — CLI & ops tools (reference tools/src/main/scala/io/prediction/tools/)."""

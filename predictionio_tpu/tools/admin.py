@@ -1,0 +1,132 @@
+"""Admin REST API on :7071.
+
+Reference: tools/.../admin/AdminAPI.scala:35,132 + CommandClient.scala:58 —
+experimental REST mirror of the console's app commands:
+  GET    /                     → server status
+  GET    /cmd/app              → list apps
+  POST   /cmd/app              → create app {"name": ...}
+  DELETE /cmd/app/{name}       → delete app
+  DELETE /cmd/app/{name}/data  → wipe app event data
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.tools import common
+from predictionio_tpu.tools.common import CommandError
+from predictionio_tpu.utils.http import (
+    HttpError,
+    JsonHandler,
+    ServerProcess,
+    ThreadedServer,
+)
+
+
+class _Handler(JsonHandler):
+    server: "_Server"  # type: ignore[assignment]
+
+    @property
+    def storage(self) -> Storage:
+        return self.server.storage
+
+    def do_GET(self):
+        self._drain_body()
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                self._respond(200, {"status": "alive"})
+            elif path == "/cmd/app":
+                apps = self.storage.get_meta_data_apps().get_all()
+                keys = self.storage.get_meta_data_access_keys()
+                self._respond(200, [
+                    {
+                        "name": a.name,
+                        "id": a.id,
+                        "description": a.description,
+                        "accessKeys": [k.key for k in keys.get_by_app_id(a.id)],
+                    }
+                    for a in sorted(apps, key=lambda a: a.id)
+                ])
+            else:
+                raise HttpError(404, "Not Found")
+        except HttpError as e:
+            self._respond(e.status, {"message": e.message})
+
+    def do_POST(self):
+        self._drain_body()
+        path = self.path.split("?")[0].rstrip("/")
+        try:
+            if path == "/cmd/app":
+                obj = self._json_body()
+                if not isinstance(obj, dict) or not obj.get("name"):
+                    raise HttpError(400, "app 'name' is required")
+                raw_id = obj.get("id") or 0
+                if not isinstance(raw_id, int) or isinstance(raw_id, bool):
+                    raise HttpError(400, "app 'id' must be an integer")
+                try:
+                    app, key = common.create_app(
+                        self.storage, obj["name"],
+                        description=obj.get("description"), app_id=raw_id,
+                    )
+                except CommandError as e:
+                    raise HttpError(409, str(e))
+                self._respond(
+                    201, {"name": app.name, "id": app.id, "accessKey": key}
+                )
+            else:
+                raise HttpError(404, "Not Found")
+        except HttpError as e:
+            self._respond(e.status, {"message": e.message})
+
+    def do_DELETE(self):
+        self._drain_body()
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        try:
+            if len(parts) >= 2 and parts[:2] == ["cmd", "app"]:
+                if len(parts) == 3:
+                    self._delete_app(parts[2])
+                elif len(parts) == 4 and parts[3] == "data":
+                    self._delete_data(parts[2])
+                else:
+                    raise HttpError(404, "Not Found")
+            else:
+                raise HttpError(404, "Not Found")
+        except HttpError as e:
+            self._respond(e.status, {"message": e.message})
+
+    def _app(self, name: str) -> App:
+        app = self.storage.get_meta_data_apps().get_by_name(name)
+        if app is None:
+            raise HttpError(404, f"App {name!r} does not exist.")
+        return app
+
+    def _delete_app(self, name: str) -> None:
+        common.delete_app(self.storage, self._app(name))
+        self._respond(200, {"message": f"App {name!r} deleted."})
+
+    def _delete_data(self, name: str) -> None:
+        common.delete_app_data(self.storage, self._app(name), all_channels=True)
+        self._respond(200, {"message": f"Event data of app {name!r} deleted."})
+
+
+class _Server(ThreadedServer):
+    def __init__(self, addr, storage: Storage):
+        super().__init__(addr, _Handler)
+        self.storage = storage
+
+
+class AdminServer(ServerProcess):
+    _name = "admin-server"
+
+    def __init__(self, storage: Optional[Storage] = None, ip: str = "0.0.0.0",
+                 port: int = 7071):
+        super().__init__()
+        self.storage = storage or Storage.get_instance()
+        self.ip = ip
+        self.port_config = port
+
+    def _make_server(self) -> _Server:
+        return _Server((self.ip, self.port_config), self.storage)

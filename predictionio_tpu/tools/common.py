@@ -1,0 +1,92 @@
+"""Shared operator-command logic used by both the console and the admin
+REST API (the role of the reference's admin/CommandClient.scala:58 — one
+implementation, two frontends)."""
+
+from __future__ import annotations
+
+import secrets
+from typing import Optional
+
+from predictionio_tpu.data.storage.base import AccessKey, App
+from predictionio_tpu.data.storage.registry import Storage
+
+
+class CommandError(ValueError):
+    pass
+
+
+def create_app(
+    storage: Storage,
+    name: str,
+    description: Optional[str] = None,
+    access_key: Optional[str] = None,
+    app_id: int = 0,
+) -> tuple[App, str]:
+    """Create app + default access key; returns (app, key)."""
+    apps = storage.get_meta_data_apps()
+    if apps.get_by_name(name) is not None:
+        raise CommandError(f"App {name!r} already exists.")
+    new_id = apps.insert(App(id=app_id, name=name, description=description))
+    if new_id is None:
+        raise CommandError(f"App id {app_id} is already taken.")
+    storage.get_events().init_app(new_id)
+    key = access_key or secrets.token_urlsafe(32)
+    created = storage.get_meta_data_access_keys().insert(
+        AccessKey(key=key, app_id=new_id, events=())
+    )
+    if created is None:
+        # roll back the half-created app — a name that errored must not
+        # linger as an app row without a key
+        storage.get_events().remove_app(new_id)
+        apps.delete(new_id)
+        raise CommandError(f"Access key {key!r} already exists.")
+    return App(id=new_id, name=name, description=description), key
+
+
+def create_access_key(
+    storage: Storage, app: App, key: Optional[str], events: tuple[str, ...]
+) -> str:
+    created = storage.get_meta_data_access_keys().insert(
+        AccessKey(
+            key=key or secrets.token_urlsafe(32), app_id=app.id, events=events
+        )
+    )
+    if created is None:
+        raise CommandError(f"Access key {key!r} already exists.")
+    return created
+
+
+def resolve_channel(storage: Storage, app: App, name: str) -> int:
+    """Channel name → id for an app; raises CommandError when missing."""
+    channels = storage.get_meta_data_channels().get_by_app_id(app.id)
+    match = [c for c in channels if c.name == name]
+    if not match:
+        raise CommandError(f"Channel {name!r} does not exist.")
+    return match[0].id
+
+
+def delete_app(storage: Storage, app: App) -> None:
+    """Full cascade: channels (+their events) → events → keys → app row."""
+    events = storage.get_events()
+    for ch in storage.get_meta_data_channels().get_by_app_id(app.id):
+        events.remove_app(app.id, ch.id)
+        storage.get_meta_data_channels().delete(ch.id)
+    events.remove_app(app.id)
+    for k in storage.get_meta_data_access_keys().get_by_app_id(app.id):
+        storage.get_meta_data_access_keys().delete(k.key)
+    storage.get_meta_data_apps().delete(app.id)
+
+
+def delete_app_data(
+    storage: Storage, app: App, channel_id: Optional[int] = None,
+    all_channels: bool = False,
+) -> None:
+    """Wipe event data: one channel, the default namespace, or everything."""
+    events = storage.get_events()
+    if all_channels:
+        for ch in storage.get_meta_data_channels().get_by_app_id(app.id):
+            events.remove_app(app.id, ch.id)
+            events.init_app(app.id, ch.id)
+        channel_id = None
+    events.remove_app(app.id, channel_id)
+    events.init_app(app.id, channel_id)

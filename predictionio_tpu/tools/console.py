@@ -1,0 +1,529 @@
+"""`pio` console: the operator CLI.
+
+Reference: tools/.../console/Console.scala:131 (scopt dispatch, 1,277 LoC),
+App.scala (app/channel mgmt), AccessKey.scala, Export.scala / Import.scala,
+RunWorkflow/RunServer (spark-submit assembly — here train/deploy run
+in-process; no JVM, no sbt build step: engines are Python entry points
+named in engine.json, so `pio build` has no equivalent and engine
+registration happens implicitly at train time).
+
+Commands:
+  app new|list|show|delete|data-delete; channel new|delete
+  accesskey new|list|delete
+  train / deploy / eval / eventserver
+  status / export / import
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from predictionio_tpu.data.storage.base import App, Channel
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.tools import common
+from predictionio_tpu.tools.common import CommandError
+
+
+def _storage() -> Storage:
+    return Storage.get_instance()
+
+
+def _fail(msg: str) -> int:
+    print(f"[ERROR] {msg}", file=sys.stderr)
+    return 1
+
+
+def _get_app(storage: Storage, name: str) -> Optional[App]:
+    app = storage.get_meta_data_apps().get_by_name(name)
+    if app is None:
+        print(f"[ERROR] App '{name}' does not exist.", file=sys.stderr)
+    return app
+
+
+# ---------------------------------------------------------------------------
+# app / channel (reference console/App.scala)
+# ---------------------------------------------------------------------------
+
+
+def cmd_app_new(args) -> int:
+    app, key = common.create_app(
+        _storage(), args.name,
+        description=args.description, access_key=args.access_key,
+    )
+    print(f"[INFO] App created: ID={app.id} Name={app.name}")
+    print(f"[INFO] Access key: {key}")
+    return 0
+
+
+def cmd_app_list(args) -> int:
+    storage = _storage()
+    keys = storage.get_meta_data_access_keys()
+    print(f"{'ID':>4}  {'Name':<24} Access key(s)")
+    for app in sorted(storage.get_meta_data_apps().get_all(), key=lambda a: a.id):
+        ks = ", ".join(k.key for k in keys.get_by_app_id(app.id)) or "-"
+        print(f"{app.id:>4}  {app.name:<24} {ks}")
+    return 0
+
+
+def cmd_app_show(args) -> int:
+    storage = _storage()
+    app = _get_app(storage, args.name)
+    if app is None:
+        return 1
+    print(f"[INFO] App: ID={app.id} Name={app.name} Description={app.description or ''}")
+    for ch in storage.get_meta_data_channels().get_by_app_id(app.id):
+        print(f"[INFO] Channel: ID={ch.id} Name={ch.name}")
+    for k in storage.get_meta_data_access_keys().get_by_app_id(app.id):
+        events = ",".join(k.events) or "(all)"
+        print(f"[INFO] Access key: {k.key} events={events}")
+    return 0
+
+
+def cmd_app_delete(args) -> int:
+    storage = _storage()
+    app = _get_app(storage, args.name)
+    if app is None:
+        return 1
+    if not args.force:
+        confirm = input(
+            f"Delete app '{app.name}' and ALL its data? (YES to confirm): "
+        )
+        if confirm != "YES":
+            print("[INFO] Aborted.")
+            return 1
+    common.delete_app(storage, app)
+    print(f"[INFO] App '{app.name}' deleted.")
+    return 0
+
+
+def cmd_app_data_delete(args) -> int:
+    storage = _storage()
+    app = _get_app(storage, args.name)
+    if app is None:
+        return 1
+    channel_id = (
+        common.resolve_channel(storage, app, args.channel)
+        if args.channel
+        else None
+    )
+    if not args.force:
+        scope = f"channel '{args.channel}'" if args.channel else "default channel"
+        confirm = input(
+            f"Delete all event data of app '{app.name}' ({scope})? (YES to confirm): "
+        )
+        if confirm != "YES":
+            print("[INFO] Aborted.")
+            return 1
+    common.delete_app_data(storage, app, channel_id)
+    print(f"[INFO] Event data of app '{app.name}' deleted.")
+    return 0
+
+
+def cmd_channel_new(args) -> int:
+    storage = _storage()
+    app = _get_app(storage, args.app)
+    if app is None:
+        return 1
+    if not Channel.is_valid_name(args.channel):
+        return _fail(f"Channel name {args.channel!r}: {Channel.NAME_CONSTRAINT}")
+    chans = storage.get_meta_data_channels()
+    if any(c.name == args.channel for c in chans.get_by_app_id(app.id)):
+        return _fail(f"Channel '{args.channel}' already exists.")
+    ch_id = chans.insert(Channel(id=0, name=args.channel, app_id=app.id))
+    storage.get_events().init_app(app.id, ch_id)
+    print(f"[INFO] Channel created: ID={ch_id} Name={args.channel}")
+    return 0
+
+
+def cmd_channel_delete(args) -> int:
+    storage = _storage()
+    app = _get_app(storage, args.app)
+    if app is None:
+        return 1
+    chans = storage.get_meta_data_channels()
+    match = [c for c in chans.get_by_app_id(app.id) if c.name == args.channel]
+    if not match:
+        return _fail(f"Channel '{args.channel}' does not exist.")
+    storage.get_events().remove_app(app.id, match[0].id)
+    chans.delete(match[0].id)
+    print(f"[INFO] Channel '{args.channel}' deleted.")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# accesskey (reference console/AccessKey.scala)
+# ---------------------------------------------------------------------------
+
+
+def cmd_accesskey_new(args) -> int:
+    storage = _storage()
+    app = _get_app(storage, args.app)
+    if app is None:
+        return 1
+    events = tuple(e for e in (args.events or "").split(",") if e)
+    key = common.create_access_key(storage, app, args.key, events)
+    print(f"[INFO] Access key created: {key}")
+    return 0
+
+
+def cmd_accesskey_list(args) -> int:
+    storage = _storage()
+    keys = storage.get_meta_data_access_keys()
+    if args.app:
+        app = _get_app(storage, args.app)
+        if app is None:
+            return 1
+        rows = keys.get_by_app_id(app.id)
+    else:
+        rows = keys.get_all()
+    print(f"{'App':>4}  {'Access key':<48} Allowed events")
+    for k in rows:
+        events = ",".join(k.events) or "(all)"
+        print(f"{k.app_id:>4}  {k.key:<48} {events}")
+    return 0
+
+
+def cmd_accesskey_delete(args) -> int:
+    if _storage().get_meta_data_access_keys().delete(args.key):
+        print(f"[INFO] Access key deleted: {args.key}")
+        return 0
+    return _fail(f"Access key not found: {args.key}")
+
+
+# ---------------------------------------------------------------------------
+# train / deploy / eval / eventserver (reference RunWorkflow/RunServer)
+# ---------------------------------------------------------------------------
+
+
+def _serve_until_interrupt(server, banner: str) -> int:
+    """Start a ServerProcess, print the banner, block until Ctrl-C."""
+    import threading
+
+    port = server.start()
+    print(banner.format(port=port))
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.core.base import WorkflowParams
+    from predictionio_tpu.workflow.core import load_variant, run_train
+
+    variant = load_variant(args.engine_json)
+    wp = WorkflowParams(
+        batch=args.batch or "",
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    inst = run_train(
+        _storage(), variant, workflow_params=wp,
+        engine_version=args.engine_version,
+    )
+    print(f"[INFO] Training {inst.status.lower()}: instance {inst.id}")
+    return 0 if inst.status in ("COMPLETED", "INTERRUPTED") else 1
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.workflow.core import load_variant
+    from predictionio_tpu.workflow.server import (
+        QueryServer,
+        QueryServerConfig,
+        latest_completed_runtime,
+    )
+
+    variant = load_variant(args.engine_json)
+    runtime = latest_completed_runtime(
+        _storage(), variant["id"], args.engine_version, variant["id"]
+    )
+    config = QueryServerConfig(
+        ip=args.ip,
+        port=args.port,
+        feedback=args.feedback,
+        event_server_url=args.event_server_url,
+        access_key=args.access_key,
+    )
+    return _serve_until_interrupt(
+        QueryServer(_storage(), runtime, config),
+        f"[INFO] Engine is deployed and running. Engine API is live at "
+        f"http://{args.ip}:{{port}}.",
+    )
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.controller.evaluation import Evaluation
+    from predictionio_tpu.controller.params import load_symbol
+    from predictionio_tpu.workflow.evaluation import run_evaluation
+
+    evaluation = load_symbol(args.evaluation)
+    if isinstance(evaluation, type):
+        evaluation = evaluation()
+    if not isinstance(evaluation, Evaluation):
+        return _fail(f"{args.evaluation} is not an Evaluation")
+    params_list = None
+    if args.params_generator:
+        gen = load_symbol(args.params_generator)
+        if isinstance(gen, type):
+            gen = gen()
+        params_list = list(gen.engine_params_list)
+    inst, result = run_evaluation(_storage(), evaluation, params_list)
+    print(f"[INFO] Evaluation {inst.status}: {result.to_one_liner()}")
+    return 0 if inst.status == "EVALCOMPLETED" else 1
+
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.data.api.server import EventServer, EventServerConfig
+
+    return _serve_until_interrupt(
+        EventServer(
+            _storage(),
+            EventServerConfig(ip=args.ip, port=args.port, stats=args.stats),
+        ),
+        f"[INFO] Event Server is listening at http://{args.ip}:{{port}}.",
+    )
+
+
+# ---------------------------------------------------------------------------
+# status / export / import (reference Console.status, EventsToFile, FileToEvents)
+# ---------------------------------------------------------------------------
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.tools.admin import AdminServer
+
+    return _serve_until_interrupt(
+        AdminServer(_storage(), ip=args.ip, port=args.port),
+        f"[INFO] Admin server is listening at http://{args.ip}:{{port}}.",
+    )
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.tools.dashboard import Dashboard
+
+    return _serve_until_interrupt(
+        Dashboard(_storage(), ip=args.ip, port=args.port),
+        f"[INFO] Dashboard is listening at http://{args.ip}:{{port}}.",
+    )
+
+
+def cmd_status(args) -> int:
+    storage = _storage()
+    print("[INFO] Inspecting predictionio_tpu...")
+    import predictionio_tpu
+
+    print(f"[INFO] predictionio_tpu {predictionio_tpu.__version__}")
+    import jax
+
+    print(f"[INFO] jax {jax.__version__}; devices: {jax.devices()}")
+    print("[INFO] Verifying storage backend connections...")
+    try:
+        for line in storage.verify_all_data_objects():
+            print(f"[INFO]   {line}")
+    except Exception as e:
+        return _fail(f"storage verification failed: {e}")
+    print("[INFO] (sleeping 0 seconds) Your system is all ready to go.")
+    return 0
+
+
+def cmd_export(args) -> int:
+    storage = _storage()
+    app = _get_app(storage, args.app)
+    if app is None:
+        return 1
+    channel_id = (
+        common.resolve_channel(storage, app, args.channel)
+        if args.channel
+        else None
+    )
+    from predictionio_tpu.data.storage.base import EventQuery
+
+    n = 0
+    with open(args.output, "w") as f:
+        for e in storage.get_events().find(
+            EventQuery(app_id=app.id, channel_id=channel_id)
+        ):
+            f.write(e.to_json() + "\n")
+            n += 1
+    print(f"[INFO] Exported {n} events to {args.output}")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from predictionio_tpu.data.event import Event, EventValidation
+
+    storage = _storage()
+    app = _get_app(storage, args.app)
+    if app is None:
+        return 1
+    channel_id = (
+        common.resolve_channel(storage, app, args.channel)
+        if args.channel
+        else None
+    )
+    events = []
+    errors = 0
+    with open(args.input) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = Event.from_json(line)
+                EventValidation.validate(e)
+                events.append(e)
+            except Exception as exc:
+                errors += 1
+                print(f"[WARN] line {i}: {exc}", file=sys.stderr)
+    storage.get_events().write(events, app.id, channel_id)
+    print(f"[INFO] Imported {len(events)} events ({errors} malformed lines skipped)")
+    return 0 if errors == 0 else 1
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio", description="predictionio_tpu operator console"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    # app
+    app = sub.add_parser("app", help="manage apps").add_subparsers(
+        dest="subcommand", required=True
+    )
+    s = app.add_parser("new")
+    s.add_argument("name")
+    s.add_argument("--description")
+    s.add_argument("--access-key")
+    s.set_defaults(func=cmd_app_new)
+    s = app.add_parser("list")
+    s.set_defaults(func=cmd_app_list)
+    s = app.add_parser("show")
+    s.add_argument("name")
+    s.set_defaults(func=cmd_app_show)
+    s = app.add_parser("delete")
+    s.add_argument("name")
+    s.add_argument("-f", "--force", action="store_true")
+    s.set_defaults(func=cmd_app_delete)
+    s = app.add_parser("data-delete")
+    s.add_argument("name")
+    s.add_argument("--channel")
+    s.add_argument("-f", "--force", action="store_true")
+    s.set_defaults(func=cmd_app_data_delete)
+
+    # channel
+    ch = sub.add_parser("channel", help="manage channels").add_subparsers(
+        dest="subcommand", required=True
+    )
+    s = ch.add_parser("new")
+    s.add_argument("app")
+    s.add_argument("channel")
+    s.set_defaults(func=cmd_channel_new)
+    s = ch.add_parser("delete")
+    s.add_argument("app")
+    s.add_argument("channel")
+    s.set_defaults(func=cmd_channel_delete)
+
+    # accesskey
+    ak = sub.add_parser("accesskey", help="manage access keys").add_subparsers(
+        dest="subcommand", required=True
+    )
+    s = ak.add_parser("new")
+    s.add_argument("app")
+    s.add_argument("--key")
+    s.add_argument("--events", help="comma-separated whitelist")
+    s.set_defaults(func=cmd_accesskey_new)
+    s = ak.add_parser("list")
+    s.add_argument("app", nargs="?")
+    s.set_defaults(func=cmd_accesskey_list)
+    s = ak.add_parser("delete")
+    s.add_argument("key")
+    s.set_defaults(func=cmd_accesskey_delete)
+
+    # train
+    s = sub.add_parser("train", help="run a training workflow")
+    s.add_argument("--engine-json", default="engine.json")
+    s.add_argument("--engine-version", default="0")
+    s.add_argument("--batch")
+    s.add_argument("--skip-sanity-check", action="store_true")
+    s.add_argument("--stop-after-read", action="store_true")
+    s.add_argument("--stop-after-prepare", action="store_true")
+    s.set_defaults(func=cmd_train)
+
+    # deploy
+    s = sub.add_parser("deploy", help="serve the latest trained model")
+    s.add_argument("--engine-json", default="engine.json")
+    s.add_argument("--engine-version", default="0")
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--feedback", action="store_true")
+    s.add_argument("--event-server-url")
+    s.add_argument("--access-key")
+    s.set_defaults(func=cmd_deploy)
+
+    # eval
+    s = sub.add_parser("eval", help="run an evaluation")
+    s.add_argument("evaluation", help="import path of an Evaluation")
+    s.add_argument(
+        "params_generator", nargs="?",
+        help="import path of an EngineParamsGenerator",
+    )
+    s.set_defaults(func=cmd_eval)
+
+    # eventserver
+    s = sub.add_parser("eventserver", help="run the event ingestion server")
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=7070)
+    s.add_argument("--stats", action="store_true")
+    s.set_defaults(func=cmd_eventserver)
+
+    # adminserver / dashboard
+    s = sub.add_parser("adminserver", help="run the admin REST API")
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=7071)
+    s.set_defaults(func=cmd_adminserver)
+    s = sub.add_parser("dashboard", help="run the evaluation dashboard")
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=9000)
+    s.set_defaults(func=cmd_dashboard)
+
+    # status
+    s = sub.add_parser("status", help="verify environment + storage")
+    s.set_defaults(func=cmd_status)
+
+    # export / import
+    s = sub.add_parser("export", help="export events to JSON lines")
+    s.add_argument("--app", required=True)
+    s.add_argument("--channel")
+    s.add_argument("--output", required=True)
+    s.set_defaults(func=cmd_export)
+    s = sub.add_parser("import", help="import events from JSON lines")
+    s.add_argument("--app", required=True)
+    s.add_argument("--channel")
+    s.add_argument("--input", required=True)
+    s.set_defaults(func=cmd_import)
+
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except KeyboardInterrupt:
+        return 130
+    except (CommandError, OSError, ValueError, RuntimeError) as e:
+        # operator-facing errors print cleanly; genuine bugs still traceback
+        return _fail(str(e))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+"""Evaluation dashboard on :9000.
+
+Reference: tools/.../dashboard/Dashboard.scala:37 — an HTML page listing
+completed EvaluationInstances newest-first with their one-liner results and
+links to the full HTML/JSON reports."""
+
+from __future__ import annotations
+
+import html
+from typing import Optional
+
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.utils.http import (
+    HttpError,
+    JsonHandler,
+    ServerProcess,
+    ThreadedServer,
+)
+
+
+class _Handler(JsonHandler):
+    server: "_Server"  # type: ignore[assignment]
+
+    def do_GET(self):
+        self._drain_body()
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                self._respond(200, self._index(), "text/html")
+            elif path.startswith("/engine_instances/") and path.endswith(".html"):
+                iid = path[len("/engine_instances/"):-len(".html")]
+                inst = (
+                    self.server.storage.get_meta_data_evaluation_instances()
+                    .get(iid)
+                )
+                if inst is None:
+                    raise HttpError(404, "Not Found")
+                self._respond(
+                    200, inst.evaluator_results_html or "<p>(no report)</p>",
+                    "text/html",
+                )
+            elif path.startswith("/engine_instances/") and path.endswith(".json"):
+                iid = path[len("/engine_instances/"):-len(".json")]
+                inst = (
+                    self.server.storage.get_meta_data_evaluation_instances()
+                    .get(iid)
+                )
+                if inst is None:
+                    raise HttpError(404, "Not Found")
+                self._respond(200, inst.evaluator_results_json or "{}")
+            else:
+                raise HttpError(404, "Not Found")
+        except HttpError as e:
+            self._respond(e.status, {"message": e.message})
+
+    def _index(self) -> str:
+        instances = (
+            self.server.storage.get_meta_data_evaluation_instances()
+            .get_completed()
+        )
+        rows = "".join(
+            f"<tr><td>{i.id}</td><td>{i.start_time}</td>"
+            f"<td>{html.escape(i.evaluation_class)}</td>"
+            f"<td>{html.escape(i.evaluator_results)}</td>"
+            f"<td><a href='/engine_instances/{i.id}.html'>HTML</a> "
+            f"<a href='/engine_instances/{i.id}.json'>JSON</a></td></tr>"
+            for i in instances
+        )
+        return f"""<!DOCTYPE html><html><head><title>predictionio_tpu dashboard</title></head>
+<body><h1>Completed evaluations</h1>
+<table border="1" cellpadding="4">
+<tr><th>ID</th><th>Started</th><th>Evaluation</th><th>Result</th><th>Reports</th></tr>
+{rows}
+</table></body></html>"""
+
+
+class _Server(ThreadedServer):
+    def __init__(self, addr, storage: Storage):
+        super().__init__(addr, _Handler)
+        self.storage = storage
+
+
+class Dashboard(ServerProcess):
+    _name = "dashboard"
+
+    def __init__(self, storage: Optional[Storage] = None, ip: str = "0.0.0.0",
+                 port: int = 9000):
+        super().__init__()
+        self.storage = storage or Storage.get_instance()
+        self.ip = ip
+        self.port_config = port
+
+    def _make_server(self) -> _Server:
+        return _Server((self.ip, self.port_config), self.storage)

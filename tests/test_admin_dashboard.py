@@ -1,0 +1,102 @@
+"""Admin REST API + dashboard tests (ports of reference AdminAPISpec +
+Dashboard smoke)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.tools.admin import AdminServer
+from predictionio_tpu.tools.dashboard import Dashboard
+
+
+def req(port, path, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data,
+        headers={"Content-Type": "application/json"}, method=method,
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            raw = resp.read().decode()
+            return resp.status, raw
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture()
+def admin(fresh_storage):
+    srv = AdminServer(fresh_storage, ip="127.0.0.1", port=0)
+    port = srv.start()
+    yield fresh_storage, port
+    srv.stop()
+
+
+class TestAdminAPI:
+    def test_status_and_app_crud(self, admin):
+        storage, port = admin
+        status, raw = req(port, "/")
+        assert status == 200 and json.loads(raw)["status"] == "alive"
+
+        status, raw = req(port, "/cmd/app", "POST", {"name": "adm1"})
+        assert status == 201
+        created = json.loads(raw)
+        assert created["name"] == "adm1" and created["accessKey"]
+
+        status, raw = req(port, "/cmd/app", "POST", {"name": "adm1"})
+        assert status == 409
+
+        status, raw = req(port, "/cmd/app")
+        apps = json.loads(raw)
+        assert [a["name"] for a in apps] == ["adm1"]
+        assert apps[0]["accessKeys"] == [created["accessKey"]]
+
+        status, _ = req(port, "/cmd/app/adm1/data", "DELETE")
+        assert status == 200
+        status, _ = req(port, "/cmd/app/adm1", "DELETE")
+        assert status == 200
+        status, _ = req(port, "/cmd/app/adm1", "DELETE")
+        assert status == 404
+
+    def test_create_requires_name(self, admin):
+        _, port = admin
+        status, raw = req(port, "/cmd/app", "POST", {})
+        assert status == 400
+
+
+class TestDashboard:
+    def test_lists_completed_evaluations(self, fresh_storage):
+        # seed a completed evaluation via the real workflow
+        from predictionio_tpu.controller import Evaluation
+        from predictionio_tpu.controller.metrics import AverageMetric
+        from predictionio_tpu.workflow.evaluation import run_evaluation
+        import sample_engine as se
+        from test_evaluation import ep_with_algo
+
+        class M(AverageMetric):
+            def calculate_one(self, q, p, a):
+                return p.algo_id
+
+        class E(Evaluation):
+            engine = se.Engine0Factory().apply()
+            metric = M()
+
+        inst, _ = run_evaluation(fresh_storage, E(), [ep_with_algo(4)])
+
+        srv = Dashboard(fresh_storage, ip="127.0.0.1", port=0)
+        port = srv.start()
+        try:
+            status, html_page = req(port, "/")
+            assert status == 200
+            assert inst.id in html_page and "4.0" in html_page
+
+            status, detail = req(port, f"/engine_instances/{inst.id}.html")
+            assert status == 200 and "M" in detail
+            status, js = req(port, f"/engine_instances/{inst.id}.json")
+            assert status == 200 and json.loads(js)["bestScore"] == 4.0
+
+            status, _ = req(port, "/engine_instances/nope.html")
+            assert status == 404
+        finally:
+            srv.stop()

@@ -1,0 +1,97 @@
+"""CLI tests — in-process main(argv) against an injected Storage (the
+black-box shell tests of the reference live in test_console_sh via
+subprocess; these cover command logic + output)."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.tools.console import main
+
+
+@pytest.fixture()
+def cli(fresh_storage, monkeypatch):
+    Storage.set_instance(fresh_storage)
+    yield lambda *argv: main(list(argv))
+    Storage.set_instance(None)
+
+
+def test_app_lifecycle(cli, capsys):
+    assert cli("app", "new", "myapp", "--access-key", "SECRET") == 0
+    out = capsys.readouterr().out
+    assert "App created" in out and "SECRET" in out
+
+    assert cli("app", "new", "myapp") == 1  # duplicate
+
+    assert cli("app", "list") == 0
+    assert "myapp" in capsys.readouterr().out
+
+    assert cli("app", "show", "myapp") == 0
+    assert "SECRET" in capsys.readouterr().out
+
+    assert cli("app", "delete", "myapp", "-f") == 0
+    assert cli("app", "show", "myapp") == 1
+
+
+def test_channel_and_accesskey(cli, capsys):
+    cli("app", "new", "chapp")
+    capsys.readouterr()
+    assert cli("channel", "new", "chapp", "live") == 0
+    assert cli("channel", "new", "chapp", "bad name!") == 1
+    assert cli("accesskey", "new", "chapp", "--key", "K2", "--events", "rate,buy") == 0
+    capsys.readouterr()
+    assert cli("accesskey", "list", "chapp") == 0
+    out = capsys.readouterr().out
+    assert "K2" in out and "rate,buy" in out
+    assert cli("accesskey", "delete", "K2") == 0
+    assert cli("accesskey", "delete", "K2") == 1
+    assert cli("channel", "delete", "chapp", "live") == 0
+    assert cli("channel", "delete", "chapp", "live") == 1
+
+
+def test_train_from_cli(cli, tmp_path, capsys):
+    variant = {
+        "id": "cli-test",
+        "engineFactory": "sample_engine.Engine0Factory",
+        "datasource": {"params": {"id": 1}},
+        "preparator": {"params": {"id": 2}},
+        "algorithms": [{"name": "algo0", "params": {"id": 3}}],
+    }
+    path = tmp_path / "engine.json"
+    path.write_text(json.dumps(variant))
+    assert cli("train", "--engine-json", str(path)) == 0
+    assert "Training completed" in capsys.readouterr().out
+
+    # stop-after-read is a clean interrupted stop, not a failure
+    assert cli("train", "--engine-json", str(path), "--stop-after-read") == 0
+    assert "interrupted" in capsys.readouterr().out.lower()
+
+
+def test_status(cli, capsys):
+    assert cli("status") == 0
+    out = capsys.readouterr().out
+    assert "ready to go" in out
+
+
+def test_export_import_roundtrip(cli, tmp_path, capsys):
+    cli("app", "new", "exapp")
+    capsys.readouterr()
+    # import some events
+    src = tmp_path / "in.jsonl"
+    lines = [
+        {"event": "rate", "entityType": "user", "entityId": f"u{i}",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": 5}}
+        for i in range(4)
+    ]
+    lines.append({"event": "$bad", "entityType": "user", "entityId": "x"})
+    src.write_text("\n".join(json.dumps(l) for l in lines))
+    assert cli("import", "--app", "exapp", "--input", str(src)) == 1  # 1 bad line
+    assert "Imported 4 events" in capsys.readouterr().out
+
+    dst = tmp_path / "out.jsonl"
+    assert cli("export", "--app", "exapp", "--output", str(dst)) == 0
+    exported = [json.loads(l) for l in dst.read_text().splitlines()]
+    assert len(exported) == 4
+    assert {e["entityId"] for e in exported} == {f"u{i}" for i in range(4)}
