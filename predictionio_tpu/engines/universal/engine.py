@@ -1,0 +1,280 @@
+"""Universal-Recommender-style engine: multi-event CCO + realtime history.
+
+Reference: the ActionML Universal Recommender (external template
+actionml/template-scala-parallel-universal-recommendation — the fork's
+north-star workload, RELEASE.md:3; BASELINE.json configs #5). Its
+prerequisites in the fork are all present here: batch events API,
+SelfCleaningDataSource (core/self_cleaning.py), and
+deploy-without-retraining.
+
+Shape of the engine:
+- DataSource reads one EventFrame per *indicator* event type (the first
+  indicator is the PRIMARY — its targets define the recommendation item
+  space) and optionally self-cleans the event store first.
+- Algorithm computes, per indicator, each item's top correlators by CCO+LLR
+  (models/cco.py — dense MXU matmuls, user-sharded over the mesh).
+- Serving reads the user's RECENT event history live from the event store
+  (the reason the reference fork needed serving-time LEventStore reads) and
+  scores items by summed LLR over history hits, minus business rules.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+)
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.core.self_cleaning import EventWindow, SelfCleaningDataSource
+from predictionio_tpu.data.store.bimap import BiMap
+from predictionio_tpu.data.store.event_store import EventStoreFacade
+from predictionio_tpu.models import cco, ranking
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class Query:
+    user: str
+    num: int = 10
+    blacklist: Optional[list[str]] = None
+    # exclude items the user has already acted on with the primary event
+    exclude_seen: bool = True
+
+
+@dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass
+class PredictedResult:
+    item_scores: list[ItemScore] = field(default_factory=list)
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str
+    # indicator event names, PRIMARY first (UR's eventNames)
+    indicators: tuple[str, ...] = ("buy", "view")
+    # optional self-cleaning window: {"duration": "30 days", ...}
+    event_window: Optional[dict] = None
+
+
+@dataclass
+class IndicatorData:
+    name: str
+    rows: np.ndarray  # user idx
+    cols: np.ndarray  # target idx (into its own target vocab)
+    target_vocab: BiMap
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    indicators: list[IndicatorData]
+    n_users: int
+    user_vocab: BiMap
+
+    def sanity_check(self) -> None:
+        if not self.indicators or len(self.indicators[0].rows) == 0:
+            raise ValueError("no primary indicator events found")
+
+
+class URDataSource(DataSource, SelfCleaningDataSource):
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+        self.app_name = params.app_name
+        self.event_window = (
+            EventWindow(**params.event_window) if params.event_window else None
+        )
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        self.clean_persisted_events(ctx)
+        store = EventStoreFacade(ctx.storage)
+        frame = store.find_frame(
+            app_name=self.params.app_name,
+            entity_type="user",
+            event_names=list(self.params.indicators),
+        )
+        indicators = []
+        for name in self.params.indicators:
+            sub = frame.where_event(name)
+            mask = sub.target_idx >= 0
+            # each indicator gets its own compact target vocabulary
+            raw_targets = sub.target_idx[mask]
+            uniq = np.unique(raw_targets)
+            remap = {int(t): i for i, t in enumerate(uniq)}
+            inv_frame = frame.target_vocab.inverse()
+            vocab = BiMap({inv_frame(int(t)): i for t, i in remap.items()})
+            indicators.append(
+                IndicatorData(
+                    name=name,
+                    rows=sub.entity_idx[mask].astype(np.int32),
+                    cols=np.asarray(
+                        [remap[int(t)] for t in raw_targets], dtype=np.int32
+                    ),
+                    target_vocab=vocab,
+                )
+            )
+        return TrainingData(
+            indicators=indicators,
+            n_users=frame.n_entities,
+            user_vocab=frame.entity_vocab,
+        )
+
+
+# -- algorithm --------------------------------------------------------------
+
+
+@dataclass
+class URAlgorithmParams:
+    app_name: str
+    max_correlators_per_item: int = 50
+    max_query_events: int = 100  # recent history depth per indicator
+    indicators: Optional[tuple[str, ...]] = None  # default: all from data
+
+
+@dataclass
+class IndicatorModel:
+    name: str
+    correlator_scores: np.ndarray  # (I, top_n)
+    correlator_idx: np.ndarray  # (I, top_n) into its target vocab, -1 pad
+    target_vocab: BiMap
+
+
+class URModel:
+    def __init__(
+        self,
+        item_vocab: BiMap,
+        indicator_models: list[IndicatorModel],
+        primary_indicator: str,
+    ):
+        self.item_vocab = item_vocab  # primary target vocab = item space
+        self.indicator_models = indicator_models
+        self.primary_indicator = primary_indicator
+
+
+class URAlgorithm(Algorithm):
+    def __init__(self, params: URAlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> URModel:
+        primary = pd.indicators[0]
+        n_items = len(primary.target_vocab)
+        p_matrix = cco.edges_to_indicator(
+            primary.rows, primary.cols, pd.n_users, n_items
+        )
+        wanted = self.params.indicators or tuple(i.name for i in pd.indicators)
+        models = []
+        for ind in pd.indicators:
+            if ind.name not in wanted:
+                continue
+            s_matrix = cco.edges_to_indicator(
+                ind.rows, ind.cols, pd.n_users, len(ind.target_vocab)
+            )
+            scores, idx = cco.cross_occurrence_topn(
+                p_matrix,
+                s_matrix,
+                top_n=self.params.max_correlators_per_item,
+                self_indicator=ind.name == primary.name,
+                mesh=ctx.mesh,
+            )
+            models.append(
+                IndicatorModel(
+                    name=ind.name,
+                    correlator_scores=scores,
+                    correlator_idx=idx,
+                    target_vocab=ind.target_vocab,
+                )
+            )
+        return URModel(
+            item_vocab=primary.target_vocab,
+            indicator_models=models,
+            primary_indicator=primary.name,
+        )
+
+    # -- serving -----------------------------------------------------------
+    def _user_history(
+        self,
+        ctx: RuntimeContext,
+        user: str,
+        event_name: str,
+        target_vocab: BiMap,
+    ) -> np.ndarray:
+        if ctx.storage is None:
+            return np.empty(0, dtype=np.int64)
+        store = EventStoreFacade(ctx.storage)
+        try:
+            events = store.find_by_entity(
+                app_name=self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=[event_name],
+                limit=self.params.max_query_events,
+                latest=True,
+            )
+            rows = []
+            for e in events:
+                ix = target_vocab.get(e.target_entity_id)
+                if ix is not None:
+                    rows.append(ix)
+            return np.asarray(rows, dtype=np.int64)
+        except Exception:
+            log.exception("history lookup failed for %s", event_name)
+            return np.empty(0, dtype=np.int64)
+
+    def predict(self, model: URModel, query: Query) -> PredictedResult:
+        ctx = self.serving_context
+        n_items = len(model.item_vocab)
+        scores = np.zeros(n_items, dtype=np.float32)
+        for ind in model.indicator_models:
+            history = self._user_history(
+                ctx, query.user, ind.name, ind.target_vocab
+            )
+            scores += cco.score_history(
+                ind.correlator_idx, ind.correlator_scores, history
+            )
+        excluded = np.zeros(n_items, dtype=bool)
+        if query.exclude_seen:
+            # seen-filter always works in the PRIMARY item space, even when
+            # the algorithm was configured to keep only secondary indicators
+            primary_history = self._user_history(
+                ctx, query.user, model.primary_indicator, model.item_vocab
+            )
+            excluded[primary_history] = True
+        for it in query.blacklist or []:
+            ix = model.item_vocab.get(it)
+            if ix is not None:
+                excluded[ix] = True
+        # items with zero LLR evidence are not recommendations
+        excluded |= scores <= 0.0
+        scores = ranking.exclusion_scores(scores, excluded)
+        inv = model.item_vocab.inverse()
+        return PredictedResult(
+            item_scores=[
+                ItemScore(item=inv(int(ix)), score=float(scores[ix]))
+                for ix in ranking.top_k_indices(scores, query.num)
+            ]
+        )
+
+
+class UniversalRecommenderEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            URDataSource,
+            IdentityPreparator,
+            {"ur": URAlgorithm},
+            FirstServing,
+        )

@@ -1,0 +1,143 @@
+"""Correlated cross-occurrence (CCO) with log-likelihood-ratio scoring.
+
+The compute core of the Universal Recommender (BASELINE.json configs #5;
+external template actionml/template-scala-parallel-universal-recommendation,
+which delegates to Mahout's SimilarityAnalysis.cooccurrences on Spark).
+
+TPU-first design: the cross-occurrence count matrix between a primary
+interaction matrix P (users × items) and a secondary indicator matrix S
+(users × things) is EXACTLY PᵀS on binarized indicators — one dense MXU
+matmul — instead of Mahout's sparse row-similarity shuffle. Dunning's LLR
+then scores every (item, thing) pair elementwise on device, and a masked
+top-k keeps each item's strongest correlators. Multi-chip: shard the user
+dimension over the mesh's data axis; GSPMD reduces the matmul's user
+contraction with an ICI all-reduce (psum) — user-partitioned co-occurrence
+counting, the TPU-native analogue of Mahout's map-side combining.
+
+Counts stay exact in float32 (counts ≤ U < 2²⁴) with HIGHEST precision.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.ops.topk import NEG_INF, masked_top_k
+
+
+def _x_log_x(x: jax.Array) -> jax.Array:
+    return jnp.where(x > 0, x * jnp.log(jnp.maximum(x, 1e-30)), 0.0)
+
+
+def llr_scores(
+    k11: jax.Array,  # (I, J) co-occurrence counts
+    prim_totals: jax.Array,  # (I,) per-item event totals
+    sec_totals: jax.Array,  # (J,) per-thing event totals
+    n_users: jax.Array | float,
+) -> jax.Array:
+    """Dunning log-likelihood ratio of the 2×2 contingency per pair."""
+    k12 = prim_totals[:, None] - k11
+    k21 = sec_totals[None, :] - k11
+    k22 = n_users - k11 - k12 - k21
+    row_entropy = _x_log_x(k11 + k12) + _x_log_x(k21 + k22)
+    col_entropy = _x_log_x(k11 + k21) + _x_log_x(k12 + k22)
+    mat_entropy = (
+        _x_log_x(k11) + _x_log_x(k12) + _x_log_x(k21) + _x_log_x(k22)
+    )
+    llr = 2.0 * (mat_entropy - row_entropy - col_entropy + _x_log_x(
+        jnp.asarray(n_users, jnp.float32)
+    ))
+    return jnp.maximum(llr, 0.0)
+
+
+@partial(jax.jit, static_argnames=("top_n", "exclude_diagonal"))
+def _cco_topn(
+    primary: jax.Array,  # (U, I) binarized (possibly zero-padded rows)
+    secondary: jax.Array,  # (U, J) binarized
+    n_users: jax.Array,  # scalar — TRUE user count (padding rows excluded)
+    *,
+    top_n: int,
+    exclude_diagonal: bool,
+):
+    counts = jax.lax.dot_general(
+        primary, secondary,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (I, J) — MXU, user dim contracted (psum over dp shards)
+    prim_totals = jnp.sum(primary, axis=0)
+    sec_totals = jnp.sum(secondary, axis=0)
+    llr = llr_scores(counts, prim_totals, sec_totals, n_users)
+    exclude = counts <= 0  # never correlate never-co-occurring pairs
+    if exclude_diagonal:
+        eye = jnp.eye(llr.shape[0], llr.shape[1], dtype=bool)
+        exclude = exclude | eye
+    vals, idx = masked_top_k(llr, top_n, exclude)
+    idx = jnp.where(vals > 0.0, idx, -1)  # llr 0 → not a correlator
+    return vals, idx
+
+
+def edges_to_indicator(
+    rows: np.ndarray, cols: np.ndarray, n_rows: int, n_cols: int
+) -> np.ndarray:
+    """Binarized dense indicator matrix from an edge list."""
+    m = np.zeros((n_rows, n_cols), dtype=np.float32)
+    m[rows, cols] = 1.0
+    return m
+
+
+def cross_occurrence_topn(
+    primary: np.ndarray,  # (U, I)
+    secondary: np.ndarray,  # (U, J)
+    top_n: int,
+    self_indicator: bool = False,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per primary item: top correlator columns of `secondary` by LLR.
+
+    Returns (scores (I, top_n), indices (I, top_n)) with -1 index padding.
+    `self_indicator` excludes the diagonal (an item trivially co-occurs
+    with itself)."""
+    top_n = min(top_n, secondary.shape[1])
+    true_n_users = primary.shape[0]
+    if mesh is not None:
+        # pad the user dim so it shards evenly; zero rows are inert in the
+        # counts/totals and the true user count is passed separately for LLR
+        pad = (-primary.shape[0]) % mesh.devices.size
+        if pad:
+            primary = np.concatenate(
+                [primary, np.zeros((pad, primary.shape[1]), np.float32)]
+            )
+            secondary = np.concatenate(
+                [secondary, np.zeros((pad, secondary.shape[1]), np.float32)]
+            )
+    p = jnp.asarray(primary)
+    s = jnp.asarray(secondary)
+    if mesh is not None:
+        from predictionio_tpu.parallel.mesh import DATA_AXIS
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        user_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        p = jax.device_put(p, user_sh)
+        s = jax.device_put(s, user_sh)
+    vals, idx = _cco_topn(
+        p, s, jnp.float32(true_n_users),
+        top_n=top_n, exclude_diagonal=self_indicator,
+    )
+    return np.asarray(vals), np.asarray(idx)
+
+
+def score_history(
+    correlator_idx: np.ndarray,  # (I, top_n) int, -1 padded
+    correlator_scores: np.ndarray,  # (I, top_n)
+    history: np.ndarray,  # (H,) int — the user's recent things for this indicator
+) -> np.ndarray:
+    """Serving-side: per-item sum of LLR over correlators present in the
+    user's history. Vectorized membership test — no per-item Python."""
+    if len(history) == 0:
+        return np.zeros(correlator_idx.shape[0], dtype=np.float32)
+    hit = np.isin(correlator_idx, history) & (correlator_idx >= 0)
+    return np.where(hit, correlator_scores, 0.0).sum(axis=1).astype(np.float32)

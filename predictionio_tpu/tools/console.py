@@ -142,12 +142,9 @@ def cmd_channel_delete(args) -> int:
     app = _get_app(storage, args.app)
     if app is None:
         return 1
-    chans = storage.get_meta_data_channels()
-    match = [c for c in chans.get_by_app_id(app.id) if c.name == args.channel]
-    if not match:
-        return _fail(f"Channel '{args.channel}' does not exist.")
-    storage.get_events().remove_app(app.id, match[0].id)
-    chans.delete(match[0].id)
+    channel_id = common.resolve_channel(storage, app, args.channel)
+    storage.get_events().remove_app(app.id, channel_id)
+    storage.get_meta_data_channels().delete(channel_id)
     print(f"[INFO] Channel '{args.channel}' deleted.")
     return 0
 

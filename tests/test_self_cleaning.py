@@ -1,0 +1,155 @@
+"""SelfCleaningDataSource tests (port of reference
+SelfCleaningDataSourceTest: compaction, dedupe, age-out)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.core.self_cleaning import (
+    EventWindow,
+    SelfCleaningDataSource,
+    parse_duration,
+)
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App, EventQuery
+
+UTC = dt.timezone.utc
+
+
+class CleaningSource(SelfCleaningDataSource):
+    def __init__(self, app_name, window):
+        self.app_name = app_name
+        self.event_window = window
+
+
+@pytest.fixture()
+def app(fresh_storage):
+    app_id = fresh_storage.get_meta_data_apps().insert(App(id=0, name="clean"))
+    fresh_storage.get_events().init_app(app_id)
+    return fresh_storage, app_id
+
+
+def all_events(storage, app_id):
+    return list(storage.get_events().find(EventQuery(app_id=app_id)))
+
+
+def test_parse_duration():
+    assert parse_duration("4 days") == dt.timedelta(days=4)
+    assert parse_duration("12 hours") == dt.timedelta(hours=12)
+    assert parse_duration("1 week") == dt.timedelta(weeks=1)
+    with pytest.raises(ValueError):
+        parse_duration("fortnight")
+
+
+def test_compress_properties(app):
+    storage, app_id = app
+    t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    storage.get_events().insert_batch(
+        [
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties={"a": 1, "b": 2}, event_time=t0),
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties={"a": 9}, event_time=t0 + dt.timedelta(days=1)),
+            Event(event="$unset", entity_type="item", entity_id="i1",
+                  properties={"b": None},
+                  event_time=t0 + dt.timedelta(days=2)),
+            Event(event="$set", entity_type="item", entity_id="i2",
+                  properties={"x": 1}, event_time=t0),
+        ],
+        app_id,
+    )
+    src = CleaningSource("clean", EventWindow(compress_properties=True))
+    stats = src.clean_persisted_events(RuntimeContext(storage=storage))
+    assert stats["compacted"] == 3  # i1's three events; i2 untouched
+
+    events = all_events(storage, app_id)
+    i1 = [e for e in events if e.entity_id == "i1"]
+    assert len(i1) == 1
+    assert i1[0].event == "$set"
+    assert i1[0].properties.to_dict() == {"a": 9}  # b unset, a overwritten
+    assert len([e for e in events if e.entity_id == "i2"]) == 1
+
+
+def test_compact_fully_deleted_entity(app):
+    storage, app_id = app
+    t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    storage.get_events().insert_batch(
+        [
+            Event(event="$set", entity_type="item", entity_id="gone",
+                  properties={"a": 1}, event_time=t0),
+            Event(event="$delete", entity_type="item", entity_id="gone",
+                  event_time=t0 + dt.timedelta(days=1)),
+        ],
+        app_id,
+    )
+    src = CleaningSource("clean", EventWindow(compress_properties=True))
+    src.clean_persisted_events(RuntimeContext(storage=storage))
+    assert all_events(storage, app_id) == []  # deleted entity leaves nothing
+
+
+def test_remove_duplicates(app):
+    storage, app_id = app
+    t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    dup = dict(
+        event="buy", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i1",
+    )
+    storage.get_events().insert_batch(
+        [
+            Event(**dup, event_time=t0),
+            Event(**dup, event_time=t0 + dt.timedelta(hours=1)),
+            Event(**dup, event_time=t0 + dt.timedelta(hours=2)),
+            Event(event="buy", entity_type="user", entity_id="u2",
+                  target_entity_type="item", target_entity_id="i1",
+                  event_time=t0),
+        ],
+        app_id,
+    )
+    src = CleaningSource("clean", EventWindow(remove_duplicates=True))
+    stats = src.clean_persisted_events(RuntimeContext(storage=storage))
+    assert stats["deduplicated"] == 2
+    events = all_events(storage, app_id)
+    assert len(events) == 2
+    # the EARLIEST copy survives
+    u1 = [e for e in events if e.entity_id == "u1"]
+    assert u1[0].event_time == t0
+
+
+def test_age_out(app):
+    storage, app_id = app
+    now = dt.datetime.now(UTC)
+    storage.get_events().insert_batch(
+        [
+            Event(event="view", entity_type="user", entity_id="old",
+                  target_entity_type="item", target_entity_id="i1",
+                  event_time=now - dt.timedelta(days=30)),
+            Event(event="view", entity_type="user", entity_id="new",
+                  target_entity_type="item", target_entity_id="i1",
+                  event_time=now - dt.timedelta(hours=1)),
+            # $set events are NOT aged out (they carry state, not history)
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties={"a": 1},
+                  event_time=now - dt.timedelta(days=60)),
+        ],
+        app_id,
+    )
+    src = CleaningSource("clean", EventWindow(duration="7 days"))
+    stats = src.clean_persisted_events(RuntimeContext(storage=storage))
+    assert stats["aged_out"] == 1
+    remaining = all_events(storage, app_id)
+    ids = {e.entity_id for e in remaining}
+    assert ids == {"new", "i1"}
+
+
+def test_no_window_is_noop(app):
+    storage, app_id = app
+    storage.get_events().insert(
+        Event(event="view", entity_type="user", entity_id="u",
+              target_entity_type="item", target_entity_id="i"),
+        app_id,
+    )
+    src = CleaningSource("clean", None)
+    stats = src.clean_persisted_events(RuntimeContext(storage=storage))
+    assert stats == {"compacted": 0, "deduplicated": 0, "aged_out": 0}
+    assert len(all_events(storage, app_id)) == 1
