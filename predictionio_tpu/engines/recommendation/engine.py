@@ -232,9 +232,9 @@ class ALSAlgorithmParams:
 
 
 class ALSModel:
-    """Trained factors + device-resident item factors for serving
+    """Trained factors + device-resident factor matrices for serving
     (reference template ALSModel.scala persists factor RDDs; here the
-    serving-side copy lives in HBM across queries)."""
+    serving-side copies live in HBM across queries)."""
 
     def __init__(
         self,
@@ -244,8 +244,9 @@ class ALSModel:
         self.factors = factors
         self.item_categories = item_categories
         self._item_factors_device = None
+        self._user_factors_device = None
 
-    # device cache is serving state, not part of the pickled model
+    # device caches are serving state, not part of the pickled model
     def __getstate__(self):
         return {"factors": self.factors, "item_categories": self.item_categories}
 
@@ -253,6 +254,7 @@ class ALSModel:
         self.factors = state["factors"]
         self.item_categories = state.get("item_categories")
         self._item_factors_device = None
+        self._user_factors_device = None
 
     def item_factors_device(self):
         if self._item_factors_device is None:
@@ -260,6 +262,13 @@ class ALSModel:
 
             self._item_factors_device = jnp.asarray(self.factors.item_factors)
         return self._item_factors_device
+
+    def user_factors_device(self):
+        if self._user_factors_device is None:
+            import jax.numpy as jnp
+
+            self._user_factors_device = jnp.asarray(self.factors.user_factors)
+        return self._user_factors_device
 
 
 class ALSAlgorithm(Algorithm):
@@ -289,6 +298,29 @@ class ALSAlgorithm(Algorithm):
         return ALSModel(factors, item_categories=pd.item_categories)
 
     # -- serving -----------------------------------------------------------
+    def warmup(self, model: ALSModel) -> None:
+        """Pre-compile the serving programs + stage factors into HBM so the
+        first live queries don't pay XLA compile (deploy server calls this
+        at build_runtime; reference has no analogue — JVM serving had no
+        compile step). Warms the single-query and micro-batch bucket
+        shapes."""
+        if model.factors.user_factors.shape[0] == 0:
+            return
+        vocab_ids = list(model.factors.user_vocab.to_dict())
+        if not vocab_ids:
+            return
+        for batch in (1, 16):
+            # nomask program
+            self._predict_batch(
+                model, [Query(user=vocab_ids[0], num=10)] * batch
+            )
+            # masked program (filters allocate the exclusion-mask variant)
+            self._predict_batch(
+                model,
+                [Query(user=vocab_ids[0], num=10, blacklist=["__warmup__"])]
+                * batch,
+            )
+
     def _exclusion_mask(
         self, model: ALSModel, queries: Sequence[Query]
     ) -> Optional[np.ndarray]:
@@ -338,20 +370,40 @@ class ALSAlgorithm(Algorithm):
         results: list[PredictedResult] = [PredictedResult() for _ in queries]
         if not known_ix:
             return results
-        k = max(q.num for q in queries)
-        k = min(k, model.factors.item_factors.shape[0])
+        # fixed device-side k (pow2-bucketed above a floor) so q.num does
+        # NOT create a new compiled program per distinct value — warmup can
+        # actually cover live traffic; results are sliced to num on host
+        n_items = model.factors.item_factors.shape[0]
+        k_req = min(max(q.num for q in queries), n_items)
+        k = n_items if n_items <= 128 else min(
+            n_items, max(128, 1 << (k_req - 1).bit_length())
+        )
         user_rows = np.array([u for _, u in known_ix], dtype=np.int64)
         full_mask = self._exclusion_mask(model, queries)
         sub_mask = (
             full_mask[[i for i, _ in known_ix]] if full_mask is not None else None
         )
+        # bucket the batch dim to powers of two so micro-batched serving
+        # reuses a handful of compiled programs instead of one per size
+        n_real = len(user_rows)
+        bucket = 1 << (n_real - 1).bit_length() if n_real > 1 else 1
+        if bucket != n_real:
+            user_rows = np.concatenate(
+                [user_rows, np.zeros(bucket - n_real, dtype=np.int64)]
+            )
+            if sub_mask is not None:
+                sub_mask = np.concatenate(
+                    [sub_mask, np.zeros((bucket - n_real, sub_mask.shape[1]), bool)]
+                )
         scores, items = als.recommend(
             model.factors,
             user_rows,
             k,
             exclude_mask=sub_mask,
             item_factors_device=model.item_factors_device(),
+            user_factors_device=model.user_factors_device(),
         )
+        scores, items = scores[:n_real], items[:n_real]
         inv = model.factors.item_vocab.inverse()
         from predictionio_tpu.ops.topk import NEG_INF
 

@@ -329,13 +329,25 @@ def train(
 
 @partial(jax.jit, static_argnames=("k",))
 def _recommend_jit(
-    user_vec: jax.Array,  # (B, K)
-    item_factors: jax.Array,  # (I, K)
+    user_rows: jax.Array,  # (B,) int — rows into user_factors
+    user_factors: jax.Array,  # (U, K) device-resident
+    item_factors: jax.Array,  # (I, K) device-resident
     exclude_mask: jax.Array,  # (B, I) bool
     k: int,
 ):
-    scores = user_vec @ item_factors.T  # (B, I) — MXU
+    scores = user_factors[user_rows] @ item_factors.T  # (B, I) — MXU
     return masked_top_k(scores, k, exclude_mask)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _recommend_jit_nomask(
+    user_rows: jax.Array,
+    user_factors: jax.Array,
+    item_factors: jax.Array,
+    k: int,
+):
+    scores = user_factors[user_rows] @ item_factors.T
+    return jax.lax.top_k(scores, k)
 
 
 def recommend(
@@ -344,22 +356,30 @@ def recommend(
     k: int,
     exclude_mask: Optional[np.ndarray] = None,  # (B, I) bool
     item_factors_device: Optional[jax.Array] = None,
+    user_factors_device: Optional[jax.Array] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k items for a batch of users; returns (scores, item_indices).
 
-    `item_factors_device` lets the deploy server keep factors resident in
-    HBM across queries (CreateServer-style TPU-resident model state)."""
+    The serving hot path is ONE device dispatch: only the (B,) user rows
+    (and the mask, when any filter applies) cross host→device per query;
+    both factor matrices stay HBM-resident across queries
+    (CreateServer-style TPU-resident model state). The unfiltered path
+    skips mask allocation entirely."""
     itf = (
         item_factors_device
         if item_factors_device is not None
         else jnp.asarray(model.item_factors)
     )
-    uvec = jnp.asarray(model.user_factors[np.asarray(user_indices)])
+    uf = (
+        user_factors_device
+        if user_factors_device is not None
+        else jnp.asarray(model.user_factors)
+    )
+    rows = jnp.asarray(np.asarray(user_indices, dtype=np.int32))
     if exclude_mask is None:
-        exclude_mask = jnp.zeros((uvec.shape[0], itf.shape[0]), dtype=bool)
+        vals, idx = _recommend_jit_nomask(rows, uf, itf, k)
     else:
-        exclude_mask = jnp.asarray(exclude_mask)
-    vals, idx = _recommend_jit(uvec, itf, exclude_mask, k)
+        vals, idx = _recommend_jit(rows, uf, itf, jnp.asarray(exclude_mask), k)
     return np.asarray(vals), np.asarray(idx)
 
 

@@ -67,6 +67,9 @@ class JsonHandler(BaseHTTPRequestHandler):
 class ThreadedServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # socketserver's default listen backlog of 5 drops connections under
+    # concurrent load (micro-batched serving expects bursts of clients)
+    request_queue_size = 128
 
 
 class ServerProcess:

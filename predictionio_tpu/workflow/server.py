@@ -51,6 +51,12 @@ class QueryServerConfig:
     event_server_url: Optional[str] = None  # e.g. http://127.0.0.1:7070
     access_key: Optional[str] = None
     plugins: list = field(default_factory=list)
+    # micro-batching: coalesce concurrent queries into one device program
+    # (the "one model, many queries → batched inference queue" hard part,
+    # SURVEY.md §7 — no reference analogue; JVM serving was per-request)
+    micro_batch: bool = False
+    batch_window_ms: float = 2.0
+    max_batch: int = 64
 
 
 @dataclass
@@ -76,8 +82,14 @@ def build_runtime(storage: Storage, instance: EngineInstance) -> EngineRuntime:
     algorithms = engine.make_algorithms(engine_params)
     serving = engine.make_serving(engine_params)
     serving_ctx = RuntimeContext(storage=storage, mode="serve")
-    for algo in algorithms:
+    for algo, model in zip(algorithms, models):
         algo.set_serving_context(serving_ctx)
+        warmup = getattr(algo, "warmup", None)
+        if callable(warmup):
+            try:
+                warmup(model)
+            except Exception:
+                log.exception("algorithm warmup failed; serving continues")
     query_class = algorithms[0].query_class() if algorithms else None
     return EngineRuntime(
         instance=instance,
@@ -182,11 +194,14 @@ class _Handler(JsonHandler):
 
             supplemented = rt.serving.supplement(query)
             try:
-                predictions = [
-                    algo.predict(model, supplemented)
-                    for algo, model in zip(rt.algorithms, rt.models)
-                ]
-                prediction = rt.serving.serve(supplemented, predictions)
+                if owner.dispatcher is not None:
+                    prediction = owner.dispatcher.submit(supplemented, rt)
+                else:
+                    predictions = [
+                        algo.predict(model, supplemented)
+                        for algo, model in zip(rt.algorithms, rt.models)
+                    ]
+                    prediction = rt.serving.serve(supplemented, predictions)
             except ValueError as e:
                 # algorithms raise ValueError for query-level contract
                 # violations (e.g. category filter without category data)
@@ -209,6 +224,108 @@ class _Handler(JsonHandler):
         except Exception as e:
             log.exception("query failed")
             self._respond(500, {"message": str(e)})
+
+
+class _BatchDispatcher:
+    """Coalesces concurrent queries into one batch_predict device call.
+
+    Handler threads submit a supplemented query and block on a Future; a
+    single dispatcher thread drains the queue every `window_ms` (or at
+    `max_batch`) and runs the runtime's algorithms once for the whole
+    batch."""
+
+    def __init__(self, owner: "QueryServer", window_ms: float, max_batch: int):
+        import queue
+
+        self.owner = owner
+        self.window_s = window_ms / 1000.0
+        self.max_batch = max_batch
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="query-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, query: Any, runtime: "EngineRuntime", timeout: float = 30.0) -> Any:
+        """Submit with the runtime snapshot the handler extracted the query
+        against — a /reload mid-window must not serve an old-typed query
+        with the new model."""
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        self._queue.put((query, runtime, fut))
+        return fut.result(timeout=timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+        # fail any waiters still queued so their handler threads don't
+        # block out the full submit timeout
+        import queue as _q
+
+        while True:
+            try:
+                _query, _rt, fut = self._queue.get_nowait()
+            except _q.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(RuntimeError("query server stopped"))
+
+    def _run_group(self, rt: "EngineRuntime", group: list) -> None:
+        queries = [(i, q) for i, (q, _f) in enumerate(group)]
+        try:
+            per_algo = [
+                dict(algo.batch_predict(algo.serving_context, model, queries))
+                for algo, model in zip(rt.algorithms, rt.models)
+            ]
+            for i, (q, fut) in enumerate(group):
+                try:
+                    fut.set_result(
+                        rt.serving.serve(q, [pa[i] for pa in per_algo])
+                    )
+                except Exception as e:  # serve failure is per-query
+                    fut.set_exception(e)
+        except Exception:
+            # one bad query must not poison the batch: retry individually
+            # so each waiter gets its own result or its own error
+            for _i, (q, fut) in enumerate(group):
+                try:
+                    predictions = [
+                        algo.predict(model, q)
+                        for algo, model in zip(rt.algorithms, rt.models)
+                    ]
+                    fut.set_result(rt.serving.serve(q, predictions))
+                except Exception as e:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def _loop(self) -> None:
+        import queue as _q
+        import time as _t
+
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.2)
+            except _q.Empty:
+                continue
+            batch = [first]
+            deadline = _t.monotonic() + self.window_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except _q.Empty:
+                    break
+            # group by runtime snapshot: queries spanning a /reload are
+            # served by the runtime they were extracted against
+            groups: dict[int, tuple[Any, list]] = {}
+            for query, rt, fut in batch:
+                groups.setdefault(id(rt), (rt, []))[1].append((query, fut))
+            for rt, group in groups.values():
+                self._run_group(rt, group)
 
 
 class _Server(ThreadedServer):
@@ -243,6 +360,16 @@ class QueryServer(ServerProcess):
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        self.dispatcher: Optional[_BatchDispatcher] = None
+        if self.config.micro_batch:
+            self.dispatcher = _BatchDispatcher(
+                self, self.config.batch_window_ms, self.config.max_batch
+            )
+
+    def stop(self) -> None:
+        if self.dispatcher is not None:
+            self.dispatcher.stop()
+        super().stop()
 
     def _make_server(self) -> _Server:
         server = _Server((self.config.ip, self.config.port), _Handler)

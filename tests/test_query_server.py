@@ -139,6 +139,42 @@ def test_hot_reload_swaps_to_latest(served):
     assert status == 200 and len(body["item_scores"]) == 2
 
 
+def test_micro_batching(fresh_storage):
+    """Concurrent queries coalesce into batched device calls and still get
+    the right per-user answers."""
+    import concurrent.futures
+
+    seed(fresh_storage)
+    run_train(fresh_storage, VARIANT)
+    runtime = latest_completed_runtime(fresh_storage, "qsrv", "0", "qsrv")
+    srv = QueryServer(
+        fresh_storage,
+        runtime,
+        QueryServerConfig(
+            ip="127.0.0.1", port=0, micro_batch=True, batch_window_ms=10.0
+        ),
+    )
+    port = srv.start()
+    try:
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futures = {
+                u: pool.submit(post, port, "/queries.json", {"user": f"u{u}", "num": 3})
+                for u in range(8)
+            }
+            results = {u: f.result() for u, f in futures.items()}
+        for u, (status, body) in results.items():
+            assert status == 200
+            items = {s["item"] for s in body["item_scores"]}
+            lo, hi = (0, 5) if u % 2 == 0 else (5, 10)
+            cohort = {f"i{i}" for i in range(lo, hi)}
+            assert items <= cohort, (u, items)
+        # validation still 400s through the batched path
+        status, body = post(port, "/queries.json", {"user": "u0", "oops": 1})
+        assert status == 400
+    finally:
+        srv.stop()
+
+
 def test_feedback_loop(fresh_storage):
     app_id = seed(fresh_storage)
     fresh_storage.get_meta_data_access_keys().insert(
