@@ -1,0 +1,314 @@
+"""Parquet-segment event store: the columnar filesystem backend.
+
+Fills the role of the reference's HDFS-parquet surfaces (DataView's parquet
+caching, view/DataView.scala:37-110, and the HDFS model store) for EVENT
+data: events land in immutable parquet segments per (app, channel)
+namespace, deletes are tombstones compacted on flush, and the training
+read path (`find_frame`) scans only the needed columns straight into an
+EventFrame — no per-row Event object materialization between disk and the
+device-staging arrays.
+
+Layout under PATH:
+  app_{appId}[_{channelId}]/seg-{n:08d}.parquet
+  app_{appId}[_{channelId}]/tombstones.json
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import shutil
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage.base import EventQuery, EventStore
+from predictionio_tpu.data.store.columnar import EventFrame
+
+_SCHEMA = pa.schema(
+    [
+        ("event_id", pa.string()),
+        ("event", pa.string()),
+        ("entity_type", pa.string()),
+        ("entity_id", pa.string()),
+        ("target_entity_type", pa.string()),
+        ("target_entity_id", pa.string()),
+        ("properties", pa.string()),  # JSON
+        ("event_time_ms", pa.int64()),
+        ("tags", pa.string()),  # JSON array
+        ("pr_id", pa.string()),
+        ("creation_time_ms", pa.int64()),
+    ]
+)
+
+_UTC = _dt.timezone.utc
+
+
+def _ms(t: _dt.datetime) -> int:
+    return int(t.timestamp() * 1000)
+
+
+def _from_ms(ms: int) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(ms / 1000.0, _UTC)
+
+
+class ParquetFSEventStore(EventStore):
+    FLUSH_THRESHOLD = 4096
+
+    def __init__(self, config: dict):
+        path = config.get("PATH")
+        if not path:
+            raise ValueError("parquetfs requires a PATH setting")
+        self.base = path
+        os.makedirs(self.base, exist_ok=True)
+        self._lock = threading.RLock()
+        # (app, ch) → list[Event] pending write
+        self._buffers: dict[tuple[int, Optional[int]], list[Event]] = {}
+
+    # -- namespace plumbing ------------------------------------------------
+    def _dir(self, app_id: int, channel_id: Optional[int]) -> str:
+        name = f"app_{app_id}" + (f"_{channel_id}" if channel_id else "")
+        return os.path.join(self.base, name)
+
+    def _segments(self, d: str) -> list[str]:
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            os.path.join(d, f)
+            for f in os.listdir(d)
+            if f.startswith("seg-") and f.endswith(".parquet")
+        )
+
+    def _tombstones(self, d: str) -> set[str]:
+        p = os.path.join(d, "tombstones.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return set(json.load(f))
+        return set()
+
+    def _write_tombstones(self, d: str, stones: set[str]) -> None:
+        with open(os.path.join(d, "tombstones.json"), "w") as f:
+            json.dump(sorted(stones), f)
+
+    # -- lifecycle ---------------------------------------------------------
+    def init_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        os.makedirs(self._dir(app_id, channel_id), exist_ok=True)
+        return True
+
+    def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            self._buffers.pop((app_id, channel_id), None)
+            d = self._dir(app_id, channel_id)
+            if os.path.isdir(d):
+                shutil.rmtree(d)
+            return True
+
+    # -- writes ------------------------------------------------------------
+    def insert(
+        self, event: Event, app_id: int, channel_id: Optional[int] = None
+    ) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> list[str]:
+        with self._lock:
+            buf = self._buffers.setdefault((app_id, channel_id), [])
+            ids = []
+            for e in events:
+                if e.event_id is None:
+                    e = e.with_id(new_event_id())
+                buf.append(e)
+                ids.append(e.event_id)
+            if len(buf) >= self.FLUSH_THRESHOLD:
+                self._flush(app_id, channel_id)
+            return ids
+
+    def _flush(self, app_id: int, channel_id: Optional[int]) -> None:
+        buf = self._buffers.get((app_id, channel_id))
+        if not buf:
+            return
+        d = self._dir(app_id, channel_id)
+        os.makedirs(d, exist_ok=True)
+        n = len(self._segments(d))
+        table = pa.Table.from_pydict(
+            {
+                "event_id": [e.event_id for e in buf],
+                "event": [e.event for e in buf],
+                "entity_type": [e.entity_type for e in buf],
+                "entity_id": [e.entity_id for e in buf],
+                "target_entity_type": [e.target_entity_type for e in buf],
+                "target_entity_id": [e.target_entity_id for e in buf],
+                "properties": [json.dumps(e.properties.to_dict()) for e in buf],
+                "event_time_ms": [_ms(e.event_time) for e in buf],
+                "tags": [json.dumps(list(e.tags)) for e in buf],
+                "pr_id": [e.pr_id for e in buf],
+                "creation_time_ms": [_ms(e.creation_time) for e in buf],
+            },
+            schema=_SCHEMA,
+        )
+        pq.write_table(table, os.path.join(d, f"seg-{n:08d}.parquet"))
+        buf.clear()
+
+    def flush(self) -> None:
+        with self._lock:
+            for app_id, channel_id in list(self._buffers):
+                self._flush(app_id, channel_id)
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        with self._lock:
+            self._flush(app_id, channel_id)
+            d = self._dir(app_id, channel_id)
+            stones = self._tombstones(d)
+            if event_id in stones:
+                return False
+            # verify existence before tombstoning
+            exists = any(
+                e.event_id == event_id
+                for e in self._iter_events(app_id, channel_id)
+            )
+            if not exists:
+                return False
+            stones.add(event_id)
+            self._write_tombstones(d, stones)
+            return True
+
+    # -- reads -------------------------------------------------------------
+    def _read_table(
+        self, app_id: int, channel_id: Optional[int], columns=None
+    ) -> Optional[pa.Table]:
+        d = self._dir(app_id, channel_id)
+        segs = self._segments(d)
+        if not segs:
+            return None
+        tables = [pq.read_table(s, columns=columns) for s in segs]
+        return pa.concat_tables(tables)
+
+    def _iter_events(
+        self, app_id: int, channel_id: Optional[int]
+    ) -> Iterator[Event]:
+        with self._lock:
+            self._flush(app_id, channel_id)
+            table = self._read_table(app_id, channel_id)
+            stones = self._tombstones(self._dir(app_id, channel_id))
+        if table is None:
+            return
+        cols = {name: table.column(name).to_pylist() for name in table.schema.names}
+        for i in range(table.num_rows):
+            if cols["event_id"][i] in stones:
+                continue
+            yield Event(
+                event=cols["event"][i],
+                entity_type=cols["entity_type"][i],
+                entity_id=cols["entity_id"][i],
+                target_entity_type=cols["target_entity_type"][i],
+                target_entity_id=cols["target_entity_id"][i],
+                properties=DataMap(json.loads(cols["properties"][i])),
+                event_time=_from_ms(cols["event_time_ms"][i]),
+                tags=tuple(json.loads(cols["tags"][i])),
+                pr_id=cols["pr_id"][i],
+                creation_time=_from_ms(cols["creation_time_ms"][i]),
+                event_id=cols["event_id"][i],
+            )
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        for e in self._iter_events(app_id, channel_id):
+            if e.event_id == event_id:
+                return e
+        return None
+
+    def find(self, query: EventQuery) -> Iterator[Event]:
+        matches = (
+            e
+            for e in self._iter_events(query.app_id, query.channel_id)
+            if query.matches(e)
+        )
+        ordered = sorted(
+            matches, key=lambda e: e.event_time, reverse=query.reversed
+        )
+        if query.limit is not None:
+            ordered = ordered[: query.limit]
+        return iter(ordered)
+
+    # -- columnar fast path (the training read) ----------------------------
+    def find_frame(
+        self,
+        query: EventQuery,
+        value_prop: Optional[str] = None,
+        default_value: float = 1.0,
+    ) -> EventFrame:
+        """Column-projected scan → EventFrame. Only the filter/identity
+        columns (+ properties when a value is extracted) leave disk."""
+        with self._lock:
+            self._flush(query.app_id, query.channel_id)
+            columns = [
+                "event_id", "event", "entity_type", "entity_id",
+                "target_entity_id", "event_time_ms",
+            ]
+            if value_prop is not None:
+                columns.append("properties")
+            if query.target_entity_type is not None:
+                columns.append("target_entity_type")
+            table = self._read_table(query.app_id, query.channel_id, columns)
+            stones = self._tombstones(self._dir(query.app_id, query.channel_id))
+        if table is None or table.num_rows == 0:
+            return EventFrame.from_events([])
+
+        mask = np.ones(table.num_rows, dtype=bool)
+        if stones:
+            ids = np.asarray(table.column("event_id").to_pylist(), dtype=object)
+            mask &= ~np.isin(ids, list(stones))
+        times = table.column("event_time_ms").to_numpy()
+        if query.start_time is not None:
+            mask &= times >= _ms(query.start_time)
+        if query.until_time is not None:
+            mask &= times < _ms(query.until_time)
+        names = np.asarray(table.column("event").to_pylist(), dtype=object)
+        if query.event_names is not None:
+            mask &= np.isin(names, list(query.event_names))
+        etypes = np.asarray(table.column("entity_type").to_pylist(), dtype=object)
+        if query.entity_type is not None:
+            mask &= etypes == query.entity_type
+        if query.target_entity_type is not None:
+            ttypes = np.asarray(
+                table.column("target_entity_type").to_pylist(), dtype=object
+            )
+            mask &= ttypes == query.target_entity_type
+
+        idx = np.nonzero(mask)[0]
+        entity_ids = np.asarray(table.column("entity_id").to_pylist(), dtype=object)
+        target_ids = np.asarray(
+            table.column("target_entity_id").to_pylist(), dtype=object
+        )
+        if value_prop is not None:
+            props = table.column("properties").to_pylist()
+
+            def _val(raw: Optional[str]) -> float:
+                if not raw:
+                    return default_value
+                v = json.loads(raw).get(value_prop)
+                # 0 / 0.0 are legitimate values — only absence defaults
+                return float(v) if isinstance(v, (int, float)) else default_value
+
+            values = np.asarray([_val(props[i]) for i in idx], dtype=np.float32)
+        else:
+            values = np.full(len(idx), default_value, dtype=np.float32)
+        return EventFrame.from_columns(
+            event_names=[names[i] for i in idx],
+            entity_ids=[entity_ids[i] for i in idx],
+            target_ids=[target_ids[i] for i in idx],
+            time_ms=times[idx],
+            values=values,
+            entity_type=query.entity_type,
+            target_entity_type=query.target_entity_type,
+        )

@@ -47,10 +47,14 @@ def ev(name, eid, t=0, etype="user", **kw):
     )
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "parquetfs"])
 def events(request, tmp_path):
     if request.param == "memory":
         store = MemoryEventStore()
+    elif request.param == "parquetfs":
+        from predictionio_tpu.data.storage.parquetfs import ParquetFSEventStore
+
+        store = ParquetFSEventStore({"PATH": str(tmp_path / "pq")})
     else:
         store = SqliteEventStore({"PATH": str(tmp_path / "ev.db")})
     store.init_app(APP)
