@@ -156,7 +156,8 @@ class NaiveBayesAlgorithm(Algorithm):
     def train(self, ctx: RuntimeContext, pd: TrainingData) -> NBModel:
         return NBModel(
             model=classify.train_naive_bayes(
-                pd.features, pd.labels, len(pd.label_vocab), self.params.lambda_
+                pd.features, pd.labels, len(pd.label_vocab),
+                self.params.lambda_, mesh=ctx.mesh,
             ),
             label_vocab=pd.label_vocab,
         )
@@ -203,6 +204,7 @@ class LogisticRegressionAlgorithm(Algorithm):
                 iterations=self.params.iterations,
                 lr=self.params.lr,
                 l2=self.params.l2,
+                mesh=ctx.mesh,
             ),
             label_vocab=pd.label_vocab,
         )

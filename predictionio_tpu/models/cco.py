@@ -106,23 +106,12 @@ def cross_occurrence_topn(
     if mesh is not None:
         # pad the user dim so it shards evenly; zero rows are inert in the
         # counts/totals and the true user count is passed separately for LLR
-        pad = (-primary.shape[0]) % mesh.devices.size
-        if pad:
-            primary = np.concatenate(
-                [primary, np.zeros((pad, primary.shape[1]), np.float32)]
-            )
-            secondary = np.concatenate(
-                [secondary, np.zeros((pad, secondary.shape[1]), np.float32)]
-            )
-    p = jnp.asarray(primary)
-    s = jnp.asarray(secondary)
-    if mesh is not None:
-        from predictionio_tpu.parallel.mesh import DATA_AXIS
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from predictionio_tpu.parallel.mesh import pad_and_shard_rows
 
-        user_sh = NamedSharding(mesh, P(DATA_AXIS, None))
-        p = jax.device_put(p, user_sh)
-        s = jax.device_put(s, user_sh)
+        p, s = pad_and_shard_rows(mesh, primary, secondary)
+    else:
+        p = jnp.asarray(primary)
+        s = jnp.asarray(secondary)
     vals, idx = _cco_topn(
         p, s, jnp.float32(true_n_users),
         top_n=top_n, exclude_diagonal=self_indicator,

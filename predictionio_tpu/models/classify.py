@@ -14,12 +14,23 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.ops.segment import segment_sum
+
+
+def _shard_batch(mesh, x, y, w):
+    """Shard the (N, D) batch over the data axis with inert weight-0
+    padding rows — the analogue of the reference's RDD partitioning of
+    labeled points (e2 CategoricalNaiveBayes.scala aggregate / MLlib GD
+    treeAggregate)."""
+    from predictionio_tpu.parallel.mesh import pad_and_shard_rows
+
+    return pad_and_shard_rows(mesh, x, y, w)
 
 
 # ---------------------------------------------------------------------------
@@ -47,11 +58,11 @@ def _nb_scores(x, log_prior, log_like):
 
 
 @partial(jax.jit, static_argnames=("n_classes",))
-def _nb_train(x, y, *, n_classes: int, lam: float):
-    n, d = x.shape
-    class_count = segment_sum(jnp.ones(n, jnp.float32), y, n_classes)
-    feat_sum = segment_sum(x, y, n_classes)  # (C, D)
-    log_prior = jnp.log(class_count) - jnp.log(jnp.float32(n))
+def _nb_train(x, y, w, *, n_classes: int, lam: float):
+    d = x.shape[1]
+    class_count = segment_sum(w, y, n_classes)
+    feat_sum = segment_sum(x * w[:, None], y, n_classes)  # (C, D)
+    log_prior = jnp.log(class_count) - jnp.log(jnp.sum(w))
     smoothed = feat_sum + lam
     log_like = jnp.log(smoothed) - jnp.log(
         jnp.sum(feat_sum, axis=1, keepdims=True) + lam * d
@@ -60,16 +71,28 @@ def _nb_train(x, y, *, n_classes: int, lam: float):
 
 
 def train_naive_bayes(
-    x: np.ndarray, y: np.ndarray, n_classes: int, lam: float = 1.0
+    x: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    lam: float = 1.0,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> NaiveBayesModel:
-    """x must be non-negative (multinomial counts / binary indicators)."""
+    """x must be non-negative (multinomial counts / binary indicators).
+
+    With `mesh`, the (N, D) batch is sharded over the data axis; the
+    label-indexed segment-sums reduce locally per shard and GSPMD inserts
+    the ICI all-reduce — the TPU-native analogue of the reference's
+    aggregateByKey pass (e2 CategoricalNaiveBayes.scala:55-70)."""
     x = np.asarray(x, dtype=np.float32)
     y = np.asarray(y, dtype=np.int32)
     if (x < 0).any():
         raise ValueError("multinomial NB requires non-negative features")
-    log_prior, log_like = _nb_train(
-        jnp.asarray(x), jnp.asarray(y), n_classes=n_classes, lam=lam
-    )
+    w = np.ones(x.shape[0], np.float32)
+    if mesh is not None:
+        xj, yj, wj = _shard_batch(mesh, x, y, w)
+    else:
+        xj, yj, wj = jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+    log_prior, log_like = _nb_train(xj, yj, wj, n_classes=n_classes, lam=lam)
     return NaiveBayesModel(np.asarray(log_prior), np.asarray(log_like))
 
 
@@ -98,16 +121,15 @@ def _lr_scores(x, w):
 
 @partial(jax.jit, static_argnames=("n_classes", "iterations"))
 def _lr_train(
-    x, y, *, n_classes: int, iterations: int, lr: float, l2: float
+    x, y, wt, *, n_classes: int, iterations: int, lr: float, l2: float
 ):
-    n, d = x.shape
+    d = x.shape[1]
     y1h = jax.nn.one_hot(y, n_classes, dtype=jnp.float32)
 
     def loss(w):
         logits = x @ w[:-1] + w[-1]
-        ll = jnp.mean(
-            jnp.sum(y1h * jax.nn.log_softmax(logits, axis=-1), axis=-1)
-        )
+        row_ll = jnp.sum(y1h * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        ll = jnp.sum(wt * row_ll) / jnp.sum(wt)
         return -ll + 0.5 * l2 * jnp.sum(w[:-1] ** 2)
 
     grad = jax.grad(loss)
@@ -127,21 +149,35 @@ def train_logistic_regression(
     lr: float = 0.5,
     l2: float = 1e-4,
     normalize: bool = True,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> LogisticRegressionModel:
+    """With `mesh`, the batch is sharded over the data axis and the
+    full-batch gradient reduces via GSPMD psum — the analogue of MLlib
+    LBFGS's treeAggregate gradient (used by LogisticRegressionWithLBFGS)."""
     x = np.asarray(x, dtype=np.float32)
     y = np.asarray(y, dtype=np.int32)
     if normalize:
-        # scale features to unit stdev so a fixed lr behaves across datasets;
-        # fold the scaling into the returned weights
+        # standardize (center + scale) so a fixed lr is stable across
+        # datasets — an uncentered mean component inflates the top Hessian
+        # eigenvalue past 2/lr and GD amplifies float noise geometrically;
+        # the affine map is folded back into the returned weights below
+        mu = x.mean(axis=0).astype(np.float32)
         std = x.std(axis=0)
         std = np.where(std > 0, std, 1.0).astype(np.float32)
-        x = x / std
+        x = (x - mu) / std
+    wt = np.ones(x.shape[0], np.float32)
+    if mesh is not None:
+        xj, yj, wtj = _shard_batch(mesh, x, y, wt)
+    else:
+        xj, yj, wtj = jnp.asarray(x), jnp.asarray(y), jnp.asarray(wt)
     w = np.asarray(
         _lr_train(
-            jnp.asarray(x), jnp.asarray(y),
+            xj, yj, wtj,
             n_classes=n_classes, iterations=iterations, lr=lr, l2=l2,
         )
     )
     if normalize:
-        w = np.concatenate([w[:-1] / std[:, None], w[-1:]], axis=0)
+        scaled = w[:-1] / std[:, None]
+        bias = w[-1:] - (mu / std) @ w[:-1]
+        w = np.concatenate([scaled, bias], axis=0)
     return LogisticRegressionModel(weights=w)

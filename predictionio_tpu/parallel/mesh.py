@@ -101,3 +101,24 @@ def factor_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def pad_and_shard_rows(mesh: Mesh, *arrays: np.ndarray):
+    """Zero-pad axis 0 of each array to a multiple of the mesh size and
+    shard axis 0 over the data axis (remaining axes replicated).
+
+    Callers must ensure zero rows are inert in their reductions (weight-0
+    samples, empty indicator rows). All arrays must share axis-0 length.
+    Returns jax arrays, one per input."""
+    import jax.numpy as jnp
+
+    pad = (-arrays[0].shape[0]) % mesh.devices.size
+    out = []
+    for a in arrays:
+        if pad:
+            a = np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)]
+            )
+        spec = P(DATA_AXIS, *([None] * (a.ndim - 1)))
+        out.append(jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec)))
+    return tuple(out)

@@ -24,3 +24,11 @@ def test_dryrun_multichip_8():
 
 def test_dryrun_multichip_1():
     graft.dryrun_multichip(1)
+
+
+def test_dryrun_self_provisions_when_devices_insufficient():
+    """The driver environment sees ONE real chip; dryrun_multichip must
+    still succeed by spawning a virtual-CPU subprocess (VERDICT r1 #1)."""
+    from predictionio_tpu.parallel.dryrun import run_dryrun_subprocess
+
+    run_dryrun_subprocess(8)
