@@ -123,6 +123,12 @@ class MetricEvaluator(BaseEvaluator):
         engine_eval_data_set: list[tuple[EngineParams, list]],
         params: WorkflowParams,
     ) -> MetricEvaluatorResult:
+        if not engine_eval_data_set:
+            raise ValueError(
+                "MetricEvaluator.evaluate: empty engine_eval_data_set — "
+                "the tuning grid produced no (EngineParams, eval data) "
+                "pairs; check the EngineParamsGenerator"
+            )
         scores: list[MetricScores] = []
         for ep, eval_data in engine_eval_data_set:
             score = self.metric.calculate(ctx, eval_data)

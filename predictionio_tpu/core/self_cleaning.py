@@ -14,6 +14,7 @@ compressProperties)`:314 is the config carrier.
 from __future__ import annotations
 
 import datetime as _dt
+import json
 import logging
 import re
 from dataclasses import dataclass
@@ -120,10 +121,11 @@ class SelfCleaningDataSource:
         if window.remove_duplicates:
             seen: set[tuple] = set()
             for e in sorted(regular, key=lambda e: e.event_time):
+                # canonical JSON so list/dict-valued properties stay hashable
                 key = (
                     e.event, e.entity_type, e.entity_id,
                     e.target_entity_type, e.target_entity_id,
-                    tuple(sorted(e.properties.to_dict().items())),
+                    json.dumps(e.properties.to_dict(), sort_keys=True),
                 )
                 if key in seen:
                     if e.event_id:
@@ -144,8 +146,7 @@ class SelfCleaningDataSource:
         # wipe happens only after cleaned data is persisted)
         if to_insert:
             store.insert_batch(to_insert, app_id)
-        for event_id in to_delete:
-            store.delete(event_id, app_id)
+        store.delete_batch(to_delete, app_id)
         log.info(
             "self-cleaning %s: compacted=%d deduplicated=%d aged_out=%d",
             self.app_name, stats["compacted"], stats["deduplicated"],

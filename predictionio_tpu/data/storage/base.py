@@ -126,6 +126,18 @@ class EventStore(abc.ABC):
     ) -> bool:
         """Delete by id; returns whether it existed."""
 
+    def delete_batch(
+        self,
+        event_ids: Sequence[str],
+        app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> int:
+        """Bulk delete; returns how many existed. Backends override when
+        a single pass beats per-id deletes (e.g. parquetfs tombstones)."""
+        return sum(
+            self.delete(eid, app_id, channel_id) for eid in event_ids
+        )
+
     def write(
         self, events: Iterable[Event], app_id: int, channel_id: Optional[int] = None
     ) -> None:

@@ -164,22 +164,34 @@ class ParquetFSEventStore(EventStore):
     def delete(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
     ) -> bool:
+        return self.delete_batch([event_id], app_id, channel_id) == 1
+
+    def delete_batch(
+        self,
+        event_ids,
+        app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> int:
+        """One id-column scan + one tombstones.json write for the whole
+        batch — SelfCleaningDataSource cleanup of a large store is O(N),
+        not O(N·deletes)."""
+        if not event_ids:
+            return 0
         with self._lock:
             self._flush(app_id, channel_id)
             d = self._dir(app_id, channel_id)
             stones = self._tombstones(d)
-            if event_id in stones:
-                return False
-            # verify existence before tombstoning
-            exists = any(
-                e.event_id == event_id
-                for e in self._iter_events(app_id, channel_id)
+            table = self._read_table(app_id, channel_id, columns=["event_id"])
+            live = (
+                set(table.column("event_id").to_pylist()) - stones
+                if table is not None
+                else set()
             )
-            if not exists:
-                return False
-            stones.add(event_id)
-            self._write_tombstones(d, stones)
-            return True
+            hits = [eid for eid in dict.fromkeys(event_ids) if eid in live]
+            if hits:
+                stones.update(hits)
+                self._write_tombstones(d, stones)
+            return len(hits)
 
     # -- reads -------------------------------------------------------------
     def _read_table(

@@ -116,7 +116,8 @@ def _half_step_implicit(
     fixed: jax.Array,  # (N_fixed, K) — e.g. item factors when solving users
     src_idx: jax.Array,  # (E,) — edge rows into `fixed`
     dst_idx: jax.Array,  # (E,) — edge rows being solved (sorted)
-    conf: jax.Array,  # (E,) confidence c = 1 + alpha*r
+    conf: jax.Array,  # (E,) confidence c = 1 + alpha*|r|
+    pref: jax.Array,  # (E,) preference p = 1[r > 0] (MLlib trainImplicit)
     valid: jax.Array,  # (E,) 1.0 real edge / 0.0 padding
     x0: jax.Array,  # (N_dst, K) warm start
     lam: float,
@@ -124,7 +125,9 @@ def _half_step_implicit(
 ) -> jax.Array:
     n_dst = x0.shape[0]
     gram = f32_gram(fixed)  # (K, K)
-    b = weighted_edge_sum(fixed, src_idx, dst_idx, conf * valid, n_dst, True)
+    b = weighted_edge_sum(
+        fixed, src_idx, dst_idx, conf * pref * valid, n_dst, True
+    )
 
     def matvec(v):
         base = v @ gram + lam * v
@@ -218,16 +221,23 @@ def _train_jit(
     )
 
     if implicit:
-        u_w = 1.0 + alpha * u_val
-        i_w = 1.0 + alpha * i_val
+        # MLlib trainImplicit semantics (Hu-Koren-Volinsky with signed
+        # feedback): confidence from |r| so a dislike (r<0) still raises
+        # confidence, preference 1 only for r>0 — a disliked item is pulled
+        # toward 0 HARDER than a never-seen one, and c stays positive so
+        # the normal-equation operator is always SPD for CG.
+        u_w = 1.0 + alpha * jnp.abs(u_val)
+        i_w = 1.0 + alpha * jnp.abs(i_val)
+        u_p = (u_val > 0).astype(jnp.float32)
+        i_p = (i_val > 0).astype(jnp.float32)
 
         def body(_, fs):
             uf, itf = fs
             uf = shard_factors(_half_step_implicit(
-                itf, u_src, u_dst, u_w, u_ok, uf, lam, cg_iterations
+                itf, u_src, u_dst, u_w, u_p, u_ok, uf, lam, cg_iterations
             ))
             itf = shard_factors(_half_step_implicit(
-                uf, i_src, i_dst, i_w, i_ok, itf, lam, cg_iterations
+                uf, i_src, i_dst, i_w, i_p, i_ok, itf, lam, cg_iterations
             ))
             return uf, itf
 

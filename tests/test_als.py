@@ -115,6 +115,7 @@ class TestImplicitALS:
                 jnp.asarray(cols[order]),
                 jnp.asarray(rows[order]),
                 jnp.asarray(conf[order]),
+                jnp.ones(len(rows), jnp.float32),  # pref: all positive
                 jnp.ones(len(rows), jnp.float32),
                 jnp.zeros((n_users, k), jnp.float32),
                 lam,
@@ -145,6 +146,37 @@ class TestImplicitALS:
         un_c = np.array([c for _, c in unseen])
         uns = als.score_pairs(model, un_r, un_c).mean()
         assert obs > uns + 0.2, f"observed {obs} vs unseen {uns}"
+
+    def test_implicit_dislike_scores_below_unseen(self):
+        """MLlib trainImplicit semantics (ADVICE r1): a dislike (r=-1) is
+        high-confidence zero-preference, so a disliked item must score
+        BELOW a never-seen item, and training must stay stable for
+        alpha > 1 (c = 1 + alpha*|r| keeps the operator SPD)."""
+        rng = np.random.default_rng(9)
+        n_users, n_items = 40, 30
+        rows, cols, vals = [], [], []
+        for u in range(n_users):
+            liked = rng.choice(n_items // 2, 6, replace=False)
+            disliked = n_items // 2 + rng.choice(n_items // 2, 3, replace=False)
+            for i in liked:
+                rows.append(u); cols.append(i); vals.append(1.0)
+            for i in disliked:
+                rows.append(u); cols.append(i); vals.append(-1.0)
+        rows = np.array(rows, np.int32)
+        cols = np.array(cols, np.int32)
+        vals = np.array(vals, np.float32)
+        params = als.ALSParams(rank=6, iterations=10, lambda_=0.01, alpha=4.0)
+        model = als.train(rows, cols, vals, n_users, n_items, params)
+        assert np.all(np.isfinite(model.user_factors))
+        pos = als.score_pairs(
+            model, rows[vals > 0], cols[vals > 0]
+        ).mean()
+        neg = als.score_pairs(
+            model, rows[vals < 0], cols[vals < 0]
+        ).mean()
+        assert pos > 0.5, f"liked items should score high, got {pos}"
+        assert neg < pos - 0.3, f"disliked {neg} not below liked {pos}"
+        assert neg < 0.25, f"dislikes should be pulled toward 0, got {neg}"
 
 
 class TestServing:

@@ -91,6 +91,16 @@ def test_tombstones_excluded_from_frame(pq_store):
     assert pq_store.get(deleted, APP) is None
 
 
+def test_delete_batch_single_pass(pq_store):
+    ids = seed(pq_store)
+    # batch of 3 existing + 1 unknown + 1 duplicate → 3 deleted
+    n = pq_store.delete_batch([ids[0], ids[1], ids[2], "nope", ids[0]], APP)
+    assert n == 3
+    f = pq_store.find_frame(EventQuery(app_id=APP))
+    assert len(f) == 21
+    assert pq_store.delete_batch([], APP) == 0
+
+
 def test_segments_accumulate_and_survive_reopen(tmp_path):
     store = ParquetFSEventStore({"PATH": str(tmp_path / "pq")})
     seed(store)

@@ -116,6 +116,27 @@ def test_remove_duplicates(app):
     assert u1[0].event_time == t0
 
 
+def test_remove_duplicates_with_list_valued_properties(app):
+    """ADVICE r1: list/dict-valued properties must not crash the dedupe
+    key (canonical-JSON key, not a tuple of raw values)."""
+    storage, app_id = app
+    t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    base = dict(
+        event="view", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i1",
+        properties={"categories": ["a", "b"], "meta": {"k": 1}},
+    )
+    storage.get_events().insert_batch(
+        [Event(**base, event_time=t0),
+         Event(**base, event_time=t0 + dt.timedelta(hours=1))],
+        app_id,
+    )
+    src = CleaningSource("clean", EventWindow(remove_duplicates=True))
+    stats = src.clean_persisted_events(RuntimeContext(storage=storage))
+    assert stats["deduplicated"] == 1
+    assert len(all_events(storage, app_id)) == 1
+
+
 def test_age_out(app):
     storage, app_id = app
     now = dt.datetime.now(UTC)
