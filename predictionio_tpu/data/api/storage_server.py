@@ -1,0 +1,201 @@
+"""Storage service daemon — the client-server backend's server half.
+
+Fills the architectural role of the reference's external storage servers
+(HBase region servers / a Postgres instance behind the JDBC DAOs,
+Storage.scala:140-142): the four long-running process kinds — event
+server, deploy server, dashboard, admin — plus train workflows share an
+app's state ONLY through this service. The daemon fronts any embedded
+backend (sqlite by default) with a threaded JSON-RPC-over-HTTP surface
+exposing the complete DAO contract: events + the seven metadata DAOs +
+model blobs.
+
+Concurrency: one OS thread per connection (ThreadingHTTPServer); the
+backing DAOs are the already-thread-safe embedded stores, so cross-process
+writes serialize exactly once, in this process — the same single-writer
+discipline a Postgres instance provides the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from predictionio_tpu.data.storage import wire
+from predictionio_tpu.data.storage.registry import Storage
+
+log = logging.getLogger(__name__)
+
+# dao name → (storage getter, allowed methods). Methods not listed are
+# rejected — the RPC surface is the DAO contract, not arbitrary attributes.
+_DAO_TABLE: dict[str, tuple[str, frozenset[str]]] = {
+    "events": (
+        "get_events",
+        frozenset({
+            "init_app", "remove_app", "insert", "insert_batch", "delete",
+            "delete_batch", "get", "find",
+        }),
+    ),
+    "apps": (
+        "get_meta_data_apps",
+        frozenset({"insert", "get", "get_by_name", "get_all", "update",
+                   "delete"}),
+    ),
+    "access_keys": (
+        "get_meta_data_access_keys",
+        frozenset({"insert", "get", "get_all", "get_by_app_id", "update",
+                   "delete"}),
+    ),
+    "channels": (
+        "get_meta_data_channels",
+        frozenset({"insert", "get", "get_by_app_id", "delete"}),
+    ),
+    "engine_instances": (
+        "get_meta_data_engine_instances",
+        frozenset({"insert", "get", "get_all", "get_latest_completed",
+                   "get_completed", "update", "delete"}),
+    ),
+    "evaluation_instances": (
+        "get_meta_data_evaluation_instances",
+        frozenset({"insert", "get", "get_all", "get_completed", "update",
+                   "delete"}),
+    ),
+    "engine_manifests": (
+        "get_meta_data_engine_manifests",
+        frozenset({"insert", "get", "get_all", "update", "delete"}),
+    ),
+    "models": (
+        "get_model_data_models",
+        frozenset({"insert", "get", "delete"}),
+    ),
+}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "pio-storage/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("storage-server: " + fmt, *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload, separators=(",", ":")).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/health":
+            self._reply(200, {"status": "alive"})
+        else:
+            self._reply(404, {"ok": False, "error": "not found"})
+
+    def do_POST(self):
+        if self.path != "/rpc":
+            self._reply(404, {"ok": False, "error": "not found"})
+            return
+        auth_key = self.server.auth_key  # type: ignore[attr-defined]
+        if auth_key and self.headers.get("X-PIO-Storage-Key") != auth_key:
+            self._reply(401, {"ok": False, "error": "bad storage key"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length))
+            dao_name = req["dao"]
+            method = req["method"]
+            args = [wire.decode(a) for a in req.get("args", [])]
+            kwargs = {k: wire.decode(v) for k, v in req.get("kwargs", {}).items()}
+        except Exception as e:  # malformed request
+            self._reply(400, {"ok": False, "error": f"bad request: {e}"})
+            return
+        entry = _DAO_TABLE.get(dao_name)
+        if entry is None or method not in entry[1]:
+            self._reply(
+                400,
+                {"ok": False, "error": f"unknown rpc {dao_name}.{method}"},
+            )
+            return
+        storage: Storage = self.server.storage  # type: ignore[attr-defined]
+        try:
+            dao = getattr(storage, entry[0])()
+            result = getattr(dao, method)(*args, **kwargs)
+            if method == "find":  # iterator → materialized list
+                result = list(result)
+            if isinstance(result, list):
+                encoded: Any = {"$list": [wire.encode(v) for v in result]}
+            else:
+                encoded = wire.encode(result)
+            self._reply(200, {"ok": True, "result": encoded})
+        except Exception as e:
+            log.exception("storage rpc %s.%s failed", dao_name, method)
+            self._reply(
+                200,
+                {"ok": False, "error": f"{type(e).__name__}: {e}"},
+            )
+
+
+class StorageServer:
+    """Embeddable daemon: `serve_forever()` blocks; `start()` backgrounds."""
+
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        host: str = "127.0.0.1",
+        port: int = 7077,
+        auth_key: Optional[str] = None,
+    ):
+        self.storage = storage or Storage.get_instance()
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.request_queue_size = 128
+        self.httpd.storage = self.storage  # type: ignore[attr-defined]
+        self.httpd.auth_key = auth_key  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "StorageServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="pio-storage", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pio storage-server",
+        description="Shared storage service for multi-process deployments",
+    )
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=7077)
+    ap.add_argument("--auth-key", default=None)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = StorageServer(host=args.host, port=args.port,
+                           auth_key=args.auth_key)
+    log.info("storage server listening on %s:%d", args.host, server.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,6 +36,9 @@ BACKENDS: dict[str, tuple[str, str]] = {
     "sqlite": ("predictionio_tpu.data.storage.sqlite", "Sqlite"),
     "localfs": ("predictionio_tpu.data.storage.localfs", "LocalFS"),
     "parquetfs": ("predictionio_tpu.data.storage.parquetfs", "ParquetFS"),
+    # client-server backend: all DAOs proxied to a storage service daemon
+    # (the reference's JDBC/HBase client role, Storage.scala:140-142)
+    "remote": ("predictionio_tpu.data.storage.remote", "Remote"),
 }
 
 # DAO logical names → class suffix
@@ -179,10 +182,13 @@ class Storage:
                 raise StorageError(
                     f"backend {src.type!r} does not implement {_DAO_SUFFIXES[dao]}"
                 )
-            # share one client across DAOs of the same source when supported
+            # share one client across DAOs of the same source when the
+            # backend module exports a client factory
             kwargs: dict[str, Any] = {"config": dict(src.settings)}
-            client_factory = getattr(module, "_SqliteClient", None)
-            if client_factory is not None and src.type == "sqlite":
+            client_factory = getattr(module, "CLIENT_FACTORY", None)
+            if client_factory is None and src.type == "sqlite":
+                client_factory = getattr(module, "_SqliteClient", None)
+            if client_factory is not None:
                 client = self._clients.get(src.name)
                 if client is None:
                     client = client_factory(dict(src.settings))

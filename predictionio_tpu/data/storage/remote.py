@@ -1,0 +1,301 @@
+"""Client-server storage backend — the client half.
+
+Configure with
+  PIO_STORAGE_SOURCES_<NAME>_TYPE=remote
+  PIO_STORAGE_SOURCES_<NAME>_HOST=<storage-server host>
+  PIO_STORAGE_SOURCES_<NAME>_PORT=<port>
+  PIO_STORAGE_SOURCES_<NAME>_AUTH_KEY=<optional shared secret>
+
+and any repository (METADATA / EVENTDATA / MODELDATA) may point at it.
+Fills the reference's JDBC client role (jdbc/JDBCLEvents.scala:34,
+JDBCPEvents.scala:29 and the seven JDBC metadata DAOs): every process —
+event server, deploy server, train workflow, admin, dashboard — on any
+host shares one app through the storage service daemon
+(data/api/storage_server.py).
+
+Transport: persistent keep-alive HTTP connections, one per thread, over
+the stdlib client — no third-party driver needed.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import Any, Iterator, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base, wire
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    EventQuery,
+    Model,
+    StorageError,
+)
+
+
+class RemoteClient:
+    """Thread-safe RPC client with per-thread persistent connections."""
+
+    def __init__(self, config: dict[str, str]):
+        self.host = config.get("HOST", "127.0.0.1")
+        self.port = int(config.get("PORT", "7077"))
+        self.auth_key = config.get("AUTH_KEY")
+        self.timeout = float(config.get("TIMEOUT", "30"))
+        self._local = threading.local()
+
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+            self._local.conn = conn
+        return conn
+
+    def call(self, dao: str, method: str, *args: Any, **kwargs: Any) -> Any:
+        body = json.dumps(
+            {
+                "dao": dao,
+                "method": method,
+                "args": [wire.encode(a) for a in args],
+                "kwargs": {k: wire.encode(v) for k, v in kwargs.items()},
+            },
+            separators=(",", ":"),
+        ).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.auth_key:
+            headers["X-PIO-Storage-Key"] = self.auth_key
+        for attempt in (0, 1):  # one retry on a stale keep-alive connection
+            conn = self._conn()
+            try:
+                conn.request("POST", "/rpc", body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+                break
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                self._local.conn = None
+                if attempt:
+                    raise StorageError(
+                        f"storage server {self.host}:{self.port} unreachable"
+                    )
+        if not payload.get("ok"):
+            raise StorageError(
+                f"storage rpc {dao}.{method} failed: {payload.get('error')}"
+            )
+        return wire.decode(payload.get("result"))
+
+    def ping(self) -> bool:
+        try:
+            conn = self._conn()
+            conn.request("GET", "/health")
+            return conn.getresponse().read() is not None
+        except (http.client.HTTPException, OSError):
+            return False
+
+
+def CLIENT_FACTORY(config: dict[str, str]) -> RemoteClient:
+    return RemoteClient(config)
+
+
+class _RemoteDao:
+    DAO = ""
+
+    def __init__(self, config: dict[str, str], client: Optional[RemoteClient] = None):
+        self._client = client or RemoteClient(config)
+
+    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        return self._client.call(self.DAO, method, *args, **kwargs)
+
+
+class RemoteEventStore(_RemoteDao, base.EventStore):
+    DAO = "events"
+
+    def init_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return self._call("init_app", app_id, channel_id)
+
+    def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return self._call("remove_app", app_id, channel_id)
+
+    def insert(
+        self, event: Event, app_id: int, channel_id: Optional[int] = None
+    ) -> str:
+        return self._call("insert", event, app_id, channel_id)
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> list[str]:
+        return self._call("insert_batch", list(events), app_id, channel_id)
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        return self._call("delete", event_id, app_id, channel_id)
+
+    def delete_batch(
+        self, event_ids: Sequence[str], app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> int:
+        return self._call("delete_batch", list(event_ids), app_id, channel_id)
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        return self._call("get", event_id, app_id, channel_id)
+
+    def find(self, query: EventQuery) -> Iterator[Event]:
+        return iter(self._call("find", query))
+
+
+class RemoteApps(_RemoteDao, base.Apps):
+    DAO = "apps"
+
+    def insert(self, app: App) -> Optional[int]:
+        return self._call("insert", app)
+
+    def get(self, app_id: int) -> Optional[App]:
+        return self._call("get", app_id)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        return self._call("get_by_name", name)
+
+    def get_all(self) -> list[App]:
+        return self._call("get_all")
+
+    def update(self, app: App) -> bool:
+        return self._call("update", app)
+
+    def delete(self, app_id: int) -> bool:
+        return self._call("delete", app_id)
+
+
+class RemoteAccessKeys(_RemoteDao, base.AccessKeys):
+    DAO = "access_keys"
+
+    def insert(self, k: AccessKey) -> Optional[str]:
+        return self._call("insert", k)
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return self._call("get", key)
+
+    def get_all(self) -> list[AccessKey]:
+        return self._call("get_all")
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return self._call("get_by_app_id", app_id)
+
+    def update(self, k: AccessKey) -> bool:
+        return self._call("update", k)
+
+    def delete(self, key: str) -> bool:
+        return self._call("delete", key)
+
+
+class RemoteChannels(_RemoteDao, base.Channels):
+    DAO = "channels"
+
+    def insert(self, c: Channel) -> Optional[int]:
+        return self._call("insert", c)
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return self._call("get", channel_id)
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return self._call("get_by_app_id", app_id)
+
+    def delete(self, channel_id: int) -> bool:
+        return self._call("delete", channel_id)
+
+
+class RemoteEngineInstances(_RemoteDao, base.EngineInstances):
+    DAO = "engine_instances"
+
+    def insert(self, i: EngineInstance) -> str:
+        return self._call("insert", i)
+
+    def get(self, iid: str) -> Optional[EngineInstance]:
+        return self._call("get", iid)
+
+    def get_all(self) -> list[EngineInstance]:
+        return self._call("get_all")
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        return self._call(
+            "get_latest_completed", engine_id, engine_version, engine_variant
+        )
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]:
+        return self._call(
+            "get_completed", engine_id, engine_version, engine_variant
+        )
+
+    def update(self, i: EngineInstance) -> bool:
+        return self._call("update", i)
+
+    def delete(self, iid: str) -> bool:
+        return self._call("delete", iid)
+
+
+class RemoteEvaluationInstances(_RemoteDao, base.EvaluationInstances):
+    DAO = "evaluation_instances"
+
+    def insert(self, i: EvaluationInstance) -> str:
+        return self._call("insert", i)
+
+    def get(self, iid: str) -> Optional[EvaluationInstance]:
+        return self._call("get", iid)
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return self._call("get_all")
+
+    def get_completed(self) -> list[EvaluationInstance]:
+        return self._call("get_completed")
+
+    def update(self, i: EvaluationInstance) -> bool:
+        return self._call("update", i)
+
+    def delete(self, iid: str) -> bool:
+        return self._call("delete", iid)
+
+
+class RemoteEngineManifests(_RemoteDao, base.EngineManifests):
+    DAO = "engine_manifests"
+
+    def insert(self, m: EngineManifest) -> None:
+        self._call("insert", m)
+
+    def get(self, mid: str, version: str) -> Optional[EngineManifest]:
+        return self._call("get", mid, version)
+
+    def get_all(self) -> list[EngineManifest]:
+        return self._call("get_all")
+
+    def update(self, m: EngineManifest, upsert: bool = False) -> None:
+        self._call("update", m, upsert)
+
+    def delete(self, mid: str, version: str) -> None:
+        self._call("delete", mid, version)
+
+
+class RemoteModels(_RemoteDao, base.Models):
+    DAO = "models"
+
+    def insert(self, m: Model) -> None:
+        self._call("insert", m)
+
+    def get(self, mid: str) -> Optional[Model]:
+        return self._call("get", mid)
+
+    def delete(self, mid: str) -> None:
+        self._call("delete", mid)

@@ -290,6 +290,22 @@ def cmd_eventserver(args) -> int:
 # ---------------------------------------------------------------------------
 
 
+def cmd_storage_server(args) -> int:
+    from predictionio_tpu.data.api.storage_server import StorageServer
+
+    server = StorageServer(
+        _storage(), host=args.ip, port=args.port, auth_key=args.auth_key
+    )
+    print(
+        f"[INFO] Storage server is listening at http://{args.ip}:{server.port}."
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
 def cmd_adminserver(args) -> int:
     from predictionio_tpu.tools.admin import AdminServer
 
@@ -481,6 +497,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=7070)
     s.add_argument("--stats", action="store_true")
     s.set_defaults(func=cmd_eventserver)
+
+    # storage-server (client-server storage daemon; the role the
+    # reference fills with an external HBase/Postgres instance)
+    s = sub.add_parser(
+        "storage-server",
+        help="run the shared storage service for multi-process deployments",
+    )
+    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=7077)
+    s.add_argument("--auth-key", default=None)
+    s.set_defaults(func=cmd_storage_server)
 
     # adminserver / dashboard
     s = sub.add_parser("adminserver", help="run the admin REST API")

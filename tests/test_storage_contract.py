@@ -47,19 +47,52 @@ def ev(name, eid, t=0, etype="user", **kw):
     )
 
 
-@pytest.fixture(params=["memory", "sqlite", "parquetfs"])
+def _remote_server(tmp_path):
+    """In-process storage daemon backed by throwaway sqlite+localfs."""
+    from predictionio_tpu.data.api.storage_server import StorageServer
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    cfg = StorageConfig(
+        sources={
+            "SQL": SourceConfig(
+                "SQL", "sqlite", {"PATH": str(tmp_path / "served.db")}
+            ),
+            "FS": SourceConfig("FS", "localfs", {"PATH": str(tmp_path)}),
+        },
+        repositories={
+            "METADATA": "SQL", "EVENTDATA": "SQL", "MODELDATA": "FS",
+        },
+    )
+    return StorageServer(Storage(cfg), host="127.0.0.1", port=0).start()
+
+
+@pytest.fixture(params=["memory", "sqlite", "parquetfs", "remote"])
 def events(request, tmp_path):
+    server = None
     if request.param == "memory":
         store = MemoryEventStore()
     elif request.param == "parquetfs":
         from predictionio_tpu.data.storage.parquetfs import ParquetFSEventStore
 
         store = ParquetFSEventStore({"PATH": str(tmp_path / "pq")})
+    elif request.param == "remote":
+        from predictionio_tpu.data.storage.remote import RemoteEventStore
+
+        server = _remote_server(tmp_path)
+        store = RemoteEventStore(
+            {"HOST": "127.0.0.1", "PORT": str(server.port)}
+        )
     else:
         store = SqliteEventStore({"PATH": str(tmp_path / "ev.db")})
     store.init_app(APP)
     yield store
     store.remove_app(APP)
+    if server is not None:
+        server.shutdown()
 
 
 class TestEventStoreContract:
@@ -162,18 +195,42 @@ class TestEventStoreContract:
         assert got[0].event_time > got[1].event_time
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "remote"])
 def meta(request, tmp_path):
     if request.param == "memory":
-        return {
+        yield {
             "apps": MemoryApps(),
             "keys": MemoryAccessKeys(),
             "channels": MemoryChannels(),
             "instances": MemoryEngineInstances(),
             "models": MemoryModels(),
         }
+        return
+    if request.param == "remote":
+        from predictionio_tpu.data.storage.remote import (
+            RemoteAccessKeys,
+            RemoteApps,
+            RemoteChannels,
+            RemoteClient,
+            RemoteEngineInstances,
+            RemoteModels,
+        )
+
+        server = _remote_server(tmp_path)
+        client = RemoteClient(
+            {"HOST": "127.0.0.1", "PORT": str(server.port)}
+        )
+        yield {
+            "apps": RemoteApps({}, client=client),
+            "keys": RemoteAccessKeys({}, client=client),
+            "channels": RemoteChannels({}, client=client),
+            "instances": RemoteEngineInstances({}, client=client),
+            "models": RemoteModels({}, client=client),
+        }
+        server.shutdown()
+        return
     cfg = {"PATH": str(tmp_path / "meta.db")}
-    return {
+    yield {
         "apps": SqliteApps(cfg),
         "keys": SqliteAccessKeys(cfg),
         "channels": SqliteChannels(cfg),
