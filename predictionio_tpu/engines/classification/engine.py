@@ -32,7 +32,7 @@ from predictionio_tpu.controller.metrics import AverageMetric
 from predictionio_tpu.core.base import RuntimeContext
 from predictionio_tpu.data.store.event_store import EventStoreFacade
 from predictionio_tpu.e2.cross_validation import split_data
-from predictionio_tpu.models import classify
+from predictionio_tpu.models import classify, forest
 
 
 @dataclass
@@ -222,6 +222,61 @@ class LogisticRegressionAlgorithm(Algorithm):
         ]
 
 
+@dataclass
+class RFModel:
+    model: forest.RandomForestModel
+    label_vocab: tuple[str, ...]
+
+
+@dataclass
+class RandomForestParams:
+    """Reference RandomForestAlgoParams (RandomForestAlgorithm.scala:17-24:
+    numTrees/maxDepth/maxBins; featureSubsetStrategy="auto" →
+    feature_fraction=None)."""
+
+    num_trees: int = 20
+    max_depth: int = 6
+    max_bins: int = 32
+    feature_fraction: Optional[float] = None
+    seed: int = 42
+
+
+class RandomForestAlgorithm(Algorithm):
+    """Histogram random forest (models/forest.py) — the add-algorithm
+    variant's second MLlib algorithm, rebuilt as an XLA program."""
+
+    def __init__(self, params: RandomForestParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> RFModel:
+        return RFModel(
+            model=forest.train_random_forest(
+                pd.features,
+                pd.labels,
+                len(pd.label_vocab),
+                n_trees=self.params.num_trees,
+                max_depth=self.params.max_depth,
+                n_bins=self.params.max_bins,
+                feature_fraction=self.params.feature_fraction,
+                seed=self.params.seed,
+                mesh=ctx.mesh,
+            ),
+            label_vocab=pd.label_vocab,
+        )
+
+    def predict(self, model: RFModel, query: Query) -> PredictedResult:
+        cls = int(model.model.predict(np.asarray(query.features))[0])
+        return PredictedResult(label=model.label_vocab[cls])
+
+    def batch_predict(self, ctx, model: RFModel, queries):
+        x = np.asarray([q.features for _, q in queries], dtype=np.float32)
+        classes = model.model.predict(x)
+        return [
+            (qx, PredictedResult(label=model.label_vocab[int(c)]))
+            for (qx, _q), c in zip(queries, classes)
+        ]
+
+
 # -- evaluation -------------------------------------------------------------
 
 
@@ -244,6 +299,7 @@ class ClassificationEngine(EngineFactory):
             {
                 "naive": NaiveBayesAlgorithm,
                 "logreg": LogisticRegressionAlgorithm,
+                "randomforest": RandomForestAlgorithm,
             },
             FirstServing,
         )

@@ -1,0 +1,79 @@
+"""Histogram random forest (models/forest.py) — MLlib RandomForest parity
+(reference add-algorithm RandomForestAlgorithm.scala)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models import classify, forest
+
+
+@pytest.fixture(scope="module")
+def multimodal():
+    """3 blobs per class → multimodal within-class structure that a
+    linear/NB model cannot capture but trees can."""
+    rng = np.random.RandomState(0)
+    n_per, C, D = 300, 4, 8
+    cents = rng.randn(C, 3, D) * 3
+    xs, ys = [], []
+    for c in range(C):
+        for b in range(3):
+            xs.append(cents[c, b] + rng.randn(n_per // 3, D))
+            ys.append(np.full(n_per // 3, c))
+    x = np.concatenate(xs).astype(np.float32)
+    y = np.concatenate(ys).astype(np.int32)
+    perm = rng.permutation(len(x))
+    x, y = x[perm], y[perm]
+    ntr = int(0.8 * len(x))
+    return x[:ntr], y[:ntr], x[ntr:], y[ntr:], C
+
+
+class TestForest:
+    def test_beats_naive_bayes_on_multimodal(self, multimodal):
+        xtr, ytr, xte, yte, C = multimodal
+        rf = forest.train_random_forest(xtr, ytr, C, n_trees=20, max_depth=6)
+        acc_rf = (rf.predict(xte) == yte).mean()
+        nb = classify.train_naive_bayes(np.abs(xtr), ytr, C)
+        acc_nb = (nb.predict(np.abs(xte)) == yte).mean()
+        assert acc_rf >= acc_nb, (acc_rf, acc_nb)
+        assert acc_rf > 0.9, acc_rf
+
+    def test_proba_normalized(self, multimodal):
+        xtr, ytr, xte, _, C = multimodal
+        rf = forest.train_random_forest(xtr, ytr, C, n_trees=5, max_depth=4)
+        p = rf.predict_proba(xte)
+        assert p.shape == (len(xte), C)
+        np.testing.assert_allclose(p.sum(-1), 1.0, atol=1e-5)
+        assert (p >= 0).all()
+
+    def test_deterministic_given_seed(self, multimodal):
+        xtr, ytr, _, _, C = multimodal
+        a = forest.train_random_forest(xtr, ytr, C, n_trees=4, max_depth=4,
+                                       seed=7)
+        b = forest.train_random_forest(xtr, ytr, C, n_trees=4, max_depth=4,
+                                       seed=7)
+        assert (a.routes_f == b.routes_f).all()
+        assert (a.routes_t == b.routes_t).all()
+        np.testing.assert_array_equal(a.leaf_proba, b.leaf_proba)
+
+    def test_early_stop_pure_node(self):
+        """A perfectly separable 1-feature dataset: the root splits once,
+        children are pure → deeper levels are leaves (feature == -1) and
+        routing still lands every sample in the right class."""
+        rng = np.random.RandomState(1)
+        x = np.concatenate([rng.rand(50, 1), rng.rand(50, 1) + 5.0]).astype(
+            np.float32
+        )
+        y = np.concatenate([np.zeros(50), np.ones(50)]).astype(np.int32)
+        rf = forest.train_random_forest(x, y, 2, n_trees=3, max_depth=4)
+        assert (rf.predict(x) == y).all()
+        # below the first split every internal node is a leaf marker
+        assert (rf.features[:, 2:, :] == -1).all()
+
+    def test_mesh_parity(self, mesh8, multimodal):
+        xtr, ytr, _, _, C = multimodal
+        a = forest.train_random_forest(xtr, ytr, C, n_trees=4, max_depth=4)
+        b = forest.train_random_forest(xtr, ytr, C, n_trees=4, max_depth=4,
+                                       mesh=mesh8)
+        assert (a.routes_f == b.routes_f).all()
+        assert (a.routes_t == b.routes_t).all()
+        np.testing.assert_allclose(a.leaf_proba, b.leaf_proba, atol=1e-5)

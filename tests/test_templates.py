@@ -81,6 +81,25 @@ class TestClassification:
         assert algo.predict(models[0], Query([9.0, 9.0, 0.5])).label == "premium"
         assert algo.predict(models[0], Query([2.0, 2.0, 0.5])).label == "free"
 
+    def test_randomforest_variant(self, classify_storage):
+        """engine.json-driven swap to the third algorithm (reference
+        add-algorithm variant's whole point)."""
+        variant = dict(
+            CLS_VARIANT,
+            algorithms=[
+                {"name": "randomforest",
+                 "params": {"num_trees": 10, "max_depth": 4}}
+            ],
+        )
+        inst = run_train(classify_storage, variant)
+        assert inst.status == "COMPLETED"
+        engine, ep, models = prepare_deploy_models(classify_storage, inst)
+        algo = engine.make_algorithms(ep)[0]
+        from predictionio_tpu.engines.classification import Query
+
+        assert algo.predict(models[0], Query([9.0, 9.0, 0.5])).label == "premium"
+        assert algo.predict(models[0], Query([2.0, 2.0, 0.5])).label == "free"
+
     def test_eval_accuracy(self, classify_storage):
         from predictionio_tpu.controller import Evaluation
         from predictionio_tpu.engines.classification import ClassificationEngine
