@@ -164,6 +164,22 @@ class ECommModel:
             self._normed = ranking.l2_normalize(self.factors.item_factors)
         return self._normed
 
+    def category_index(self) -> dict:
+        """category → sorted item-index array, built once per deploy —
+        query-time category filtering is then a sparse candidate union
+        instead of an O(I) per-query scan."""
+        cached = getattr(self, "_cat_index", None)
+        if cached is None:
+            cached = {}
+            for ix, cats in enumerate(self.item_categories or []):
+                for c in cats:
+                    cached.setdefault(c, []).append(ix)
+            cached = {
+                c: np.asarray(v, dtype=np.int64) for c, v in cached.items()
+            }
+            self._cat_index = cached
+        return cached
+
 
 class ECommAlgorithm(Algorithm):
     def __init__(self, params: ECommAlgorithmParams):
@@ -262,9 +278,12 @@ class ECommAlgorithm(Algorithm):
         self, ctx: RuntimeContext, model: ECommModel, query: Query
     ) -> PredictedResult:
         vocab = model.factors.item_vocab
-        n_items = model.factors.item_factors.shape[0]
-        excluded = np.zeros(n_items, dtype=bool)
 
+        # sparse business-rule filters: a candidate whitelist (categories /
+        # explicit whitelist → index arrays) + an exclusion set (blacklist,
+        # unavailable, seen, basis). Per-query memory stays
+        # O(k + history + filters); no dense item-space mask is built.
+        include = None
         if query.categories:
             if model.item_categories is None:
                 # fail loudly instead of silently serving every category
@@ -273,32 +292,43 @@ class ECommAlgorithm(Algorithm):
                     "query filters by categories but no item category "
                     "properties were found at train time"
                 )
-            wanted = set(query.categories)
-            excluded |= np.fromiter(
-                (not (c & wanted) for c in model.item_categories),
-                dtype=bool, count=n_items,
+            cat_index = model.category_index()
+            arrs = [
+                cat_index[c] for c in query.categories if c in cat_index
+            ]
+            include = (
+                np.unique(np.concatenate(arrs))
+                if arrs
+                else np.empty(0, np.int64)
             )
         if query.whitelist is not None:
-            keep = np.zeros(n_items, dtype=bool)
-            for it in query.whitelist:
-                ix = vocab.get(it)
-                if ix is not None:
-                    keep[ix] = True
-            excluded |= ~keep
+            wl = np.asarray(
+                [
+                    ix
+                    for it in query.whitelist
+                    if (ix := vocab.get(it)) is not None
+                ],
+                dtype=np.int64,
+            )
+            include = (
+                wl if include is None
+                else np.intersect1d(include, wl)
+            )
+        exclude: list[int] = []
         for it in query.blacklist or []:
             ix = vocab.get(it)
             if ix is not None:
-                excluded[ix] = True
+                exclude.append(ix)
         if ctx.storage is not None:
             for it in self._unavailable_items(ctx):
                 ix = vocab.get(it)
                 if ix is not None:
-                    excluded[ix] = True
+                    exclude.append(ix)
             if self.params.unseen_only:
                 for it in self._seen_items(ctx, query.user):
                     ix = vocab.get(it)
                     if ix is not None:
-                        excluded[ix] = True
+                        exclude.append(ix)
 
         user_row = model.factors.user_vocab.get(query.user)
         if user_row is not None:
@@ -316,14 +346,16 @@ class ECommAlgorithm(Algorithm):
                 return PredictedResult()
             normed = model.normed_item_factors()
             scores = normed @ normed[basis].mean(axis=0)
-            excluded[basis] = True  # don't recommend the basis items
+            exclude.extend(basis)  # don't recommend the basis items
 
-        scores = ranking.exclusion_scores(scores, excluded)
         inv = vocab.inverse()
         return PredictedResult(
             item_scores=[
                 ItemScore(item=inv(int(ix)), score=float(scores[ix]))
-                for ix in ranking.top_k_indices(scores, query.num)
+                for ix in ranking.top_k_filtered(
+                    scores, query.num,
+                    exclude_idx=exclude, include_idx=include,
+                )
             ]
         )
 

@@ -246,26 +246,28 @@ class URAlgorithm(Algorithm):
             scores += cco.score_history(
                 ind.correlator_idx, ind.correlator_scores, history
             )
-        excluded = np.zeros(n_items, dtype=bool)
+        # sparse exclusion set (O(history + blacklist), never a dense
+        # item-space mask — catalog-scale serving stays O(B·k + history))
+        exclude: list[int] = []
         if query.exclude_seen:
             # seen-filter always works in the PRIMARY item space, even when
             # the algorithm was configured to keep only secondary indicators
             primary_history = self._user_history(
                 ctx, query.user, model.primary_indicator, model.item_vocab
             )
-            excluded[primary_history] = True
+            exclude.extend(int(ix) for ix in primary_history)
         for it in query.blacklist or []:
             ix = model.item_vocab.get(it)
             if ix is not None:
-                excluded[ix] = True
-        # items with zero LLR evidence are not recommendations
-        excluded |= scores <= 0.0
-        scores = ranking.exclusion_scores(scores, excluded)
+                exclude.append(ix)
         inv = model.item_vocab.inverse()
         return PredictedResult(
             item_scores=[
                 ItemScore(item=inv(int(ix)), score=float(scores[ix]))
-                for ix in ranking.top_k_indices(scores, query.num)
+                # positive_only: zero LLR evidence is not a recommendation
+                for ix in ranking.top_k_filtered(
+                    scores, query.num, exclude_idx=exclude, positive_only=True
+                )
             ]
         )
 
