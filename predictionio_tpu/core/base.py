@@ -57,6 +57,9 @@ class RuntimeContext:
     mesh: Any = None  # Optional[jax.sharding.Mesh]
     mode: str = "train"  # train | eval | serve
     workflow_params: WorkflowParams = field(default_factory=WorkflowParams)
+    # the EngineInstance id of the current train run ("" outside train
+    # workflows) — keys mid-training checkpoints in MODELDATA
+    instance_id: str = ""
 
     @property
     def is_serving(self) -> bool:

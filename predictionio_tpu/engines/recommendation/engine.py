@@ -229,6 +229,9 @@ class ALSAlgorithmParams:
     implicit_prefs: bool = True
     cg_iterations: int = 3
     seed: int = 3
+    # > 0: snapshot factor state into MODELDATA every N iterations so an
+    # interrupted train resumes (workflow/checkpoint.py); 0 disables
+    checkpoint_every: int = 0
 
 
 class ALSModel:
@@ -276,21 +279,36 @@ class ALSAlgorithm(Algorithm):
         self.params = params
 
     def train(self, ctx: RuntimeContext, pd: TrainingData) -> ALSModel:
-        factors = als.train(
+        from predictionio_tpu.workflow.checkpoint import (
+            CheckpointManager,
+            train_als_checkpointed,
+        )
+
+        als_params = als.ALSParams(
+            rank=self.params.rank,
+            iterations=self.params.num_iterations,
+            lambda_=self.params.lambda_,
+            alpha=self.params.alpha,
+            implicit_prefs=self.params.implicit_prefs,
+            cg_iterations=self.params.cg_iterations,
+            seed=self.params.seed,
+        )
+        manager = None
+        if (
+            self.params.checkpoint_every > 0
+            and ctx.storage is not None
+            and ctx.instance_id
+        ):
+            manager = CheckpointManager(ctx.storage, ctx.instance_id)
+        factors = train_als_checkpointed(
             pd.rows,
             pd.cols,
             pd.vals,
             pd.n_users,
             pd.n_items,
-            als.ALSParams(
-                rank=self.params.rank,
-                iterations=self.params.num_iterations,
-                lambda_=self.params.lambda_,
-                alpha=self.params.alpha,
-                implicit_prefs=self.params.implicit_prefs,
-                cg_iterations=self.params.cg_iterations,
-                seed=self.params.seed,
-            ),
+            als_params,
+            manager,
+            self.params.checkpoint_every,
             user_vocab=pd.user_vocab,
             item_vocab=pd.item_vocab,
             mesh=ctx.mesh,

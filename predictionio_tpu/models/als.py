@@ -41,10 +41,16 @@ import numpy as np
 from predictionio_tpu.data.store.bimap import BiMap
 from predictionio_tpu.ops.segment import (
     batched_cg,
-    edge_matvec,
+    chunked_edge_matvec,
+    chunked_gram_edge_sum,
+    chunked_weighted_edge_sum,
     f32_gram,
-    weighted_edge_sum,
 )
+
+# ranks up to this solve via explicitly-built per-row K×K operators (one
+# edge pass per half-step); beyond it the matrix-free CG path keeps memory
+# O(E·K) — the (N, K, K) operator tensor would start to dominate HBM
+GRAM_SOLVER_MAX_RANK = 32
 from predictionio_tpu.ops.topk import NEG_INF, masked_top_k
 
 
@@ -57,6 +63,10 @@ class ALSParams:
     implicit_prefs: bool = True
     cg_iterations: int = 3
     seed: int = 3
+    # max edges per device program step; larger edge lists are scanned in
+    # chunks so the lane-padded (E, K) gather intermediates stay bounded
+    # (at ML-20M scale a single-shot build OOMs a 16G chip)
+    edge_chunk_size: int = 1 << 21
 
 
 @dataclass
@@ -122,19 +132,34 @@ def _half_step_implicit(
     x0: jax.Array,  # (N_dst, K) warm start
     lam: float,
     cg_iterations: int,
+    n_chunks: int = 1,
 ) -> jax.Array:
-    n_dst = x0.shape[0]
+    n_dst, k = x0.shape
     gram = f32_gram(fixed)  # (K, K)
-    b = weighted_edge_sum(
-        fixed, src_idx, dst_idx, conf * pref * valid, n_dst, True
+    b = chunked_weighted_edge_sum(
+        fixed, src_idx, dst_idx, conf * pref * valid, n_dst, n_chunks
     )
+
+    if k <= GRAM_SOLVER_MAX_RANK:
+        # explicit per-row operator: ONE edge pass builds all Σ(c-1)yyᵀ
+        # corrections; CG then runs on the dense (N, K, K) batch with no
+        # further edge traffic (2·cg_iterations fewer HBM sweeps)
+        corr = chunked_gram_edge_sum(
+            fixed, src_idx, dst_idx, (conf - 1.0) * valid, n_dst, n_chunks
+        ).reshape(n_dst, k, k)
+        a = corr + gram[None, :, :] + lam * jnp.eye(k, dtype=jnp.float32)
+
+        def matvec(v):
+            return jnp.einsum("nij,nj->ni", a, v)
+
+        return batched_cg(matvec, b, x0, cg_iterations)
 
     def matvec(v):
         base = v @ gram + lam * v
         # (c-1) is already 0 for pads (r=0), but multiply by `valid` so
         # padding is inert regardless of alpha/rating conventions
-        corr = edge_matvec(
-            fixed, v, src_idx, dst_idx, (conf - 1.0) * valid, n_dst, True
+        corr = chunked_edge_matvec(
+            fixed, v, src_idx, dst_idx, (conf - 1.0) * valid, n_dst, n_chunks
         )
         return base + corr
 
@@ -151,13 +176,30 @@ def _half_step_explicit(
     x0: jax.Array,
     lam: float,
     cg_iterations: int,
+    n_chunks: int = 1,
 ) -> jax.Array:
-    n_dst = x0.shape[0]
-    b = weighted_edge_sum(fixed, src_idx, dst_idx, ratings * valid, n_dst, True)
+    n_dst, k = x0.shape
+    b = chunked_weighted_edge_sum(
+        fixed, src_idx, dst_idx, ratings * valid, n_dst, n_chunks
+    )
+    reg = lam * jnp.maximum(degree, 1.0)  # ALS-WR per-row scaling
+
+    if k <= GRAM_SOLVER_MAX_RANK:
+        obs = chunked_gram_edge_sum(
+            fixed, src_idx, dst_idx, valid, n_dst, n_chunks
+        ).reshape(n_dst, k, k)
+        a = obs + reg[:, None, None] * jnp.eye(k, dtype=jnp.float32)
+
+        def matvec(v):
+            return jnp.einsum("nij,nj->ni", a, v)
+
+        return batched_cg(matvec, b, x0, cg_iterations)
 
     def matvec(v):
-        base = (lam * jnp.maximum(degree, 1.0))[:, None] * v
-        obs = edge_matvec(fixed, v, src_idx, dst_idx, valid, n_dst, True)
+        base = reg[:, None] * v
+        obs = chunked_edge_matvec(
+            fixed, v, src_idx, dst_idx, valid, n_dst, n_chunks
+        )
         return base + obs
 
     return batched_cg(matvec, b, x0, cg_iterations)
@@ -167,7 +209,7 @@ def _half_step_explicit(
     jax.jit,
     static_argnames=(
         "n_users", "n_items", "rank", "iterations", "implicit", "cg_iterations",
-        "mesh",
+        "mesh", "n_chunks",
     ),
 )
 def _train_jit(
@@ -181,6 +223,8 @@ def _train_jit(
     i_ok: jax.Array,  # (E,)
     user_deg: jax.Array,
     item_deg: jax.Array,
+    uf0: Optional[jax.Array] = None,  # warm start (resume/checkpoint)
+    itf0: Optional[jax.Array] = None,
     *,
     n_users: int,
     n_items: int,
@@ -192,6 +236,7 @@ def _train_jit(
     cg_iterations: int,
     seed: int,
     mesh: Optional[jax.sharding.Mesh] = None,
+    n_chunks: int = 1,
 ):
     if mesh is not None:
         from predictionio_tpu.parallel.mesh import MODEL_AXIS, factor_sharding, replicated
@@ -210,15 +255,21 @@ def _train_jit(
         def shard_factors(f):
             return f
 
-    ku, ki = jax.random.split(jax.random.PRNGKey(seed))
-    # signed gaussian init scaled by 1/sqrt(rank); an all-positive init
-    # (as some ALS impls use) starts near rank-1 and converges far slower
-    uf = shard_factors(
-        jax.random.normal(ku, (n_users, rank), jnp.float32) / jnp.sqrt(rank)
-    )
-    itf = shard_factors(
-        jax.random.normal(ki, (n_items, rank), jnp.float32) / jnp.sqrt(rank)
-    )
+    if uf0 is not None and itf0 is not None:
+        uf = shard_factors(uf0)
+        itf = shard_factors(itf0)
+    else:
+        ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+        # signed gaussian init scaled by 1/sqrt(rank); an all-positive init
+        # (as some ALS impls use) starts near rank-1 and converges far slower
+        uf = shard_factors(
+            jax.random.normal(ku, (n_users, rank), jnp.float32)
+            / jnp.sqrt(rank)
+        )
+        itf = shard_factors(
+            jax.random.normal(ki, (n_items, rank), jnp.float32)
+            / jnp.sqrt(rank)
+        )
 
     if implicit:
         # MLlib trainImplicit semantics (Hu-Koren-Volinsky with signed
@@ -234,10 +285,12 @@ def _train_jit(
         def body(_, fs):
             uf, itf = fs
             uf = shard_factors(_half_step_implicit(
-                itf, u_src, u_dst, u_w, u_p, u_ok, uf, lam, cg_iterations
+                itf, u_src, u_dst, u_w, u_p, u_ok, uf, lam, cg_iterations,
+                n_chunks,
             ))
             itf = shard_factors(_half_step_implicit(
-                uf, i_src, i_dst, i_w, i_p, i_ok, itf, lam, cg_iterations
+                uf, i_src, i_dst, i_w, i_p, i_ok, itf, lam, cg_iterations,
+                n_chunks,
             ))
             return uf, itf
 
@@ -246,10 +299,12 @@ def _train_jit(
         def body(_, fs):
             uf, itf = fs
             uf = shard_factors(_half_step_explicit(
-                itf, u_src, u_dst, u_val, u_ok, user_deg, uf, lam, cg_iterations
+                itf, u_src, u_dst, u_val, u_ok, user_deg, uf, lam,
+                cg_iterations, n_chunks,
             ))
             itf = shard_factors(_half_step_explicit(
-                uf, i_src, i_dst, i_val, i_ok, item_deg, itf, lam, cg_iterations
+                uf, i_src, i_dst, i_val, i_ok, item_deg, itf, lam,
+                cg_iterations, n_chunks,
             ))
             return uf, itf
 
@@ -267,8 +322,14 @@ def train(
     user_vocab: Optional[BiMap] = None,
     item_vocab: Optional[BiMap] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
+    init_factors: Optional[tuple[np.ndarray, np.ndarray]] = None,
 ) -> ALSFactors:
     """Train factors from a COO interaction list.
+
+    `init_factors=(uf, itf)` warm-starts the alternating loop (checkpoint
+    resume / incremental retrain); ALS iterations are memoryless in the
+    factor state, so k resumed segments of m iterations reproduce one
+    k·m-iteration run.
 
     When `mesh` is given, edge arrays are sharded over its first (data)
     axis and GSPMD inserts the ICI all-reduces for the segment sums;
@@ -283,22 +344,36 @@ def train(
     np.add.at(user_deg, rows, 1.0)
     item_deg = np.zeros(n_items, np.float32)
     np.add.at(item_deg, cols, 1.0)
-    if mesh is not None:
-        pad = (-len(rows)) % mesh.devices.size
-        if pad:
-            # padded edges carry valid=0.0 and are inert in every term
-            rows = np.concatenate([rows, np.zeros(pad, np.int32)])
-            cols = np.concatenate([cols, np.zeros(pad, np.int32)])
-            vals = np.concatenate([vals, np.zeros(pad, np.float32)])
-            valid = np.concatenate([valid, np.zeros(pad, np.float32)])
+    n_chunks = max(
+        1, -(-len(rows) // max(1, params.edge_chunk_size))
+    )
+    # pad so the edge axis divides by n_chunks (and the mesh size when
+    # sharded) — padded edges carry valid=0.0 and are inert in every term
+    unit = n_chunks * (mesh.devices.size if mesh is not None else 1)
+    pad = (-len(rows)) % unit
+    if pad:
+        rows = np.concatenate([rows, np.zeros(pad, np.int32)])
+        cols = np.concatenate([cols, np.zeros(pad, np.int32)])
+        vals = np.concatenate([vals, np.zeros(pad, np.float32)])
+        valid = np.concatenate([valid, np.zeros(pad, np.float32)])
 
     by_user = np.argsort(rows, kind="stable")
     by_item = np.argsort(cols, kind="stable")
 
+    uf0 = itf0 = None
+    if init_factors is not None:
+        uf0 = np.asarray(init_factors[0], np.float32)
+        itf0 = np.asarray(init_factors[1], np.float32)
+        if uf0.shape != (n_users, params.rank) or itf0.shape != (
+            n_items, params.rank,
+        ):
+            raise ValueError(
+                "init_factors shapes do not match (n_users/n_items, rank)"
+            )
     args = (
         cols[by_user], rows[by_user], vals[by_user], valid[by_user],
         rows[by_item], cols[by_item], vals[by_item], valid[by_item],
-        user_deg, item_deg,
+        user_deg, item_deg, uf0, itf0,
     )
     kwargs = dict(
         n_users=n_users,
@@ -310,6 +385,7 @@ def train(
         alpha=params.alpha,
         cg_iterations=params.cg_iterations,
         seed=params.seed,
+        n_chunks=n_chunks,
     )
     if mesh is not None:
         from predictionio_tpu.parallel.mesh import edge_sharding, replicated
@@ -318,7 +394,10 @@ def train(
         rep_sh = replicated(mesh)
         device_args = [
             jax.device_put(a, edge_sh) for a in args[:8]
-        ] + [jax.device_put(a, rep_sh) for a in args[8:]]
+        ] + [
+            jax.device_put(a, rep_sh) if a is not None else None
+            for a in args[8:]
+        ]
         uf, itf = _train_jit(*device_args, mesh=mesh, **kwargs)
     else:
         uf, itf = _train_jit(*args, **kwargs)

@@ -119,6 +119,7 @@ def run_train(
     instance.id = instance_id
 
     ctx = runtime_context_from_variant(storage, variant, "train", wp)
+    ctx.instance_id = instance_id
     try:
         instance.status = "TRAINING"
         instances.update(instance)

@@ -147,6 +147,26 @@ class TestImplicitALS:
         uns = als.score_pairs(model, un_r, un_c).mean()
         assert obs > uns + 0.2, f"observed {obs} vs unseen {uns}"
 
+    def test_chunked_edges_match_single_shot(self):
+        """Tiny edge_chunk_size forces the scan-accumulated path; factors
+        must match the single-shot program (the chunked path is what runs
+        at ML-20M scale to bound lane-padded gather intermediates)."""
+        rows, cols, vals = make_synthetic(implicit=True, density=0.4)
+        for implicit in (True, False):
+            p1 = als.ALSParams(rank=6, iterations=4, implicit_prefs=implicit)
+            p2 = als.ALSParams(
+                rank=6, iterations=4, implicit_prefs=implicit,
+                edge_chunk_size=97,  # ~8 chunks over ~720 edges
+            )
+            m1 = als.train(rows, cols, vals, 60, 40, p1)
+            m2 = als.train(rows, cols, vals, 60, 40, p2)
+            np.testing.assert_allclose(
+                m1.user_factors, m2.user_factors, rtol=1e-4, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                m1.item_factors, m2.item_factors, rtol=1e-4, atol=1e-5
+            )
+
     def test_implicit_dislike_scores_below_unseen(self):
         """MLlib trainImplicit semantics (ADVICE r1): a dislike (r=-1) is
         high-confidence zero-preference, so a disliked item must score

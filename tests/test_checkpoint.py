@@ -1,0 +1,137 @@
+"""Mid-training checkpointing (VERDICT r1 #10): segmented warm-started
+ALS must reproduce an uninterrupted run, and a killed train must resume
+from its MODELDATA snapshot."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models import als
+from predictionio_tpu.workflow.checkpoint import (
+    CheckpointManager,
+    train_als_checkpointed,
+)
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.RandomState(5)
+    n_users, n_items, n_edges = 50, 30, 600
+    rows = rng.randint(0, n_users, n_edges).astype(np.int32)
+    cols = rng.randint(0, n_items, n_edges).astype(np.int32)
+    vals = (rng.rand(n_edges) * 4 + 1).astype(np.float32)
+    return rows, cols, vals, n_users, n_items
+
+
+PARAMS = als.ALSParams(rank=6, iterations=9, implicit_prefs=True)
+
+
+def test_warm_start_segments_equal_uninterrupted(data):
+    rows, cols, vals, u, i = data
+    full = als.train(rows, cols, vals, u, i, PARAMS)
+    # 9 iterations as 4 + 4 + 1 with explicit warm starts
+    seg = als.train(
+        rows, cols, vals, u, i,
+        als.ALSParams(rank=6, iterations=4, implicit_prefs=True),
+    )
+    seg = als.train(
+        rows, cols, vals, u, i,
+        als.ALSParams(rank=6, iterations=4, implicit_prefs=True),
+        init_factors=(seg.user_factors, seg.item_factors),
+    )
+    seg = als.train(
+        rows, cols, vals, u, i,
+        als.ALSParams(rank=6, iterations=1, implicit_prefs=True),
+        init_factors=(seg.user_factors, seg.item_factors),
+    )
+    np.testing.assert_allclose(
+        full.user_factors, seg.user_factors, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        full.item_factors, seg.item_factors, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_kill_and_resume_matches_uninterrupted(data, fresh_storage):
+    rows, cols, vals, u, i = data
+    full = als.train(rows, cols, vals, u, i, PARAMS)
+
+    manager = CheckpointManager(fresh_storage, "inst-1")
+    killed = {"count": 0}
+
+    class Killed(RuntimeError):
+        pass
+
+    def die_after_two_segments(done):
+        killed["count"] += 1
+        if killed["count"] == 2:
+            raise Killed()
+
+    with pytest.raises(Killed):
+        train_als_checkpointed(
+            rows, cols, vals, u, i, PARAMS, manager,
+            checkpoint_every=3, on_segment=die_after_two_segments,
+        )
+    # a snapshot at iteration 6 survives the crash
+    loaded = manager.load()
+    assert loaded is not None and loaded[0] == 6
+
+    resumed = train_als_checkpointed(
+        rows, cols, vals, u, i, PARAMS, manager, checkpoint_every=3
+    )
+    np.testing.assert_allclose(
+        full.user_factors, resumed.user_factors, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        full.item_factors, resumed.item_factors, rtol=1e-5, atol=1e-6
+    )
+    assert manager.load() is None  # cleared on success
+
+
+def test_checkpointing_disabled_is_plain_train(data):
+    rows, cols, vals, u, i = data
+    a = train_als_checkpointed(
+        rows, cols, vals, u, i, PARAMS, None, checkpoint_every=0
+    )
+    b = als.train(rows, cols, vals, u, i, PARAMS)
+    np.testing.assert_array_equal(a.user_factors, b.user_factors)
+
+
+def test_engine_level_checkpointing(fresh_storage):
+    """engine.json-driven: checkpoint_every flows through run_train; the
+    completed train leaves no stale checkpoint behind."""
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow.core import run_train
+
+    app_id = fresh_storage.get_meta_data_apps().insert(App(id=0, name="ckapp"))
+    fresh_storage.get_events().init_app(app_id)
+    rng = np.random.RandomState(0)
+    fresh_storage.get_events().insert_batch(
+        [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{rng.randint(8)}",
+                target_entity_type="item", target_entity_id=f"i{rng.randint(6)}",
+                properties={"rating": float(rng.randint(1, 6))},
+            )
+            for _ in range(60)
+        ],
+        app_id,
+    )
+    variant = {
+        "id": "ck",
+        "engineFactory":
+            "predictionio_tpu.engines.recommendation.RecommendationEngine",
+        "datasource": {"params": {"app_name": "ckapp"}},
+        "algorithms": [
+            {
+                "name": "als",
+                "params": {
+                    "rank": 4, "num_iterations": 6, "checkpoint_every": 2,
+                },
+            }
+        ],
+    }
+    inst = run_train(fresh_storage, variant)
+    assert inst.status == "COMPLETED"
+    assert fresh_storage.get_model_data_models().get(f"ckpt:{inst.id}") is None
+    assert fresh_storage.get_model_data_models().get(inst.id) is not None
