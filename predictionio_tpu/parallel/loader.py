@@ -1,0 +1,116 @@
+"""Multi-host-shaped data staging: EventFrame/COO → sharded device arrays.
+
+The multi-host seam (SURVEY.md §7 stage 7). The reference scales its read
+path by partitioning the event RDD across Spark executors
+(HBPEvents.scala:84-90); the TPU-native equivalent is each HOST PROCESS
+staging only its row slice into its local devices' HBM, with
+`jax.make_array_from_process_local_data` assembling the logical global
+array over the mesh. On a single process this degenerates to a plain
+sharded device_put — the call sites don't change when the job grows to
+multi-host (jax.distributed.initialize + a mesh spanning all processes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def stage_rows(
+    mesh: Mesh,
+    *arrays: np.ndarray,
+    pad_multiple: Optional[int] = None,
+) -> tuple:
+    """Stage host arrays as globally-sharded device arrays, row axis over
+    the data axis, each process contributing only its own slice.
+
+    All arrays share axis-0 length. Rows are zero-padded to a multiple of
+    (mesh size × pad_multiple) — callers must ensure zero rows are inert
+    (weight-0 / empty-indicator convention, as everywhere else in the
+    framework). Returns one jax.Array per input with GLOBAL logical shape.
+    """
+    n_procs = process_count()
+    p_idx = process_index()
+    unit = mesh.devices.size * (pad_multiple or 1)
+    n = arrays[0].shape[0]
+    pad = (-n) % unit
+    out = []
+    for a in arrays:
+        if a.shape[0] != n:
+            raise ValueError("all arrays must share axis-0 length")
+        if pad:
+            a = np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)]
+            )
+        global_shape = a.shape
+        spec = P(DATA_AXIS, *([None] * (a.ndim - 1)))
+        sharding = NamedSharding(mesh, spec)
+        # this process's contiguous row block (multi-host contract: row
+        # blocks laid out in process order along the data axis)
+        per_proc = global_shape[0] // n_procs
+        local = a[p_idx * per_proc : (p_idx + 1) * per_proc]
+        out.append(
+            jax.make_array_from_process_local_data(
+                sharding, local, global_shape
+            )
+        )
+    return tuple(out)
+
+
+def stage_edges(
+    mesh: Mesh,
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: Optional[np.ndarray] = None,
+):
+    """COO interaction staging: (rows, cols, vals?, valid) sharded over the
+    data axis with an inert-padding validity column — the loader shape
+    every factorization kernel consumes."""
+    rows = np.asarray(rows, np.int32)
+    cols = np.asarray(cols, np.int32)
+    arrays: list[np.ndarray] = [rows, cols]
+    if vals is not None:
+        arrays.append(np.asarray(vals, np.float32))
+    arrays.append(np.ones(len(rows), np.float32))  # valid
+    return stage_rows(mesh, *arrays)
+
+
+def frame_to_device(
+    frame,
+    mesh: Mesh,
+    event_names: Optional[Sequence[str]] = None,
+):
+    """EventFrame → sharded (entity_idx, target_idx, value, valid) device
+    arrays, optionally filtered to `event_names` first (host-side
+    vectorized mask — no per-row Python)."""
+    entity = frame.entity_idx
+    target = frame.target_idx
+    value = frame.value
+    if event_names is not None:
+        codes = [
+            frame.event_vocab.get(name)
+            for name in event_names
+            if frame.event_vocab.get(name) is not None
+        ]
+        keep = np.isin(frame.event_code, codes)
+        entity, target, value = entity[keep], target[keep], value[keep]
+    return stage_rows(
+        mesh,
+        entity.astype(np.int32),
+        target.astype(np.int32),
+        value.astype(np.float32),
+        np.ones(len(entity), np.float32),
+    )

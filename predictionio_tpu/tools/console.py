@@ -290,6 +290,24 @@ def cmd_eventserver(args) -> int:
 # ---------------------------------------------------------------------------
 
 
+def cmd_template(args) -> int:
+    from predictionio_tpu.tools.template import list_templates, scaffold
+
+    if args.template_action == "list":
+        for t in list_templates():
+            print(f"{t.name:16s} {t.description}")
+        return 0
+    # get
+    try:
+        dest = scaffold(args.name, args.directory, args.package)
+    except (ValueError, FileExistsError) as e:
+        return _fail(str(e))
+    print(f"[INFO] Engine template '{args.name}' scaffolded at {dest}.")
+    print("[INFO] Next: edit engine.json, then `pio train` from that "
+          "directory.")
+    return 0
+
+
 def cmd_storage_server(args) -> int:
     from predictionio_tpu.data.api.storage_server import StorageServer
 
@@ -497,6 +515,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=7070)
     s.add_argument("--stats", action="store_true")
     s.set_defaults(func=cmd_eventserver)
+
+    # template gallery (reference console/Template.scala:69-429)
+    s = sub.add_parser("template", help="scaffold engines from built-ins")
+    tsub = s.add_subparsers(dest="template_action", required=True)
+    tl = tsub.add_parser("list", help="list available templates")
+    tl.set_defaults(func=cmd_template)
+    tg = tsub.add_parser("get", help="copy a template into a directory")
+    tg.add_argument("name", help="template name (see `pio template list`)")
+    tg.add_argument("directory", help="destination directory")
+    tg.add_argument(
+        "--package", default=None,
+        help="package name for the scaffolded engine (default my_<name>)",
+    )
+    tg.set_defaults(func=cmd_template)
 
     # storage-server (client-server storage daemon; the role the
     # reference fills with an external HBase/Postgres instance)

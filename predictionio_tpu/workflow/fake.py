@@ -1,0 +1,62 @@
+"""FakeWorkflow: run an arbitrary function under the full eval
+environment without persisting anything.
+
+Parity target: core/src/main/scala/io/prediction/workflow/
+FakeWorkflow.scala:25-106 — a @DeveloperApi harness that wraps a
+user function in a fake engine/evaluator pair so it executes inside the
+real evaluation machinery (context construction, workflow params, result
+rendering) with `noSave` semantics: no EvaluationInstance row is
+written. Used for experimentation and for testing workflow plumbing
+itself."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional
+
+from predictionio_tpu.core.base import RuntimeContext, WorkflowParams
+
+log = logging.getLogger(__name__)
+
+
+class FakeEvalResult:
+    """Result wrapper with noSave semantics (FakeWorkflow.scala:37-44)."""
+
+    no_save = True
+
+    def __init__(self, value: Any):
+        self.value = value
+
+    def to_one_liner(self) -> str:
+        return f"FakeEvalResult({self.value!r})"
+
+    def to_html(self) -> str:
+        return f"<pre>{self.to_one_liner()}</pre>"
+
+    def to_json(self) -> str:
+        import json
+
+        try:
+            return json.dumps({"value": self.value})
+        except TypeError:
+            return json.dumps({"value": repr(self.value)})
+
+
+def run_fake_workflow(
+    fn: Callable[[RuntimeContext], Any],
+    storage: Any = None,
+    mesh: Any = None,
+    workflow_params: Optional[WorkflowParams] = None,
+) -> FakeEvalResult:
+    """Execute `fn(ctx)` under a fully-constructed eval RuntimeContext.
+
+    Nothing is persisted: no EvaluationInstance, no models — the
+    reference's `FakeRunner` + noSave path. The function's return value
+    comes back wrapped in a FakeEvalResult."""
+    wp = workflow_params or WorkflowParams()
+    ctx = RuntimeContext(
+        storage=storage, mesh=mesh, mode="eval", workflow_params=wp
+    )
+    log.info("fake workflow: running %s", getattr(fn, "__name__", fn))
+    value = fn(ctx)
+    return FakeEvalResult(value)

@@ -1,0 +1,50 @@
+"""FakeWorkflow harness (reference FakeWorkflow.scala:25-106): arbitrary
+function under the eval environment, nothing persisted."""
+
+import numpy as np
+
+from predictionio_tpu.workflow.fake import FakeEvalResult, run_fake_workflow
+
+
+def test_runs_fn_under_eval_context(fresh_storage):
+    seen = {}
+
+    def probe(ctx):
+        seen["mode"] = ctx.mode
+        seen["has_storage"] = ctx.storage is not None
+        # real device work is fine inside the harness
+        return float(np.square(np.arange(4)).sum())
+
+    result = run_fake_workflow(probe, storage=fresh_storage)
+    assert isinstance(result, FakeEvalResult)
+    assert result.no_save
+    assert result.value == 14.0
+    assert seen == {"mode": "eval", "has_storage": True}
+    assert "FakeEvalResult" in result.to_one_liner()
+    assert "14.0" in result.to_json()
+
+
+def test_nothing_persisted(fresh_storage):
+    before = fresh_storage.get_meta_data_evaluation_instances().get_all()
+    run_fake_workflow(lambda ctx: "hello", storage=fresh_storage)
+    after = fresh_storage.get_meta_data_evaluation_instances().get_all()
+    assert len(before) == len(after) == 0
+
+
+def test_mesh_flows_through(mesh8):
+    def probe(ctx):
+        return ctx.mesh.devices.size
+
+    assert run_fake_workflow(probe, mesh=mesh8).value == 8
+
+
+def test_exceptions_propagate(fresh_storage):
+    import pytest
+
+    def boom(ctx):
+        raise RuntimeError("bad fn")
+
+    with pytest.raises(RuntimeError, match="bad fn"):
+        run_fake_workflow(boom, storage=fresh_storage)
+    # still nothing persisted after a failure
+    assert fresh_storage.get_meta_data_evaluation_instances().get_all() == []

@@ -1,0 +1,91 @@
+"""Multi-host-shaped loader (parallel/loader.py): sharded staging must be
+value-equal to a plain sharded device_put, and the process-count seam
+must hold on a single process."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.parallel import loader
+
+
+def test_process_seam_single_process():
+    assert loader.process_count() == 1
+    assert loader.process_index() == 0
+
+
+def test_stage_rows_matches_device_put(mesh8):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(41, 3).astype(np.float32)  # not divisible by 8
+    y = rng.randint(0, 9, 41).astype(np.int32)
+    xs, ys = loader.stage_rows(mesh8, x, y)
+    assert xs.shape[0] % 8 == 0 and xs.shape[0] >= 41
+    # values: original rows intact, padding zero
+    np.testing.assert_array_equal(np.asarray(xs)[:41], x)
+    assert (np.asarray(xs)[41:] == 0).all()
+    np.testing.assert_array_equal(np.asarray(ys)[:41], y)
+    # sharding: split over dp on axis 0
+    ref = jax.device_put(
+        np.concatenate([x, np.zeros((xs.shape[0] - 41, 3), np.float32)]),
+        NamedSharding(mesh8, P("dp", None)),
+    )
+    assert xs.sharding.is_equivalent_to(ref.sharding, xs.ndim)
+    np.testing.assert_array_equal(np.asarray(xs), np.asarray(ref))
+
+
+def test_stage_edges_valid_column(mesh8):
+    rows = np.arange(10, dtype=np.int32)
+    cols = np.arange(10, dtype=np.int32)[::-1].copy()
+    vals = np.linspace(1, 2, 10).astype(np.float32)
+    r, c, v, ok = loader.stage_edges(mesh8, rows, cols, vals)
+    ok_np = np.asarray(ok)
+    assert ok_np[:10].sum() == 10 and ok_np[10:].sum() == 0
+
+
+def test_frame_to_device_event_filter(mesh8):
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.store.columnar import EventFrame
+
+    events = []
+    for i in range(12):
+        events.append(
+            Event(
+                event="view" if i % 2 == 0 else "buy",
+                entity_type="user", entity_id=f"u{i % 3}",
+                target_entity_type="item", target_entity_id=f"i{i % 4}",
+            )
+        )
+    frame = EventFrame.from_events(events)
+    e, t, v, ok = loader.frame_to_device(frame, mesh8, event_names=["buy"])
+    assert int(np.asarray(ok).sum()) == 6  # only the buys
+
+    mismatch = loader.frame_to_device(frame, mesh8, event_names=["nope"])
+    assert int(np.asarray(mismatch[3]).sum()) == 0
+
+
+def test_training_through_staged_arrays(mesh8):
+    """Staged edges drive a real sharded ALS step and match host-array
+    training — the loader is a drop-in seam, not a new semantics."""
+    from predictionio_tpu.models import als
+
+    rng = np.random.RandomState(2)
+    rows = rng.randint(0, 20, 150).astype(np.int32)
+    cols = rng.randint(0, 15, 150).astype(np.int32)
+    vals = (rng.rand(150) * 4 + 1).astype(np.float32)
+    params = als.ALSParams(rank=4, iterations=3)
+    with mesh8:
+        direct = als.train(rows, cols, vals, 20, 15, params, mesh=mesh8)
+    staged = loader.stage_edges(mesh8, rows, cols, vals)
+    # loader output is value-identical input — training from fetched
+    # staged arrays must reproduce the direct path
+    r, c, v, ok = (np.asarray(a) for a in staged)
+    keep = ok > 0
+    with mesh8:
+        via_loader = als.train(
+            r[keep], c[keep], v[keep], 20, 15, params, mesh=mesh8
+        )
+    np.testing.assert_allclose(
+        direct.user_factors, via_loader.user_factors, atol=1e-5
+    )

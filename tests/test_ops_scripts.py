@@ -1,0 +1,98 @@
+"""bin/pio-start-all / pio-stop-all / pio-daemon (VERDICT r1 #8, reference
+bin/pio-start-all, bin/pio-daemon): single-command bring-up of storage
+server + event server + admin + dashboard, pidfile lifecycle, clean stop."""
+
+import json
+import os
+import socket
+import subprocess
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BIN = REPO / "bin"
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_http(url, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                return r.status, r.read().decode()
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(url)
+
+
+def test_start_all_and_stop_all(tmp_path):
+    env = dict(os.environ)
+    ports = {
+        "PIO_STORAGE_SERVER_PORT": str(free_port()),
+        "PIO_EVENTSERVER_PORT": str(free_port()),
+        "PIO_ADMINSERVER_PORT": str(free_port()),
+        "PIO_DASHBOARD_PORT": str(free_port()),
+    }
+    env.update(ports)
+    env["PIO_FS_BASEDIR"] = str(tmp_path / "store")
+    run_dir = tmp_path / "run"
+    env["PIO_RUN_DIR"] = str(run_dir)
+    env["PIO_LOG_DIR"] = str(tmp_path / "log")
+
+    out = subprocess.run(
+        [str(BIN / "pio-start-all")], env=env, capture_output=True,
+        text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    try:
+        # all four services answer
+        status, body = wait_http(
+            f"http://127.0.0.1:{ports['PIO_STORAGE_SERVER_PORT']}/health"
+        )
+        assert status == 200 and json.loads(body)["status"] == "alive"
+        wait_http(
+            f"http://127.0.0.1:{ports['PIO_EVENTSERVER_PORT']}/"
+        )
+        wait_http(
+            f"http://127.0.0.1:{ports['PIO_ADMINSERVER_PORT']}/"
+        )
+        wait_http(
+            f"http://127.0.0.1:{ports['PIO_DASHBOARD_PORT']}/"
+        )
+        pids = {
+            p.name: int(p.read_text()) for p in run_dir.glob("pio-*.pid")
+        }
+        assert len(pids) == 4, pids
+        # double-start refuses while running
+        again = subprocess.run(
+            [str(BIN / "pio-start-all")], env=env, capture_output=True,
+            text=True, timeout=60,
+        )
+        assert again.returncode != 0
+        assert "already running" in again.stdout + again.stderr
+    finally:
+        stop = subprocess.run(
+            [str(BIN / "pio-stop-all")], env=env, capture_output=True,
+            text=True, timeout=60,
+        )
+    assert stop.returncode == 0, stop.stdout + stop.stderr
+    assert stop.stdout.count("stopped") == 4, stop.stdout
+    assert not list(run_dir.glob("pio-*.pid"))
+    for pid in pids.values():
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)
+    # idempotent stop
+    stop2 = subprocess.run(
+        [str(BIN / "pio-stop-all")], env=env, capture_output=True,
+        text=True, timeout=60,
+    )
+    assert stop2.returncode == 0
+    assert "nothing to stop" in stop2.stdout

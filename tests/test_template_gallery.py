@@ -1,0 +1,124 @@
+"""Template gallery e2e (VERDICT r1 #8): scaffold → import events →
+train → deploy → query, all through bin/pio as an operator would."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+PIO = str(REPO / "bin" / "pio")
+
+
+def run_pio(args, cwd, env, timeout=180):
+    out = subprocess.run(
+        [PIO, *args], cwd=cwd, env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert out.returncode == 0, (
+        f"pio {' '.join(args)} failed:\n{out.stdout}\n{out.stderr}"
+    )
+    return out.stdout
+
+
+@pytest.fixture()
+def workdir(tmp_path):
+    env = dict(os.environ)
+    env["PIO_FS_BASEDIR"] = str(tmp_path / "store")
+    env.pop("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", None)
+    return tmp_path, env
+
+
+def test_template_list(workdir):
+    tmp, env = workdir
+    out = run_pio(["template", "list"], tmp, env)
+    for name in (
+        "recommendation", "similarproduct", "classification",
+        "ecommerce", "universal",
+    ):
+        assert name in out
+
+
+def test_scaffold_refuses_overwrite(workdir):
+    tmp, env = workdir
+    run_pio(["template", "get", "classification", str(tmp / "eng")], tmp, env)
+    out = subprocess.run(
+        [PIO, "template", "get", "classification", str(tmp / "eng")],
+        cwd=tmp, env=env, capture_output=True, text=True,
+    )
+    assert out.returncode != 0
+    assert "already contains" in out.stdout + out.stderr
+
+
+def test_scaffolded_engine_trains_and_deploys(workdir):
+    tmp, env = workdir
+    eng_dir = tmp / "myengine"
+    run_pio(
+        ["template", "get", "recommendation", str(eng_dir),
+         "--package", "shoprec"],
+        tmp, env,
+    )
+    # engine.json points at the scaffolded package, not the built-in
+    variant = json.loads((eng_dir / "engine.json").read_text())
+    assert variant["engineFactory"] == "shoprec.RecommendationEngine"
+    # wire the app name and create the app + events
+    variant["datasource"]["params"]["app_name"] = "ShopApp"
+    (eng_dir / "engine.json").write_text(json.dumps(variant))
+    run_pio(["app", "new", "ShopApp"], eng_dir, env)
+    lines = []
+    for u in range(6):
+        for i in range(5):
+            if (u + i) % 2 == 0:
+                lines.append(json.dumps({
+                    "event": "rate", "entityType": "user",
+                    "entityId": f"u{u}", "targetEntityType": "item",
+                    "targetEntityId": f"i{i}",
+                    "properties": {"rating": 4.0},
+                    "eventTime": "2026-01-01T00:00:00.000Z",
+                }))
+    (eng_dir / "events.jsonl").write_text("\n".join(lines) + "\n")
+    run_pio(["import", "--app", "ShopApp", "--input", "events.jsonl"],
+            eng_dir, env)
+
+    out = run_pio(["train", "--engine-json", "engine.json"], eng_dir, env)
+    assert "completed" in out.lower()
+
+    # deploy on an ephemeral port and query it
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [PIO, "deploy", "--engine-json", "engine.json",
+         "--ip", "127.0.0.1", "--port", str(port)],
+        cwd=eng_dir, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 120
+        body = None
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps({"user": "u0", "num": 2}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    body = json.loads(r.read())
+                break
+            except OSError:
+                assert proc.poll() is None, (
+                    "deploy died:\n" + proc.stdout.read()
+                )
+                time.sleep(0.5)
+        assert body is not None, "deploy server never answered"
+        assert len(body["item_scores"]) == 2
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
