@@ -169,6 +169,16 @@ class BaseAlgorithm(Generic[PD, M, Q, P]):
         to batch queries into one device program."""
         return [(qx, self.predict(model, q)) for qx, q in queries]
 
+    def query_serializer(self) -> Optional[Any]:
+        """Optional custom query/result serde (reference
+        CustomQuerySerializer.scala: `querySerializer` formats attached to
+        an algorithm, e.g. the regression example's VectorSerializer).
+        Return an object with `query_from_json(parsed_json) -> Q` and/or
+        `result_to_json(prediction) -> jsonable`; either may be absent.
+        When set, the deploy server hands it the RAW parsed JSON (not
+        necessarily an object) instead of dataclass extraction."""
+        return None
+
     def query_class(self) -> Optional[type]:
         """Query type for JSON extraction at serving time (reference
         BaseAlgorithm.queryClass via TypeResolver). Resolved from the

@@ -1,0 +1,218 @@
+"""Regression engine template: entity properties → numeric prediction.
+
+Reference: the regression example family
+(examples/experimental/scala-parallel-regression/Run.scala — MLlib
+LinearRegressionWithSGD behind a P2LAlgorithm with k-fold eval, MSE
+metric, LAverageServing over a params grid, and a custom VectorSerializer
+for queries; also java-local-regression, scala-local-regression).
+
+TPU re-design: entity $set properties aggregate into one dense (N, D)
+matrix; ridge regression solves the normal equations with two MXU
+contractions (models/linreg.py) instead of an SGD loop. The vector-query
+serializer is reproduced through the Algorithm.query_serializer hook: a
+bare JSON array `[x1, x2, ...]` is a valid query, and the response is the
+bare predicted number."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    Engine,
+    EngineFactory,
+    IdentityPreparator,
+    SanityCheck,
+)
+from predictionio_tpu.controller.metrics import AverageMetric
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.data.store.event_store import EventStoreFacade
+from predictionio_tpu.e2.cross_validation import split_data
+from predictionio_tpu.models import linreg
+
+
+@dataclass
+class Query:
+    features: list[float] = field(default_factory=list)
+
+
+@dataclass
+class PredictedResult:
+    value: float
+
+
+@dataclass
+class ActualResult:
+    value: float
+
+
+class VectorQuerySerializer:
+    """Reference VectorSerializer analogue: accepts `[1.0, 2.0]` (bare
+    array) or `{"features": [...]}`; renders the bare predicted value."""
+
+    def query_from_json(self, parsed) -> Query:
+        if isinstance(parsed, list):
+            return Query(features=[float(v) for v in parsed])
+        if isinstance(parsed, dict) and "features" in parsed:
+            return Query(features=[float(v) for v in parsed["features"]])
+        raise ValueError(
+            "regression query must be a JSON array or {'features': [...]}"
+        )
+
+    def result_to_json(self, prediction):
+        if isinstance(prediction, PredictedResult):
+            return prediction.value
+        return prediction
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str
+    entity_type: str = "point"
+    attrs: tuple[str, ...] = ("x0", "x1", "x2")
+    target_attr: str = "y"
+    eval_k: int = 0
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    features: np.ndarray  # (N, D) float32
+    targets: np.ndarray  # (N,) float32
+
+    def sanity_check(self) -> None:
+        if len(self.features) == 0:
+            raise ValueError("no regression points found")
+
+
+@dataclass
+class EvalInfo:
+    fold: int
+
+
+class RegressionDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def _read_all(self, ctx: RuntimeContext) -> TrainingData:
+        store = EventStoreFacade(ctx.storage)
+        props = store.aggregate_properties(
+            app_name=self.params.app_name,
+            entity_type=self.params.entity_type,
+            required=[*self.params.attrs, self.params.target_attr],
+        )
+        rows, targets = [], []
+        for _entity, pmap in sorted(props.items()):
+            rows.append(
+                [
+                    float(pmap.get_opt(a, float) or 0.0)
+                    for a in self.params.attrs
+                ]
+            )
+            targets.append(float(pmap.get_opt(self.params.target_attr, float)))
+        return TrainingData(
+            features=np.asarray(rows, dtype=np.float32),
+            targets=np.asarray(targets, dtype=np.float32),
+        )
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        return self._read_all(ctx)
+
+    def read_eval(self, ctx: RuntimeContext):
+        if self.params.eval_k <= 0:
+            raise ValueError("eval requires datasource params eval_k > 0")
+        td = self._read_all(ctx)
+        idx = list(range(len(td.targets)))
+        out = []
+        for fold, (train_ix, test_ix) in enumerate(
+            split_data(self.params.eval_k, idx)
+        ):
+            tr = TrainingData(
+                features=td.features[train_ix], targets=td.targets[train_ix]
+            )
+            qa = [
+                (
+                    Query(features=td.features[i].tolist()),
+                    ActualResult(value=float(td.targets[i])),
+                )
+                for i in test_ix
+            ]
+            out.append((tr, EvalInfo(fold=fold), qa))
+        return out
+
+
+@dataclass
+class RidgeParams:
+    l2: float = 1e-6
+    fit_intercept: bool = True
+
+
+@dataclass
+class RidgeModel:
+    model: linreg.LinearRegressionModel
+
+
+class RidgeAlgorithm(Algorithm):
+    """Closed-form ridge (replaces LinearRegressionWithSGD — same model
+    family, exact solution)."""
+
+    def __init__(self, params: RidgeParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> RidgeModel:
+        return RidgeModel(
+            model=linreg.train_linear_regression(
+                pd.features,
+                pd.targets,
+                l2=self.params.l2,
+                fit_intercept=self.params.fit_intercept,
+                mesh=ctx.mesh,
+            )
+        )
+
+    def predict(self, model: RidgeModel, query: Query) -> PredictedResult:
+        val = float(model.model.predict(np.asarray(query.features))[0])
+        return PredictedResult(value=val)
+
+    def batch_predict(self, ctx, model: RidgeModel, queries):
+        x = np.asarray([q.features for _, q in queries], dtype=np.float32)
+        vals = model.model.predict(x)
+        return [
+            (qx, PredictedResult(value=float(v)))
+            for (qx, _q), v in zip(queries, vals)
+        ]
+
+    def query_serializer(self):
+        return VectorQuerySerializer()
+
+
+class RegressionAverageServing(AverageServing):
+    """LAverageServing analogue: mean of the per-algorithm predictions
+    (the reference serves the average of the SGD params grid)."""
+
+    FIELD = "value"
+
+
+class MeanSquareError(AverageMetric):
+    """Reference controller MeanSquareError (used by the example's
+    Workflow run)."""
+
+    def calculate_one(self, q: Query, p: PredictedResult, a: ActualResult):
+        return (p.value - a.value) ** 2
+
+    def compare(self, a: float, b: float) -> int:
+        # lower MSE is better
+        return (a < b) - (a > b)
+
+
+class RegressionEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            RegressionDataSource,
+            IdentityPreparator,
+            {"ridge": RidgeAlgorithm},
+            RegressionAverageServing,
+        )

@@ -1,0 +1,82 @@
+"""Linear (ridge) regression as an XLA program.
+
+Parity target: the reference regression examples' delegation to MLlib
+LinearRegressionWithSGD (examples/experimental/scala-parallel-regression/
+Run.scala:62-64, java-local-regression, scala-local-regression).
+
+TPU-first shape: the normal equations are TWO MXU contractions —
+XᵀX (D×D) and Xᵀy (D) — followed by one tiny host-side solve; no SGD
+loop at all for the D ≤ a-few-thousand regime these templates live in.
+Multi-chip: the batch axis shards over the mesh's data axis and GSPMD
+reduces both contractions with an ICI psum (inert weight-0 padding),
+exactly the treeAggregate shape MLlib's optimizer uses on Spark."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class LinearRegressionModel:
+    weights: np.ndarray  # (D,)
+    intercept: float
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        x = np.atleast_2d(np.asarray(x, np.float32))
+        return x @ self.weights + self.intercept
+
+
+@jax.jit
+def _normal_eq_terms(x, y, w):
+    """Weighted XᵀX and Xᵀy at full f32 precision (psum over dp shards)."""
+    xw = x * w[:, None]
+    xtx = jax.lax.dot_general(
+        xw, x,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    xty = jax.lax.dot_general(
+        xw, y[:, None],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )[:, 0]
+    return xtx, xty, jnp.sum(w), xw.sum(0), jnp.sum(w * y)
+
+
+def train_linear_regression(
+    x: np.ndarray,
+    y: np.ndarray,
+    l2: float = 1e-6,
+    fit_intercept: bool = True,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> LinearRegressionModel:
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.float32)
+    w = np.ones(len(x), np.float32)
+    if mesh is not None:
+        from predictionio_tpu.parallel.mesh import pad_and_shard_rows
+
+        xj, yj, wj = pad_and_shard_rows(mesh, x, y, w)
+    else:
+        xj, yj, wj = jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+    xtx, xty, n, xsum, ysum = (
+        np.asarray(v, np.float64) for v in _normal_eq_terms(xj, yj, wj)
+    )
+    d = x.shape[1]
+    if fit_intercept:
+        # fold the intercept by centering the sufficient statistics:
+        # (X-μ)ᵀ(X-μ) = XᵀX − n μμᵀ, (X-μ)ᵀ(y-ȳ) = Xᵀy − n μ ȳ
+        mu = xsum / n
+        ybar = ysum / n
+        xtx = xtx - np.outer(mu, mu) * n
+        xty = xty - mu * ybar * n
+    a = xtx + l2 * n * np.eye(d)
+    weights = np.linalg.solve(a, xty).astype(np.float32)
+    intercept = float(ybar - mu @ weights) if fit_intercept else 0.0
+    return LinearRegressionModel(weights=weights, intercept=intercept)

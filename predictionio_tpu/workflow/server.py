@@ -76,6 +76,7 @@ class EngineRuntime:
     models: list[Any]
     serving: Any
     query_class: Optional[type]
+    query_serializer: Optional[Any] = None
     started_at: _dt.datetime = field(
         default_factory=lambda: _dt.datetime.now(_dt.timezone.utc)
     )
@@ -97,6 +98,9 @@ def build_runtime(storage: Storage, instance: EngineInstance) -> EngineRuntime:
             except Exception:
                 log.exception("algorithm warmup failed; serving continues")
     query_class = algorithms[0].query_class() if algorithms else None
+    query_serializer = (
+        algorithms[0].query_serializer() if algorithms else None
+    )
     return EngineRuntime(
         instance=instance,
         engine=engine,
@@ -105,6 +109,7 @@ def build_runtime(storage: Storage, instance: EngineInstance) -> EngineRuntime:
         models=models,
         serving=serving,
         query_class=query_class,
+        query_serializer=query_serializer,
     )
 
 
@@ -185,18 +190,23 @@ class _Handler(JsonHandler):
                 query_json = json.loads(raw or "null")
             except json.JSONDecodeError as e:
                 raise _HttpError(400, f"invalid query JSON: {e}")
-            if not isinstance(query_json, dict):
-                raise _HttpError(400, "query must be a JSON object")
-
             rt = owner.runtime  # snapshot — /reload swaps atomically
+            custom_from = getattr(
+                rt.query_serializer, "query_from_json", None
+            )
+            if custom_from is None and not isinstance(query_json, dict):
+                raise _HttpError(400, "query must be a JSON object")
             try:
-                query = (
-                    extract_params(rt.query_class, query_json)
-                    if rt.query_class is not None
-                    else query_json
-                )
+                if custom_from is not None:
+                    query = custom_from(query_json)
+                elif rt.query_class is not None:
+                    query = extract_params(rt.query_class, query_json)
+                else:
+                    query = query_json
             except ParamsError as e:
                 raise _HttpError(400, str(e))
+            except ValueError as e:
+                raise _HttpError(400, f"query serializer rejected: {e}")
 
             supplemented = rt.serving.supplement(query)
             try:
@@ -214,7 +224,11 @@ class _Handler(JsonHandler):
                 # algorithms raise ValueError for query-level contract
                 # violations (e.g. category filter without category data)
                 raise _HttpError(400, str(e))
-            result = _to_jsonable(prediction)
+            custom_to = getattr(rt.query_serializer, "result_to_json", None)
+            result = (
+                custom_to(prediction) if custom_to is not None
+                else _to_jsonable(prediction)
+            )
 
             for plugin in owner.output_blockers:
                 result = plugin.process(query_json, result, {})
