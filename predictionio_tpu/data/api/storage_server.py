@@ -21,6 +21,7 @@ import argparse
 import json
 import logging
 import threading
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
@@ -108,6 +109,7 @@ class _Handler(BaseHTTPRequestHandler):
             req = json.loads(self.rfile.read(length))
             dao_name = req["dao"]
             method = req["method"]
+            req_id = req.get("req_id")
             args = [wire.decode(a) for a in req.get("args", [])]
             kwargs = {k: wire.decode(v) for k, v in req.get("kwargs", {}).items()}
         except Exception as e:  # malformed request
@@ -120,23 +122,95 @@ class _Handler(BaseHTTPRequestHandler):
                 {"ok": False, "error": f"unknown rpc {dao_name}.{method}"},
             )
             return
+        # Writes carry a req_id: a retry of a request we already applied
+        # (the client lost the response) replays the recorded outcome
+        # instead of re-executing. If the first attempt is still executing
+        # (client timed out mid-request), the retry WAITS for it rather
+        # than racing it — check-then-execute without in-flight tracking
+        # would apply the write twice.
+        inflight_done = None
+        if req_id is not None:
+            lock = self.server.dedupe_lock  # type: ignore[attr-defined]
+            cache = self.server.dedupe_cache  # type: ignore[attr-defined]
+            inflight = self.server.dedupe_inflight  # type: ignore[attr-defined]
+            cached = None
+            while True:
+                with lock:
+                    cached = cache.get(req_id)
+                    if cached is not None:
+                        break
+                    waiter = inflight.get(req_id)
+                    if waiter is None:
+                        inflight_done = threading.Event()
+                        inflight[req_id] = inflight_done
+                        break
+                if not waiter.wait(timeout=120):
+                    break  # first attempt hung; execute without dedupe
+            if cached is not None:
+                self._reply(200, cached)
+                return
         storage: Storage = self.server.storage  # type: ignore[attr-defined]
         try:
             dao = getattr(storage, entry[0])()
-            result = getattr(dao, method)(*args, **kwargs)
-            if method == "find":  # iterator → materialized list
-                result = list(result)
+            if dao_name == "events" and method == "find":
+                result: Any = self._paged_find(dao, args, kwargs)
+            else:
+                result = getattr(dao, method)(*args, **kwargs)
             if isinstance(result, list):
                 encoded: Any = {"$list": [wire.encode(v) for v in result]}
             else:
                 encoded = wire.encode(result)
-            self._reply(200, {"ok": True, "result": encoded})
+            payload = {"ok": True, "result": encoded}
         except Exception as e:
             log.exception("storage rpc %s.%s failed", dao_name, method)
-            self._reply(
-                200,
-                {"ok": False, "error": f"{type(e).__name__}: {e}"},
+            payload = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if req_id is not None:
+            with self.server.dedupe_lock:  # type: ignore[attr-defined]
+                cache = self.server.dedupe_cache  # type: ignore[attr-defined]
+                cache[req_id] = payload
+                while len(cache) > 4096:
+                    cache.popitem(last=False)
+                if inflight_done is not None:
+                    self.server.dedupe_inflight.pop(req_id, None)  # type: ignore[attr-defined]
+            if inflight_done is not None:
+                inflight_done.set()
+        self._reply(200, payload)
+
+
+    def _paged_find(self, dao: Any, args: list, kwargs: dict) -> Any:
+        """find with a server-enforced page limit + keyset continuation.
+
+        The client resends the last (eventTime, event_id) it saw (`_after`);
+        the server pushes it down as EventQuery.start_after, which every
+        backend turns into an ordered-scan predicate — sqlite into an
+        indexed range clause. Each page is O(page) regardless of how deep
+        the scan is, the continuation is stable under concurrent writes
+        (both scan directions), and no train-scale read materializes as one
+        JSON body (the reference DAOs stream — jdbc/JDBCLEvents.scala:34).
+        A request with no paging kwargs gets the whole-list reply.
+        """
+        import dataclasses
+
+        query = args[0]
+        if "_page" not in kwargs and "_after" not in kwargs:
+            return list(dao.find(query))
+        max_page = self.server.find_page_size  # type: ignore[attr-defined]
+        page = min(int(kwargs.pop("_page", 0)) or max_page, max_page)
+        after = kwargs.pop("_after", None)
+        q2 = query
+        if after is not None:
+            q2 = dataclasses.replace(
+                q2, start_after=(after["t"], after["id"])
             )
+        eff_limit = page + 1  # +1 sentinel detects a further page
+        if after is None and query.limit is not None and query.limit >= 0:
+            # first page of a limited query; later pages are capped by the
+            # client shrinking `_page` to the remaining budget
+            eff_limit = min(eff_limit, query.limit)
+        q2 = dataclasses.replace(q2, limit=eff_limit)
+        items = list(dao.find(q2))
+        more = len(items) > page
+        return {"events": items[:page], "more": more}
 
 
 class StorageServer:
@@ -148,12 +222,22 @@ class StorageServer:
         host: str = "127.0.0.1",
         port: int = 7077,
         auth_key: Optional[str] = None,
+        find_page_size: int = 10_000,
     ):
         self.storage = storage or Storage.get_instance()
+        if host not in ("127.0.0.1", "localhost", "::1") and not auth_key:
+            log.warning(
+                "storage server binding %s WITHOUT --auth-key: all app data "
+                "is readable/writable by any network peer", host,
+            )
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.request_queue_size = 128
         self.httpd.storage = self.storage  # type: ignore[attr-defined]
         self.httpd.auth_key = auth_key  # type: ignore[attr-defined]
+        self.httpd.find_page_size = find_page_size  # type: ignore[attr-defined]
+        self.httpd.dedupe_lock = threading.Lock()  # type: ignore[attr-defined]
+        self.httpd.dedupe_cache = OrderedDict()  # type: ignore[attr-defined]
+        self.httpd.dedupe_inflight = {}  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -182,7 +266,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         prog="pio storage-server",
         description="Shared storage service for multi-process deployments",
     )
-    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=7077)
     ap.add_argument("--auth-key", default=None)
     args = ap.parse_args(argv)

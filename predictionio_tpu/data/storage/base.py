@@ -60,8 +60,21 @@ class EventQuery:
     reversed: bool = False
     # tri-state for target filters: None = no filter; NONE_SENTINEL = must be absent
     filter_target_absent: bool = False
+    # keyset cursor: resume strictly after (eventTime, event_id) in scan
+    # order — greater-than for forward scans, less-than for reversed. Find
+    # results are ordered by (eventTime, event_id), so this gives O(page)
+    # stable pagination (the role of the reference's HBase scan-from-row-key,
+    # hbase/HBEventsUtil.scala:286).
+    start_after: Optional[tuple[_dt.datetime, str]] = None
 
     def matches(self, e: Event) -> bool:
+        if self.start_after is not None:
+            key = (e.event_time, e.event_id or "")
+            if self.reversed:
+                if key >= self.start_after:
+                    return False
+            elif key <= self.start_after:
+                return False
         if self.start_time is not None and e.event_time < self.start_time:
             return False
         if self.until_time is not None and e.event_time >= self.until_time:

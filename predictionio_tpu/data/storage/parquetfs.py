@@ -246,7 +246,9 @@ class ParquetFSEventStore(EventStore):
             if query.matches(e)
         )
         ordered = sorted(
-            matches, key=lambda e: e.event_time, reverse=query.reversed
+            matches,
+            key=lambda e: (e.event_time, e.event_id or ""),
+            reverse=query.reversed,
         )
         if query.limit is not None:
             ordered = ordered[: query.limit]

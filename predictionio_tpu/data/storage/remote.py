@@ -22,6 +22,7 @@ from __future__ import annotations
 import http.client
 import json
 import threading
+import uuid
 from typing import Any, Iterator, Optional, Sequence
 
 from predictionio_tpu.data.event import Event
@@ -59,19 +60,26 @@ class RemoteClient:
         return conn
 
     def call(self, dao: str, method: str, *args: Any, **kwargs: Any) -> Any:
-        body = json.dumps(
-            {
-                "dao": dao,
-                "method": method,
-                "args": [wire.encode(a) for a in args],
-                "kwargs": {k: wire.encode(v) for k, v in kwargs.items()},
-            },
-            separators=(",", ":"),
-        ).encode()
+        req: dict[str, Any] = {
+            "dao": dao,
+            "method": method,
+            "args": [wire.encode(a) for a in args],
+            "kwargs": {k: wire.encode(v) for k, v in kwargs.items()},
+        }
+        # Every write carries a request id; the server deduplicates on it,
+        # so a retry after a response-phase failure (which may postdate the
+        # server applying the request — e.g. a response lost on the wire)
+        # replays the recorded outcome instead of re-executing. For inserts
+        # that prevents duplicate rows; for delete/update it prevents the
+        # retry from observing its own first application (e.g. a re-executed
+        # delete returning False) (ADVICE r2 medium).
+        if not method.startswith(("get", "find")):
+            req["req_id"] = uuid.uuid4().hex
+        body = json.dumps(req, separators=(",", ":")).encode()
         headers = {"Content-Type": "application/json"}
         if self.auth_key:
             headers["X-PIO-Storage-Key"] = self.auth_key
-        for attempt in (0, 1):  # one retry on a stale keep-alive connection
+        for attempt in (0, 1):
             conn = self._conn()
             try:
                 conn.request("POST", "/rpc", body=body, headers=headers)
@@ -79,6 +87,10 @@ class RemoteClient:
                 payload = json.loads(resp.read())
                 break
             except (http.client.HTTPException, OSError):
+                # Covers both pre-delivery failures (send on a dead socket,
+                # idle-closed keep-alive surfacing as a zero-byte response)
+                # and lost responses; the req_id dedupe above makes the
+                # single retry safe in every case.
                 conn.close()
                 self._local.conn = None
                 if attempt:
@@ -95,8 +107,14 @@ class RemoteClient:
         try:
             conn = self._conn()
             conn.request("GET", "/health")
-            return conn.getresponse().read() is not None
-        except (http.client.HTTPException, OSError):
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return False
+            health = json.loads(body)
+            return isinstance(health, dict) and health.get("status") == "alive"
+        except (http.client.HTTPException, OSError, ValueError):
+            self._local.conn = None
             return False
 
 
@@ -150,8 +168,43 @@ class RemoteEventStore(_RemoteDao, base.EventStore):
     ) -> Optional[Event]:
         return self._call("get", event_id, app_id, channel_id)
 
+    # Page size for find; the daemon pages result sets so a train-scale
+    # read never materializes as one JSON body on either side (the
+    # reference JDBC/HBase DAOs stream for the same reason).
+    FIND_PAGE = 10_000
+
     def find(self, query: EventQuery) -> Iterator[Event]:
-        return iter(self._call("find", query))
+        """Streams pages from the daemon.
+
+        Continuation is by keyset: the client resends the last (eventTime,
+        event_id) it saw, which the server pushes down into the DAO query as
+        EventQuery.start_after. Each page is O(page) on the server (sqlite
+        turns the cursor into an indexed range predicate) and pagination is
+        stable under concurrent writes, in both scan directions.
+        """
+
+        def _pages() -> Iterator[Event]:
+            yielded = 0
+            cursor: Optional[tuple] = None
+            while True:
+                want = self.FIND_PAGE
+                if query.limit is not None and query.limit >= 0:
+                    want = min(want, query.limit - yielded)
+                    if want <= 0:
+                        return
+                kw: dict[str, Any] = {"_page": want}
+                if cursor is not None:
+                    kw["_after"] = {"t": cursor[0], "id": cursor[1]}
+                page = self._call("find", query, **kw)
+                events = page["events"]
+                yield from events
+                yielded += len(events)
+                if not page["more"]:
+                    return
+                last = events[-1]
+                cursor = (last.event_time, last.event_id or "")
+
+        return _pages()
 
 
 class RemoteApps(_RemoteDao, base.Apps):

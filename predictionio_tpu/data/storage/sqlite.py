@@ -230,6 +230,13 @@ class SqliteEventStore(base.EventStore):
             if query.target_entity_id is not None:
                 clauses.append("targetEntityId = ?")
                 params.append(query.target_entity_id)
+        if query.start_after is not None:
+            t, eid = query.start_after
+            op = "<" if query.reversed else ">"
+            clauses.append(
+                f"(eventTime {op} ? OR (eventTime = ? AND id {op} ?))"
+            )
+            params.extend([_ms(t), _ms(t), eid])
         where = ("WHERE " + " AND ".join(clauses)) if clauses else ""
         order = "DESC" if query.reversed else "ASC"
         limit = f"LIMIT {int(query.limit)}" if query.limit is not None and query.limit >= 0 else ""
@@ -265,6 +272,13 @@ class SqliteEventStore(base.EventStore):
             if query.target_entity_id is not None:
                 clauses.append("targetEntityId = ?")
                 params.append(query.target_entity_id)
+        if query.start_after is not None:
+            t, eid = query.start_after
+            op = "<" if query.reversed else ">"
+            clauses.append(
+                f"(eventTime {op} ? OR (eventTime = ? AND id {op} ?))"
+            )
+            params.extend([_ms(t), _ms(t), eid])
         return ("WHERE " + " AND ".join(clauses)) if clauses else "", params
 
     def find_frame(

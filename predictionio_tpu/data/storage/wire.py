@@ -53,7 +53,14 @@ def encode(obj: Any) -> Any:
     if isinstance(obj, dict):
         return {"$dict": {str(k): encode(v) for k, v in obj.items()}}
     if isinstance(obj, Event):
-        return {"$event": obj.to_json_dict(with_id=True)}
+        # Full-precision datetimes on the wire: the public JSON form
+        # (Event.to_json_dict) truncates to milliseconds for API parity,
+        # but the storage RPC must round-trip microseconds so time-window
+        # filters and dedupe ordering match the embedded backends.
+        d = obj.to_json_dict(with_id=True)
+        d["eventTime"] = _enc_dt(obj.event_time)
+        d["creationTime"] = _enc_dt(obj.creation_time)
+        return {"$event": d}
     if isinstance(obj, EventQuery):
         return {
             "$query": {
@@ -71,6 +78,11 @@ def encode(obj: Any) -> Any:
                 "limit": obj.limit,
                 "reversed": obj.reversed,
                 "filter_target_absent": obj.filter_target_absent,
+                "start_after": (
+                    [_enc_dt(obj.start_after[0]), obj.start_after[1]]
+                    if obj.start_after is not None
+                    else None
+                ),
             }
         }
     if isinstance(obj, App):
@@ -158,6 +170,11 @@ def decode(obj: Any) -> Any:
                 limit=val["limit"],
                 reversed=val["reversed"],
                 filter_target_absent=val["filter_target_absent"],
+                start_after=(
+                    (_dec_dt(val["start_after"][0]), val["start_after"][1])
+                    if val.get("start_after") is not None
+                    else None
+                ),
             )
         if tag == "$app":
             return App(**val)

@@ -536,7 +536,7 @@ def build_parser() -> argparse.ArgumentParser:
         "storage-server",
         help="run the shared storage service for multi-process deployments",
     )
-    s.add_argument("--ip", default="0.0.0.0")
+    s.add_argument("--ip", default="127.0.0.1")
     s.add_argument("--port", type=int, default=7077)
     s.add_argument("--auth-key", default=None)
     s.set_defaults(func=cmd_storage_server)

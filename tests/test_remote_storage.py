@@ -208,3 +208,342 @@ def test_event_server_ingests_through_remote_storage(daemon, tmp_path):
         env,
     )
     assert reader.strip().endswith("OK")
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r2 hardening: paging, precision, ping, retry idempotency
+# ---------------------------------------------------------------------------
+
+
+def _inproc_server(tmp_path, **kw):
+    from predictionio_tpu.data.api.storage_server import StorageServer
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    cfg = StorageConfig(
+        sources={
+            "SQL": SourceConfig(
+                "SQL", "sqlite", {"PATH": str(tmp_path / "paged.db")}
+            ),
+        },
+        repositories={
+            "METADATA": "SQL", "EVENTDATA": "SQL", "MODELDATA": "SQL",
+        },
+    )
+    return StorageServer(Storage(cfg), host="127.0.0.1", port=0, **kw).start()
+
+
+def test_find_pages_across_rpc_calls(tmp_path):
+    """A result set larger than the server page limit arrives complete and
+    in order, via multiple RPC round trips (ADVICE r2: the find RPC must not
+    materialize train-scale reads as one JSON body)."""
+    import datetime as dt
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import EventQuery
+    from predictionio_tpu.data.storage.remote import RemoteEventStore
+
+    server = _inproc_server(tmp_path, find_page_size=7)
+    try:
+        store = RemoteEventStore({"HOST": "127.0.0.1", "PORT": str(server.port)})
+        store.init_app(1)
+        base_t = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+        events = [
+            Event(event="view", entity_type="user", entity_id=f"u{i:03d}",
+                  event_time=base_t + dt.timedelta(seconds=i))
+            for i in range(25)
+        ]
+        store.insert_batch(events, 1)
+
+        calls = {"n": 0}
+        orig_call = store._client.call
+
+        def counting_call(dao, method, *a, **kw):
+            if method == "find":
+                calls["n"] += 1
+            return orig_call(dao, method, *a, **kw)
+
+        store._client.call = counting_call
+        got = list(store.find(EventQuery(app_id=1)))
+        assert [e.entity_id for e in got] == [f"u{i:03d}" for i in range(25)]
+        assert calls["n"] == 4  # ceil(25/7) pages
+
+        # query.limit is respected across pages
+        calls["n"] = 0
+        got = list(store.find(EventQuery(app_id=1, limit=10)))
+        assert len(got) == 10
+        assert calls["n"] == 2
+    finally:
+        server.shutdown()
+
+
+def test_event_datetimes_roundtrip_microseconds(tmp_path):
+    """Wire codec keeps microsecond precision (ADVICE r2: the public JSON
+    form truncates to ms; the storage RPC must not)."""
+    import datetime as dt
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import wire
+
+    t = dt.datetime(2021, 6, 1, 12, 0, 0, 123456, tzinfo=dt.timezone.utc)
+    e = Event(event="buy", entity_type="user", entity_id="u1",
+              event_time=t, creation_time=t)
+    rt = wire.decode(wire.encode(e))
+    assert rt.event_time == t
+    assert rt.creation_time == t
+
+
+def test_ping_validates_health_response(tmp_path):
+    """ping() is only true for a real storage daemon answering 200 with the
+    health JSON — not for any listener that happens to answer (ADVICE r2)."""
+    import http.server
+    import threading
+
+    from predictionio_tpu.data.storage.remote import RemoteClient
+
+    server = _inproc_server(tmp_path)
+    try:
+        good = RemoteClient({"HOST": "127.0.0.1", "PORT": str(server.port)})
+        assert good.ping() is True
+    finally:
+        server.shutdown()
+
+    class NotFound(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"nope"
+            self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    impostor = http.server.HTTPServer(("127.0.0.1", 0), NotFound)
+    t = threading.Thread(target=impostor.serve_forever, daemon=True)
+    t.start()
+    try:
+        bad = RemoteClient(
+            {"HOST": "127.0.0.1", "PORT": str(impostor.server_address[1])}
+        )
+        assert bad.ping() is False
+    finally:
+        impostor.shutdown()
+
+    dead = RemoteClient({"HOST": "127.0.0.1", "PORT": str(_free_port())})
+    assert dead.ping() is False
+
+
+def test_lost_response_insert_dedupes_on_retry(tmp_path):
+    """A response-phase failure on insert retries with the same request id;
+    the server replays the recorded outcome instead of applying the write
+    twice (ADVICE r2 medium: non-idempotent RPCs must not duplicate)."""
+    import http.client
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import EventQuery
+    from predictionio_tpu.data.storage.remote import RemoteClient, RemoteEventStore
+
+    server = _inproc_server(tmp_path)
+    try:
+        store = RemoteEventStore({"HOST": "127.0.0.1", "PORT": str(server.port)})
+        store.init_app(1)
+
+        class FlakyResponseConn:
+            """Delivers the request (server applies it), then dies before
+            the response arrives."""
+
+            def __init__(self, real):
+                self.real = real
+
+            def request(self, *a, **kw):
+                self.real.request(*a, **kw)
+
+            def getresponse(self):
+                self.real.getresponse().read()  # drain the real response
+                raise http.client.HTTPException("connection lost mid-response")
+
+            def close(self):
+                self.real.close()
+
+        client: RemoteClient = store._client
+        real_conn = client._conn()
+        client._local.conn = FlakyResponseConn(real_conn)
+
+        e = Event(event="buy", entity_type="user", entity_id="once")
+        eid = store.insert(e, 1)  # applied once; retry replays the outcome
+
+        got = list(store.find(EventQuery(app_id=1)))
+        assert len(got) == 1 and got[0].entity_id == "once"
+        assert got[0].event_id == eid  # the replayed id is the applied one
+    finally:
+        server.shutdown()
+
+
+def test_stale_keepalive_insert_retries_safely(tmp_path):
+    """A zero-byte failure on a REUSED keep-alive socket means the server
+    idle-closed before the request arrived — the client must retry even a
+    non-idempotent insert (code-review r3: send() is buffered, so the stale
+    socket surfaces in getresponse, not conn.request)."""
+    import http.client
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import EventQuery
+    from predictionio_tpu.data.storage.remote import RemoteEventStore
+
+    server = _inproc_server(tmp_path)
+    try:
+        store = RemoteEventStore({"HOST": "127.0.0.1", "PORT": str(server.port)})
+        store.init_app(1)  # also warms the keep-alive connection
+
+        class IdleClosedConn:
+            """Reused socket the server closed: the request never arrives,
+            getresponse sees zero bytes."""
+
+            def request(self, *a, **kw):
+                pass  # written into a dead socket — not delivered
+
+            def getresponse(self):
+                raise http.client.RemoteDisconnected(
+                    "Remote end closed connection without response"
+                )
+
+            def close(self):
+                pass
+
+        client = store._client
+        client._local.conn = IdleClosedConn()  # reused → fresh=False
+
+        e = Event(event="buy", entity_type="user", entity_id="retry-me")
+        store.insert(e, 1)  # retries transparently on a fresh socket
+
+        got = list(store.find(EventQuery(app_id=1)))
+        assert len(got) == 1 and got[0].entity_id == "retry-me"
+    finally:
+        server.shutdown()
+
+
+def test_paged_find_stable_under_concurrent_inserts(tmp_path):
+    """Keyset continuation: rows inserted between page RPCs neither shift
+    events into duplication nor skip them (code-review r3: offset pages are
+    not snapshot-stable under mutation)."""
+    import datetime as dt
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import EventQuery
+    from predictionio_tpu.data.storage.remote import RemoteEventStore
+
+    server = _inproc_server(tmp_path, find_page_size=5)
+    try:
+        store = RemoteEventStore({"HOST": "127.0.0.1", "PORT": str(server.port)})
+        store.init_app(1)
+        base_t = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+        store.insert_batch(
+            [
+                Event(event="view", entity_type="user", entity_id=f"u{i:03d}",
+                      event_time=base_t + dt.timedelta(seconds=i))
+                for i in range(17)
+            ],
+            1,
+        )
+
+        orig_call = store._client.call
+        page_no = {"n": 0}
+
+        def interfering_call(dao, method, *a, **kw):
+            result = orig_call(dao, method, *a, **kw)
+            if method == "find":
+                page_no["n"] += 1
+                if page_no["n"] == 1:
+                    # concurrent writer lands an EARLIER-timestamped event
+                    # between page 1 and page 2 — with offset paging this
+                    # would duplicate the page-1 boundary event
+                    orig_call(
+                        "events", "insert",
+                        Event(event="view", entity_type="user",
+                              entity_id="early-bird",
+                              event_time=base_t - dt.timedelta(hours=1)),
+                        1, None,
+                    )
+            return result
+
+        store._client.call = interfering_call
+        got = [e.entity_id for e in store.find(EventQuery(app_id=1))]
+        # no duplicates, and every pre-scan event is present exactly once
+        assert len(got) == len(set(got))
+        assert {f"u{i:03d}" for i in range(17)} <= set(got)
+    finally:
+        server.shutdown()
+
+
+def test_find_pages_reversed_keyset(tmp_path):
+    """Reversed scans page by keyset too — descending order is preserved
+    across page boundaries (code-review r3)."""
+    import datetime as dt
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import EventQuery
+    from predictionio_tpu.data.storage.remote import RemoteEventStore
+
+    server = _inproc_server(tmp_path, find_page_size=7)
+    try:
+        store = RemoteEventStore({"HOST": "127.0.0.1", "PORT": str(server.port)})
+        store.init_app(1)
+        base_t = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+        store.insert_batch(
+            [
+                Event(event="view", entity_type="user", entity_id=f"u{i:03d}",
+                      event_time=base_t + dt.timedelta(seconds=i))
+                for i in range(25)
+            ],
+            1,
+        )
+        got = [e.entity_id for e in store.find(EventQuery(app_id=1, reversed=True))]
+        assert got == [f"u{i:03d}" for i in reversed(range(25))]
+    finally:
+        server.shutdown()
+
+
+def test_concurrent_same_req_id_applies_once(tmp_path):
+    """Concurrent retries with one req_id (client timeout + retry while the
+    first attempt is still executing) apply the write once: later arrivals
+    wait for the in-flight first attempt instead of racing it."""
+    import concurrent.futures
+    import http.client as hc
+    import json as _json
+
+    from predictionio_tpu.data.storage.base import EventQuery
+    from predictionio_tpu.data.storage.remote import RemoteEventStore
+    from predictionio_tpu.data.storage import wire
+    from predictionio_tpu.data.event import Event
+
+    server = _inproc_server(tmp_path)
+    try:
+        store = RemoteEventStore({"HOST": "127.0.0.1", "PORT": str(server.port)})
+        store.init_app(1)
+        e = Event(event="buy", entity_type="user", entity_id="racer")
+        body = _json.dumps({
+            "dao": "events", "method": "insert", "req_id": "fixed-req-id",
+            "args": [wire.encode(e), 1, None], "kwargs": {},
+        }).encode()
+
+        def fire(_):
+            conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=30)
+            conn.request("POST", "/rpc", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = _json.loads(conn.getresponse().read())
+            conn.close()
+            return resp
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=20) as ex:
+            results = list(ex.map(fire, range(20)))
+
+        ids = {r["result"] for r in results if r["ok"]}
+        assert len(ids) == 1  # every response replays the same applied id
+        got = list(store.find(EventQuery(app_id=1)))
+        assert len(got) == 1 and got[0].entity_id == "racer"
+    finally:
+        server.shutdown()
