@@ -327,7 +327,7 @@ class ALSAlgorithm(Algorithm):
         vocab_ids = list(model.factors.user_vocab.to_dict())
         if not vocab_ids:
             return
-        for batch in (1, 16):
+        for batch in (1, 8, 64):  # the full serving bucket ladder
             # nomask program
             self._predict_batch(
                 model, [Query(user=vocab_ids[0], num=10)] * batch
@@ -401,10 +401,20 @@ class ALSAlgorithm(Algorithm):
         sub_mask = (
             full_mask[[i for i, _ in known_ix]] if full_mask is not None else None
         )
-        # bucket the batch dim to powers of two so micro-batched serving
-        # reuses a handful of compiled programs instead of one per size
+        # bucket the batch dim to {1, 8, 64, pow2 beyond} so micro-batched
+        # serving reuses THREE compiled programs for everything up to the
+        # default dispatcher max_batch — padding a (B, K) row batch is
+        # near-free device-side, while every extra compiled shape is a
+        # multi-second XLA compile a live query would otherwise eat
         n_real = len(user_rows)
-        bucket = 1 << (n_real - 1).bit_length() if n_real > 1 else 1
+        if n_real <= 1:
+            bucket = 1
+        elif n_real <= 8:
+            bucket = 8
+        elif n_real <= 64:
+            bucket = 64
+        else:
+            bucket = 1 << (n_real - 1).bit_length()
         if bucket != n_real:
             user_rows = np.concatenate(
                 [user_rows, np.zeros(bucket - n_real, dtype=np.int64)]

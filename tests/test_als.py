@@ -275,3 +275,18 @@ class TestShardedALS:
         params = als.ALSParams(rank=4, iterations=3, implicit_prefs=False)
         model = als.train(rows, cols, vals, 60, 40, params, mesh=mesh8)
         assert np.all(np.isfinite(model.user_factors))
+
+
+def test_train_empty_interactions():
+    """Zero events must yield a well-formed (regularized-init) model, not a
+    deep IndexError from the windowed planner (code-review r3)."""
+    from predictionio_tpu.models import als as _als
+
+    m = _als.train(
+        np.array([], np.int32), np.array([], np.int32),
+        np.array([], np.float32), 5, 4,
+        _als.ALSParams(rank=10, iterations=2),
+    )
+    assert m.user_factors.shape == (5, 10)
+    assert m.item_factors.shape == (4, 10)
+    assert np.all(np.isfinite(m.user_factors))
