@@ -39,6 +39,9 @@ BACKENDS: dict[str, tuple[str, str]] = {
     # client-server backend: all DAOs proxied to a storage service daemon
     # (the reference's JDBC/HBase client role, Storage.scala:140-142)
     "remote": ("predictionio_tpu.data.storage.remote", "Remote"),
+    # scale-out SQL backend (reference jdbc/ Postgres role); needs a
+    # psycopg2 or pg8000 driver at runtime
+    "postgres": ("predictionio_tpu.data.storage.postgres", "Postgres"),
 }
 
 # DAO logical names → class suffix

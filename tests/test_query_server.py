@@ -212,8 +212,14 @@ def test_load_32_clients_qps_and_p99(served):
     total = n_clients * n_per
     qps = total / wall
     p99 = sorted(latencies)[int(0.99 * (len(latencies) - 1))]
-    assert qps >= 100, f"qps {qps:.1f} under load target"
-    assert p99 < 2.0, f"p99 {p99 * 1000:.0f} ms"
+    # VERDICT r2 #2: the bar tracks measured capability (CPU-local serving
+    # measures >600 qps) instead of sitting 5x below it; override on
+    # slower/contended CI hosts via PIO_TEST_QPS_BAR
+    import os as _os
+
+    qps_bar = float(_os.environ.get("PIO_TEST_QPS_BAR", "300"))
+    assert qps >= qps_bar, f"qps {qps:.1f} under load target {qps_bar}"
+    assert p99 < 1.0, f"p99 {p99 * 1000:.0f} ms"
     # device-side latency is bookkept separately from end-to-end
     assert srv.predict_count > 0
     assert srv.avg_predict_sec <= srv.avg_serving_sec

@@ -70,11 +70,24 @@ def _remote_server(tmp_path):
     return StorageServer(Storage(cfg), host="127.0.0.1", port=0).start()
 
 
-@pytest.fixture(params=["memory", "sqlite", "parquetfs", "remote"])
+def _pg_fake_client():
+    """postgres backend over the sqlite-backed fake driver (fake_pg.py) —
+    full-contract coverage of the SQL/codec layer without a server."""
+    import fake_pg
+    from predictionio_tpu.data.storage.postgres import _PGClient
+
+    return _PGClient(conn=fake_pg.connect())
+
+
+@pytest.fixture(params=["memory", "sqlite", "parquetfs", "remote", "postgres"])
 def events(request, tmp_path):
     server = None
     if request.param == "memory":
         store = MemoryEventStore()
+    elif request.param == "postgres":
+        from predictionio_tpu.data.storage.postgres import PostgresEventStore
+
+        store = PostgresEventStore(client=_pg_fake_client())
     elif request.param == "parquetfs":
         from predictionio_tpu.data.storage.parquetfs import ParquetFSEventStore
 
@@ -195,8 +208,26 @@ class TestEventStoreContract:
         assert got[0].event_time > got[1].event_time
 
 
-@pytest.fixture(params=["memory", "sqlite", "remote"])
+@pytest.fixture(params=["memory", "sqlite", "remote", "postgres"])
 def meta(request, tmp_path):
+    if request.param == "postgres":
+        from predictionio_tpu.data.storage.postgres import (
+            PostgresAccessKeys,
+            PostgresApps,
+            PostgresChannels,
+            PostgresEngineInstances,
+            PostgresModels,
+        )
+
+        client = _pg_fake_client()
+        yield {
+            "apps": PostgresApps({}, client=client),
+            "keys": PostgresAccessKeys({}, client=client),
+            "channels": PostgresChannels({}, client=client),
+            "instances": PostgresEngineInstances({}, client=client),
+            "models": PostgresModels({}, client=client),
+        }
+        return
     if request.param == "memory":
         yield {
             "apps": MemoryApps(),
@@ -342,3 +373,35 @@ class TestRegistry:
     def test_dao_singletons(self, fresh_storage):
         assert fresh_storage.get_events() is fresh_storage.get_events()
         assert fresh_storage.get_meta_data_apps() is fresh_storage.get_meta_data_apps()
+
+
+class TestFindFrameContract:
+    """Columnar training-read fast path, for backends that provide it
+    (sqlite json_extract pushdown, parquetfs column projection, postgres
+    host-side pull)."""
+
+    def test_find_frame_values_and_order(self, events):
+        if not hasattr(events, "find_frame"):
+            pytest.skip("backend uses the base find() fallback")
+        evs = [
+            ev("rate", f"u{i}", t=i, target_entity_type="item",
+               target_entity_id=f"i{i % 3}",
+               properties=DataMap({"rating": float(i + 1)}))
+            for i in range(6)
+        ]
+        events.insert_batch(evs, APP)
+        frame = events.find_frame(
+            EventQuery(app_id=APP), value_prop="rating", default_value=9.0
+        )
+        assert len(frame) == 6
+        assert frame.value.tolist() == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+        # and the default applies when the property is absent
+        events.insert(
+            ev("rate", "u9", t=10, target_entity_type="item",
+               target_entity_id="i0"),
+            APP,
+        )
+        frame = events.find_frame(
+            EventQuery(app_id=APP), value_prop="rating", default_value=9.0
+        )
+        assert frame.value.tolist()[-1] == 9.0
