@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
-import re
 import threading
 from typing import Any, Iterator, Optional
 
@@ -83,10 +82,24 @@ def _load_driver():
     )
 
 
-_URL_RE = re.compile(
-    r"^postgres(?:ql)?://(?:(?P<user>[^:@/]+)(?::(?P<pw>[^@/]*))?@)?"
-    r"(?P<host>[^:/]+)(?::(?P<port>\d+))?/(?P<db>[^?]+)"
-)
+def _parse_url(url: str) -> dict:
+    """postgres:// DSN → connect kwargs. urlsplit-based: percent-decoded
+    credentials, IPv6 hosts, and query params (sslmode=…) passed through
+    to the driver."""
+    from urllib.parse import parse_qsl, unquote, urlsplit
+
+    parts = urlsplit(url)
+    if parts.scheme not in ("postgres", "postgresql"):
+        raise StorageError(f"cannot parse postgres URL {url!r}")
+    kw = dict(
+        host=parts.hostname or "127.0.0.1",
+        port=parts.port or 5432,
+        database=(parts.path or "/pio").lstrip("/"),
+        user=unquote(parts.username) if parts.username else "pio",
+        password=unquote(parts.password) if parts.password else "",
+    )
+    kw.update(dict(parse_qsl(parts.query)))
+    return kw
 
 
 class _PGClient:
@@ -103,16 +116,7 @@ class _PGClient:
         _, driver = _load_driver()
         url = config.get("URL")
         if url:
-            m = _URL_RE.match(url)
-            if not m:
-                raise StorageError(f"cannot parse postgres URL {url!r}")
-            kw = dict(
-                host=m.group("host"),
-                port=int(m.group("port") or 5432),
-                database=m.group("db"),
-                user=m.group("user") or "pio",
-                password=m.group("pw") or "",
-            )
+            kw = _parse_url(url)
         else:
             kw = dict(
                 host=config.get("HOST", "127.0.0.1"),
@@ -181,6 +185,18 @@ class _PGClient:
                 cur.close()
             return rows
 
+    def executemany(self, sql: str, rows: list[tuple]) -> None:
+        with self.lock:
+            cur = self.conn.cursor()
+            try:
+                cur.executemany(sql, rows)
+                self.conn.commit()
+            except Exception:
+                self._rollback_quietly()
+                raise
+            finally:
+                cur.close()
+
 
 def CLIENT_FACTORY(config: dict[str, str]) -> _PGClient:
     return _PGClient(config)
@@ -236,8 +252,14 @@ class PostgresEventStore(base.EventStore):
         return True
 
     def close(self) -> None:
+        # commit-only, like the sqlite backend: the registry shares one
+        # _PGClient across every DAO of the source, so actually closing the
+        # connection here would kill the metadata/model DAOs too
         with self._client.lock:
-            self._client.conn.close()
+            try:
+                self._client.conn.commit()
+            except Exception:
+                pass
 
     def _row(self, event: Event, eid: str) -> tuple:
         return (
@@ -278,12 +300,10 @@ class PostgresEventStore(base.EventStore):
     def insert_batch(self, events, app_id, channel_id=None) -> list[str]:
         name = self._ensure_table(app_id, channel_id)
         eids = [e.event_id or new_event_id() for e in events]
-        sql = _pg(self._UPSERT.format(t=name))
-        with self._client.lock:
-            cur = self._client.conn.cursor()
-            cur.executemany(sql, [self._row(e, i) for e, i in zip(events, eids)])
-            self._client.conn.commit()
-            cur.close()
+        self._client.executemany(
+            _pg(self._UPSERT.format(t=name)),
+            [self._row(e, i) for e, i in zip(events, eids)],
+        )
         return eids
 
     def delete(

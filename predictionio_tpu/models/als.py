@@ -519,16 +519,33 @@ def train(
         n_chunks=n_chunks,
     )
     if mesh is not None:
-        from predictionio_tpu.parallel.mesh import edge_sharding, replicated
+        if jax.process_count() > 1:
+            # multi-host: device_put cannot place onto other processes'
+            # devices — stage through the loader seam instead. Every
+            # process passes the identical full edge arrays; stage_rows
+            # extracts this process's contiguous row block and assembles
+            # the global sharded array (reference analogue: HBase
+            # executor-partitioned reads, HBPEvents.scala:84-90).
+            from predictionio_tpu.parallel.loader import (
+                stage_replicated,
+                stage_rows,
+            )
 
-        edge_sh = edge_sharding(mesh)
-        rep_sh = replicated(mesh)
-        device_args = [
-            jax.device_put(a, edge_sh) for a in args[:8]
-        ] + [
-            jax.device_put(a, rep_sh) if a is not None else None
-            for a in args[8:]
-        ]
+            device_args = list(stage_rows(mesh, *args[:8])) + [
+                stage_replicated(mesh, a) if a is not None else None
+                for a in args[8:]
+            ]
+        else:
+            from predictionio_tpu.parallel.mesh import edge_sharding, replicated
+
+            edge_sh = edge_sharding(mesh)
+            rep_sh = replicated(mesh)
+            device_args = [
+                jax.device_put(a, edge_sh) for a in args[:8]
+            ] + [
+                jax.device_put(a, rep_sh) if a is not None else None
+                for a in args[8:]
+            ]
         uf, itf = _train_jit(*device_args, mesh=mesh, **kwargs)
     else:
         uf, itf = _train_jit(*args, **kwargs)

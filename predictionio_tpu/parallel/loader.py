@@ -70,6 +70,15 @@ def stage_rows(
     return tuple(out)
 
 
+def stage_replicated(mesh: Mesh, array: np.ndarray) -> jax.Array:
+    """Stage a host array fully replicated over the mesh — every process
+    contributes the (identical) whole array. The multi-host-safe
+    equivalent of jax.device_put(a, replicated_sharding), which cannot be
+    used once devices span processes."""
+    sharding = NamedSharding(mesh, P())
+    return jax.make_array_from_process_local_data(sharding, array, array.shape)
+
+
 def stage_edges(
     mesh: Mesh,
     rows: np.ndarray,

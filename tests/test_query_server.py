@@ -218,8 +218,9 @@ def test_load_32_clients_qps_and_p99(served):
     import os as _os
 
     qps_bar = float(_os.environ.get("PIO_TEST_QPS_BAR", "300"))
+    p99_bar = float(_os.environ.get("PIO_TEST_P99_BAR", "1.0"))
     assert qps >= qps_bar, f"qps {qps:.1f} under load target {qps_bar}"
-    assert p99 < 1.0, f"p99 {p99 * 1000:.0f} ms"
+    assert p99 < p99_bar, f"p99 {p99 * 1000:.0f} ms over {p99_bar * 1000:.0f} ms"
     # device-side latency is bookkept separately from end-to-end
     assert srv.predict_count > 0
     assert srv.avg_predict_sec <= srv.avg_serving_sec
