@@ -392,29 +392,17 @@ class ALSAlgorithm(Algorithm):
         # NOT create a new compiled program per distinct value — warmup can
         # actually cover live traffic; results are sliced to num on host
         n_items = model.factors.item_factors.shape[0]
+        from predictionio_tpu.utils.bucket import batch_bucket, topk_bucket
+
         k_req = min(max(q.num for q in queries), n_items)
-        k = n_items if n_items <= 128 else min(
-            n_items, max(128, 1 << (k_req - 1).bit_length())
-        )
+        k = topk_bucket(k_req, n_items)
         user_rows = np.array([u for _, u in known_ix], dtype=np.int64)
         full_mask = self._exclusion_mask(model, queries)
         sub_mask = (
             full_mask[[i for i, _ in known_ix]] if full_mask is not None else None
         )
-        # bucket the batch dim to {1, 8, 64, pow2 beyond} so micro-batched
-        # serving reuses THREE compiled programs for everything up to the
-        # default dispatcher max_batch — padding a (B, K) row batch is
-        # near-free device-side, while every extra compiled shape is a
-        # multi-second XLA compile a live query would otherwise eat
         n_real = len(user_rows)
-        if n_real <= 1:
-            bucket = 1
-        elif n_real <= 8:
-            bucket = 8
-        elif n_real <= 64:
-            bucket = 64
-        else:
-            bucket = 1 << (n_real - 1).bit_length()
+        bucket = batch_bucket(n_real)
         if bucket != n_real:
             user_rows = np.concatenate(
                 [user_rows, np.zeros(bucket - n_real, dtype=np.int64)]

@@ -250,3 +250,81 @@ class TestUniversalRecommender:
             if e.event_time <= old
         ]
         assert remaining == []
+
+
+class TestDeviceBatchServing:
+    """VERDICT r2 #5: the UR serving hot path is one device dispatch."""
+
+    def _tables(self, rng, n_items, n_things, top_n):
+        idx = rng.randint(0, n_things, (n_items, top_n)).astype(np.int32)
+        # -1-pad a ragged tail like real correlator tables
+        for i in range(0, n_items, 3):
+            idx[i, top_n // 2:] = -1
+        scores = rng.rand(n_items, top_n).astype(np.float32) + 0.1
+        scores[idx < 0] = 0.0
+        return idx, scores
+
+    def test_batch_matches_score_history_reference(self):
+        from predictionio_tpu.models import cco
+
+        rng = np.random.RandomState(5)
+        n_items = 500
+        tables = [
+            self._tables(rng, n_items, n_things, 16) + (n_things,)
+            for n_things in (300, 120)
+        ]
+        B, H = 6, 20
+        hists = []
+        for _, _, j in tables:
+            h = np.full((B, H), -1, np.int32)
+            for b in range(B):
+                n = rng.randint(0, H)
+                h[b, :n] = rng.randint(0, j, n)
+            hists.append(h)
+        exclude = np.full((B, 8), -1, np.int32)
+        exclude[0, :3] = [1, 2, 3]
+        vals, idx = cco.batch_score_topk(tables, hists, exclude, k=n_items)
+
+        for b in range(B):
+            expect = np.zeros(n_items, np.float32)
+            for (cidx, csc, _j), h in zip(tables, hists):
+                hh = h[b][h[b] >= 0]
+                expect += cco.score_history(cidx, csc, hh)
+            got = np.zeros(n_items, np.float32)
+            got[idx[b]] = np.maximum(vals[b], 0.0)
+            expect[exclude[b][exclude[b] >= 0]] = 0.0  # device masks these
+            np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+    def test_catalog_scale_qps(self):
+        """10^5-item catalog: the batched program must sustain real
+        throughput (measured on the CPU test backend; the JSON-visible
+        bench numbers come from bench.py on the chip)."""
+        import time
+
+        from predictionio_tpu.models import cco
+
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(9)
+        n_items = 100_000
+        cidx, csc = self._tables(rng, n_items, 80_000, 50)
+        # device-resident tables, as URModel.device_tables stages them —
+        # re-uploading 20 MB of correlators per batch is NOT the product
+        # configuration
+        tables = [(jnp.asarray(cidx), jnp.asarray(csc), 80_000)]
+        B, H = 64, 100
+        hist = np.full((B, H), -1, np.int32)
+        for b in range(B):
+            hist[b] = rng.randint(0, 80_000, H)
+        exclude = np.full((B, 8), -1, np.int32)
+        vals, idx = cco.batch_score_topk(tables, [hist], exclude, k=64)  # warm
+        t0 = time.perf_counter()
+        n_reps = 3
+        for _ in range(n_reps):
+            vals, idx = cco.batch_score_topk(tables, [hist], exclude, k=64)
+        dt = (time.perf_counter() - t0) / n_reps
+        qps = B / dt
+        assert vals.shape == (B, 64)
+        # CPU-backend floor; the device path exists precisely so this does
+        # not degrade to per-(query x indicator) numpy loops
+        assert qps > 40, f"batched UR qps {qps:.0f}"
