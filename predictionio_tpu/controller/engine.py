@@ -114,19 +114,26 @@ class Engine(BaseEngine):
 
     # -- train (reference Engine.train:154 + object Engine.train:622) ------
     def train(self, ctx: RuntimeContext, engine_params: EngineParams) -> list[Any]:
+        import time as _time
+
         wp = ctx.workflow_params
+        t0 = _time.perf_counter()
         data_source = self.make_data_source(engine_params)
         td = data_source.read_training(ctx)
         _sanity(td, "training data", wp)
+        ctx.stage_timings["read"] = _time.perf_counter() - t0
         if wp.stop_after_read:
             raise StopAfterReadInterruption()
 
+        t0 = _time.perf_counter()
         preparator = self.make_preparator(engine_params)
         pd = preparator.prepare(ctx, td)
         _sanity(pd, "prepared data", wp)
+        ctx.stage_timings["prepare"] = _time.perf_counter() - t0
         if wp.stop_after_prepare:
             raise StopAfterPrepareInterruption()
 
+        t0 = _time.perf_counter()
         algorithms = self.make_algorithms(engine_params)
         if not algorithms:
             raise ParamsError("engine has no algorithms configured")
@@ -135,6 +142,7 @@ class Engine(BaseEngine):
             model = algo.train(ctx, pd)
             _sanity(model, f"model of algorithm #{i}", wp)
             models.append(model)
+        ctx.stage_timings["train"] = _time.perf_counter() - t0
         return models
 
     # -- serializable models (reference makeSerializableModels:283) --------

@@ -43,6 +43,9 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    # when set, the train run is wrapped in jax.profiler.trace(profile_dir)
+    # (SURVEY §5: XLA profiler hook; `pio train --profile DIR`)
+    profile_dir: Optional[str] = None
 
 
 @dataclass
@@ -60,6 +63,10 @@ class RuntimeContext:
     # the EngineInstance id of the current train run ("" outside train
     # workflows) — keys mid-training checkpoints in MODELDATA
     instance_id: str = ""
+    # per-stage wall-clock seconds (read/prepare/train/persist), filled by
+    # Engine.train + run_train and recorded on the EngineInstance row
+    # (SURVEY §5 observability; reference had only Spark-UI visibility)
+    stage_timings: dict = field(default_factory=dict)
 
     @property
     def is_serving(self) -> bool:

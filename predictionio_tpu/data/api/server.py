@@ -54,6 +54,8 @@ class EventServerConfig:
     port: int = 7070
     stats: bool = False
     plugins: list = field(default_factory=list)
+    # remote log shipping (reference CreateServer.scala:441-452 --log-url)
+    log_url: Optional[str] = None
 
 
 @dataclass
@@ -330,3 +332,4 @@ class EventServer(ServerProcess):
         return _Server(
             (self.config.ip, self.config.port), self.storage, self.config
         )
+    # log shipping (config.log_url) attaches/detaches in ServerProcess

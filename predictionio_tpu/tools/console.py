@@ -217,12 +217,19 @@ def cmd_train(args) -> int:
         skip_sanity_check=args.skip_sanity_check,
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
+        profile_dir=args.profile,
     )
     inst = run_train(
         _storage(), variant, workflow_params=wp,
         engine_version=args.engine_version,
     )
     print(f"[INFO] Training {inst.status.lower()}: instance {inst.id}")
+    if args.profile:
+        print(f"[INFO] XLA profile written to {args.profile} "
+              f"(inspect with tensorboard --logdir)")
+    timings = (inst.env or {}).get("stage_timings")
+    if timings:
+        print(f"[INFO] Stage timings (s): {timings}")
     return 0 if inst.status in ("COMPLETED", "INTERRUPTED") else 1
 
 
@@ -244,6 +251,7 @@ def cmd_deploy(args) -> int:
         feedback=args.feedback,
         event_server_url=args.event_server_url,
         access_key=args.access_key,
+        log_url=args.log_url,
     )
     return _serve_until_interrupt(
         QueryServer(_storage(), runtime, config),
@@ -279,7 +287,10 @@ def cmd_eventserver(args) -> int:
     return _serve_until_interrupt(
         EventServer(
             _storage(),
-            EventServerConfig(ip=args.ip, port=args.port, stats=args.stats),
+            EventServerConfig(
+                ip=args.ip, port=args.port, stats=args.stats,
+                log_url=args.log_url,
+            ),
         ),
         f"[INFO] Event Server is listening at http://{args.ip}:{{port}}.",
     )
@@ -487,6 +498,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--skip-sanity-check", action="store_true")
     s.add_argument("--stop-after-read", action="store_true")
     s.add_argument("--stop-after-prepare", action="store_true")
+    s.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="wrap the train run in jax.profiler.trace(DIR)",
+    )
     s.set_defaults(func=cmd_train)
 
     # deploy
@@ -498,6 +513,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--feedback", action="store_true")
     s.add_argument("--event-server-url")
     s.add_argument("--access-key")
+    s.add_argument(
+        "--log-url", default=None,
+        help="POST server log records to this collector URL (JSON lines)",
+    )
     s.set_defaults(func=cmd_deploy)
 
     # eval
@@ -514,6 +533,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ip", default="0.0.0.0")
     s.add_argument("--port", type=int, default=7070)
     s.add_argument("--stats", action="store_true")
+    s.add_argument(
+        "--log-url", default=None,
+        help="POST server log records to this collector URL (JSON lines)",
+    )
     s.set_defaults(func=cmd_eventserver)
 
     # template gallery (reference console/Template.scala:69-429)

@@ -96,9 +96,28 @@ class ServerProcess:
             target=self._server.serve_forever, name=self._name, daemon=True
         )
         self._thread.start()
+        # remote log shipping (reference CreateServer.scala:441-452
+        # --log-url): any server whose config carries log_url ships the
+        # framework's log records to the collector
+        log_url = getattr(getattr(self, "config", None), "log_url", None)
+        if log_url and getattr(self, "_log_shipper", None) is None:
+            import logging
+
+            from predictionio_tpu.utils.logship import attach_log_shipper
+
+            self._log_shipper = attach_log_shipper(
+                log_url, logging.getLogger("predictionio_tpu")
+            )
         return self.port
 
     def stop(self) -> None:
+        shipper = getattr(self, "_log_shipper", None)
+        if shipper is not None:
+            import logging
+
+            logging.getLogger("predictionio_tpu").removeHandler(shipper)
+            shipper.close()
+            self._log_shipper = None
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

@@ -115,39 +115,69 @@ def run_train(
         serving_params=_stage_json(engine_params.serving_params),
         mesh_conf=variant.get("mesh") or {},
     )
+    import contextlib
+    import time as _time
+
+    profile_cm: Any = contextlib.nullcontext()
+    if wp.profile_dir:
+        # SURVEY §5: XLA profiler hook — the whole train runs under a
+        # jax.profiler trace; inspect with tensorboard/xprof. Built BEFORE
+        # the instance row is inserted so a failure here can't strand a
+        # row in INIT.
+        import jax
+
+        profile_cm = jax.profiler.trace(wp.profile_dir)
+
     instance_id = instances.insert(instance)
     instance.id = instance_id
 
     ctx = runtime_context_from_variant(storage, variant, "train", wp)
     ctx.instance_id = instance_id
+
+    def _record_timings() -> None:
+        instance.env = dict(instance.env or {})
+        instance.env["stage_timings"] = json.dumps(
+            {k: round(v, 4) for k, v in ctx.stage_timings.items()}
+        )
+
     try:
         instance.status = "TRAINING"
         instances.update(instance)
-        try:
-            models = engine.train(ctx, engine_params)
-        except (StopAfterReadInterruption, StopAfterPrepareInterruption) as e:
-            # intentional debug stop-points, not failures (reference
-            # CoreWorkflow.scala:88-93 logs "Training interrupted")
-            log.info("training interrupted by %s", type(e).__name__)
-            instance.status = "INTERRUPTED"
-            instance.end_time = _dt.datetime.now(_dt.timezone.utc)
-            instances.update(instance)
-            return instance
-        if wp.save_model:
-            serializable = engine.make_serializable_models(
-                ctx, models, engine_params, instance_id
-            )
-            storage.get_model_data_models().insert(
-                Model(id=instance_id, models=serialize_models(serializable))
-            )
+        with profile_cm:
+            try:
+                models = engine.train(ctx, engine_params)
+            except (StopAfterReadInterruption, StopAfterPrepareInterruption) as e:
+                # intentional debug stop-points, not failures (reference
+                # CoreWorkflow.scala:88-93 logs "Training interrupted")
+                log.info("training interrupted by %s", type(e).__name__)
+                instance.status = "INTERRUPTED"
+                instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+                _record_timings()
+                instances.update(instance)
+                return instance
+            if wp.save_model:
+                t0 = _time.perf_counter()
+                serializable = engine.make_serializable_models(
+                    ctx, models, engine_params, instance_id
+                )
+                storage.get_model_data_models().insert(
+                    Model(id=instance_id, models=serialize_models(serializable))
+                )
+                ctx.stage_timings["persist"] = _time.perf_counter() - t0
         instance.status = "COMPLETED"
         instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+        _record_timings()
         instances.update(instance)
-        log.info("training completed: instance %s", instance_id)
+        log.info(
+            "training completed: instance %s (stages: %s)",
+            instance_id,
+            {k: round(v, 3) for k, v in ctx.stage_timings.items()},
+        )
         return instance
     except Exception:
         instance.status = "ABORTED"
         instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+        _record_timings()  # partial timings show WHERE the failed run spent time
         instances.update(instance)
         raise
 

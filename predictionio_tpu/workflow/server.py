@@ -63,6 +63,9 @@ class QueryServerConfig:
     batch_window_ms: float = 2.0
     max_window_ms: float = 60.0
     max_batch: int = 64
+    # remote log shipping (reference CreateServer.scala:441-452 --log-url):
+    # server log records POST to this collector as JSON lines, best-effort
+    log_url: Optional[str] = None
 
 
 @dataclass
@@ -419,7 +422,7 @@ class QueryServer(ServerProcess):
     def stop(self) -> None:
         if self.dispatcher is not None:
             self.dispatcher.stop()
-        super().stop()
+        super().stop()  # also detaches the log shipper (ServerProcess)
 
     def _make_server(self) -> _Server:
         server = _Server((self.config.ip, self.config.port), _Handler)
