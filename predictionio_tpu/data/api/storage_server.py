@@ -37,7 +37,7 @@ _DAO_TABLE: dict[str, tuple[str, frozenset[str]]] = {
         "get_events",
         frozenset({
             "init_app", "remove_app", "insert", "insert_batch", "delete",
-            "delete_batch", "get", "find",
+            "delete_batch", "get", "find", "data_signature",
         }),
     ),
     "apps": (

@@ -169,6 +169,25 @@ class EventStore(abc.ABC):
         """Stream events matching the filter, ordered by event_time
         (reversed=True → descending)."""
 
+    def data_signature(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> str:
+        """Cheap fingerprint of an (app, channel) namespace — changes
+        whenever events are written or deleted. Keys DataView cache
+        invalidation (data/view.py; reference DataView.scala version hash).
+
+        The default is an O(n) scan over ids (count + order-independent
+        id-hash xor — exact: a delete paired with a replayed insert cannot
+        collide). Backends override with metadata-cheap versions."""
+        import zlib
+
+        n = 0
+        acc = 0
+        for e in self.find(EventQuery(app_id=app_id, channel_id=channel_id)):
+            n += 1
+            acc ^= zlib.crc32((e.event_id or "").encode())
+        return f"{n}:{acc}"
+
     # -- derived reads (shared implementations) ----------------------------
     def find_single_entity(
         self,

@@ -32,6 +32,12 @@ class MemoryEventStore(base.EventStore):
         self._lock = threading.RLock()
         # (app_id, channel_id) → {event_id: Event}
         self._ns: dict[tuple[int, Optional[int]], dict[str, Event]] = {}
+        # (app_id, channel_id) → write version (bumped on every mutation)
+        self._versions: dict[tuple[int, Optional[int]], int] = {}
+
+    def _bump(self, app_id: int, channel_id: Optional[int]) -> None:
+        key = self._key(app_id, channel_id)
+        self._versions[key] = self._versions.get(key, 0) + 1
 
     def _key(self, app_id: int, channel_id: Optional[int]):
         return (app_id, channel_id)
@@ -59,15 +65,17 @@ class MemoryEventStore(base.EventStore):
         with self._lock:
             eid = event.event_id or new_event_id()
             self._table(app_id, channel_id)[eid] = event.with_id(eid)
+            self._bump(app_id, channel_id)
             return eid
 
     def delete(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
     ) -> bool:
         with self._lock:
-            return (
-                self._table(app_id, channel_id).pop(event_id, None) is not None
-            )
+            hit = self._table(app_id, channel_id).pop(event_id, None) is not None
+            if hit:
+                self._bump(app_id, channel_id)
+            return hit
 
     def get(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
@@ -83,6 +91,13 @@ class MemoryEventStore(base.EventStore):
         if query.limit is not None and query.limit >= 0:
             events = events[: query.limit]
         return iter(events)
+
+    def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
+        # exact write counter: bumped on every insert/delete (see _bump)
+        with self._lock:
+            n = len(self._table(app_id, channel_id))
+            ver = self._versions.get((app_id, channel_id), 0)
+            return f"{n}:{ver}"
 
 
 class MemoryApps(base.Apps):

@@ -239,6 +239,20 @@ class ParquetFSEventStore(EventStore):
                 return e
         return None
 
+    def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Metadata-cheap: one column scan of creation_time_ms + the
+        tombstone count (no Event materialization)."""
+        with self._lock:
+            self._flush(app_id, channel_id)
+            table = self._read_table(app_id, channel_id, ["creation_time_ms"])
+            stones = self._tombstones(self._dir(app_id, channel_id))
+        if table is None or table.num_rows == 0:
+            return f"0:{len(stones)}:0"
+        import pyarrow.compute as pc
+
+        mx = pc.max(table.column("creation_time_ms")).as_py() or 0
+        return f"{table.num_rows}:{len(stones)}:{mx}"
+
     def find(self, query: EventQuery) -> Iterator[Event]:
         matches = (
             e

@@ -173,6 +173,9 @@ class RemoteEventStore(_RemoteDao, base.EventStore):
     # reference JDBC/HBase DAOs stream for the same reason).
     FIND_PAGE = 10_000
 
+    def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
+        return self._call("data_signature", app_id, channel_id)
+
     def find(self, query: EventQuery) -> Iterator[Event]:
         """Streams pages from the daemon.
 

@@ -245,6 +245,20 @@ class SqliteEventStore(base.EventStore):
             rows = self._client.conn.execute(sql, params).fetchall()
         return (self._to_event(r) for r in rows)
 
+    def data_signature(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> str:
+        # count + max creationTime + max rowid: rowid is assigned
+        # monotonically, so a delete paired with a replayed historical
+        # insert (same count, old creationTime) still changes the signature
+        name = self._ensure_table(app_id, channel_id)
+        with self._client.lock:
+            n, mx, rid = self._client.conn.execute(
+                f"SELECT COUNT(*), COALESCE(MAX(creationTime), 0), "
+                f"COALESCE(MAX(rowid), 0) FROM {name}"
+            ).fetchone()
+        return f"{n}:{mx}:{rid}"
+
     def _where(self, query: EventQuery) -> tuple[str, list]:
         clauses, params = [], []
         if query.start_time is not None:

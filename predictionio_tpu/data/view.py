@@ -1,0 +1,185 @@
+"""DataView: cached derived event frames keyed by (query, data version).
+
+Reference analogue: data/src/main/scala/io/prediction/data/view/
+DataView.scala:37-110 — events → DataFrame with parquet caching keyed by
+MurmurHash(time-range + version + schema). Here the derived artifact is
+the columnar EventFrame (the training read's staging format): repeated
+trainings of the same window deserialize the cached frame instead of
+re-scanning and re-folding the event store.
+
+The cache key hashes the full query shape (app/channel, time range,
+entity/event filters, value extraction) together with the store's DATA
+SIGNATURE — a cheap monotone fingerprint (event count + newest creation
+time) every backend exposes — so any write to the window's namespace
+invalidates the cache without explicit bookkeeping.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import json
+import logging
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.store.bimap import BiMap
+from predictionio_tpu.data.store.columnar import EventFrame
+from predictionio_tpu.data.store.event_store import EventStoreFacade
+
+log = logging.getLogger(__name__)
+
+
+def default_view_dir() -> str:
+    base = os.environ.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+    )
+    return os.path.join(base, "view")
+
+
+def _iso(t: Optional[_dt.datetime]) -> Optional[str]:
+    return t.isoformat() if t is not None else None
+
+
+def _save_frame(path: str, frame: EventFrame) -> None:
+    def vocab_bytes(v: BiMap) -> np.ndarray:
+        return np.frombuffer(
+            json.dumps(list(v.to_dict().items())).encode(), dtype=np.uint8
+        )
+
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            event_code=frame.event_code,
+            entity_idx=frame.entity_idx,
+            target_idx=frame.target_idx,
+            time_ms=frame.time_ms,
+            value=frame.value,
+            event_vocab=vocab_bytes(frame.event_vocab),
+            entity_vocab=vocab_bytes(frame.entity_vocab),
+            target_vocab=vocab_bytes(frame.target_vocab),
+            meta=np.frombuffer(
+                json.dumps(
+                    {
+                        "entity_type": frame.entity_type,
+                        "target_entity_type": frame.target_entity_type,
+                    }
+                ).encode(),
+                dtype=np.uint8,
+            ),
+        )
+    os.replace(tmp, path)  # atomic: a concurrent reader never sees a torn file
+
+
+def _load_frame(path: str) -> EventFrame:
+    def vocab(z, key) -> BiMap:
+        return BiMap(dict(json.loads(bytes(z[key].tobytes()).decode())))
+
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        return EventFrame(
+            event_code=z["event_code"],
+            entity_idx=z["entity_idx"],
+            target_idx=z["target_idx"],
+            time_ms=z["time_ms"],
+            value=z["value"],
+            event_vocab=vocab(z, "event_vocab"),
+            entity_vocab=vocab(z, "entity_vocab"),
+            target_vocab=vocab(z, "target_vocab"),
+            entity_type=meta["entity_type"],
+            target_entity_type=meta["target_entity_type"],
+        )
+
+
+class DataView:
+    """Cached find_frame over any storage backend.
+
+    `find_frame(storage, …)` takes the EventStoreFacade.find_frame
+    signature; on a key hit the cached frame loads from `view_dir`, else
+    the store is scanned/folded once and the result cached. Process-wide
+    hit/miss counters support tests and `pio status`-style introspection.
+    """
+
+    stats = {"hits": 0, "misses": 0}
+
+    def __init__(self, view_dir: Optional[str] = None):
+        self.view_dir = view_dir or default_view_dir()
+
+    def find_frame(
+        self,
+        storage,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        value_prop: Optional[str] = None,
+        default_value: float = 1.0,
+    ) -> EventFrame:
+        facade = EventStoreFacade(storage)
+        app_id, channel_id = facade.app_name_to_id(app_name, channel_name)
+        signature = storage.get_events().data_signature(app_id, channel_id)
+        query_key = hashlib.sha1(
+            json.dumps(
+                {
+                    "app_id": app_id,
+                    "channel_id": channel_id,
+                    "event_names": sorted(event_names) if event_names else None,
+                    "entity_type": entity_type,
+                    "target_entity_type": target_entity_type,
+                    "start": _iso(start_time),
+                    "until": _iso(until_time),
+                    "value_prop": value_prop,
+                    "default": default_value,
+                },
+                sort_keys=True,
+            ).encode()
+        ).hexdigest()[:20]
+        sig_key = hashlib.sha1(signature.encode()).hexdigest()[:16]
+        # filename = query hash + signature hash, so superseded versions of
+        # the SAME query are identifiable for eviction
+        key = f"{query_key}_{sig_key}"
+        path = os.path.join(self.view_dir, f"frame_{key}.npz")
+        if os.path.exists(path):
+            try:
+                frame = _load_frame(path)
+                DataView.stats["hits"] += 1
+                log.info("DataView hit: %s (%d events)", key[:12], len(frame))
+                return frame
+            except Exception:
+                log.exception("DataView cache %s unreadable; refolding", path)
+        DataView.stats["misses"] += 1
+        frame = facade.find_frame(
+            app_name=app_name,
+            channel_name=channel_name,
+            event_names=event_names,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            start_time=start_time,
+            until_time=until_time,
+            value_prop=value_prop,
+            default_value=default_value,
+        )
+        os.makedirs(self.view_dir, exist_ok=True)
+        try:
+            _save_frame(path, frame)
+            # evict superseded versions of this query — the signature is
+            # monotone, so older frames are unreachable and would otherwise
+            # accumulate one full-window frame per retrain
+            for name in os.listdir(self.view_dir):
+                if (
+                    name.startswith(f"frame_{query_key}_")
+                    and name != os.path.basename(path)
+                ):
+                    try:
+                        os.unlink(os.path.join(self.view_dir, name))
+                    except OSError:
+                        pass
+        except Exception:
+            log.exception("DataView cache write failed (continuing uncached)")
+        return frame

@@ -83,6 +83,11 @@ class DataSourceParams:
     # this in a separate filter-by-category variant; off by default so the
     # plain variant pays no extra event-store scan)
     read_item_categories: bool = False
+    # cache the folded EventFrame keyed by (query, data version): repeated
+    # trainings of an unchanged window skip the event scan+fold entirely
+    # (data/view.py; reference DataView.scala:37-110)
+    use_data_view: bool = False
+    data_view_dir: Optional[str] = None  # default $PIO_FS_BASEDIR/view
 
 
 @dataclass
@@ -117,8 +122,7 @@ class RecommendationDataSource(DataSource):
         self.params = params
 
     def _frame(self, ctx: RuntimeContext):
-        store = EventStoreFacade(ctx.storage)
-        frame = store.find_frame(
+        frame_kwargs = dict(
             app_name=self.params.app_name,
             entity_type="user",
             target_entity_type="item",
@@ -126,6 +130,14 @@ class RecommendationDataSource(DataSource):
             value_prop="rating",
             default_value=1.0,
         )
+        if self.params.use_data_view:
+            from predictionio_tpu.data.view import DataView
+
+            frame = DataView(self.params.data_view_dir).find_frame(
+                ctx.storage, **frame_kwargs
+            )
+        else:
+            frame = EventStoreFacade(ctx.storage).find_frame(**frame_kwargs)
         # only the rate event carries a rating payload; every other
         # interaction type ("buy", "view"…) weighs 1.0 even if it happens
         # to have a "rating" property (reference custom-query DataSource

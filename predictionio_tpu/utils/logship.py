@@ -66,7 +66,7 @@ class RemoteLogHandler(logging.Handler):
                 break
         return out
 
-    def _ship(self, records: list[dict]) -> None:
+    def _ship(self, records: list[dict]) -> bool:
         body = "\n".join(json.dumps(r) for r in records).encode()
         req = urllib.request.Request(
             self.url,
@@ -77,6 +77,7 @@ class RemoteLogHandler(logging.Handler):
         try:
             with urllib.request.urlopen(req, timeout=5):
                 pass
+            return True
         except Exception as e:
             if not self._warned:
                 self._warned = True
@@ -87,6 +88,7 @@ class RemoteLogHandler(logging.Handler):
                     "log shipping to %s failing (%s); further failures "
                     "are silent", self.url, e,
                 )
+            return False
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -97,11 +99,12 @@ class RemoteLogHandler(logging.Handler):
 
     def close(self) -> None:
         self._stop.set()
-        while True:  # flush EVERYTHING pending, batch by batch
+        while True:  # flush everything pending, batch by batch…
             records = self._drain()
             if not records:
                 break
-            self._ship(records)
+            if not self._ship(records):
+                break  # …but a dead collector must not block shutdown
         self._thread.join(timeout=2)
         super().close()
 

@@ -405,3 +405,19 @@ class TestFindFrameContract:
             EventQuery(app_id=APP), value_prop="rating", default_value=9.0
         )
         assert frame.value.tolist()[-1] == 9.0
+
+
+class TestDataSignature:
+    """data_signature: cheap monotone namespace fingerprint (DataView key)."""
+
+    def test_changes_on_write_and_delete(self, events):
+        s0 = events.data_signature(APP)
+        eid = events.insert(ev("view", "u1"), APP)
+        s1 = events.data_signature(APP)
+        assert s1 != s0
+        events.insert(ev("view", "u2", t=1), APP)
+        s2 = events.data_signature(APP)
+        assert s2 != s1
+        events.delete(eid, APP)
+        s3 = events.data_signature(APP)
+        assert s3 != s2
