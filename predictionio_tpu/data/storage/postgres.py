@@ -213,10 +213,28 @@ class PostgresEventStore(base.EventStore):
     def _table_name(self, app_id: int, channel_id: Optional[int]) -> str:
         return f"events_{app_id}" + (f"_{channel_id}" if channel_id else "")
 
+    _VERSIONS_DDL = (
+        "CREATE TABLE IF NOT EXISTS pio_data_versions "
+        "(tbl TEXT PRIMARY KEY, ver BIGINT NOT NULL)"
+    )
+
+    def _bump(self, name: str) -> None:
+        # exact write version: bumped on every mutation (incl. upsert
+        # in-place updates) so data_signature cannot collide under
+        # delete+replay or property rewrites
+        self._client.execute(
+            _pg(
+                "INSERT INTO pio_data_versions VALUES (?, 1) "
+                "ON CONFLICT (tbl) DO UPDATE SET ver = pio_data_versions.ver + 1"
+            ),
+            (name,),
+        )
+
     def _ensure_table(self, app_id: int, channel_id: Optional[int]) -> str:
         name = self._table_name(app_id, channel_id)
         if name in self._known_tables:
             return name
+        self._client.execute(self._VERSIONS_DDL)
         self._client.execute(
             f"""CREATE TABLE IF NOT EXISTS {name} (
                 id TEXT PRIMARY KEY,
@@ -295,6 +313,7 @@ class PostgresEventStore(base.EventStore):
         self._client.execute(
             _pg(self._UPSERT.format(t=name)), self._row(event, eid)
         )
+        self._bump(name)
         return eid
 
     def insert_batch(self, events, app_id, channel_id=None) -> list[str]:
@@ -304,6 +323,7 @@ class PostgresEventStore(base.EventStore):
             _pg(self._UPSERT.format(t=name)),
             [self._row(e, i) for e, i in zip(events, eids)],
         )
+        self._bump(name)
         return eids
 
     def delete(
@@ -313,6 +333,8 @@ class PostgresEventStore(base.EventStore):
         cur = self._client.execute(
             _pg(f"DELETE FROM {name} WHERE id = ?"), (event_id,)
         )
+        if cur.rowcount > 0:
+            self._bump(name)
         return cur.rowcount > 0
 
     def delete_batch(self, event_ids, app_id, channel_id=None) -> int:
@@ -323,6 +345,8 @@ class PostgresEventStore(base.EventStore):
         cur = self._client.execute(
             f"DELETE FROM {name} WHERE id IN ({marks})", tuple(event_ids)
         )
+        if cur.rowcount > 0:
+            self._bump(name)
         return cur.rowcount
 
     @staticmethod
@@ -407,26 +431,14 @@ class PostgresEventStore(base.EventStore):
         return (self._to_event(r) for r in rows)
 
     def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
+        # count + exact write version (pio_data_versions): no collision
+        # under delete+replayed-insert or in-place upsert rewrites
         name = self._ensure_table(app_id, channel_id)
-        try:
-            # order-independent id-hash sum: exact under delete+replay
-            # (count + max creationTime alone would collide when a delete
-            # is paired with an insert carrying a historical creationTime)
-            rows = self._client.query(
-                f"SELECT COUNT(*), COALESCE(MAX(creationTime), 0), "
-                f"COALESCE(SUM(('x'||substr(md5(id),1,8))::bit(32)::int::bigint), 0) "
-                f"FROM {name}"
-            )
-            return f"{rows[0][0]}:{rows[0][1]}:{rows[0][2]}"
-        except Exception:
-            # non-pg SQL engines (the test fake driver) lack the cast
-            # chain; degrade to the count/max form
-            with self._client.lock:
-                self._client._rollback_quietly()
-            rows = self._client.query(
-                f"SELECT COUNT(*), COALESCE(MAX(creationTime), 0) FROM {name}"
-            )
-            return f"{rows[0][0]}:{rows[0][1]}"
+        rows = self._client.query(f"SELECT COUNT(*) FROM {name}")
+        ver = self._client.query(
+            _pg("SELECT ver FROM pio_data_versions WHERE tbl = ?"), (name,)
+        )
+        return f"{rows[0][0]}:{ver[0][0] if ver else 0}"
 
     def find_frame(
         self,

@@ -66,11 +66,27 @@ class SqliteEventStore(base.EventStore):
     def _table_name(self, app_id: int, channel_id: Optional[int]) -> str:
         return f"events_{app_id}" + (f"_{channel_id}" if channel_id else "")
 
+    # exact write-version bookkeeping: bumped on EVERY mutation (including
+    # INSERT OR REPLACE in-place updates), so data_signature cannot collide
+    # under delete+replay or property rewrites
+    _VERSIONS_DDL = (
+        "CREATE TABLE IF NOT EXISTS pio_data_versions "
+        "(tbl TEXT PRIMARY KEY, ver INTEGER NOT NULL)"
+    )
+
+    def _bump(self, name: str) -> None:
+        self._client.conn.execute(
+            "INSERT INTO pio_data_versions VALUES (?, 1) "
+            "ON CONFLICT(tbl) DO UPDATE SET ver = ver + 1",
+            (name,),
+        )
+
     def _ensure_table(self, app_id: int, channel_id: Optional[int]) -> str:
         name = self._table_name(app_id, channel_id)
         if name in self._known_tables:
             return name
         with self._client.lock:
+            self._client.conn.execute(self._VERSIONS_DDL)
             self._client.conn.execute(
                 f"""CREATE TABLE IF NOT EXISTS {name} (
                     id TEXT PRIMARY KEY,
@@ -137,6 +153,7 @@ class SqliteEventStore(base.EventStore):
                 f"INSERT OR REPLACE INTO {name} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                 self._row(event, eid),
             )
+            self._bump(name)
             self._client.conn.commit()
         return eid
 
@@ -148,6 +165,7 @@ class SqliteEventStore(base.EventStore):
                 f"INSERT OR REPLACE INTO {name} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                 [self._row(e, eid) for e, eid in zip(events, ids)],
             )
+            self._bump(name)
             self._client.conn.commit()
         return ids
 
@@ -159,6 +177,8 @@ class SqliteEventStore(base.EventStore):
             cur = self._client.conn.execute(
                 f"DELETE FROM {name} WHERE id = ?", (event_id,)
             )
+            if cur.rowcount > 0:
+                self._bump(name)
             self._client.conn.commit()
             return cur.rowcount > 0
 
@@ -248,16 +268,18 @@ class SqliteEventStore(base.EventStore):
     def data_signature(
         self, app_id: int, channel_id: Optional[int] = None
     ) -> str:
-        # count + max creationTime + max rowid: rowid is assigned
-        # monotonically, so a delete paired with a replayed historical
-        # insert (same count, old creationTime) still changes the signature
+        # count + exact write version (pio_data_versions, bumped on every
+        # mutation incl. INSERT OR REPLACE updates): no collision under
+        # delete+replayed-insert or in-place property rewrites
         name = self._ensure_table(app_id, channel_id)
         with self._client.lock:
-            n, mx, rid = self._client.conn.execute(
-                f"SELECT COUNT(*), COALESCE(MAX(creationTime), 0), "
-                f"COALESCE(MAX(rowid), 0) FROM {name}"
+            (n,) = self._client.conn.execute(
+                f"SELECT COUNT(*) FROM {name}"
             ).fetchone()
-        return f"{n}:{mx}:{rid}"
+            row = self._client.conn.execute(
+                "SELECT ver FROM pio_data_versions WHERE tbl = ?", (name,)
+            ).fetchone()
+        return f"{n}:{row[0] if row else 0}"
 
     def _where(self, query: EventQuery) -> tuple[str, list]:
         clauses, params = [], []

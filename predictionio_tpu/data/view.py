@@ -49,8 +49,14 @@ def _save_frame(path: str, frame: EventFrame) -> None:
             json.dumps(list(v.to_dict().items())).encode(), dtype=np.uint8
         )
 
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
+    import tempfile
+
+    # unique temp name per writer: concurrent trainings of the same window
+    # must not interleave into one .tmp before the atomic publish
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path), suffix=".tmp"
+    )
+    with os.fdopen(fd, "wb") as f:
         np.savez_compressed(
             f,
             event_code=frame.event_code,

@@ -1,0 +1,21 @@
+from predictionio_tpu.engines.itemsim.engine import (
+    DataSourceParams,
+    ItemScore,
+    ItemSimAlgorithm,
+    ItemSimAlgorithmParams,
+    ItemSimDataSource,
+    ItemSimilarityEngine,
+    PredictedResult,
+    Query,
+)
+
+__all__ = [
+    "DataSourceParams",
+    "ItemScore",
+    "ItemSimAlgorithm",
+    "ItemSimAlgorithmParams",
+    "ItemSimDataSource",
+    "ItemSimilarityEngine",
+    "PredictedResult",
+    "Query",
+]

@@ -1,0 +1,144 @@
+"""Item-similarity engine: exact column-cosine (the DIMSUM workload).
+
+Reference: the experimental DIMSUM demo (examples/experimental/ — Spark
+MLlib RowMatrix.columnSimilarities with sampling). On TPU the item-item
+Gram matrix is one dense MXU matmul, so similarities are exact
+(models/dimsum.py documents why sampling is obsolete here).
+
+Shape: DataSource folds user→item interactions into a weighted indicator
+matrix; the algorithm computes each item's top-N cosine-similar items
+once at train time; serving sums similarity scores over the queried
+items (multi-item queries rank by total similarity to the basket).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+)
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.data.store.bimap import BiMap
+from predictionio_tpu.data.store.event_store import EventStoreFacade
+from predictionio_tpu.models import dimsum
+
+
+@dataclass
+class Query:
+    items: list[str] = field(default_factory=list)
+    num: int = 10
+
+
+@dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass
+class PredictedResult:
+    item_scores: list[ItemScore] = field(default_factory=list)
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str
+    event_names: tuple[str, ...] = ("view", "buy")
+    entity_type: str = "user"
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    matrix: np.ndarray  # (U, I) weighted indicator
+    item_vocab: BiMap
+
+    def sanity_check(self) -> None:
+        if self.matrix.size == 0 or not self.matrix.any():
+            raise ValueError("no user→item interactions found")
+
+
+class ItemSimDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        frame = EventStoreFacade(ctx.storage).find_frame(
+            app_name=self.params.app_name,
+            entity_type=self.params.entity_type,
+            event_names=list(self.params.event_names),
+        )
+        mask = frame.target_idx >= 0
+        users = frame.entity_idx[mask]
+        items = frame.target_idx[mask]
+        m = np.zeros((frame.n_entities, frame.n_targets), dtype=np.float32)
+        np.add.at(m, (users, items), 1.0)
+        return TrainingData(matrix=m, item_vocab=frame.target_vocab)
+
+
+@dataclass
+class ItemSimAlgorithmParams:
+    top_n: int = 50  # similar items kept per item
+
+
+@dataclass
+class ItemSimModel:
+    sim_scores: np.ndarray  # (I, top_n)
+    sim_idx: np.ndarray  # (I, top_n), -1 padded
+    item_vocab: BiMap
+
+
+class ItemSimAlgorithm(Algorithm):
+    def __init__(self, params: ItemSimAlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> ItemSimModel:
+        scores, idx = dimsum.column_cosine_topn(
+            pd.matrix, top_n=self.params.top_n, mesh=ctx.mesh
+        )
+        return ItemSimModel(
+            sim_scores=scores, sim_idx=idx, item_vocab=pd.item_vocab
+        )
+
+    def predict(self, model: ItemSimModel, query: Query) -> PredictedResult:
+        n_items = len(model.item_vocab)
+        known = [
+            model.item_vocab.get(i)
+            for i in query.items
+            if model.item_vocab.get(i) is not None
+        ]
+        if not known:
+            return PredictedResult()
+        total = np.zeros(n_items, dtype=np.float32)
+        for row in known:
+            idx = model.sim_idx[row]
+            ok = idx >= 0
+            np.add.at(total, idx[ok], model.sim_scores[row][ok])
+        total[known] = 0.0  # never recommend the queried items themselves
+        top = np.argsort(-total)[: query.num]
+        inv = model.item_vocab.inverse()
+        return PredictedResult(
+            item_scores=[
+                ItemScore(item=inv(int(ix)), score=float(total[ix]))
+                for ix in top
+                if total[ix] > 0.0
+            ]
+        )
+
+
+class ItemSimilarityEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            ItemSimDataSource,
+            IdentityPreparator,
+            {"dimsum": ItemSimAlgorithm},
+            FirstServing,
+        )

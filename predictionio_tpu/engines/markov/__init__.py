@@ -1,0 +1,21 @@
+from predictionio_tpu.engines.markov.engine import (
+    DataSourceParams,
+    ItemScore,
+    MarkovAlgorithm,
+    MarkovAlgorithmParams,
+    MarkovDataSource,
+    MarkovEngine,
+    PredictedResult,
+    Query,
+)
+
+__all__ = [
+    "DataSourceParams",
+    "ItemScore",
+    "MarkovAlgorithm",
+    "MarkovAlgorithmParams",
+    "MarkovDataSource",
+    "MarkovEngine",
+    "PredictedResult",
+    "Query",
+]

@@ -1,0 +1,157 @@
+"""Markov-chain engine: next-item prediction from event sequences.
+
+Reference: the experimental Markov demos (examples/experimental/
+scala-parallel-trim-app and the Markov stock examples) built on the e2
+MarkovChain kernel (e2/.../engine/MarkovChain.scala:25-89) — which until
+this engine existed had no in-tree consumer.
+
+Shape: the DataSource orders each user's events by time and emits
+(item_t → item_{t+1}) transition counts; the algorithm builds the
+row-normalized top-N-pruned transition matrix (e2/markov_chain.py);
+serving answers "what follows item X" with the top transition targets,
+optionally conditioned on a user's several recent items (their state
+distribution is averaged — the reference model's vector×matrix predict).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+)
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.data.store.bimap import BiMap
+from predictionio_tpu.data.store.event_store import EventStoreFacade
+from predictionio_tpu.e2.markov_chain import MarkovChain, MarkovChainModel
+
+
+@dataclass
+class Query:
+    items: list[str] = field(default_factory=list)  # recent items, newest last
+    num: int = 10
+
+
+@dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass
+class PredictedResult:
+    item_scores: list[ItemScore] = field(default_factory=list)
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str
+    event_names: tuple[str, ...] = ("view",)
+    entity_type: str = "user"
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    trans_rows: np.ndarray  # (T,) from-state idx
+    trans_cols: np.ndarray  # (T,) to-state idx
+    trans_counts: np.ndarray  # (T,)
+    item_vocab: BiMap
+
+    def sanity_check(self) -> None:
+        if len(self.trans_rows) == 0:
+            raise ValueError("no item→item transitions found")
+
+
+class MarkovDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        frame = EventStoreFacade(ctx.storage).find_frame(
+            app_name=self.params.app_name,
+            entity_type=self.params.entity_type,
+            event_names=list(self.params.event_names),
+        )
+        # per-user sequences ordered by event time (vectorized sort, then
+        # boundaries between users — no per-event Python)
+        mask = frame.target_idx >= 0
+        users = frame.entity_idx[mask]
+        items = frame.target_idx[mask]
+        times = frame.time_ms[mask]
+        order = np.lexsort((times, users))
+        u, it = users[order], items[order]
+        same_user = u[1:] == u[:-1]
+        frm, to = it[:-1][same_user], it[1:][same_user]
+        # duplicate (from, to) pairs aggregate inside MarkovChain.train's
+        # np.add.at — no host-side pre-counting needed
+        return TrainingData(
+            trans_rows=frm.astype(np.int64),
+            trans_cols=to.astype(np.int64),
+            trans_counts=np.ones(len(frm), dtype=np.float64),
+            item_vocab=frame.target_vocab,
+        )
+
+
+@dataclass
+class MarkovAlgorithmParams:
+    top_n: int = 50  # transition pruning per row (reference topN)
+
+
+@dataclass
+class MarkovModel:
+    chain: MarkovChainModel
+    item_vocab: BiMap
+
+
+class MarkovAlgorithm(Algorithm):
+    def __init__(self, params: MarkovAlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> MarkovModel:
+        n_states = len(pd.item_vocab)
+        chain = MarkovChain.train(
+            pd.trans_rows, pd.trans_cols, pd.trans_counts,
+            n_states=n_states, top_n=self.params.top_n,
+        )
+        return MarkovModel(chain=chain, item_vocab=pd.item_vocab)
+
+    def predict(self, model: MarkovModel, query: Query) -> PredictedResult:
+        n_states = len(model.item_vocab)
+        state = np.zeros(n_states, dtype=np.float32)
+        known = [
+            model.item_vocab.get(i)
+            for i in query.items
+            if model.item_vocab.get(i) is not None
+        ]
+        if not known:
+            return PredictedResult()
+        state[known] = 1.0 / len(known)
+        probs = model.chain.predict(state)
+        top = np.argsort(-probs)[: query.num]
+        inv = model.item_vocab.inverse()
+        return PredictedResult(
+            item_scores=[
+                ItemScore(item=inv(int(ix)), score=float(probs[ix]))
+                for ix in top
+                if probs[ix] > 0.0
+            ]
+        )
+
+
+class MarkovEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            MarkovDataSource,
+            IdentityPreparator,
+            {"markov": MarkovAlgorithm},
+            FirstServing,
+        )

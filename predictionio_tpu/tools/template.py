@@ -92,6 +92,30 @@ TEMPLATES: dict[str, Template] = {
             },
         ),
         Template(
+            "markov",
+            "predictionio_tpu.engines.markov",
+            "MarkovEngine",
+            "next-item prediction from event sequences (Markov chain)",
+            {
+                "datasource": {"params": {"app_name": "MyApp"}},
+                "algorithms": [
+                    {"name": "markov", "params": {"top_n": 50}},
+                ],
+            },
+        ),
+        Template(
+            "itemsim",
+            "predictionio_tpu.engines.itemsim",
+            "ItemSimilarityEngine",
+            "exact item-item cosine similarity (the DIMSUM workload)",
+            {
+                "datasource": {"params": {"app_name": "MyApp"}},
+                "algorithms": [
+                    {"name": "dimsum", "params": {"top_n": 50}},
+                ],
+            },
+        ),
+        Template(
             "universal",
             "predictionio_tpu.engines.universal",
             "UniversalRecommenderEngine",
