@@ -94,6 +94,12 @@ class FastEvalEngine(Engine):
             results.append((ei, qpa))
         return results
 
+    def batch_eval(self, ctx: RuntimeContext, engine_params_list):
+        """Always the memoized per-point path: the base Engine's
+        grid-batched route would bypass this class's prefix caches and
+        compute_counts contract."""
+        return [(ep, self.eval(ctx, ep)) for ep in engine_params_list]
+
     def clear_caches(self) -> None:
         self._ds_cache.clear()
         self._prep_cache.clear()

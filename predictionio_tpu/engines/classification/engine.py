@@ -162,6 +162,17 @@ class NaiveBayesAlgorithm(Algorithm):
             label_vocab=pd.label_vocab,
         )
 
+    def train_grid(
+        self, ctx: RuntimeContext, pd: TrainingData, params_list
+    ) -> list[NBModel]:
+        """Whole smoothing grid in one device program (Engine.batch_eval's
+        grid-batched tuning path, VERDICT r2 #9)."""
+        models = classify.train_naive_bayes_grid(
+            pd.features, pd.labels, len(pd.label_vocab),
+            [p.lambda_ for p in params_list],
+        )
+        return [NBModel(model=m, label_vocab=pd.label_vocab) for m in models]
+
     def predict(self, model: NBModel, query: Query) -> PredictedResult:
         cls = int(model.model.predict(np.asarray(query.features))[0])
         return PredictedResult(label=model.label_vocab[cls])
@@ -208,6 +219,25 @@ class LogisticRegressionAlgorithm(Algorithm):
             ),
             label_vocab=pd.label_vocab,
         )
+
+    def train_grid(
+        self, ctx: RuntimeContext, pd: TrainingData, params_list
+    ) -> list[LRModel]:
+        """Whole (lr, l2) grid as one vmapped GD program — iterations must
+        agree across points (it is a static loop bound); falls back to
+        per-point training otherwise."""
+        iterations = {p.iterations for p in params_list}
+        if len(iterations) != 1:
+            return [
+                LogisticRegressionAlgorithm(p).train(ctx, pd)
+                for p in params_list
+            ]
+        models = classify.train_logistic_regression_grid(
+            pd.features, pd.labels, len(pd.label_vocab),
+            [(p.lr, p.l2) for p in params_list],
+            iterations=iterations.pop(),
+        )
+        return [LRModel(model=m, label_vocab=pd.label_vocab) for m in models]
 
     def predict(self, model: LRModel, query: Query) -> PredictedResult:
         cls = int(model.model.predict(np.asarray(query.features))[0])

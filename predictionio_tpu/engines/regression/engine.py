@@ -173,6 +173,21 @@ class RidgeAlgorithm(Algorithm):
             )
         )
 
+    def train_grid(
+        self, ctx: RuntimeContext, pd: TrainingData, params_list
+    ) -> list[RidgeModel]:
+        """Whole l2 grid from one sufficient-statistics pass; falls back
+        per point when fit_intercept differs across the grid."""
+        intercepts = {p.fit_intercept for p in params_list}
+        if len(intercepts) != 1:
+            return [RidgeAlgorithm(p).train(ctx, pd) for p in params_list]
+        models = linreg.train_linear_regression_grid(
+            pd.features, pd.targets,
+            [p.l2 for p in params_list],
+            fit_intercept=intercepts.pop(),
+        )
+        return [RidgeModel(model=m) for m in models]
+
     def predict(self, model: RidgeModel, query: Query) -> PredictedResult:
         val = float(model.model.predict(np.asarray(query.features))[0])
         return PredictedResult(value=val)

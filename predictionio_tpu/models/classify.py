@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -96,6 +96,48 @@ def train_naive_bayes(
     return NaiveBayesModel(np.asarray(log_prior), np.asarray(log_like))
 
 
+@partial(jax.jit, static_argnames=("n_classes",))
+def _nb_train_grid(x, y, w, lams, *, n_classes: int):
+    # vmap over the smoothing grid: the data-dependent segment sums are
+    # computed ONCE and closed over; only the O(C·D) smoothing/log math
+    # vectorizes per grid point
+    class_count = segment_sum(w, y, n_classes)
+    feat_sum = segment_sum(x * w[:, None], y, n_classes)
+    d = x.shape[1]
+
+    def smooth(lam):
+        log_prior = jnp.log(class_count) - jnp.log(jnp.sum(w))
+        smoothed = feat_sum + lam
+        log_like = jnp.log(smoothed) - jnp.log(
+            jnp.sum(feat_sum, axis=1, keepdims=True) + lam * d
+        )
+        return log_prior, log_like
+
+    return jax.vmap(smooth)(lams)
+
+
+def train_naive_bayes_grid(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    lams: Sequence[float],
+) -> list[NaiveBayesModel]:
+    """Whole smoothing grid in ONE device program (VERDICT r2 #9: tuning
+    throughput — the expensive label-indexed segment sums run once, the
+    per-lambda smoothing is vmapped)."""
+    x = np.asarray(x, dtype=np.float32)
+    y = np.asarray(y, dtype=np.int32)
+    if (x < 0).any():
+        raise ValueError("multinomial NB requires non-negative features")
+    w = np.ones(x.shape[0], np.float32)
+    priors, likes = _nb_train_grid(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+        jnp.asarray(np.asarray(lams, np.float32)), n_classes=n_classes,
+    )
+    priors, likes = np.asarray(priors), np.asarray(likes)
+    return [NaiveBayesModel(priors[g], likes[g]) for g in range(len(lams))]
+
+
 # ---------------------------------------------------------------------------
 # Softmax (multinomial) logistic regression — full-batch GD under jit
 # ---------------------------------------------------------------------------
@@ -123,22 +165,9 @@ def _lr_scores(x, w):
 def _lr_train(
     x, y, wt, *, n_classes: int, iterations: int, lr: float, l2: float
 ):
-    d = x.shape[1]
-    y1h = jax.nn.one_hot(y, n_classes, dtype=jnp.float32)
-
-    def loss(w):
-        logits = x @ w[:-1] + w[-1]
-        row_ll = jnp.sum(y1h * jax.nn.log_softmax(logits, axis=-1), axis=-1)
-        ll = jnp.sum(wt * row_ll) / jnp.sum(wt)
-        return -ll + 0.5 * l2 * jnp.sum(w[:-1] ** 2)
-
-    grad = jax.grad(loss)
-
-    def body(_, w):
-        return w - lr * grad(w)
-
-    w0 = jnp.zeros((d + 1, n_classes), jnp.float32)
-    return jax.lax.fori_loop(0, iterations, body, w0)
+    return _lr_train_body(
+        x, y, wt, lr, l2, n_classes=n_classes, iterations=iterations
+    )
 
 
 def train_logistic_regression(
@@ -157,14 +186,7 @@ def train_logistic_regression(
     x = np.asarray(x, dtype=np.float32)
     y = np.asarray(y, dtype=np.int32)
     if normalize:
-        # standardize (center + scale) so a fixed lr is stable across
-        # datasets — an uncentered mean component inflates the top Hessian
-        # eigenvalue past 2/lr and GD amplifies float noise geometrically;
-        # the affine map is folded back into the returned weights below
-        mu = x.mean(axis=0).astype(np.float32)
-        std = x.std(axis=0)
-        std = np.where(std > 0, std, 1.0).astype(np.float32)
-        x = (x - mu) / std
+        x, mu, std = _standardize(x)
     wt = np.ones(x.shape[0], np.float32)
     if mesh is not None:
         xj, yj, wtj = _shard_batch(mesh, x, y, wt)
@@ -177,7 +199,84 @@ def train_logistic_regression(
         )
     )
     if normalize:
-        scaled = w[:-1] / std[:, None]
-        bias = w[-1:] - (mu / std) @ w[:-1]
-        w = np.concatenate([scaled, bias], axis=0)
+        w = _fold_back_standardization(w, mu, std)
     return LogisticRegressionModel(weights=w)
+
+
+def _standardize(x: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Center + scale so a fixed lr is stable across datasets — an
+    uncentered mean component inflates the top Hessian eigenvalue past
+    2/lr and GD amplifies float noise geometrically; the affine map folds
+    back into the returned weights via _fold_back_standardization."""
+    mu = x.mean(axis=0).astype(np.float32)
+    std = x.std(axis=0)
+    std = np.where(std > 0, std, 1.0).astype(np.float32)
+    return (x - mu) / std, mu, std
+
+
+def _fold_back_standardization(w, mu, std) -> np.ndarray:
+    scaled = w[:-1] / std[:, None]
+    bias = w[-1:] - (mu / std) @ w[:-1]
+    return np.concatenate([scaled, bias], axis=0)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "iterations"))
+def _lr_train_grid(x, y, wt, lrs, l2s, *, n_classes: int, iterations: int):
+    def one(lr, l2):
+        return _lr_train_body(
+            x, y, wt, lr, l2, n_classes=n_classes, iterations=iterations
+        )
+
+    return jax.vmap(one)(lrs, l2s)
+
+
+def _lr_train_body(x, y, wt, lr, l2, *, n_classes: int, iterations: int):
+    d = x.shape[1]
+    y1h = jax.nn.one_hot(y, n_classes, dtype=jnp.float32)
+
+    def loss(w):
+        logits = x @ w[:-1] + w[-1]
+        row_ll = jnp.sum(y1h * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        ll = jnp.sum(wt * row_ll) / jnp.sum(wt)
+        return -ll + 0.5 * l2 * jnp.sum(w[:-1] ** 2)
+
+    grad = jax.grad(loss)
+
+    def body(_, w):
+        return w - lr * grad(w)
+
+    w0 = jnp.zeros((d + 1, n_classes), jnp.float32)
+    return jax.lax.fori_loop(0, iterations, body, w0)
+
+
+def train_logistic_regression_grid(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    grid: Sequence[tuple[float, float]],  # (lr, l2) per point
+    iterations: int = 200,
+    normalize: bool = True,
+) -> list[LogisticRegressionModel]:
+    """Whole (lr, l2) grid as ONE vmapped GD program: G gradient loops run
+    as a single batched device computation instead of G sequential jit
+    dispatches (VERDICT r2 #9)."""
+    x = np.asarray(x, dtype=np.float32)
+    y = np.asarray(y, dtype=np.int32)
+    if normalize:
+        x, mu, std = _standardize(x)
+    wt = np.ones(x.shape[0], np.float32)
+    lrs = jnp.asarray([g[0] for g in grid], jnp.float32)
+    l2s = jnp.asarray([g[1] for g in grid], jnp.float32)
+    ws = np.asarray(
+        _lr_train_grid(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(wt), lrs, l2s,
+            n_classes=n_classes, iterations=iterations,
+        )
+    )
+    out = []
+    for g in range(len(grid)):
+        w = ws[g]
+        if normalize:
+            w = _fold_back_standardization(w, mu, std)
+        out.append(LogisticRegressionModel(weights=w))
+    return out

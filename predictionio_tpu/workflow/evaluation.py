@@ -58,9 +58,17 @@ def run_evaluation(
 
     ctx = RuntimeContext(storage=storage, mesh=mesh, mode="eval", workflow_params=wp)
     try:
+        import time as _time
+
         instance.status = "EVALRUNNING"
         instances.update(instance)
-        engine_eval_data = engine.batch_eval(ctx, list(engine_params_list))
+        eps = list(engine_params_list)  # materialize once (generators)
+        t0 = _time.perf_counter()
+        engine_eval_data = engine.batch_eval(ctx, eps)
+        eval_wall = _time.perf_counter() - t0
+        instance.env = dict(instance.env or {})
+        instance.env["eval_wall_sec"] = f"{eval_wall:.3f}"
+        instance.env["grid_points"] = str(len(eps))
         result = evaluator.evaluate(ctx, evaluation, engine_eval_data, wp)
         if not getattr(result, "no_save", False):
             instance.evaluator_results = result.to_one_liner()
