@@ -98,7 +98,9 @@ class FastEvalEngine(Engine):
         """Always the memoized per-point path: the base Engine's
         grid-batched route would bypass this class's prefix caches and
         compute_counts contract."""
-        return [(ep, self.eval(ctx, ep)) for ep in engine_params_list]
+        from predictionio_tpu.core.base import BaseEngine
+
+        return BaseEngine.batch_eval(self, ctx, engine_params_list)
 
     def clear_caches(self) -> None:
         self._ds_cache.clear()

@@ -228,10 +228,8 @@ class LogisticRegressionAlgorithm(Algorithm):
         per-point training otherwise."""
         iterations = {p.iterations for p in params_list}
         if len(iterations) != 1:
-            return [
-                LogisticRegressionAlgorithm(p).train(ctx, pd)
-                for p in params_list
-            ]
+            # type(self): a subclass's train() override must win here too
+            return [type(self)(p).train(ctx, pd) for p in params_list]
         models = classify.train_logistic_regression_grid(
             pd.features, pd.labels, len(pd.label_vocab),
             [(p.lr, p.l2) for p in params_list],

@@ -180,7 +180,8 @@ class RidgeAlgorithm(Algorithm):
         per point when fit_intercept differs across the grid."""
         intercepts = {p.fit_intercept for p in params_list}
         if len(intercepts) != 1:
-            return [RidgeAlgorithm(p).train(ctx, pd) for p in params_list]
+            # type(self): a subclass's train() override must win here too
+            return [type(self)(p).train(ctx, pd) for p in params_list]
         models = linreg.train_linear_regression_grid(
             pd.features, pd.targets,
             [p.l2 for p in params_list],

@@ -368,6 +368,18 @@ def cmd_status(args) -> int:
             print(f"[INFO]   {line}")
     except Exception as e:
         return _fail(f"storage verification failed: {e}")
+    try:
+        manifests = storage.get_meta_data_engine_manifests().get_all()
+    except Exception as e:
+        manifests = []
+        print(f"[WARN] could not list engine manifests: {e}")
+    if manifests:
+        print("[INFO] Registered engines (trained at least once):")
+        for m in manifests:
+            print(
+                f"[INFO]   {m.id} v{m.version}: {m.engine_factory}"
+                + (f" — {m.description}" if m.description else "")
+            )
     print("[INFO] (sleeping 0 seconds) Your system is all ready to go.")
     return 0
 

@@ -168,6 +168,7 @@ def run_train(
         instance.end_time = _dt.datetime.now(_dt.timezone.utc)
         _record_timings()
         instances.update(instance)
+        _register_manifest(storage, instance, variant)
         log.info(
             "training completed: instance %s (stages: %s)",
             instance_id,
@@ -180,6 +181,36 @@ def run_train(
         _record_timings()  # partial timings show WHERE the failed run spent time
         instances.update(instance)
         raise
+
+
+def _register_manifest(
+    storage: Storage, instance: EngineInstance, variant: dict
+) -> None:
+    """Upsert the EngineManifest row for a successfully trained engine.
+
+    Reference RegisterEngine.scala:32 writes the manifest at `pio build`;
+    here there is no build step (engines are Python entry points named in
+    engine.json), so registration happens at the first successful train —
+    the moment the factory provably resolves and runs. `pio status` lists
+    the registered engines."""
+    from predictionio_tpu.data.storage.base import EngineManifest
+
+    try:
+        factory = load_symbol(instance.engine_factory)
+        description = (factory.__doc__ or "").strip().splitlines()
+        storage.get_meta_data_engine_manifests().update(
+            EngineManifest(
+                id=instance.engine_id,
+                version=instance.engine_version,
+                name=variant.get("id", instance.engine_id),
+                description=description[0] if description else None,
+                files=(instance.engine_factory.rsplit(".", 1)[0],),
+                engine_factory=instance.engine_factory,
+            ),
+            upsert=True,
+        )
+    except Exception:
+        log.exception("engine manifest registration failed (non-fatal)")
 
 
 def prepare_deploy_models(

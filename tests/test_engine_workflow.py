@@ -259,3 +259,22 @@ class TestPersistenceMatrix:
         )
         assert isinstance(models[0], se.UnserializableModel)
         assert (models[0].algo_id, models[0].td_id) == (5, 1)
+
+
+def test_train_registers_engine_manifest(fresh_storage):
+    """VERDICT r2 #10: a successful train upserts the EngineManifest row
+    (the reference registered at `pio build` — RegisterEngine.scala:32;
+    here registration happens when the factory provably runs)."""
+    storage, variant = fresh_storage, VARIANT
+    inst = run_train(storage, variant)
+    assert inst.status == "COMPLETED"
+    m = storage.get_meta_data_engine_manifests().get(
+        inst.engine_id, inst.engine_version
+    )
+    assert m is not None
+    assert m.engine_factory == variant["engineFactory"]
+    assert m.name == variant["id"]
+    # retrain upserts, not duplicates
+    run_train(storage, variant)
+    all_m = storage.get_meta_data_engine_manifests().get_all()
+    assert len([x for x in all_m if x.id == inst.engine_id]) == 1
