@@ -42,6 +42,9 @@ BACKENDS: dict[str, tuple[str, str]] = {
     # scale-out SQL backend (reference jdbc/ Postgres role); needs a
     # psycopg2 or pg8000 driver at runtime
     "postgres": ("predictionio_tpu.data.storage.postgres", "Postgres"),
+    # document-store metadata backend (reference elasticsearch/ role):
+    # JSON documents on a filesystem, one per row
+    "docfs": ("predictionio_tpu.data.storage.docfs", "DocFS"),
 }
 
 # DAO logical names → class suffix

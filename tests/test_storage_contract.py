@@ -208,8 +208,27 @@ class TestEventStoreContract:
         assert got[0].event_time > got[1].event_time
 
 
-@pytest.fixture(params=["memory", "sqlite", "remote", "postgres"])
+@pytest.fixture(params=["memory", "sqlite", "remote", "postgres", "docfs"])
 def meta(request, tmp_path):
+    if request.param == "docfs":
+        from predictionio_tpu.data.storage.docfs import (
+            DocFSAccessKeys,
+            DocFSApps,
+            DocFSChannels,
+            DocFSEngineInstances,
+            DocFSModels,
+            _DocFSClient,
+        )
+
+        client = _DocFSClient({"PATH": str(tmp_path / "docfs")})
+        yield {
+            "apps": DocFSApps(client=client),
+            "keys": DocFSAccessKeys(client=client),
+            "channels": DocFSChannels(client=client),
+            "instances": DocFSEngineInstances(client=client),
+            "models": DocFSModels(client=client),
+        }
+        return
     if request.param == "postgres":
         from predictionio_tpu.data.storage.postgres import (
             PostgresAccessKeys,
@@ -421,3 +440,78 @@ class TestDataSignature:
         events.delete(eid, APP)
         s3 = events.data_signature(APP)
         assert s3 != s2
+
+
+def test_docfs_metadata_with_sql_events_end_to_end(tmp_path):
+    """Split-repository topology (the reference's ES config): METADATA on
+    the document store, EVENTDATA on SQL — full train → latest-completed
+    lookup crosses both backends."""
+    import numpy as np
+
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+    from predictionio_tpu.workflow.core import run_train
+    from predictionio_tpu.workflow.server import latest_completed_runtime
+
+    cfg = StorageConfig(
+        sources={
+            "DOC": SourceConfig("DOC", "docfs", {"PATH": str(tmp_path / "meta")}),
+            "SQL": SourceConfig("SQL", "sqlite", {"PATH": str(tmp_path / "ev.db")}),
+        },
+        repositories={
+            "METADATA": "DOC", "EVENTDATA": "SQL", "MODELDATA": "DOC",
+        },
+    )
+    storage = Storage(cfg)
+    app_id = storage.get_meta_data_apps().insert(App(0, "docapp"))
+    assert app_id and app_id > 0
+    events = storage.get_events()
+    events.init_app(app_id)
+    rng = np.random.RandomState(0)
+    events.insert_batch(
+        [
+            ev("rate", f"u{rng.randint(6)}", t=i % 48,
+               target_entity_type="item",
+               target_entity_id=f"i{rng.randint(10)}",
+               properties=DataMap({"rating": float(rng.randint(1, 6))}))
+            for i in range(120)
+        ],
+        app_id,
+    )
+    variant = {
+        "id": "docrun",
+        "engineFactory":
+            "predictionio_tpu.engines.recommendation.RecommendationEngine",
+        "datasource": {"params": {"app_name": "docapp"}},
+        "algorithms": [
+            {"name": "als", "params": {"rank": 4, "num_iterations": 2}}
+        ],
+    }
+    inst = run_train(storage, variant)
+    assert inst.status == "COMPLETED"
+    runtime = latest_completed_runtime(storage, "docrun", "0", "docrun")
+    assert runtime.instance.id == inst.id
+    # manifest registered in the document store too
+    m = storage.get_meta_data_engine_manifests().get("docrun", "0")
+    assert m is not None and m.engine_factory == variant["engineFactory"]
+
+
+def test_docfs_id_allocation_skips_explicit_ids(tmp_path):
+    """Auto-ids must never collide with (and overwrite) an explicitly
+    inserted id (code-review r3): the row document's exclusive create is
+    the authoritative allocation."""
+    from predictionio_tpu.data.storage.docfs import DocFSApps, _DocFSClient
+
+    apps = DocFSApps(client=_DocFSClient({"PATH": str(tmp_path / "d")}))
+    assert apps.insert(App(3, "explicit")) == 3
+    ids = [apps.insert(App(0, f"auto{i}")) for i in range(4)]
+    assert 3 not in ids and len(set(ids)) == 4
+    assert apps.get(3).name == "explicit"  # untouched
+    # duplicate names refused even via the reservation path
+    assert apps.insert(App(0, "explicit")) is None
+    # rename moves the reservation: old name becomes free
+    assert apps.update(App(3, "renamed"))
+    assert apps.insert(App(0, "explicit")) is not None
