@@ -1,0 +1,106 @@
+"""Pallas TPU kernel for the windowed segment reduction.
+
+Fuses the one-hot build into the block matmul of ops/windowed.py's
+reduction: the XLA path materializes each block's (B_E, S) one-hot in HBM
+(write + read ≈ 2×E_p×S×4 bytes — ~21 GB per ML-20M edge pass, ~35% of
+the pass's traffic); here the one-hot lives only in VMEM, built from an
+iota compare, and the per-block partial accumulates directly into the
+output window tile.
+
+Accumulation pattern: the grid walks blocks in order; consecutive blocks
+sharing an output window map to the SAME output block (index_map reads
+the scalar-prefetched window ids), so Pallas keeps the (S, D) tile in
+VMEM across those steps and flushes it to HBM only when the window
+changes — the standard TPU reduction idiom (matmul k-loop). The host plan
+guarantees window ids are non-decreasing, which makes this exact.
+
+Used behind ops/windowed.windowed_gram_b on TPU (PIO_PALLAS_WINDOWED=0
+forces the XLA path); CPU tests run the kernel in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(bw_ref, local_ref, payload_ref, out_ref):
+    """One grid step = one edge block: out_window += onehotᵀ @ payload."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    s_rows = out_ref.shape[0]
+    prev = bw_ref[jnp.maximum(i - 1, 0)]
+    new_window = (i == 0) | (prev != bw_ref[i])
+
+    @pl.when(new_window)
+    def _zero():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    lid = local_ref[...]  # (B_E,) int32; -1 padding never matches a row
+    rows = jax.lax.broadcasted_iota(jnp.int32, (s_rows, lid.shape[0]), 0)
+    onehot = (rows == lid[None, :]).astype(jnp.float32)  # (S, B_E), VMEM-only
+    out_ref[...] += jax.lax.dot_general(
+        onehot, payload_ref[...],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        # HIGHEST: CG consumes these sums; one bf16 MXU pass loses ~2^-8
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_windows", "s_rows", "interpret")
+)
+def windowed_segment_matmul(
+    payload: jax.Array,  # (n_blocks_p * B_E, D_pad) f32; D_pad % 128 == 0
+    local: jax.Array,  # (n_blocks_p, B_E) int32, -1 padded
+    block_window: jax.Array,  # (n_blocks_p,) int32, NON-DECREASING
+    *,
+    n_windows: int,
+    s_rows: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """out[w*S + r, :] = Σ_{blocks b of window w} Σ_{e: local=r} payload_e.
+
+    Returns ((n_windows + 1) * s_rows, D_pad); the +1 window absorbs
+    chunk-padding blocks (their block_window is n_windows)."""
+    # lazy: pallas.tpu cannot import in a CPU-only process (tests force a
+    # CPU platform and strip the TPU plugin)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_blocks, b_e = local.shape
+    d_pad = payload.shape[1]
+    local_flat = local.reshape(n_blocks * b_e)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((b_e,), lambda i, bw: (i,)),
+            pl.BlockSpec((b_e, d_pad), lambda i, bw: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((s_rows, d_pad), lambda i, bw: (bw[i], 0)),
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            ((n_windows + 1) * s_rows, d_pad), jnp.float32
+        ),
+        interpret=interpret,
+    )(block_window, local_flat, payload)
+
+
+def available() -> bool:
+    """True when the TPU Pallas lowering can run here."""
+    try:
+        if jax.devices()[0].platform != "tpu":
+            return False
+        from jax.experimental.pallas import tpu as _  # noqa: F401
+
+        return True
+    except Exception:
+        return False
