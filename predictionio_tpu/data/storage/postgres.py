@@ -412,23 +412,59 @@ class PostgresEventStore(base.EventStore):
             params.extend([_ms(t), _ms(t), eid])
         return ("WHERE " + " AND ".join(clauses)) if clauses else "", params
 
+    # page size for streamed find(): bounds host memory at train scale
+    # (the ADVICE r3 streaming fix) while keeping per-page SQL overhead
+    # negligible; keyset pagination (not OFFSET) so each page is O(page)
+    FIND_PAGE = 10_000
+
     def find(self, query: EventQuery) -> Iterator[Event]:
+        """Streamed iteration via keyset pagination on (eventTime, id).
+
+        A fetchall of the whole result set would materialize a
+        train-scale read (tens of millions of rows) in host RAM at once;
+        a psycopg2 named cursor would pin the shared lock-serialized
+        connection inside a long-lived transaction. Keyset pages commit
+        between fetches, are driver-agnostic (pg8000 buffers client-side
+        anyway), and reuse the same (eventTime, id) cursor contract the
+        remote backend exposes (remote.py keyset paging)."""
+        import dataclasses as _dcs
+
         name = self._ensure_table(query.app_id, query.channel_id)
-        where, params = self._where(query)
         order = "DESC" if query.reversed else "ASC"
-        limit = (
-            f"LIMIT {int(query.limit)}"
-            if query.limit is not None and query.limit >= 0
-            else ""
-        )
-        rows = self._client.query(
-            _pg(
-                f"SELECT * FROM {name} {where} "
-                f"ORDER BY eventTime {order}, id {order} {limit}"
-            ),
-            tuple(params),
-        )
-        return (self._to_event(r) for r in rows)
+
+        def gen():
+            remaining = (
+                int(query.limit)
+                if query.limit is not None and query.limit >= 0
+                else None
+            )
+            q = query
+            while remaining is None or remaining > 0:
+                n = (
+                    self.FIND_PAGE
+                    if remaining is None
+                    else min(self.FIND_PAGE, remaining)
+                )
+                where, params = self._where(q)
+                rows = self._client.query(
+                    _pg(
+                        f"SELECT * FROM {name} {where} "
+                        f"ORDER BY eventTime {order}, id {order} LIMIT {n}"
+                    ),
+                    tuple(params),
+                )
+                for r in rows:
+                    yield self._to_event(r)
+                if len(rows) < n:
+                    return
+                if remaining is not None:
+                    remaining -= len(rows)
+                last = rows[-1]  # (id, ..., eventTime at index 7, ...)
+                q = _dcs.replace(
+                    q, start_after=(_from_ms(last[7]), last[0])
+                )
+
+        return gen()
 
     def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
         # count + exact write version (pio_data_versions): no collision
@@ -511,9 +547,30 @@ class _MetaBase:
         return self._client.execute_returning(_pg(sql), tuple(params))
 
     def _integrity_error(self, e: Exception) -> bool:
-        # psycopg2: errors.UniqueViolation (pgcode 23505); pg8000 raises
-        # DatabaseError with the SQLSTATE in its payload
-        return "23505" in repr(e) or "unique" in repr(e).lower()
+        """Duplicate-key detection by SQLSTATE, not message text.
+
+        psycopg2 exposes .pgcode; pg8000 a DatabaseError whose args dict
+        carries the code under 'C'; the fake test driver wraps sqlite's
+        IntegrityError. The SQLSTATE for unique_violation is 23505 — a
+        generic 'unique' substring match would also swallow unrelated
+        errors that merely NAME a unique index (ADVICE r3)."""
+        code = getattr(e, "pgcode", None)  # psycopg2
+        if code is not None:
+            return code == "23505"
+        for a in getattr(e, "args", ()):  # pg8000: {'C': '23505', ...}
+            if isinstance(a, dict) and a.get("C"):
+                return a["C"] == "23505"
+        if "23505" in repr(e):
+            return True
+        # fake driver (tests/fake_pg.py) wraps sqlite3.IntegrityError
+        import sqlite3
+
+        cause = e
+        while cause is not None:
+            if isinstance(cause, sqlite3.IntegrityError):
+                return "unique" in str(cause).lower()
+            cause = cause.__cause__
+        return False
 
 
 class PostgresApps(_MetaBase, base.Apps):

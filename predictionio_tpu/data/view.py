@@ -9,9 +9,13 @@ re-scanning and re-folding the event store.
 
 The cache key hashes the full query shape (app/channel, time range,
 entity/event filters, value extraction) together with the store's DATA
-SIGNATURE — a cheap monotone fingerprint (event count + newest creation
-time) every backend exposes — so any write to the window's namespace
-invalidates the cache without explicit bookkeeping.
+SIGNATURE. The signature contract is an EXACT fingerprint: it must
+change on EVERY mutation of the namespace — insert, delete, in-place
+rewrite, and delete followed by a replayed identical insert. Backends
+implement it as a write-version counter bumped on every mutation
+(sqlite/postgres keep it in a side table); a count+max-creation-time
+scheme would collide under delete+replay and is rejected by the
+contract tests (tests/test_data_view.py).
 """
 
 from __future__ import annotations

@@ -327,6 +327,42 @@ class ALSAlgorithm(Algorithm):
         )
         return ALSModel(factors, item_categories=pd.item_categories)
 
+    def train_grid(
+        self, ctx: RuntimeContext, pd: TrainingData, params_list
+    ) -> list[ALSModel]:
+        """A (λ, α) tuning grid trained as one device program sharing a
+        single staged WindowPlan (Engine.batch_eval's grid-batched path;
+        VERDICT r3 #6). Falls back to serial training when the grid
+        varies program shape (rank / iterations / …)."""
+        als_list = [
+            als.ALSParams(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                lambda_=p.lambda_,
+                alpha=p.alpha,
+                implicit_prefs=p.implicit_prefs,
+                cg_iterations=p.cg_iterations,
+                seed=p.seed,
+            )
+            for p in params_list
+        ]
+        try:
+            grid = als.train_grid(
+                pd.rows, pd.cols, pd.vals, pd.n_users, pd.n_items,
+                als_list, user_vocab=pd.user_vocab, item_vocab=pd.item_vocab,
+            )
+        except ValueError:  # heterogeneous statics: train serially
+            grid = [
+                als.train(
+                    pd.rows, pd.cols, pd.vals, pd.n_users, pd.n_items, p,
+                    user_vocab=pd.user_vocab, item_vocab=pd.item_vocab,
+                )
+                for p in als_list
+            ]
+        return [
+            ALSModel(f, item_categories=pd.item_categories) for f in grid
+        ]
+
     # -- serving -----------------------------------------------------------
     def warmup(self, model: ALSModel) -> None:
         """Pre-compile the serving programs + stage factors into HBM so the

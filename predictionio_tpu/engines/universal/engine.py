@@ -21,6 +21,7 @@ Shape of the engine:
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -165,22 +166,42 @@ class URModel:
         self.indicator_models = indicator_models
         self.primary_indicator = primary_indicator
         self._device_tables = None
+        self._stage_lock = threading.Lock()
+
+    # device caches + lock are serving state, not part of the pickled model
+    def __getstate__(self):
+        return {
+            "item_vocab": self.item_vocab,
+            "indicator_models": self.indicator_models,
+            "primary_indicator": self.primary_indicator,
+        }
+
+    def __setstate__(self, state):
+        self.__init__(
+            state["item_vocab"],
+            state["indicator_models"],
+            state["primary_indicator"],
+        )
 
     def device_tables(self) -> list:
         """HBM-resident correlator tables [(idx, scores, J), …] — staged
-        once, reused by every batched serving dispatch."""
-        if self._device_tables is None:
-            import jax.numpy as jnp
+        once, reused by every batched serving dispatch. Locked: the
+        pipelined dispatcher (server.py pipeline_depth) may run two
+        batches for the same model concurrently, and double-staging the
+        tables would transiently double their HBM footprint."""
+        with self._stage_lock:
+            if self._device_tables is None:
+                import jax.numpy as jnp
 
-            self._device_tables = [
-                (
-                    jnp.asarray(m.correlator_idx.astype("int32")),
-                    jnp.asarray(m.correlator_scores.astype("float32")),
-                    len(m.target_vocab),
-                )
-                for m in self.indicator_models
-            ]
-        return self._device_tables
+                self._device_tables = [
+                    (
+                        jnp.asarray(m.correlator_idx.astype("int32")),
+                        jnp.asarray(m.correlator_scores.astype("float32")),
+                        len(m.target_vocab),
+                    )
+                    for m in self.indicator_models
+                ]
+            return self._device_tables
 
 
 class URAlgorithm(Algorithm):
@@ -309,6 +330,10 @@ class URAlgorithm(Algorithm):
         # algorithm keeps only secondary indicators
         e_max = self._exclusion_width()
         exclude = np.full((bsz, e_max), -1, np.int32)
+        # exclusions beyond the static device width are NOT dropped
+        # (ADVICE r3): the overflow is applied host-side after top-k,
+        # with k widened so filtered rows still fill q.num results
+        overflow: dict[int, set] = {}
         for qi, q in enumerate(queries):
             ex: list[int] = []
             if q.exclude_seen:
@@ -321,13 +346,16 @@ class URAlgorithm(Algorithm):
                 if ix is not None:
                     ex.append(ix)
             if len(ex) > e_max:
-                log.warning(
-                    "query exclusion list truncated: %d > %d", len(ex), e_max
+                overflow[qi] = set(ex[e_max:])
+                log.info(
+                    "query exclusion list %d > device width %d: overflow "
+                    "filtered host-side", len(ex), e_max,
                 )
             exclude[qi, : len(ex)] = ex[:e_max]
 
         k_req = min(max((q.num for q in queries), default=10), n_items)
-        k = topk_bucket(k_req, n_items, floor=64)
+        max_over = max((len(s) for s in overflow.values()), default=0)
+        k = topk_bucket(min(k_req + max_over, n_items), n_items, floor=64)
         vals, idx = cco.batch_score_topk(
             model.device_tables(), histories, exclude, k
         )
@@ -335,10 +363,13 @@ class URAlgorithm(Algorithm):
         out = []
         for qi, q in enumerate(queries[:n_real]):
             scores = []
+            skip = overflow.get(qi)
             for v, ix in zip(vals[qi], idx[qi]):
                 if len(scores) >= q.num:
                     break
                 if v <= 0.0:  # positive_only: no LLR evidence, or excluded
+                    continue
+                if skip is not None and int(ix) in skip:
                     continue
                 scores.append(ItemScore(item=inv(int(ix)), score=float(v)))
             out.append(PredictedResult(item_scores=scores))

@@ -32,7 +32,9 @@ examples/scala-parallel-recommendation/*/ALSAlgorithm.scala:50-57).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,57 +56,75 @@ class WindowPlan:
     """Host-side blocking of one dst-sorted edge list.
 
     The plan re-indexes every per-edge array through `edge_index` (padding
-    slots point at edge 0 with valid=0), reshaped to (n_chunks,
-    chunk_blocks, block_edges) for a `lax.scan` over chunks.
+    slots point at edge 0 with valid=0), reshaped to (n_parts,
+    chunks_per_part, chunk_blocks, block_edges). `n_parts` > 1 splits the
+    block list into contiguous per-device groups for data-parallel
+    training: axis 0 shards over the mesh's dp axis, and because blocks
+    (hence output windows) are assigned to parts contiguously, the
+    part-major global block order keeps window ids non-decreasing —
+    padding blocks inside a part carry the part's LAST real window id
+    (zero-weight, so they contribute nothing) to preserve sortedness.
     """
 
     edge_index: np.ndarray  # (E_p,) int — padded slot → original edge
     valid: np.ndarray  # (E_p,) float32 — 0.0 on padding slots
     local: np.ndarray  # (E_p,) int32 — dst % S per slot
     block_window: np.ndarray  # (n_blocks_p,) int32 — output window per block
-    n_blocks: int  # real blocks (before chunk padding)
-    n_blocks_p: int  # blocks padded to a chunk multiple
-    n_chunks: int
+    n_blocks: int  # real blocks (before padding)
+    n_blocks_p: int  # padded blocks: n_parts * chunks_per_part * CB
+    n_chunks: int  # n_parts * chunks_per_part
     n_windows: int  # output rows padded to n_windows * S
     n_rows: int  # true output row count
+    n_parts: int = 1
+    chunks_per_part: int = 1
 
     @property
     def n_rows_padded(self) -> int:
         return self.n_windows * WINDOW_ROWS
 
+    def _shape4(self):
+        return (self.n_parts, self.chunks_per_part, CHUNK_BLOCKS, BLOCK_EDGES)
+
     def take(self, per_edge: np.ndarray) -> np.ndarray:
-        """Re-index a per-edge array into padded (n_chunks, CB, B_E) form.
+        """Re-index a per-edge array into padded (P, L, CB, B_E) form.
         Float arrays are masked by `valid` so padding slots are inert."""
         if per_edge.size == 0:  # empty training set: all-padding plan
             per_edge = np.zeros(1, per_edge.dtype)
         out = per_edge[self.edge_index]
         if np.issubdtype(out.dtype, np.floating):
             out = out * self.valid
-        return out.reshape(self.n_chunks, CHUNK_BLOCKS, BLOCK_EDGES)
+        return out.reshape(self._shape4())
 
     def chunked_local(self) -> np.ndarray:
-        return self.local.reshape(self.n_chunks, CHUNK_BLOCKS, BLOCK_EDGES)
+        return self.local.reshape(self._shape4())
 
     def chunked_valid(self) -> np.ndarray:
-        return self.valid.reshape(self.n_chunks, CHUNK_BLOCKS, BLOCK_EDGES)
+        return self.valid.reshape(self._shape4())
 
 
-def plan_windows(dst_sorted: np.ndarray, n_rows: int) -> WindowPlan:
-    """Build the block/window plan for a dst-sorted edge list. O(E) numpy."""
+def plan_windows(
+    dst_sorted: np.ndarray, n_rows: int, n_parts: int = 1
+) -> WindowPlan:
+    """Build the block/window plan for a dst-sorted edge list. O(E) numpy.
+
+    `n_parts` > 1 splits blocks into that many contiguous equal-size
+    (padded) groups — one per data-parallel device."""
     S, B_E, CB = WINDOW_ROWS, BLOCK_EDGES, CHUNK_BLOCKS
     dst_sorted = np.asarray(dst_sorted)
     n_windows = max(1, -(-n_rows // S))
-    if dst_sorted.size == 0:  # no edges: one all-padding chunk
+    if dst_sorted.size == 0:  # no edges: all-padding plan
         return WindowPlan(
-            edge_index=np.zeros(CB * B_E, np.int64),
-            valid=np.zeros(CB * B_E, np.float32),
-            local=np.zeros(CB * B_E, np.int32),
-            block_window=np.full(CB, n_windows, np.int32),
+            edge_index=np.zeros(n_parts * CB * B_E, np.int64),
+            valid=np.zeros(n_parts * CB * B_E, np.float32),
+            local=np.zeros(n_parts * CB * B_E, np.int32),
+            block_window=np.zeros(n_parts * CB, np.int32),
             n_blocks=1,
-            n_blocks_p=CB,
-            n_chunks=1,
+            n_blocks_p=n_parts * CB,
+            n_chunks=n_parts,
             n_windows=n_windows,
             n_rows=n_rows,
+            n_parts=n_parts,
+            chunks_per_part=1,
         )
     win = dst_sorted // S
     cnt = np.bincount(win, minlength=n_windows).astype(np.int64)
@@ -123,80 +143,174 @@ def plan_windows(dst_sorted: np.ndarray, n_rows: int) -> WindowPlan:
     np.cumsum(cnt, out=win_start[1:])
     block_start = win_start[block_win] + blk_in_win * B_E
 
-    E_p = n_blocks * B_E
-    off = np.tile(np.arange(B_E, dtype=np.int64), n_blocks)
-    blk = np.repeat(np.arange(n_blocks, dtype=np.int64), B_E)
-    valid = off < block_len[blk]
-    edge_index = np.where(
-        valid, block_start[blk] + np.minimum(off, np.maximum(block_len[blk] - 1, 0)), 0
-    )
-    local = (dst_sorted[edge_index] - block_win[blk] * S).astype(np.int32)
+    # contiguous equal-count split of real blocks over parts, each part
+    # padded to a common chunk multiple (SPMD: every device scans the
+    # same number of chunks)
+    bounds = np.linspace(0, n_blocks, n_parts + 1).astype(np.int64)
+    sizes = np.diff(bounds)
+    L = max(1, int(-(-sizes.max() // CB)))
+    bpp = L * CB  # padded blocks per part
+    n_blocks_p = n_parts * bpp
 
-    pad_blocks = (-n_blocks) % CB
-    n_blocks_p = n_blocks + pad_blocks
-    if pad_blocks:
-        edge_index = np.concatenate(
-            [edge_index, np.zeros(pad_blocks * B_E, np.int64)]
-        )
-        valid = np.concatenate([valid, np.zeros(pad_blocks * B_E, bool)])
-        local = np.concatenate([local, np.zeros(pad_blocks * B_E, np.int32)])
-        block_win = np.concatenate(
-            [block_win, np.full(pad_blocks, n_windows, np.int32)]
-        )
+    # padded-slot → real block id (-1 on padding blocks)
+    part_block = np.full(n_blocks_p, -1, np.int64)
+    pad_win = np.zeros(n_blocks_p, np.int32)
+    last_win = np.int32(0)
+    for d in range(n_parts):
+        s, e = bounds[d], bounds[d + 1]
+        lo = d * bpp
+        part_block[lo : lo + (e - s)] = np.arange(s, e)
+        if e > s:
+            last_win = block_win[e - 1]
+        pad_win[lo : lo + bpp] = last_win
+
+    is_real = part_block >= 0
+    safe = np.where(is_real, part_block, 0)
+    b_len = np.where(is_real, block_len[safe], 0)
+    b_start = np.where(is_real, block_start[safe], 0)
+    b_win = np.where(is_real, block_win[safe], pad_win).astype(np.int32)
+
+    off = np.tile(np.arange(B_E, dtype=np.int64), n_blocks_p)
+    blk = np.repeat(np.arange(n_blocks_p, dtype=np.int64), B_E)
+    valid = off < b_len[blk]
+    edge_index = np.where(
+        valid,
+        b_start[blk] + np.minimum(off, np.maximum(b_len[blk] - 1, 0)),
+        0,
+    )
+    local = (dst_sorted[edge_index] - b_win[blk] * S).astype(np.int32)
+
     return WindowPlan(
         edge_index=edge_index,
         valid=valid.astype(np.float32),
         local=local,
-        block_window=block_win,
+        block_window=b_win,
         n_blocks=n_blocks,
         n_blocks_p=n_blocks_p,
-        n_chunks=n_blocks_p // CB,
+        n_chunks=n_parts * L,
         n_windows=n_windows,
         n_rows=n_rows,
+        n_parts=n_parts,
+        chunks_per_part=L,
     )
+
+
+def resolve_pallas_mode(requested: str = "auto") -> Optional[str]:
+    """Resolve the windowed-pass Pallas dispatch once, OUTSIDE any jit.
+
+    Returns None (XLA scan path), "tpu" (compiled Pallas kernel) or
+    "interpret" (Pallas interpreter — CPU equivalence tests). "auto"
+    consults the PIO_PALLAS_WINDOWED env var: "0" forces XLA,
+    "interpret" forces the interpreter, "1"/unset means Pallas whenever
+    the default device is a TPU. Callers embedding the result in a jit
+    must treat it as a static argument (stage_windowed does)."""
+    from predictionio_tpu.ops import windowed_pallas
+
+    if requested in (None, "off"):
+        return None
+    if requested == "interpret":
+        return "interpret"
+    if requested in ("tpu", "1"):
+        return "tpu" if windowed_pallas.available() else None
+    env = os.environ.get("PIO_PALLAS_WINDOWED", "").strip()
+    if env == "0":
+        return None
+    if env == "interpret":
+        return "interpret"
+    return "tpu" if windowed_pallas.available() else None
 
 
 def windowed_gram_b(
     factors: jax.Array,  # (N_src_padded, K)
-    src: jax.Array,  # (n_chunks, CB, B_E) int32 — rows into `factors`
-    w_b: jax.Array,  # (n_chunks, CB, B_E) — b-vector edge weights (0 on pads)
-    w_g: jax.Array,  # (n_chunks, CB, B_E) — gram edge weights (0 on pads)
-    local: jax.Array,  # (n_chunks, CB, B_E) int32 — dst % S
-    block_window: jax.Array,  # (n_blocks_p,) int32
+    src: jax.Array,  # (P, L, CB, B_E) int32 — rows into `factors`
+    w_b: jax.Array,  # (P, L, CB, B_E) — b-vector edge weights (0 on pads)
+    w_g: jax.Array,  # (P, L, CB, B_E) — gram edge weights (0 on pads)
+    local: jax.Array,  # (P, L, CB, B_E) int32 — dst % S
+    block_window: jax.Array,  # (n_blocks_p,) int32, part-major, sorted
     n_windows: int,
+    pallas: Optional[str] = None,  # resolved mode; None = XLA scan path
 ) -> tuple[jax.Array, jax.Array]:
     """One fused edge pass → (b (N_pad, K), gram_flat (N_pad, K²)).
 
     b[d]    = Σ_{e→d} w_b[e] · y[src[e]]
     gram[d] = Σ_{e→d} w_g[e] · y[src[e]] ⊗ y[src[e]]   (flattened K²)
 
-    One gather of y per edge feeds both sums; the segment reduction is the
-    windowed one-hot matmul described in the module docstring.
+    One gather of y per edge feeds both sums. Chunk arrays are 4D
+    part-major (3D (L, CB, B_E) legacy inputs are treated as P=1): the
+    part axis shards over the mesh's dp axis, the scan walks each part's
+    chunks in SPMD lockstep, and GSPMD turns the final block-level
+    segment-sum into per-device partial sums + one ICI all-reduce per
+    half-step — the TPU-native analogue of MLlib ALS's block shuffle.
+
+    The segment reduction is either the chunked XLA one-hot matmul below
+    (pallas=None) or the fused VMEM kernel in ops/windowed_pallas.py
+    (pallas="tpu" / "interpret"), which skips the HBM one-hot and
+    payload entirely. The Pallas kernel is single-device (pallas_call
+    has no GSPMD partitioning rule), so P>1 always takes the XLA path.
     """
     k = factors.shape[1]
+    if src.ndim == 3:  # legacy single-part layout
+        src, w_b, w_g, local = (
+            a[None] for a in (src, w_b, w_g, local)
+        )
+    p = src.shape[0]
+    if pallas is not None and p == 1:
+        from predictionio_tpu.ops import windowed_pallas
+
+        nb = src.shape[1] * src.shape[2]
+        # transposed gather (nb, K, B_E): the edge axis stays in lanes so
+        # the pallas boundary needs no 12.8× lane-pad relayout of y
+        y_t = jnp.swapaxes(factors[src.reshape(nb, -1)], 1, 2)
+        b, g = windowed_pallas.windowed_pass(
+            y_t,
+            w_b.reshape(nb, -1),
+            w_g.reshape(nb, -1),
+            local.reshape(nb, -1),
+            block_window,
+            n_windows=n_windows,
+            s_rows=WINDOW_ROWS,
+            interpret=(pallas == "interpret"),
+        )
+        n_out = n_windows * WINDOW_ROWS
+        # windows with no blocks are never written by the kernel (their
+        # output tiles hold garbage); the XLA path's segment-sum gives
+        # exact zeros there — mask to match
+        covered = (
+            jnp.zeros(n_windows + 1, bool).at[block_window].set(True)
+        )
+        mask = jnp.repeat(covered[:n_windows], WINDOW_ROWS)[:, None]
+        return (
+            jnp.where(mask, b[:n_out], 0.0),
+            jnp.where(mask, g[:n_out], 0.0),
+        )
     d = k + k * k
     s_rows = WINDOW_ROWS
 
     def body(_, ch):
-        s, wb, wg, lc = ch  # (CB, B_E)
-        y = factors[s]  # (CB, B_E, K)
+        s, wb, wg, lc = ch  # (P, CB, B_E)
+        y = factors[s]  # (P, CB, B_E, K)
         outer = (y[..., :, None] * y[..., None, :]).reshape(
             *y.shape[:-1], k * k
         )
         payload = jnp.concatenate(
             [y * wb[..., None], outer * wg[..., None]], axis=-1
-        )  # (CB, B_E, D)
+        )  # (P, CB, B_E, D)
         onehot = (
             lc[..., None] == jnp.arange(s_rows, dtype=jnp.int32)
-        ).astype(jnp.float32)  # (CB, B_E, S)
+        ).astype(jnp.float32)  # (P, CB, B_E, S)
         part = jnp.einsum(
-            "ces,ced->csd", onehot, payload,
+            "pces,pced->pcsd", onehot, payload,
             precision=jax.lax.Precision.HIGHEST,
-        )  # (CB, S, D)
+        )  # (P, CB, S, D)
         return None, part
 
-    _, parts = jax.lax.scan(body, None, (src, w_b, w_g, local))
-    parts = parts.reshape(-1, s_rows * d)  # (n_blocks_p, S*D)
+    # scan over each part's chunks in lockstep (axis 1 → leading)
+    xs = tuple(
+        jnp.swapaxes(a, 0, 1) for a in (src, w_b, w_g, local)
+    )
+    _, parts = jax.lax.scan(body, None, xs)  # (L, P, CB, S, D)
+    # back to part-major global block order to match block_window
+    parts = jnp.swapaxes(parts, 0, 1).reshape(-1, s_rows * d)
     out = jax.ops.segment_sum(
         parts, block_window, num_segments=n_windows + 1,
         indices_are_sorted=True,

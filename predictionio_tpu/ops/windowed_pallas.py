@@ -1,21 +1,34 @@
-"""Pallas TPU kernel for the windowed segment reduction.
+"""Pallas TPU kernel for the fused windowed ALS edge pass.
 
-Fuses the one-hot build into the block matmul of ops/windowed.py's
-reduction: the XLA path materializes each block's (B_E, S) one-hot in HBM
-(write + read ≈ 2×E_p×S×4 bytes — ~21 GB per ML-20M edge pass, ~35% of
-the pass's traffic); here the one-hot lives only in VMEM, built from an
-iota compare, and the per-block partial accumulates directly into the
-output window tile.
+Replaces the device half of ops/windowed.windowed_gram_b (the XLA scan
+path) with one kernel that keeps every per-edge intermediate in VMEM:
 
-Accumulation pattern: the grid walks blocks in order; consecutive blocks
-sharing an output window map to the SAME output block (index_map reads
-the scalar-prefetched window ids), so Pallas keeps the (S, D) tile in
-VMEM across those steps and flushes it to HBM only when the window
-changes — the standard TPU reduction idiom (matmul k-loop). The host plan
-guarantees window ids are non-decreasing, which makes this exact.
+- the (B_E, S) one-hot is built from an iota compare and never touches
+  HBM (the XLA path materializes it per chunk: write + read ≈
+  2·E_p·S·4 B ≈ 21 GB per ML-20M edge pass);
+- the (B_E, K²) outer-product payload is built in-register from the
+  gathered factor rows and never touches HBM either (the XLA path
+  materializes the concatenated (B_E, K+K²) payload per chunk ≈ another
+  18 GB per pass);
+- per-window output tiles accumulate in VMEM across consecutive blocks
+  (the grid walks blocks in non-decreasing window order, so the output
+  index map revisits the same tile until the window changes — the
+  standard TPU reduction idiom), eliminating the (n_blocks, S, D)
+  partials array and the final segment-sum combine.
 
-Used behind ops/windowed.windowed_gram_b on TPU (PIO_PALLAS_WINDOWED=0
-forces the XLA path); CPU tests run the kernel in interpret mode.
+Remaining HBM traffic per pass ≈ one read of the gathered factor rows
+(E_p·K·4 B), the edge weights, and one write of the (n_windows·S, K+K²)
+output — an order of magnitude below the XLA path at ML-20M shapes.
+
+Weights are folded into the ONE-HOT (not the payload): b uses
+onehot·w_b, gram uses onehot·w_g, so the kernel needs no (B_E, 1)
+transposes and emits b and the flat gram correction as two outputs.
+
+Integration: ops/windowed.windowed_gram_b dispatches here when
+`PIO_PALLAS_WINDOWED` allows it (default: on when the default device is
+a TPU; `0` forces the XLA path; `interpret` runs this kernel through the
+Pallas interpreter on CPU — how tests/test_windowed_pallas.py checks
+bit-level agreement with the XLA path).
 """
 
 from __future__ import annotations
@@ -26,72 +39,117 @@ import jax
 import jax.numpy as jnp
 
 
-def _kernel(bw_ref, local_ref, payload_ref, out_ref):
-    """One grid step = one edge block: out_window += onehotᵀ @ payload."""
+def _kernel(bw_ref, yt_ref, wb_ref, wg_ref, local_ref, b_ref, g_ref):
+    """One grid step = one edge block.
+
+    b_window    += (onehot·w_b) @ yᵀ
+    gram_window += (onehot·w_g) @ [yᵀ_i·yᵀ_j for (i,j) in K×K]ᵀ
+
+    Everything edge-indexed keeps the 1024-wide edge axis in LANES
+    (factor rows arrive transposed (K, B_E)): the (K², B_E) outer
+    product is a sublane concat of full-lane pieces, so VMEM holds no
+    lane-padded narrow arrays, and both contractions run edge-axis
+    against edge-axis on the MXU with no in-kernel transposes.
+    """
     from jax.experimental import pallas as pl
 
-    i = pl.program_id(0)
-    s_rows = out_ref.shape[0]
-    prev = bw_ref[jnp.maximum(i - 1, 0)]
-    new_window = (i == 0) | (prev != bw_ref[i])
+    step = pl.program_id(0)
+    prev = bw_ref[jnp.maximum(step - 1, 0)]
+    new_window = (step == 0) | (prev != bw_ref[step])
 
     @pl.when(new_window)
     def _zero():
-        out_ref[...] = jnp.zeros_like(out_ref)
+        b_ref[...] = jnp.zeros_like(b_ref)
+        g_ref[...] = jnp.zeros_like(g_ref)
 
-    lid = local_ref[...]  # (B_E,) int32; -1 padding never matches a row
-    rows = jax.lax.broadcasted_iota(jnp.int32, (s_rows, lid.shape[0]), 0)
-    onehot = (rows == lid[None, :]).astype(jnp.float32)  # (S, B_E), VMEM-only
-    out_ref[...] += jax.lax.dot_general(
-        onehot, payload_ref[...],
-        dimension_numbers=(((1,), (0,)), ((), ())),
+    yt = yt_ref[0]  # (K, B_E) f32 — gathered fixed-side factor rows, transposed
+    k = yt.shape[0]
+    lid = local_ref[0]  # (1, B_E) int32; padding slots carry w_b=w_g=0
+    s_rows = b_ref.shape[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (s_rows, lid.shape[1]), 0)
+    onehot = (rows == lid).astype(jnp.float32)  # (S, B_E) — VMEM only
+
+    dot_e = functools.partial(
+        jax.lax.dot_general,  # contract both operands on their edge axis
+        dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         # HIGHEST: CG consumes these sums; one bf16 MXU pass loses ~2^-8
         precision=jax.lax.Precision.HIGHEST,
     )
+    b_ref[...] += dot_e(onehot * wb_ref[0], yt)
+    # outer_t[i*K+j, e] = y[e,i]·y[e,j] — K sublane-stacked (K, B_E) pieces
+    outer_t = jnp.concatenate(
+        [yt * yt[i : i + 1, :] for i in range(k)], axis=0
+    )  # (K², B_E)
+    g_ref[...] += dot_e(onehot * wg_ref[0], outer_t)
 
 
 @functools.partial(
     jax.jit, static_argnames=("n_windows", "s_rows", "interpret")
 )
-def windowed_segment_matmul(
-    payload: jax.Array,  # (n_blocks_p * B_E, D_pad) f32; D_pad % 128 == 0
-    local: jax.Array,  # (n_blocks_p, B_E) int32, -1 padded
+def windowed_pass(
+    y_t: jax.Array,  # (n_blocks_p, K, B_E) f32 — factors[src] per block,
+    # TRANSPOSED so the wide edge axis sits in lanes (the (·, K) layout
+    # would cost a 12.8× lane-padding relayout at the pallas boundary)
+    w_b: jax.Array,  # (n_blocks_p, B_E) f32 — b-vector edge weights (0 on pads)
+    w_g: jax.Array,  # (n_blocks_p, B_E) f32 — gram edge weights (0 on pads)
+    local: jax.Array,  # (n_blocks_p, B_E) int32 — dst % s_rows (arbitrary
+    # values outside [0, s_rows) on padding slots never match a row)
     block_window: jax.Array,  # (n_blocks_p,) int32, NON-DECREASING
     *,
     n_windows: int,
     s_rows: int = 128,
     interpret: bool = False,
-) -> jax.Array:
-    """out[w*S + r, :] = Σ_{blocks b of window w} Σ_{e: local=r} payload_e.
+) -> tuple[jax.Array, jax.Array]:
+    """Fused edge pass → (b ((n_windows+1)·S, K), gram ((n_windows+1)·S, K²)).
 
-    Returns ((n_windows + 1) * s_rows, D_pad); the +1 window absorbs
-    chunk-padding blocks (their block_window is n_windows)."""
-    # lazy: pallas.tpu cannot import in a CPU-only process (tests force a
-    # CPU platform and strip the TPU plugin)
+    b[w·S + r]    = Σ_{blocks b of w} Σ_{e: local=r} w_b[e] · y[e]
+    gram[w·S + r] = Σ_{blocks b of w} Σ_{e: local=r} w_g[e] · y[e] ⊗ y[e]
+
+    The output is over-allocated by one window and callers trim to
+    n_windows·S rows; tiles of windows NO block maps to (including that
+    spare window) are never written and hold garbage — the caller masks
+    them (windowed.windowed_gram_b's covered-mask). plan_windows gives
+    padding blocks the window id of their part's last real block (zero
+    weights, zero contribution), keeping block_window non-decreasing —
+    the invariant that makes the VMEM window accumulation exact.
+    """
+    # lazy: pallas.tpu cannot always import in a CPU-only process (tests
+    # force a CPU platform and strip the TPU plugin)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n_blocks, b_e = local.shape
-    d_pad = payload.shape[1]
-    local_flat = local.reshape(n_blocks * b_e)
+    n_blocks, k, b_e = y_t.shape
+    # Mosaic requires the last two block dims to divide (8, 128) or equal
+    # the array dims — a singleton middle axis makes (1, 1, B_E) legal.
+    w_b = w_b.reshape(n_blocks, 1, b_e)
+    w_g = w_g.reshape(n_blocks, 1, b_e)
+    local = local.reshape(n_blocks, 1, b_e)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((b_e,), lambda i, bw: (i,)),
-            pl.BlockSpec((b_e, d_pad), lambda i, bw: (i, 0)),
+            pl.BlockSpec((1, k, b_e), lambda i, bw: (i, 0, 0)),
+            pl.BlockSpec((1, 1, b_e), lambda i, bw: (i, 0, 0)),
+            pl.BlockSpec((1, 1, b_e), lambda i, bw: (i, 0, 0)),
+            pl.BlockSpec((1, 1, b_e), lambda i, bw: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((s_rows, d_pad), lambda i, bw: (bw[i], 0)),
+        out_specs=[
+            pl.BlockSpec((s_rows, k), lambda i, bw: (bw[i], 0)),
+            pl.BlockSpec((s_rows, k * k), lambda i, bw: (bw[i], 0)),
+        ],
     )
     return pl.pallas_call(
         _kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            ((n_windows + 1) * s_rows, d_pad), jnp.float32
-        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(((n_windows + 1) * s_rows, k), jnp.float32),
+            jax.ShapeDtypeStruct(
+                ((n_windows + 1) * s_rows, k * k), jnp.float32
+            ),
+        ],
         interpret=interpret,
-    )(block_window, local_flat, payload)
+    )(block_window, y_t, w_b, w_g, local)
 
 
 def available() -> bool:

@@ -36,15 +36,20 @@ def run_dryrun(n_devices: int) -> None:
         rows = rng.randint(0, n_users, n_edges).astype(np.int32)
         cols = rng.randint(0, n_items, n_edges).astype(np.int32)
         vals = rng.rand(n_edges).astype(np.float32) * 4.0 + 1.0
-        for implicit in (True, False):
+        # rank 8 → the windowed (flagship) kernel sharded part-major over
+        # dp; rank 40 → the matrix-free scatter path (rank > 32)
+        for implicit, rank in (
+            (True, 8), (False, 8), (True, 40),
+        ):
             params = als.ALSParams(
-                rank=8, iterations=1, cg_iterations=2, implicit_prefs=implicit
+                rank=rank, iterations=1, cg_iterations=2,
+                implicit_prefs=implicit,
             )
             factors = als.train(
                 rows, cols, vals, n_users, n_items, params, mesh=mesh
             )
-            assert factors.user_factors.shape == (n_users, 8)
-            assert factors.item_factors.shape == (n_items, 8)
+            assert factors.user_factors.shape == (n_users, rank)
+            assert factors.item_factors.shape == (n_items, rank)
             assert np.all(np.isfinite(factors.user_factors))
             assert np.all(np.isfinite(factors.item_factors))
 
@@ -72,18 +77,25 @@ def run_dryrun(n_devices: int) -> None:
         assert np.all(np.isfinite(lr.weights))
 
 
-# Child-process bootstrap: scrub any non-CPU PJRT plugin a sitecustomize may
-# have registered before our env vars could take effect, then run the body.
+# Child-process bootstrap: neuter any non-CPU PJRT plugin a sitecustomize
+# may have registered before our env vars could take effect, then run the
+# body. Factories are replaced (not popped) so the platform NAMES stay
+# registered — Pallas registers MLIR lowerings for "tpu" at import time
+# and errors on unknown platforms.
 _CHILD_TEMPLATE = """\
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 try:
-    from jax._src import xla_bridge as _xb
-    for _name in list(getattr(_xb, "_backend_factories", {{}})):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
+    import dataclasses as _dc
     import jax
     jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    def _blocked(*_a, **_k):
+        raise RuntimeError("non-CPU backends blocked in dryrun")
+    for _name, _reg in list(getattr(_xb, "_backend_factories", {{}}).items()):
+        if _name != "cpu":
+            _xb._backend_factories[_name] = _dc.replace(
+                _reg, factory=_blocked, fail_quietly=True)
 except Exception:
     pass
 from predictionio_tpu.parallel.dryrun import run_dryrun

@@ -12,19 +12,29 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 # If a TPU PJRT plugin was registered at interpreter start (sitecustomize),
-# drop its factory so lazy backend init can never dial TPU hardware from a
-# unit test — tests must be hermetic CPU-only.
+# neuter its factory so lazy backend init can never dial TPU hardware from
+# a unit test — tests must be hermetic CPU-only. The platform NAME must
+# stay registered (not popped): Pallas registers MLIR lowerings for the
+# "tpu" platform at import time and errors on unknown platforms.
 try:  # pragma: no cover - depends on host environment
-    from jax._src import xla_bridge as _xb
+    import dataclasses as _dc
 
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
-    # sitecustomize may have imported jax before this file ran, freezing
-    # jax_platforms at the env value; force it back to cpu.
+    # sitecustomize may have imported jax before this file ran and set
+    # jax_platforms programmatically (e.g. "axon,cpu"); force it back.
     import jax as _jax
 
     _jax.config.update("jax_platforms", "cpu")
+
+    from jax._src import xla_bridge as _xb
+
+    def _blocked_backend(*_a, **_k):
+        raise RuntimeError("non-CPU backends are blocked in unit tests")
+
+    for _name, _reg in list(getattr(_xb, "_backend_factories", {}).items()):
+        if _name != "cpu":
+            _xb._backend_factories[_name] = _dc.replace(
+                _reg, factory=_blocked_backend, fail_quietly=True
+            )
 except Exception:
     pass
 
