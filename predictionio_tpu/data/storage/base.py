@@ -33,6 +33,14 @@ from predictionio_tpu.data.event import (
 )
 
 
+def shard_of(entity_id: str, n_shards: int) -> int:
+    """Stable entity → shard assignment (crc32; every backend and the
+    storage daemon must agree so partitioned readers are disjoint)."""
+    import zlib
+
+    return zlib.crc32(entity_id.encode()) % n_shards
+
+
 class StorageError(RuntimeError):
     pass
 
@@ -66,8 +74,25 @@ class EventQuery:
     # stable pagination (the role of the reference's HBase scan-from-row-key,
     # hbase/HBEventsUtil.scala:286).
     start_after: Optional[tuple[_dt.datetime, str]] = None
+    # partitioned training reads (reference HBPEvents.scala:84-90 parallel
+    # region scans): (shard_idx, n_shards) keeps only events whose
+    # crc32(entityId) % n_shards == shard_idx. Shards are disjoint and
+    # complete, and every event of one entity lands in one shard (entity
+    # locality — the HBase row-key-prefix property). N readers each
+    # passing a distinct shard stream disjoint partitions; through the
+    # storage daemon the filter runs server-side, dividing wire traffic
+    # by N.
+    shard: Optional[tuple[int, int]] = None
+
+    def shard_matches(self, entity_id: str) -> bool:
+        if self.shard is None:
+            return True
+        idx, n = self.shard
+        return shard_of(entity_id, n) == idx
 
     def matches(self, e: Event) -> bool:
+        if not self.shard_matches(e.entity_id):
+            return False
         if self.start_after is not None:
             key = (e.event_time, e.event_id or "")
             if self.reversed:

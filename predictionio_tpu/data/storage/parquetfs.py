@@ -28,6 +28,7 @@ import pyarrow.parquet as pq
 
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import EventQuery, EventStore
 from predictionio_tpu.data.store.columnar import EventFrame
 
@@ -313,8 +314,15 @@ class ParquetFSEventStore(EventStore):
             )
             mask &= ttypes == query.target_entity_type
 
-        idx = np.nonzero(mask)[0]
         entity_ids = np.asarray(table.column("entity_id").to_pylist(), dtype=object)
+        if query.shard is not None:
+            sidx, n_sh = query.shard
+            mask &= np.fromiter(
+                (base.shard_of(e, n_sh) == sidx for e in entity_ids),
+                dtype=bool,
+                count=len(entity_ids),
+            )
+        idx = np.nonzero(mask)[0]
         target_ids = np.asarray(
             table.column("target_entity_id").to_pylist(), dtype=object
         )

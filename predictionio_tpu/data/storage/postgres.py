@@ -433,18 +433,26 @@ class PostgresEventStore(base.EventStore):
         order = "DESC" if query.reversed else "ASC"
 
         def gen():
-            remaining = (
+            limit = (
                 int(query.limit)
                 if query.limit is not None and query.limit >= 0
                 else None
             )
+            if limit == 0:
+                return
+            # without a shard filter the SQL LIMIT can carry the budget;
+            # with one, pages stay full-size and `limit` counts MATCHED
+            # events host-side (postgres has no portable crc32 to push
+            # the shard predicate into SQL — entityId filtering happens
+            # here, row[3], before Event construction)
+            matched = 0
             q = query
-            while remaining is None or remaining > 0:
-                n = (
-                    self.FIND_PAGE
-                    if remaining is None
-                    else min(self.FIND_PAGE, remaining)
-                )
+            while True:
+                n = self.FIND_PAGE
+                if query.shard is None and limit is not None:
+                    n = min(n, limit - matched)
+                    if n <= 0:
+                        return
                 where, params = self._where(q)
                 rows = self._client.query(
                     _pg(
@@ -454,11 +462,16 @@ class PostgresEventStore(base.EventStore):
                     tuple(params),
                 )
                 for r in rows:
+                    if query.shard is not None and not query.shard_matches(
+                        r[3]
+                    ):
+                        continue
                     yield self._to_event(r)
+                    matched += 1
+                    if limit is not None and matched >= limit:
+                        return
                 if len(rows) < n:
                     return
-                if remaining is not None:
-                    remaining -= len(rows)
                 last = rows[-1]  # (id, ..., eventTime at index 7, ...)
                 q = _dcs.replace(
                     q, start_after=(_from_ms(last[7]), last[0])
@@ -493,14 +506,36 @@ class PostgresEventStore(base.EventStore):
         from predictionio_tpu.data.store.columnar import EventFrame
 
         name = self._ensure_table(query.app_id, query.channel_id)
-        where, params = self._where(query)
-        rows = self._client.query(
-            _pg(
-                f"SELECT event, entityId, targetEntityId, eventTime, "
-                f"properties FROM {name} {where} ORDER BY eventTime ASC, id ASC"
-            ),
-            tuple(params),
-        )
+        # stream keyset pages (same discipline as find()): a train-scale
+        # read never materializes unfiltered in host RAM, and with a
+        # shard filter each page is thinned server-call-by-server-call
+        # instead of after one giant fetchall
+        import dataclasses as _dcs
+
+        rows: list = []
+        q = query
+        while True:
+            where, params = self._where(q)
+            page = self._client.query(
+                _pg(
+                    f"SELECT event, entityId, targetEntityId, eventTime, "
+                    f"properties, id FROM {name} {where} "
+                    f"ORDER BY eventTime ASC, id ASC LIMIT {self.FIND_PAGE}"
+                ),
+                tuple(params),
+            )
+            if query.shard is not None:
+                rows.extend(
+                    r[:5] for r in page if query.shard_matches(r[1])
+                )
+            else:
+                rows.extend(r[:5] for r in page)
+            if len(page) < self.FIND_PAGE:
+                break
+            last = page[-1]
+            q = _dcs.replace(
+                q, start_after=(_from_ms(last[3]), last[5])
+            )
         if not rows:
             return EventFrame.from_columns(
                 [], [], [], np.zeros(0, np.int64), np.zeros(0, np.float32)

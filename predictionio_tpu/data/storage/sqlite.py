@@ -51,6 +51,11 @@ class _SqliteClient:
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
+        # partitioned-read pushdown: crc32(entityId) % n in SQL (the
+        # shared shard function every backend agrees on, base.shard_of)
+        self._conn.create_function(
+            "pio_shard", 2, base.shard_of, deterministic=True
+        )
         self.lock = threading.RLock()
 
     @property
@@ -224,40 +229,7 @@ class SqliteEventStore(base.EventStore):
 
     def find(self, query: EventQuery) -> Iterator[Event]:
         name = self._ensure_table(query.app_id, query.channel_id)
-        clauses, params = [], []
-        if query.start_time is not None:
-            clauses.append("eventTime >= ?")
-            params.append(_ms(query.start_time))
-        if query.until_time is not None:
-            clauses.append("eventTime < ?")
-            params.append(_ms(query.until_time))
-        if query.entity_type is not None:
-            clauses.append("entityType = ?")
-            params.append(query.entity_type)
-        if query.entity_id is not None:
-            clauses.append("entityId = ?")
-            params.append(query.entity_id)
-        if query.event_names is not None:
-            marks = ",".join("?" for _ in query.event_names)
-            clauses.append(f"event IN ({marks})")
-            params.extend(query.event_names)
-        if query.filter_target_absent:
-            clauses.append("targetEntityType IS NULL AND targetEntityId IS NULL")
-        else:
-            if query.target_entity_type is not None:
-                clauses.append("targetEntityType = ?")
-                params.append(query.target_entity_type)
-            if query.target_entity_id is not None:
-                clauses.append("targetEntityId = ?")
-                params.append(query.target_entity_id)
-        if query.start_after is not None:
-            t, eid = query.start_after
-            op = "<" if query.reversed else ">"
-            clauses.append(
-                f"(eventTime {op} ? OR (eventTime = ? AND id {op} ?))"
-            )
-            params.extend([_ms(t), _ms(t), eid])
-        where = ("WHERE " + " AND ".join(clauses)) if clauses else ""
+        where, params = self._where(query)
         order = "DESC" if query.reversed else "ASC"
         limit = f"LIMIT {int(query.limit)}" if query.limit is not None and query.limit >= 0 else ""
         sql = f"SELECT * FROM {name} {where} ORDER BY eventTime {order}, id {order} {limit}"
@@ -315,6 +287,10 @@ class SqliteEventStore(base.EventStore):
                 f"(eventTime {op} ? OR (eventTime = ? AND id {op} ?))"
             )
             params.extend([_ms(t), _ms(t), eid])
+        if query.shard is not None:
+            idx, n = query.shard
+            clauses.append("pio_shard(entityId, ?) = ?")
+            params.extend([int(n), int(idx)])
         return ("WHERE " + " AND ".join(clauses)) if clauses else "", params
 
     def find_frame(

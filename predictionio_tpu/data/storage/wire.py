@@ -78,6 +78,9 @@ def encode(obj: Any) -> Any:
                 "limit": obj.limit,
                 "reversed": obj.reversed,
                 "filter_target_absent": obj.filter_target_absent,
+                "shard": (
+                    list(obj.shard) if obj.shard is not None else None
+                ),
                 "start_after": (
                     [_enc_dt(obj.start_after[0]), obj.start_after[1]]
                     if obj.start_after is not None
@@ -170,6 +173,11 @@ def decode(obj: Any) -> Any:
                 limit=val["limit"],
                 reversed=val["reversed"],
                 filter_target_absent=val["filter_target_absent"],
+                shard=(
+                    tuple(val["shard"])
+                    if val.get("shard") is not None
+                    else None
+                ),
                 start_after=(
                     (_dec_dt(val["start_after"][0]), val["start_after"][1])
                     if val.get("start_after") is not None

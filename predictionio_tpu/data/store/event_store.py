@@ -107,10 +107,17 @@ class EventStoreFacade:
         until_time: Optional[_dt.datetime] = None,
         value_prop: Optional[str] = None,
         default_value: float = 1.0,
+        shard: Optional[tuple[int, int]] = None,
     ) -> EventFrame:
         """Columnar batch read — the TPU-native replacement for
         PEventStore.find(...): RDD[Event]. Uses the backend's fast columnar
-        path when available."""
+        path when available.
+
+        `shard=(i, n)` streams only the i-th of n disjoint entity-hash
+        partitions — N parallel readers (one per host process) split a
+        training read the way the reference's HBase scan splits across
+        region servers (HBPEvents.scala:84-90); see parallel/loader.py
+        allgather_rows for the multi-host reassembly side."""
         app_id, channel_id = self.app_name_to_id(app_name, channel_name)
         store = self.storage.get_events()
         query = EventQuery(
@@ -121,6 +128,7 @@ class EventStoreFacade:
             entity_type=entity_type,
             event_names=event_names,
             target_entity_type=target_entity_type,
+            shard=shard,
         )
         fast = getattr(store, "find_frame", None)
         if fast is not None:

@@ -17,6 +17,7 @@ set (rated >= goal threshold).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -260,30 +261,37 @@ class ALSModel:
         self.item_categories = item_categories
         self._item_factors_device = None
         self._user_factors_device = None
+        self._stage_lock = threading.Lock()
 
-    # device caches are serving state, not part of the pickled model
+    # device caches + lock are serving state, not part of the pickled model
     def __getstate__(self):
         return {"factors": self.factors, "item_categories": self.item_categories}
 
     def __setstate__(self, state):
-        self.factors = state["factors"]
-        self.item_categories = state.get("item_categories")
-        self._item_factors_device = None
-        self._user_factors_device = None
+        self.__init__(state["factors"], state.get("item_categories"))
 
     def item_factors_device(self):
-        if self._item_factors_device is None:
-            import jax.numpy as jnp
+        # locked: the pipelined dispatcher (server.py pipeline_depth) can
+        # run two batches for one model concurrently; double-staging would
+        # transiently double the factor matrices' HBM footprint
+        with self._stage_lock:
+            if self._item_factors_device is None:
+                import jax.numpy as jnp
 
-            self._item_factors_device = jnp.asarray(self.factors.item_factors)
-        return self._item_factors_device
+                self._item_factors_device = jnp.asarray(
+                    self.factors.item_factors
+                )
+            return self._item_factors_device
 
     def user_factors_device(self):
-        if self._user_factors_device is None:
-            import jax.numpy as jnp
+        with self._stage_lock:
+            if self._user_factors_device is None:
+                import jax.numpy as jnp
 
-            self._user_factors_device = jnp.asarray(self.factors.user_factors)
-        return self._user_factors_device
+                self._user_factors_device = jnp.asarray(
+                    self.factors.user_factors
+                )
+            return self._user_factors_device
 
 
 class ALSAlgorithm(Algorithm):

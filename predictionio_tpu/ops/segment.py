@@ -201,12 +201,21 @@ def batched_cg(
     a shared matvec that applies all A_i at once. Fixed iteration count —
     compiler-friendly (no data-dependent control flow under jit). Rows whose
     residual has reached float32 noise are frozen via `where` (iterating CG
-    past convergence amplifies rounding error instead of reducing it)."""
+    past convergence amplifies rounding error instead of reducing it).
+
+    The iteration loop is PYTHON-UNROLLED, deliberately. A `lax.fori_loop`
+    here miscompiles on TPU when the loop-invariant operators feeding
+    `matvec` are large fused intermediates (observed at ML-20M shapes:
+    the windowed edge pass + fori-CG in one jit returned garbage for
+    every row — ~1000× off — while the identical math with the loop
+    unrolled, or the same fori-CG with the operators passed in as jit
+    arguments, is exact to f32). `iterations` is small and static (3 by
+    default), so unrolling also lets XLA fuse across iterations."""
     r0 = b - matvec(x0)
     rs0 = jnp.sum(r0 * r0, axis=-1)
     tol = jnp.maximum(rs0, 1.0) * 1e-12  # relative f32 floor
 
-    def body(_, state):
+    def body(state):
         x, r, p, rs = state
         live = rs > tol
         ap = matvec(p)
@@ -219,5 +228,6 @@ def batched_cg(
         return x, r, p, rs_new
 
     state = (x0, r0, r0, rs0)
-    x, *_ = jax.lax.fori_loop(0, iterations, body, state)
-    return x
+    for _ in range(iterations):
+        state = body(state)
+    return state[0]

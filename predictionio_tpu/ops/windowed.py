@@ -254,37 +254,49 @@ def windowed_gram_b(
             a[None] for a in (src, w_b, w_g, local)
         )
     p = src.shape[0]
-    if pallas is not None and p == 1:
-        from predictionio_tpu.ops import windowed_pallas
-
-        nb = src.shape[1] * src.shape[2]
-        # transposed gather (nb, K, B_E): the edge axis stays in lanes so
-        # the pallas boundary needs no 12.8× lane-pad relayout of y
-        y_t = jnp.swapaxes(factors[src.reshape(nb, -1)], 1, 2)
-        b, g = windowed_pallas.windowed_pass(
-            y_t,
-            w_b.reshape(nb, -1),
-            w_g.reshape(nb, -1),
-            local.reshape(nb, -1),
-            block_window,
-            n_windows=n_windows,
-            s_rows=WINDOW_ROWS,
-            interpret=(pallas == "interpret"),
-        )
-        n_out = n_windows * WINDOW_ROWS
-        # windows with no blocks are never written by the kernel (their
-        # output tiles hold garbage); the XLA path's segment-sum gives
-        # exact zeros there — mask to match
-        covered = (
-            jnp.zeros(n_windows + 1, bool).at[block_window].set(True)
-        )
-        mask = jnp.repeat(covered[:n_windows], WINDOW_ROWS)[:, None]
-        return (
-            jnp.where(mask, b[:n_out], 0.0),
-            jnp.where(mask, g[:n_out], 0.0),
-        )
+    if p > 1:
+        pallas = None  # pallas_call has no GSPMD partitioning rule
     d = k + k * k
     s_rows = WINDOW_ROWS
+
+    if pallas is not None:
+        from predictionio_tpu.ops import windowed_pallas
+
+        factors_t = jnp.swapaxes(factors, 0, 1)  # (K, N) — tiny
+
+        def body(_, ch):
+            s, wb, wg, lc = ch  # (1, CB, B_E)
+            cb, b_e = s.shape[1], s.shape[2]
+            # transposed per-chunk gather (CB, K, B_E): the edge axis
+            # stays in lanes, so the pallas boundary needs no 12.8×
+            # lane-pad relayout, and the gather stays chunk-sized (a
+            # whole-pass gather materialized GBs and measured slower)
+            y_t = (
+                factors_t[:, s.reshape(-1)]
+                .reshape(k, cb, b_e)
+                .transpose(1, 0, 2)
+            )
+            pb, pg = windowed_pallas.block_partials(
+                y_t,
+                wb.reshape(cb, b_e),
+                wg.reshape(cb, b_e),
+                lc.reshape(cb, b_e),
+                s_rows=s_rows,
+                interpret=(pallas == "interpret"),
+            )
+            return None, (pb, pg)
+
+        xs = tuple(jnp.swapaxes(a, 0, 1) for a in (src, w_b, w_g, local))
+        _, (parts_b, parts_g) = jax.lax.scan(body, None, xs)
+        out_b = jax.ops.segment_sum(
+            parts_b.reshape(-1, s_rows * k), block_window,
+            num_segments=n_windows + 1, indices_are_sorted=True,
+        )[:n_windows].reshape(n_windows * s_rows, k)
+        out_g = jax.ops.segment_sum(
+            parts_g.reshape(-1, s_rows * k * k), block_window,
+            num_segments=n_windows + 1, indices_are_sorted=True,
+        )[:n_windows].reshape(n_windows * s_rows, k * k)
+        return out_b, out_g
 
     def body(_, ch):
         s, wb, wg, lc = ch  # (P, CB, B_E)

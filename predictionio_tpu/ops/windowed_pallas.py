@@ -1,34 +1,31 @@
-"""Pallas TPU kernel for the fused windowed ALS edge pass.
+"""Pallas TPU kernel for the windowed ALS edge pass (per-chunk).
 
-Replaces the device half of ops/windowed.windowed_gram_b (the XLA scan
-path) with one kernel that keeps every per-edge intermediate in VMEM:
+Replaces the one-hot contraction inside ops/windowed.windowed_gram_b's
+chunk scan: the XLA path materializes, per chunk, the (CB, B_E, S)
+one-hot and the (CB, B_E, K+K²) outer-product payload in HBM (together
+~40 GB of write+read traffic per ML-20M edge pass); this kernel builds
+both in VMEM and emits only the per-block (S, K) / (S, K²) partial sums
+— the same partials the XLA path produces — so the existing block-level
+segment-sum combine is unchanged.
 
-- the (B_E, S) one-hot is built from an iota compare and never touches
-  HBM (the XLA path materializes it per chunk: write + read ≈
-  2·E_p·S·4 B ≈ 21 GB per ML-20M edge pass);
-- the (B_E, K²) outer-product payload is built in-register from the
-  gathered factor rows and never touches HBM either (the XLA path
-  materializes the concatenated (B_E, K+K²) payload per chunk ≈ another
-  18 GB per pass);
-- per-window output tiles accumulate in VMEM across consecutive blocks
-  (the grid walks blocks in non-decreasing window order, so the output
-  index map revisits the same tile until the window changes — the
-  standard TPU reduction idiom), eliminating the (n_blocks, S, D)
-  partials array and the final segment-sum combine.
+The kernel stays INSIDE the scan (one pallas_call per chunk, grid = one
+step per block) rather than spanning the whole edge list: a whole-pass
+kernel needs the gathered factor rows for every edge materialized at
+once (~GBs, plus a relayout at the pallas boundary), which measured
+SLOWER than the XLA path at ML-20M; per chunk the gather stays small
+and overlaps the kernel through XLA's scheduler.
 
-Remaining HBM traffic per pass ≈ one read of the gathered factor rows
-(E_p·K·4 B), the edge weights, and one write of the (n_windows·S, K+K²)
-output — an order of magnitude below the XLA path at ML-20M shapes.
-
-Weights are folded into the ONE-HOT (not the payload): b uses
-onehot·w_b, gram uses onehot·w_g, so the kernel needs no (B_E, 1)
-transposes and emits b and the flat gram correction as two outputs.
+Everything edge-indexed keeps the 1024-wide edge axis in LANES (factor
+rows arrive transposed (K, B_E)): the (K², B_E) outer product is a
+sublane concat of full-lane pieces, so VMEM holds no lane-padded narrow
+arrays, and both contractions run edge-axis against edge-axis on the
+MXU with no in-kernel transposes.
 
 Integration: ops/windowed.windowed_gram_b dispatches here when
 `PIO_PALLAS_WINDOWED` allows it (default: on when the default device is
 a TPU; `0` forces the XLA path; `interpret` runs this kernel through the
 Pallas interpreter on CPU — how tests/test_windowed_pallas.py checks
-bit-level agreement with the XLA path).
+agreement with the XLA path).
 """
 
 from __future__ import annotations
@@ -39,33 +36,16 @@ import jax
 import jax.numpy as jnp
 
 
-def _kernel(bw_ref, yt_ref, wb_ref, wg_ref, local_ref, b_ref, g_ref):
+def _kernel(yt_ref, wb_ref, wg_ref, local_ref, b_ref, g_ref):
     """One grid step = one edge block.
 
-    b_window    += (onehot·w_b) @ yᵀ
-    gram_window += (onehot·w_g) @ [yᵀ_i·yᵀ_j for (i,j) in K×K]ᵀ
-
-    Everything edge-indexed keeps the 1024-wide edge axis in LANES
-    (factor rows arrive transposed (K, B_E)): the (K², B_E) outer
-    product is a sublane concat of full-lane pieces, so VMEM holds no
-    lane-padded narrow arrays, and both contractions run edge-axis
-    against edge-axis on the MXU with no in-kernel transposes.
+    b_partial    = (onehot·w_b) @ yᵀ          (S, K)
+    gram_partial = (onehot·w_g) @ outer(y)ᵀ   (S, K²)
     """
-    from jax.experimental import pallas as pl
-
-    step = pl.program_id(0)
-    prev = bw_ref[jnp.maximum(step - 1, 0)]
-    new_window = (step == 0) | (prev != bw_ref[step])
-
-    @pl.when(new_window)
-    def _zero():
-        b_ref[...] = jnp.zeros_like(b_ref)
-        g_ref[...] = jnp.zeros_like(g_ref)
-
-    yt = yt_ref[0]  # (K, B_E) f32 — gathered fixed-side factor rows, transposed
+    yt = yt_ref[0]  # (K, B_E) f32 — gathered fixed-side rows, transposed
     k = yt.shape[0]
     lid = local_ref[0]  # (1, B_E) int32; padding slots carry w_b=w_g=0
-    s_rows = b_ref.shape[0]
+    s_rows = b_ref.shape[1]
     rows = jax.lax.broadcasted_iota(jnp.int32, (s_rows, lid.shape[1]), 0)
     onehot = (rows == lid).astype(jnp.float32)  # (S, B_E) — VMEM only
 
@@ -76,48 +56,38 @@ def _kernel(bw_ref, yt_ref, wb_ref, wg_ref, local_ref, b_ref, g_ref):
         # HIGHEST: CG consumes these sums; one bf16 MXU pass loses ~2^-8
         precision=jax.lax.Precision.HIGHEST,
     )
-    b_ref[...] += dot_e(onehot * wb_ref[0], yt)
+    b_ref[0] = dot_e(onehot * wb_ref[0], yt)
     # outer_t[i*K+j, e] = y[e,i]·y[e,j] — K sublane-stacked (K, B_E) pieces
     outer_t = jnp.concatenate(
         [yt * yt[i : i + 1, :] for i in range(k)], axis=0
     )  # (K², B_E)
-    g_ref[...] += dot_e(onehot * wg_ref[0], outer_t)
+    g_ref[0] = dot_e(onehot * wg_ref[0], outer_t)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("n_windows", "s_rows", "interpret")
-)
-def windowed_pass(
-    y_t: jax.Array,  # (n_blocks_p, K, B_E) f32 — factors[src] per block,
-    # TRANSPOSED so the wide edge axis sits in lanes (the (·, K) layout
-    # would cost a 12.8× lane-padding relayout at the pallas boundary)
-    w_b: jax.Array,  # (n_blocks_p, B_E) f32 — b-vector edge weights (0 on pads)
-    w_g: jax.Array,  # (n_blocks_p, B_E) f32 — gram edge weights (0 on pads)
-    local: jax.Array,  # (n_blocks_p, B_E) int32 — dst % s_rows (arbitrary
-    # values outside [0, s_rows) on padding slots never match a row)
-    block_window: jax.Array,  # (n_blocks_p,) int32, NON-DECREASING
+@functools.partial(jax.jit, static_argnames=("s_rows", "interpret"))
+def block_partials(
+    y_t: jax.Array,  # (CB, K, B_E) f32 — factors[src] per block, TRANSPOSED
+    # so the wide edge axis sits in lanes (a (·, B_E, K) layout would cost
+    # a 12.8× lane-pad relayout at the pallas boundary)
+    w_b: jax.Array,  # (CB, B_E) f32 — b-vector edge weights (0 on pads)
+    w_g: jax.Array,  # (CB, B_E) f32 — gram edge weights (0 on pads)
+    local: jax.Array,  # (CB, B_E) int32 — dst % s_rows (arbitrary values
+    # outside [0, s_rows) on padding slots never match a one-hot row)
     *,
-    n_windows: int,
     s_rows: int = 128,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
-    """Fused edge pass → (b ((n_windows+1)·S, K), gram ((n_windows+1)·S, K²)).
+    """One chunk's per-block partial sums → ((CB, S, K), (CB, S, K²)).
 
-    b[w·S + r]    = Σ_{blocks b of w} Σ_{e: local=r} w_b[e] · y[e]
-    gram[w·S + r] = Σ_{blocks b of w} Σ_{e: local=r} w_g[e] · y[e] ⊗ y[e]
+    partial_b[c, r]    = Σ_{e in block c: local=r} w_b[e] · y[e]
+    partial_gram[c, r] = Σ_{e in block c: local=r} w_g[e] · y[e] ⊗ y[e]
 
-    The output is over-allocated by one window and callers trim to
-    n_windows·S rows; tiles of windows NO block maps to (including that
-    spare window) are never written and hold garbage — the caller masks
-    them (windowed.windowed_gram_b's covered-mask). plan_windows gives
-    padding blocks the window id of their part's last real block (zero
-    weights, zero contribution), keeping block_window non-decreasing —
-    the invariant that makes the VMEM window accumulation exact.
+    Callers (windowed_gram_b) segment-sum the block partials into window
+    rows exactly as they do for the XLA einsum path.
     """
     # lazy: pallas.tpu cannot always import in a CPU-only process (tests
     # force a CPU platform and strip the TPU plugin)
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     n_blocks, k, b_e = y_t.shape
     # Mosaic requires the last two block dims to divide (8, 128) or equal
@@ -125,31 +95,25 @@ def windowed_pass(
     w_b = w_b.reshape(n_blocks, 1, b_e)
     w_g = w_g.reshape(n_blocks, 1, b_e)
     local = local.reshape(n_blocks, 1, b_e)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_blocks,),
-        in_specs=[
-            pl.BlockSpec((1, k, b_e), lambda i, bw: (i, 0, 0)),
-            pl.BlockSpec((1, 1, b_e), lambda i, bw: (i, 0, 0)),
-            pl.BlockSpec((1, 1, b_e), lambda i, bw: (i, 0, 0)),
-            pl.BlockSpec((1, 1, b_e), lambda i, bw: (i, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((s_rows, k), lambda i, bw: (bw[i], 0)),
-            pl.BlockSpec((s_rows, k * k), lambda i, bw: (bw[i], 0)),
-        ],
-    )
     return pl.pallas_call(
         _kernel,
-        grid_spec=grid_spec,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((1, k, b_e), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, b_e), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, b_e), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, b_e), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, s_rows, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, s_rows, k * k), lambda i: (i, 0, 0)),
+        ],
         out_shape=[
-            jax.ShapeDtypeStruct(((n_windows + 1) * s_rows, k), jnp.float32),
-            jax.ShapeDtypeStruct(
-                ((n_windows + 1) * s_rows, k * k), jnp.float32
-            ),
+            jax.ShapeDtypeStruct((n_blocks, s_rows, k), jnp.float32),
+            jax.ShapeDtypeStruct((n_blocks, s_rows, k * k), jnp.float32),
         ],
         interpret=interpret,
-    )(block_window, y_t, w_b, w_g, local)
+    )(y_t, w_b, w_g, local)
 
 
 def available() -> bool:

@@ -79,6 +79,48 @@ def stage_replicated(mesh: Mesh, array: np.ndarray) -> jax.Array:
     return jax.make_array_from_process_local_data(sharding, array, array.shape)
 
 
+def allgather_rows(*local_arrays: np.ndarray) -> tuple:
+    """Reassemble full per-edge arrays from per-process PARTITIONED reads.
+
+    Each process passes only the rows it streamed from its storage shard
+    (EventQuery.shard — the HBPEvents.scala:84-90 partitioned-scan role);
+    this gathers them into identical full host arrays on every process
+    so shape-global staging (e.g. the windowed ALS plan) can run. The
+    shuffle rides jax's cross-process transport (the reference's
+    equivalent data motion is the Spark shuffle after partitioned HBase
+    scans), not the storage daemon — storage read bandwidth is divided
+    by process count, which is the point.
+
+    Local row counts may differ per process; rows are concatenated in
+    process order. Returns numpy arrays."""
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return tuple(np.asarray(a) for a in local_arrays)
+    n_local = local_arrays[0].shape[0]
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.array([n_local], np.int64))
+    ).reshape(-1)
+    pad_to = int(counts.max())
+    out = []
+    for a in local_arrays:
+        if a.shape[0] != n_local:
+            raise ValueError("all arrays must share axis-0 length")
+        if pad_to > n_local:
+            a = np.concatenate(
+                [a, np.zeros((pad_to - n_local,) + a.shape[1:], a.dtype)]
+            )
+        gathered = np.asarray(multihost_utils.process_allgather(a))
+        gathered = gathered.reshape((-1,) + a.shape[1:])
+        # strip each process's padding rows (counts are authoritative)
+        parts = [
+            gathered[p * pad_to : p * pad_to + counts[p]]
+            for p in range(len(counts))
+        ]
+        out.append(np.concatenate(parts))
+    return tuple(out)
+
+
 def stage_edges(
     mesh: Mesh,
     rows: np.ndarray,

@@ -296,6 +296,8 @@ class _BatchDispatcher:
             max_workers=self.pipeline_depth, thread_name_prefix="query-batch"
         )
         self._inflight = threading.BoundedSemaphore(self.pipeline_depth)
+        self._active_lock = threading.Lock()
+        self._active = 0
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -337,9 +339,8 @@ class _BatchDispatcher:
                 dict(algo.batch_predict(algo.serving_context, model, queries))
                 for algo, model in zip(rt.algorithms, rt.models)
             ]
-            self.owner.bookkeep_predict(
-                time.perf_counter() - t0, len(group)
-            )
+            self.last_batch_sec = time.perf_counter() - t0
+            self.owner.bookkeep_predict(self.last_batch_sec, len(group))
             for i, (q, fut) in enumerate(group):
                 try:
                     fut.set_result(
@@ -370,24 +371,66 @@ class _BatchDispatcher:
                 first = self._queue.get(timeout=0.2)
             except _q.Empty:
                 continue
+            # Drain policy (VERDICT r3 #3, measured on the axon tunnel):
+            # grab everything already queued; once the queue is dry,
+            # dispatch IMMEDIATELY if nothing is in flight (the pipeline
+            # is idle — any wait is pure dead time, and a lone idle
+            # query sees zero added window latency), else linger up to
+            # max_window for more arrivals — the in-flight batch is
+            # already occupying the (request-serialized) device path, so
+            # waiting costs nothing and yields one deep batch per device
+            # cycle instead of fragments that only queue behind it.
+            # The linger bound tracks the measured in-flight batch time
+            # (waiting is free exactly until that batch retires), floored
+            # by max_window for the cold start.
             batch = [first]
-            deadline = _t.monotonic() + self.window_s
+            hard_deadline = _t.monotonic() + max(
+                self.max_window_s,
+                getattr(self, "last_batch_sec", 0.0) * 1.2,
+            )
             while len(batch) < self.max_batch:
-                remaining = deadline - _t.monotonic()
+                try:
+                    batch.append(self._queue.get_nowait())
+                    continue
+                except _q.Empty:
+                    pass
+                with self._active_lock:
+                    active = self._active
+                if active == 0:
+                    # pipeline idle: dispatch once the arrival stream
+                    # pauses. Under recent load the pause threshold
+                    # scales with the measured batch time (a closed-loop
+                    # response burst spreads over tens of ms; splitting
+                    # it costs a full device round-trip per fragment);
+                    # after a quiet second it drops back to min_window so
+                    # sporadic queries keep near-zero added latency.
+                    patience = self.min_window_s
+                    if (
+                        _t.monotonic() - getattr(self, "_last_dispatch", 0.0)
+                        < 1.0
+                    ):
+                        patience = max(
+                            patience,
+                            min(
+                                0.1 * getattr(self, "last_batch_sec", 0.0),
+                                0.02,
+                            ),
+                        )
+                    try:
+                        batch.append(self._queue.get(timeout=patience))
+                        continue
+                    except _q.Empty:
+                        break
+                remaining = hard_deadline - _t.monotonic()
                 if remaining <= 0:
                     break
                 try:
-                    batch.append(self._queue.get(timeout=remaining))
+                    batch.append(
+                        self._queue.get(timeout=min(remaining, 0.002))
+                    )
                 except _q.Empty:
-                    break
-            # adapt the window: saturation (hit max_batch before the
-            # deadline) means queue pressure — grow toward max_window so
-            # the next drain batches deeper; light traffic decays back so
-            # idle-path latency stays near the minimum
-            if len(batch) >= self.max_batch:
-                self.window_s = min(self.window_s * 1.5, self.max_window_s)
-            elif len(batch) <= 2:
-                self.window_s = max(self.window_s * 0.7, self.min_window_s)
+                    pass
+            self.window_s = self.min_window_s  # status display only
             # group by runtime snapshot: queries spanning a /reload are
             # served by the runtime they were extracted against
             groups: dict[int, tuple[Any, list]] = {}
@@ -403,11 +446,16 @@ class _BatchDispatcher:
                         break
                 if acquired:
                     try:
+                        with self._active_lock:
+                            self._active += 1
+                        self._last_dispatch = _t.monotonic()
                         self._pool.submit(
                             self._run_group_released, rt, group
                         )
                         continue
                     except RuntimeError:  # pool already shut down
+                        with self._active_lock:
+                            self._active -= 1
                         self._inflight.release()
                 for _q2, fut in group:
                     if not fut.done():
@@ -419,6 +467,8 @@ class _BatchDispatcher:
         try:
             self._run_group(rt, group)
         finally:
+            with self._active_lock:
+                self._active -= 1
             self._inflight.release()
 
 
