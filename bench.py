@@ -601,7 +601,10 @@ def bench_ur_framework():
         "datasource": {"params": {
             "app_name": "urbench", "indicators": ["buy"],
         }},
-        "algorithms": [{"name": "ur", "params": {}}],
+        "algorithms": [{
+            "name": "ur",
+            "params": {"app_name": "urbench", "indicators": ["buy"]},
+        }],
     }
     run_train(storage, variant)
     runtime = latest_completed_runtime(storage, "benchur", "0", "benchur")

@@ -34,6 +34,11 @@ class MemoryEventStore(base.EventStore):
         self._ns: dict[tuple[int, Optional[int]], dict[str, Event]] = {}
         # (app_id, channel_id) → write version (bumped on every mutation)
         self._versions: dict[tuple[int, Optional[int]], int] = {}
+        # (app_id, channel_id) → {entity_id: {event_id}} — serving-time
+        # history lookups (LEventStore find-by-entity) must not scan the
+        # whole namespace; this is the role of the reference's HBase
+        # row-key prefix (entity-first key design, HBEventsUtil.scala)
+        self._by_entity: dict[tuple, dict[str, set]] = {}
 
     def _bump(self, app_id: int, channel_id: Optional[int]) -> None:
         key = self._key(app_id, channel_id)
@@ -50,6 +55,7 @@ class MemoryEventStore(base.EventStore):
     def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self._lock:
             self._ns.pop(self._key(app_id, channel_id), None)
+            self._by_entity.pop(self._key(app_id, channel_id), None)
         return True
 
     def _table(self, app_id: int, channel_id: Optional[int]) -> dict[str, Event]:
@@ -59,12 +65,23 @@ class MemoryEventStore(base.EventStore):
             self._ns[key] = {}
         return self._ns[key]
 
+    def _index(self, app_id, channel_id) -> dict[str, set]:
+        return self._by_entity.setdefault(self._key(app_id, channel_id), {})
+
     def insert(
         self, event: Event, app_id: int, channel_id: Optional[int] = None
     ) -> str:
         with self._lock:
             eid = event.event_id or new_event_id()
+            prev = self._table(app_id, channel_id).get(eid)
+            if prev is not None:  # overwrite: re-home the entity index
+                self._index(app_id, channel_id).get(
+                    prev.entity_id, set()
+                ).discard(eid)
             self._table(app_id, channel_id)[eid] = event.with_id(eid)
+            self._index(app_id, channel_id).setdefault(
+                event.entity_id, set()
+            ).add(eid)
             self._bump(app_id, channel_id)
             return eid
 
@@ -72,10 +89,13 @@ class MemoryEventStore(base.EventStore):
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
     ) -> bool:
         with self._lock:
-            hit = self._table(app_id, channel_id).pop(event_id, None) is not None
-            if hit:
+            prev = self._table(app_id, channel_id).pop(event_id, None)
+            if prev is not None:
+                self._index(app_id, channel_id).get(
+                    prev.entity_id, set()
+                ).discard(event_id)
                 self._bump(app_id, channel_id)
-            return hit
+            return prev is not None
 
     def get(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
@@ -85,7 +105,15 @@ class MemoryEventStore(base.EventStore):
 
     def find(self, query: EventQuery) -> Iterator[Event]:
         with self._lock:
-            events = list(self._table(query.app_id, query.channel_id).values())
+            table = self._table(query.app_id, query.channel_id)
+            if query.entity_id is not None:
+                # indexed path: only that entity's events are touched
+                ids = self._index(
+                    query.app_id, query.channel_id
+                ).get(query.entity_id, ())
+                events = [table[i] for i in ids if i in table]
+            else:
+                events = list(table.values())
         events = [e for e in events if query.matches(e)]
         events.sort(key=lambda e: (e.event_time, e.event_id or ""), reverse=query.reversed)
         if query.limit is not None and query.limit >= 0:

@@ -1,0 +1,3 @@
+from predictionio_tpu.engines.friendrec.engine import FriendRecommendationEngine
+
+__all__ = ["FriendRecommendationEngine"]

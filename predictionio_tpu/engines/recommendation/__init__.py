@@ -2,6 +2,9 @@ from predictionio_tpu.engines.recommendation.engine import (
     ALSAlgorithm,
     ALSAlgorithmParams,
     DataSourceParams,
+    FileDataSourceParams,
+    FileRatingsDataSource,
+    FileRecommendationEngine,
     ItemScore,
     PredictedResult,
     Query,
@@ -14,6 +17,9 @@ __all__ = [
     "ALSAlgorithm",
     "ALSAlgorithmParams",
     "DataSourceParams",
+    "FileDataSourceParams",
+    "FileRatingsDataSource",
+    "FileRecommendationEngine",
     "ItemScore",
     "PredictedResult",
     "Query",

@@ -528,3 +528,74 @@ class RecommendationEngine(EngineFactory):
             {"als": ALSAlgorithm},
             FirstServing,
         )
+
+
+# ---------------------------------------------------------------------------
+# Custom (foreign-store) data source — the DataSource SPI demo
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FileDataSourceParams:
+    filepath: str
+    delimiter: str = "::"  # MovieLens ratings.dat convention
+
+
+class FileRatingsDataSource(DataSource):
+    """The DataSource SPI against a FOREIGN store: `user::item::rating`
+    lines from a delimited text file, no event store involved.
+
+    Reference: examples/experimental/
+    scala-parallel-recommendation-custom-datasource/DataSource.scala:24-33
+    (sc.textFile + split, swapped into the stock recommendation engine) —
+    the demo that the DASE contract only requires `read_training`, not
+    the framework's own storage. The mongo-datasource experimental demo
+    plays the same role against MongoDB; any `read_training` returning
+    TrainingData slots into the engine identically."""
+
+    def __init__(self, params: FileDataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        from predictionio_tpu.data.store.bimap import BiMap
+
+        users: dict[str, int] = {}
+        items: dict[str, int] = {}
+        rows, cols, vals = [], [], []
+        with open(self.params.filepath) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split(self.params.delimiter)
+                if len(parts) < 3:
+                    raise ValueError(
+                        f"bad ratings line (want user{self.params.delimiter}"
+                        f"item{self.params.delimiter}rating): {line!r}"
+                    )
+                u, i, r = parts[0], parts[1], float(parts[2])
+                rows.append(users.setdefault(u, len(users)))
+                cols.append(items.setdefault(i, len(items)))
+                vals.append(r)
+        return TrainingData(
+            rows=np.asarray(rows, np.int32),
+            cols=np.asarray(cols, np.int32),
+            vals=np.asarray(vals, np.float32),
+            n_users=len(users),
+            n_items=len(items),
+            user_vocab=BiMap(users),
+            item_vocab=BiMap(items),
+        )
+
+
+class FileRecommendationEngine(EngineFactory):
+    """The stock recommendation engine with the file-backed DataSource
+    swapped in — everything downstream (ALS, serving, deploy) unchanged."""
+
+    def apply(self) -> Engine:
+        return Engine(
+            FileRatingsDataSource,
+            IdentityPreparator,
+            {"als": ALSAlgorithm},
+            FirstServing,
+        )

@@ -1,0 +1,3 @@
+from predictionio_tpu.engines.simrank.engine import SimRankEngine
+
+__all__ = ["SimRankEngine"]

@@ -1,0 +1,157 @@
+"""SimRank engine: graph-structural friend/node recommendation.
+
+Reference: examples/experimental/scala-parallel-friend-recommendation —
+SimRankAlgorithm.scala + DeltaSimRankRDD.scala compute SimRank over the
+(subsampled, Sampling.scala) social graph and answer (user, user) /
+top-similar queries. Here the graph comes from relation events between
+entities of one type ("follow"/"friend"), the similarity matrix is the
+dense MXU iteration in models/simrank.py, and serving reads rows of the
+trained matrix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    SanityCheck,
+)
+from predictionio_tpu.core.base import RuntimeContext
+from predictionio_tpu.data.store.bimap import BiMap
+from predictionio_tpu.data.store.event_store import EventStoreFacade
+from predictionio_tpu.models import simrank
+
+
+@dataclass
+class Query:
+    user: str
+    user2: Optional[str] = None  # pair query: similarity of (user, user2)
+    num: int = 10  # top-N query when user2 is absent
+
+
+@dataclass
+class UserScore:
+    user: str
+    score: float
+
+
+@dataclass
+class PredictedResult:
+    user_scores: list[UserScore] = field(default_factory=list)
+    similarity: Optional[float] = None  # set on pair queries
+
+
+@dataclass
+class DataSourceParams:
+    app_name: str
+    event_names: tuple[str, ...] = ("follow",)
+    entity_type: str = "user"
+    # dense SimRank is O(N²) memory; refuse graphs beyond this size the
+    # same way the reference demo SUBSAMPLES its graph (Sampling.scala)
+    max_nodes: int = 20_000
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    src: np.ndarray  # (E,) node idx
+    dst: np.ndarray  # (E,)
+    node_vocab: BiMap
+
+    def sanity_check(self) -> None:
+        if len(self.src) == 0:
+            raise ValueError("no relation events found")
+
+
+class SimRankDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        frame = EventStoreFacade(ctx.storage).find_frame(
+            app_name=self.params.app_name,
+            entity_type=self.params.entity_type,
+            event_names=list(self.params.event_names),
+        )
+        mask = frame.target_idx >= 0
+        # one shared node space: source entities and target entities are
+        # both users — merge the two vocabularies
+        vocab = dict(frame.entity_vocab.to_dict())
+        for name, _ix in frame.target_vocab.to_dict().items():
+            if name not in vocab:
+                vocab[name] = len(vocab)
+        if len(vocab) > self.params.max_nodes:
+            raise ValueError(
+                f"graph has {len(vocab)} nodes > max_nodes="
+                f"{self.params.max_nodes}; dense SimRank is O(N²) — "
+                "subsample upstream (reference Sampling.scala does the same)"
+            )
+        node_vocab = BiMap(vocab)
+        inv_e = frame.entity_vocab.inverse()
+        inv_t = frame.target_vocab.inverse()
+        src = np.asarray(
+            [vocab[inv_e(int(i))] for i in frame.entity_idx[mask]],
+            dtype=np.int64,
+        )
+        dst = np.asarray(
+            [vocab[inv_t(int(i))] for i in frame.target_idx[mask]],
+            dtype=np.int64,
+        )
+        return TrainingData(src=src, dst=dst, node_vocab=node_vocab)
+
+
+@dataclass
+class SimRankAlgorithmParams:
+    iterations: int = 5
+    decay: float = 0.8  # DeltaSimRankRDD.scala:15 default
+
+
+class SimRankAlgorithm(Algorithm):
+    def __init__(self, params: SimRankAlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> simrank.SimRankModel:
+        return simrank.compute(
+            pd.src, pd.dst, len(pd.node_vocab),
+            iterations=self.params.iterations,
+            decay=self.params.decay,
+            node_vocab=pd.node_vocab,
+        )
+
+    def predict(
+        self, model: simrank.SimRankModel, query: Query
+    ) -> PredictedResult:
+        ix = model.node_vocab.get(query.user)
+        if ix is None:
+            return PredictedResult()
+        if query.user2 is not None:
+            jx = model.node_vocab.get(query.user2)
+            sim = float(model.scores[ix, jx]) if jx is not None else 0.0
+            return PredictedResult(similarity=sim)
+        vals, idx = model.top_k(int(ix), query.num)
+        inv = model.node_vocab.inverse()
+        return PredictedResult(
+            user_scores=[
+                UserScore(user=inv(int(j)), score=float(v))
+                for v, j in zip(vals, idx)
+                if v > 0.0
+            ]
+        )
+
+
+class SimRankEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            SimRankDataSource,
+            IdentityPreparator,
+            {"simrank": SimRankAlgorithm},
+            FirstServing,
+        )

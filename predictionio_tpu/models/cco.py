@@ -56,9 +56,10 @@ def llr_scores(
 
 @partial(jax.jit, static_argnames=("top_n", "exclude_diagonal"))
 def _cco_topn(
-    primary: jax.Array,  # (U, I) binarized (possibly zero-padded rows)
+    primary: jax.Array,  # (U, I_blk) binarized (possibly zero-padded rows)
     secondary: jax.Array,  # (U, J) binarized
     n_users: jax.Array,  # scalar — TRUE user count (padding rows excluded)
+    diag_offset: jax.Array,  # scalar — primary block's start column
     *,
     top_n: int,
     exclude_diagonal: bool,
@@ -67,14 +68,17 @@ def _cco_topn(
         primary, secondary,
         dimension_numbers=(((0,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
-    )  # (I, J) — MXU, user dim contracted (psum over dp shards)
+    )  # (I_blk, J) — MXU, user dim contracted (psum over dp shards)
     prim_totals = jnp.sum(primary, axis=0)
     sec_totals = jnp.sum(secondary, axis=0)
     llr = llr_scores(counts, prim_totals, sec_totals, n_users)
     exclude = counts <= 0  # never correlate never-co-occurring pairs
     if exclude_diagonal:
-        eye = jnp.eye(llr.shape[0], llr.shape[1], dtype=bool)
-        exclude = exclude | eye
+        # the diagonal of the GLOBAL (I, I) matrix: global row index =
+        # diag_offset + local row (item blocking shifts the block)
+        r = jnp.arange(llr.shape[0], dtype=jnp.int32)[:, None] + diag_offset
+        c = jnp.arange(llr.shape[1], dtype=jnp.int32)[None, :]
+        exclude = exclude | (r == c)
     vals, idx = masked_top_k(llr, top_n, exclude)
     idx = jnp.where(vals > 0.0, idx, -1)  # llr 0 → not a correlator
     return vals, idx
@@ -95,14 +99,23 @@ def cross_occurrence_topn(
     top_n: int,
     self_indicator: bool = False,
     mesh: Optional[jax.sharding.Mesh] = None,
+    block_items: int = 8192,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Per primary item: top correlator columns of `secondary` by LLR.
 
     Returns (scores (I, top_n), indices (I, top_n)) with -1 index padding.
     `self_indicator` excludes the diagonal (an item trivially co-occurs
-    with itself)."""
+    with itself).
+
+    The primary item axis is processed in `block_items`-column blocks so
+    the (I_blk, J) LLR intermediate stays bounded — a 100k-item catalog's
+    dense (I, I) matrix alone would be 40 GB, past single-chip HBM. Rows
+    are independent through LLR and top-k, so blocking is exact. (The
+    Mahout reference handles this scale with sparse shuffles; blocking is
+    the dense-MXU equivalent.)"""
     top_n = min(top_n, secondary.shape[1])
     true_n_users = primary.shape[0]
+    n_items = primary.shape[1]
     if mesh is not None:
         # pad the user dim so it shards evenly; zero rows are inert in the
         # counts/totals and the true user count is passed separately for LLR
@@ -112,11 +125,28 @@ def cross_occurrence_topn(
     else:
         p = jnp.asarray(primary)
         s = jnp.asarray(secondary)
-    vals, idx = _cco_topn(
-        p, s, jnp.float32(true_n_users),
-        top_n=top_n, exclude_diagonal=self_indicator,
-    )
-    return np.asarray(vals), np.asarray(idx)
+    if n_items <= block_items:
+        vals, idx = _cco_topn(
+            p, s, jnp.float32(true_n_users), jnp.int32(0),
+            top_n=top_n, exclude_diagonal=self_indicator,
+        )
+        return np.asarray(vals), np.asarray(idx)
+    # one compiled program serves every block: pad the last block's
+    # columns with zero items (counts 0 → excluded → idx -1)
+    out_vals = np.empty((n_items, top_n), np.float32)
+    out_idx = np.empty((n_items, top_n), np.int32)
+    for lo in range(0, n_items, block_items):
+        hi = min(lo + block_items, n_items)
+        blk = p[:, lo:hi]
+        if hi - lo < block_items:
+            blk = jnp.pad(blk, ((0, 0), (0, block_items - (hi - lo))))
+        vals, idx = _cco_topn(
+            blk, s, jnp.float32(true_n_users), jnp.int32(lo),
+            top_n=top_n, exclude_diagonal=self_indicator,
+        )
+        out_vals[lo:hi] = np.asarray(vals)[: hi - lo]
+        out_idx[lo:hi] = np.asarray(idx)[: hi - lo]
+    return out_vals, out_idx
 
 
 def score_history(

@@ -1,0 +1,103 @@
+"""SimRank node-pair similarity as dense MXU matmul iteration.
+
+Reference: examples/experimental/scala-parallel-friend-recommendation/
+DeltaSimRankRDD.scala:14-50 — delta-SimRank over GraphX with per-pair
+cartesian joins and reduceByKey shuffles (a sparsity optimization Spark
+needs because each iteration is an all-pairs shuffle).
+
+TPU-first re-design (NOT a port): SimRank's fixed point
+    S(a,b) = C / (|I(a)||I(b)|) · Σ_{i∈I(a), j∈I(b)} S(i,j),  S(a,a)=1
+is exactly the matrix iteration
+    S ← C · Wᵀ S W,  then  diag(S) ← 1
+with W the column-normalized in-adjacency (W[i, v] = 1/|I(v)| for
+i ∈ I(v)). Two (N, N) matmuls per iteration run on the MXU — for the
+graph sizes the reference demo handles (its SimRank example subsamples
+to thousands of nodes; Sampling.scala) the dense form is both simpler
+and faster than simulating the shuffle, and it is exact rather than
+delta-approximate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.data.store.bimap import BiMap
+
+
+@dataclass
+class SimRankModel:
+    scores: np.ndarray  # (N, N) float32 similarity matrix
+    node_vocab: BiMap
+
+    def top_k(self, node_idx: int, k: int) -> tuple[np.ndarray, np.ndarray]:
+        """(scores, indices) of the k most similar OTHER nodes."""
+        row = self.scores[node_idx].copy()
+        row[node_idx] = -np.inf  # exclude self
+        top = np.argsort(-row)[:k]
+        return row[top], top
+
+
+@partial(jax.jit, static_argnames=("iterations",))
+def _simrank_jit(w: jax.Array, *, iterations: int, decay: float) -> jax.Array:
+    n = w.shape[0]
+    eye = jnp.eye(n, dtype=jnp.float32)
+
+    def body(_, s):
+        s = decay * (w.T @ s @ w)
+        # pin the diagonal to 1 (the SimRank base case)
+        return s * (1.0 - eye) + eye
+
+    return jax.lax.fori_loop(0, iterations, body, eye)
+
+
+def compute(
+    src: np.ndarray,  # (E,) edge sources (node indices)
+    dst: np.ndarray,  # (E,) edge destinations
+    n_nodes: int,
+    iterations: int = 5,
+    decay: float = 0.8,
+    node_vocab: BiMap | None = None,
+) -> SimRankModel:
+    """SimRank over a directed edge list. O(N²) memory — intended for the
+    reference demo's scale (subsampled graphs of ~10³-10⁴ nodes)."""
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    adj = np.zeros((n_nodes, n_nodes), dtype=np.float32)
+    adj[src, dst] = 1.0  # duplicate edges collapse (simple graph)
+    in_deg = adj.sum(axis=0)
+    w = adj / np.maximum(in_deg, 1.0)[None, :]
+    scores = np.asarray(
+        _simrank_jit(jnp.asarray(w), iterations=iterations, decay=decay)
+    )
+    return SimRankModel(scores=scores, node_vocab=node_vocab or BiMap({}))
+
+
+def simrank_reference(
+    src: np.ndarray, dst: np.ndarray, n_nodes: int,
+    iterations: int = 5, decay: float = 0.8,
+) -> np.ndarray:
+    """O(N²·E) literal-definition SimRank — test oracle only."""
+    in_nb = [[] for _ in range(n_nodes)]
+    for s, d in zip(src, dst):
+        if s not in in_nb[d]:
+            in_nb[d].append(int(s))
+    s_mat = np.eye(n_nodes)
+    for _ in range(iterations):
+        nxt = np.zeros_like(s_mat)
+        for a in range(n_nodes):
+            for b in range(n_nodes):
+                if a == b:
+                    nxt[a, b] = 1.0
+                    continue
+                ia, ib = in_nb[a], in_nb[b]
+                if not ia or not ib:
+                    continue
+                acc = sum(s_mat[i, j] for i in ia for j in ib)
+                nxt[a, b] = decay * acc / (len(ia) * len(ib))
+        s_mat = nxt
+    return s_mat

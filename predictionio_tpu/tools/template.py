@@ -116,6 +116,49 @@ TEMPLATES: dict[str, Template] = {
             },
         ),
         Template(
+            "recommendation-file",
+            "predictionio_tpu.engines.recommendation",
+            "FileRecommendationEngine",
+            "recommendation with a custom FILE data source (DataSource SPI"
+            " against a foreign store)",
+            {
+                "datasource": {"params": {"filepath": "ratings.dat"}},
+                "algorithms": [
+                    {"name": "als", "params": {"rank": 10}},
+                ],
+            },
+        ),
+        Template(
+            "simrank",
+            "predictionio_tpu.engines.simrank",
+            "SimRankEngine",
+            "graph-structural friend recommendation (SimRank, MXU matmuls)",
+            {
+                "datasource": {"params": {"app_name": "MyApp"}},
+                "algorithms": [
+                    {
+                        "name": "simrank",
+                        "params": {"iterations": 5, "decay": 0.8},
+                    },
+                ],
+            },
+        ),
+        Template(
+            "friendrec",
+            "predictionio_tpu.engines.friendrec",
+            "FriendRecommendationEngine",
+            "keyword-profile similarity scoring (friend recommendation)",
+            {
+                "datasource": {"params": {"app_name": "MyApp"}},
+                "algorithms": [
+                    {
+                        "name": "keyword_similarity",
+                        "params": {"sim_weight": 1.0, "threshold": 1.0},
+                    },
+                ],
+            },
+        ),
+        Template(
             "universal",
             "predictionio_tpu.engines.universal",
             "UniversalRecommenderEngine",

@@ -40,7 +40,8 @@ def test_template_list(workdir):
     out = run_pio(["template", "list"], tmp, env)
     for name in (
         "recommendation", "similarproduct", "classification",
-        "ecommerce", "universal",
+        "ecommerce", "universal", "markov", "itemsim",
+        "simrank", "friendrec",
     ):
         assert name in out
 

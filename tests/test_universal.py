@@ -328,3 +328,21 @@ class TestDeviceBatchServing:
         # CPU-backend floor; the device path exists precisely so this does
         # not degrade to per-(query x indicator) numpy loops
         assert qps > 40, f"batched UR qps {qps:.0f}"
+
+
+def test_blocked_cco_matches_unblocked():
+    """Item-blocked CCO (the 1e5-catalog HBM fix) is exact vs single-shot."""
+    import numpy as np
+
+    from predictionio_tpu.models import cco
+
+    rng = np.random.RandomState(4)
+    P = (rng.rand(60, 300) < 0.1).astype(np.float32)
+    S = (rng.rand(60, 150) < 0.15).astype(np.float32)
+    for self_ind, sec in ((True, P), (False, S)):
+        v1, i1 = cco.cross_occurrence_topn(P, sec, 8, self_indicator=self_ind)
+        v2, i2 = cco.cross_occurrence_topn(
+            P, sec, 8, self_indicator=self_ind, block_items=64
+        )
+        np.testing.assert_allclose(v1, v2, rtol=1e-6)
+        assert (i1 == i2).all()
