@@ -45,6 +45,9 @@ BACKENDS: dict[str, tuple[str, str]] = {
     # document-store metadata backend (reference elasticsearch/ role):
     # JSON documents on a filesystem, one per row
     "docfs": ("predictionio_tpu.data.storage.docfs", "DocFS"),
+    # horizontally-sharded composite event store: N remote daemons,
+    # entity-hash routed (the reference's HBase region-server role)
+    "sharded": ("predictionio_tpu.data.storage.sharded", "Sharded"),
 }
 
 # DAO logical names → class suffix
