@@ -57,12 +57,16 @@ class ShardedEventStore(base.EventStore):
                 )
             from predictionio_tpu.data.storage.remote import RemoteEventStore
 
+            # child config inherits everything except SHARDS (AUTH_KEY,
+            # TIMEOUT, … — non-localhost daemons REQUIRE --auth-key)
+            child_cfg = {k: v for k, v in config.items() if k != "SHARDS"}
             self._stores = []
             for addr in addrs:
                 host, _, port = addr.rpartition(":")
                 self._stores.append(
-                    RemoteEventStore({"HOST": host or "127.0.0.1",
-                                      "PORT": port})
+                    RemoteEventStore(
+                        dict(child_cfg, HOST=host or "127.0.0.1", PORT=port)
+                    )
                 )
         if not self._stores:
             raise StorageError("sharded backend needs at least one shard")
@@ -74,12 +78,13 @@ class ShardedEventStore(base.EventStore):
     def _for_entity(self, entity_id: str) -> base.EventStore:
         return self._stores[shard_of(entity_id, self.n_shards)]
 
-    # -- lifecycle ---------------------------------------------------------
+    # -- lifecycle (list() defeats all()'s short-circuit: one failing
+    # shard must not leave later shards un-initialized / un-removed) ------
     def init_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        return all(s.init_app(app_id, channel_id) for s in self._stores)
+        return all([s.init_app(app_id, channel_id) for s in self._stores])
 
     def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        return all(s.remove_app(app_id, channel_id) for s in self._stores)
+        return all([s.remove_app(app_id, channel_id) for s in self._stores])
 
     def close(self) -> None:
         for s in self._stores:
@@ -89,9 +94,15 @@ class ShardedEventStore(base.EventStore):
     def insert(
         self, event: Event, app_id: int, channel_id: Optional[int] = None
     ) -> str:
-        return self._for_entity(event.entity_id).insert(
-            event, app_id, channel_id
-        )
+        home = self._for_entity(event.entity_id)
+        if event.event_id:
+            # explicit-id insert (import/replay/overwrite): the id may
+            # already live on a DIFFERENT shard if the entity changed —
+            # evict it there or get/delete-by-id would see two copies
+            for s in self._stores:
+                if s is not home:
+                    s.delete(event.event_id, app_id, channel_id)
+        return home.insert(event, app_id, channel_id)
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
@@ -100,10 +111,18 @@ class ShardedEventStore(base.EventStore):
         # input order for the returned ids (the batch API's per-event
         # status contract depends on positions)
         groups: dict[int, list[tuple[int, Event]]] = {}
+        explicit: list[tuple[int, str]] = []  # (home shard, event_id)
         for pos, e in enumerate(events):
-            groups.setdefault(
-                shard_of(e.entity_id, self.n_shards), []
-            ).append((pos, e))
+            sx = shard_of(e.entity_id, self.n_shards)
+            groups.setdefault(sx, []).append((pos, e))
+            if e.event_id:
+                explicit.append((sx, e.event_id))
+        # explicit-id replays: evict each id from every NON-home shard in
+        # one bulk delete per shard (see insert())
+        for sx in range(self.n_shards):
+            ids = [eid for home, eid in explicit if home != sx]
+            if ids:
+                self._stores[sx].delete_batch(ids, app_id, channel_id)
         out: list[Optional[str]] = [None] * len(events)
         for sx, pairs in groups.items():
             ids = self._stores[sx].insert_batch(
@@ -150,6 +169,7 @@ class ShardedEventStore(base.EventStore):
         if (
             query.shard is not None
             and query.shard[1] == self.n_shards
+            and 0 <= query.shard[0] < self.n_shards
         ):
             # the partitioned-read contract uses the SAME hash — shard i
             # of N lives entirely on child i: a direct single-daemon

@@ -202,3 +202,162 @@ def test_two_daemon_sharded_ingest_and_partitioned_read(tmp_path):
             p.terminate()
         for p in procs:
             p.wait(timeout=10)
+
+
+def test_two_daemons_two_processes_train(tmp_path):
+    """The full HBase picture: TWO daemons each holding one entity shard,
+    TWO jax.distributed processes each streaming ONLY its own daemon
+    (the sharded store routes shard=(i,2) straight to child i), factors
+    equal to a full-read train. Reuses test_partitioned_reads' child."""
+    from test_partitioned_reads import _CHILD, N_EDGES, N_ITEMS, N_USERS, RANK, ITERS
+
+    procs, ports = [], []
+    try:
+        for tag in (0, 1):
+            port = _free_port()
+            ports.append(port)
+            procs.append(subprocess.Popen(
+                [
+                    sys.executable, "-m",
+                    "predictionio_tpu.data.api.storage_server",
+                    "--host", "127.0.0.1", "--port", str(port),
+                ],
+                env=_daemon_env(tmp_path, tag), cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            ))
+        for port in ports:
+            _wait_health(port)
+        shards = ",".join(f"127.0.0.1:{p}" for p in ports)
+
+        # seed through the sharded client
+        rng = np.random.RandomState(7)
+        rows = rng.randint(0, N_USERS, N_EDGES)
+        cols = rng.randint(0, N_ITEMS, N_EDGES)
+        vals = rng.randint(1, 6, N_EDGES)
+        store = ShardedEventStore({"SHARDS": shards})
+        app_id = 9
+        store.init_app(app_id)
+        t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+        store.insert_batch(
+            [
+                Event(event="rate", entity_type="user", entity_id=f"u{r}",
+                      target_entity_type="item", target_entity_id=f"i{c}",
+                      properties={"rating": float(v)}, event_time=t0)
+                for r, c, v in zip(rows, cols, vals)
+            ],
+            app_id,
+        )
+
+        child_env = dict(os.environ)
+        child_env.update({
+            "PYTHONPATH": str(REPO) + os.pathsep + str(REPO / "tests")
+            + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            "PIO_STORAGE_SOURCES_SH_TYPE": "sharded",
+            "PIO_STORAGE_SOURCES_SH_SHARDS": shards,
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SH",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        })
+        coord_port = _free_port()
+        out_path = tmp_path / "factors.npz"
+        child = (
+            _CHILD.replace("{n_users}", str(N_USERS))
+            .replace("{n_items}", str(N_ITEMS))
+            .replace("{rank}", str(RANK))
+            .replace("{iters}", str(ITERS))
+        )
+        children = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-c", child,
+                    f"127.0.0.1:{coord_port}", str(pid), str(app_id),
+                    str(out_path),
+                ],
+                env=child_env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for pid in (0, 1)
+        ]
+        outs = [p.communicate(timeout=300) for p in children]
+        for p, (out, err) in zip(children, outs):
+            assert p.returncode == 0, f"child failed:\n{out}\n{err[-3000:]}"
+            assert "CHILD-OK" in out
+        shard_counts = {}
+        for out, _err in outs:
+            for line in out.splitlines():
+                if line.startswith("SHARD-ROWS"):
+                    _tag, pid, n = line.split()
+                    shard_counts[int(pid)] = int(n)
+        assert shard_counts[0] + shard_counts[1] == N_EDGES
+        assert 0 < shard_counts[0] < N_EDGES
+
+        with np.load(out_path) as z:
+            uf2, itf2 = z["uf"], z["itf"]
+
+        # reference: full-read train over the same gathered (shard 0 then
+        # shard 1) edge order
+        from predictionio_tpu.models import als
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        r_, c_, v_ = [], [], []
+        for s in range(2):
+            for e in store.find(EventQuery(app_id=app_id, shard=(s, 2))):
+                r_.append(int(e.entity_id[1:]))
+                c_.append(int(e.target_entity_id[1:]))
+                v_.append(float(e.properties.get("rating")))
+        ref = als.train(
+            np.asarray(r_, np.int32), np.asarray(c_, np.int32),
+            np.asarray(v_, np.float32), N_USERS, N_ITEMS,
+            als.ALSParams(rank=RANK, iterations=ITERS, implicit_prefs=True),
+            mesh=make_mesh(),
+        )
+        np.testing.assert_allclose(uf2, ref.user_factors, rtol=2e-3, atol=1e-4)
+        np.testing.assert_allclose(itf2, ref.item_factors, rtol=2e-3, atol=1e-4)
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+
+class TestShardedEdgeCases:
+    def test_explicit_id_insert_rehomes_across_shards(self):
+        store, children = _mk()
+        e1 = Event(event="rate", entity_type="user", entity_id="u1",
+                   target_entity_type="item", target_entity_id="i1",
+                   event_id="fixed-id")
+        store.insert(e1, 1)
+        # replay the same id under a DIFFERENT entity (different shard)
+        other = next(
+            f"u{k}" for k in range(50)
+            if shard_of(f"u{k}", 3) != shard_of("u1", 3)
+        )
+        e2 = Event(event="rate", entity_type="user", entity_id=other,
+                   target_entity_type="item", target_entity_id="i2",
+                   event_id="fixed-id")
+        store.insert(e2, 1)
+        live = [e for e in store.find(EventQuery(app_id=1))
+                if e.event_id == "fixed-id"]
+        assert len(live) == 1 and live[0].entity_id == other
+        assert store.get("fixed-id", 1).entity_id == other
+        # batch replay re-homes too
+        store.insert_batch([e1], 1)
+        live = [e for e in store.find(EventQuery(app_id=1))
+                if e.event_id == "fixed-id"]
+        assert len(live) == 1 and live[0].entity_id == "u1"
+
+    def test_out_of_range_shard_is_empty_not_crash(self):
+        store, _ = _mk()
+        store.insert_batch(_events(), 1)
+        assert list(store.find(EventQuery(app_id=1, shard=(3, 3)))) == []
+
+    def test_auth_key_passed_to_children(self):
+        from predictionio_tpu.data.storage.sharded import ShardedEventStore
+
+        s = ShardedEventStore(
+            {"SHARDS": "127.0.0.1:1,127.0.0.1:2", "AUTH_KEY": "sekrit"}
+        )
+        assert all(
+            child._client.auth_key == "sekrit" for child in s._stores
+        )
