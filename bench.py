@@ -337,14 +337,57 @@ def bench_serving_device():
     return p50_single * 1e3, batch / per_batch
 
 
+def _hammer_query_server(port, make_body, n_clients, n_per, timeout=60.0):
+    """Shared closed-loop load harness: n_clients keep-alive connections
+    each issuing n_per sequential POST /queries.json requests.
+    Returns {qps, p50_ms, p99_ms}."""
+    import concurrent.futures
+    import http.client
+    import threading
+
+    def query(conn, i):
+        body = make_body(i)
+        t0 = time.perf_counter()
+        conn.request(
+            "POST", "/queries.json", body=body,
+            headers={"Content-Type": "application/json"},
+        )
+        conn.getresponse().read()
+        return time.perf_counter() - t0
+
+    warm = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    query(warm, 0)  # warm the serving path + device program
+    warm.close()
+    lat: list[float] = []
+    lock = threading.Lock()
+
+    def client(c):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        try:
+            for j in range(n_per):
+                dt = query(conn, c * n_per + j)
+                with lock:
+                    lat.append(dt)
+        finally:
+            conn.close()
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(n_clients) as pool:
+        list(pool.map(client, range(n_clients)))
+    wall = time.perf_counter() - t0
+    lat.sort()
+    return {
+        "qps": len(lat) / wall,
+        "p50_ms": lat[len(lat) // 2] * 1e3,
+        "p99_ms": lat[int(0.99 * (len(lat) - 1))] * 1e3,
+    }
+
+
 def bench_serving_framework():
     """The real product path (VERDICT r2 #2): QueryServer over a trained
     recommendation engine — HTTP + JSON extraction + micro-batch
     dispatcher + serving combinator — full item catalog, concurrent
     clients. Returns framework qps / p50 / p99 (ms)."""
-    import concurrent.futures
-    import threading
-    import urllib.request
 
     from predictionio_tpu.data.event import Event
     from predictionio_tpu.data.storage.base import App
@@ -411,51 +454,16 @@ def bench_serving_framework():
     )
     port = srv.start()
     try:
-        import http.client
-
-        def query(conn, u):
-            body = json.dumps({"user": f"u{u}", "num": 10}).encode()
-            t0 = time.perf_counter()
-            conn.request(
-                "POST", "/queries.json", body=body,
-                headers={"Content-Type": "application/json"},
-            )
-            conn.getresponse().read()
-            return time.perf_counter() - t0
-
-        warm_conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
-        query(warm_conn, 0)  # warm the serving path + device program
-        warm_conn.close()
-        n_clients, n_per = 32, 8
-        lat: list[float] = []
-        lock = threading.Lock()
-
-        def client(c):
-            # persistent keep-alive connection per client (how real
-            # serving clients behave; per-request TCP+thread churn was
-            # measurable against the batching cycle)
-            conn = http.client.HTTPConnection(
-                "127.0.0.1", port, timeout=60
-            )
-            try:
-                for j in range(n_per):
-                    dt = query(conn, (c * n_per + j) % n_users_serve)
-                    with lock:
-                        lat.append(dt)
-            finally:
-                conn.close()
-
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(n_clients) as pool:
-            list(pool.map(client, range(n_clients)))
-        wall = time.perf_counter() - t0
-        lat.sort()
-        return {
-            "qps": len(lat) / wall,
-            "p50_ms": lat[len(lat) // 2] * 1e3,
-            "p99_ms": lat[int(0.99 * (len(lat) - 1))] * 1e3,
-            "clients": n_clients,
-        }
+        n_clients = 32
+        stats = _hammer_query_server(
+            port,
+            lambda i: json.dumps(
+                {"user": f"u{i % n_users_serve}", "num": 10}
+            ).encode(),
+            n_clients=n_clients,
+            n_per=8,
+        )
+        return dict(stats, clients=n_clients)
     finally:
         srv.stop()
 
@@ -546,10 +554,6 @@ def bench_ur_framework():
     (VERDICT r3 #4): universal-engine queries — history fetch, exclusion
     build, device batch score — through a QueryServer under 32
     concurrent clients at a 1e5-item catalog."""
-    import concurrent.futures
-    import threading
-    import urllib.request
-
     from predictionio_tpu.data.event import Event
     from predictionio_tpu.data.storage.base import App
     from predictionio_tpu.data.storage.registry import (
@@ -613,50 +617,20 @@ def bench_ur_framework():
     )
     port = srv.start()
     try:
-        import http.client
-
-        def query(conn, u):
-            body = json.dumps(
-                {"user": f"u{u}", "num": 10, "exclude_seen": True}
-            ).encode()
-            t0 = time.perf_counter()
-            conn.request(
-                "POST", "/queries.json", body=body,
-                headers={"Content-Type": "application/json"},
-            )
-            conn.getresponse().read()
-            return time.perf_counter() - t0
-
-        warm_conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
-        query(warm_conn, 0)  # warm serving path + device program
-        warm_conn.close()
-        n_clients, n_per = 32, 6
-        lat: list[float] = []
-        lock = threading.Lock()
-
-        def client(c):
-            conn = http.client.HTTPConnection(
-                "127.0.0.1", port, timeout=120
-            )
-            try:
-                for j in range(n_per):
-                    dt = query(conn, (c * n_per + j) % n_users_ur)
-                    with lock:
-                        lat.append(dt)
-            finally:
-                conn.close()
-
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(n_clients) as pool:
-            list(pool.map(client, range(n_clients)))
-        wall = time.perf_counter() - t0
-        lat.sort()
-        return {
-            "qps": len(lat) / wall,
-            "p50_ms": lat[len(lat) // 2] * 1e3,
-            "p99_ms": lat[int(0.99 * (len(lat) - 1))] * 1e3,
-            "catalog": n_items_ur,
-        }
+        stats = _hammer_query_server(
+            port,
+            lambda i: json.dumps(
+                {
+                    "user": f"u{i % n_users_ur}",
+                    "num": 10,
+                    "exclude_seen": True,
+                }
+            ).encode(),
+            n_clients=32,
+            n_per=6,
+            timeout=120.0,
+        )
+        return dict(stats, catalog=n_items_ur)
     finally:
         srv.stop()
 

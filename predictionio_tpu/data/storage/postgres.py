@@ -25,6 +25,7 @@ a fake-driver smoke test exercises every DAO method's SQL unconditionally
 
 from __future__ import annotations
 
+import dataclasses as _dcs
 import datetime as _dt
 import json
 import threading
@@ -427,8 +428,6 @@ class PostgresEventStore(base.EventStore):
         between fetches, are driver-agnostic (pg8000 buffers client-side
         anyway), and reuse the same (eventTime, id) cursor contract the
         remote backend exposes (remote.py keyset paging)."""
-        import dataclasses as _dcs
-
         name = self._ensure_table(query.app_id, query.channel_id)
         order = "DESC" if query.reversed else "ASC"
 
@@ -510,8 +509,6 @@ class PostgresEventStore(base.EventStore):
         # read never materializes unfiltered in host RAM, and with a
         # shard filter each page is thinned server-call-by-server-call
         # instead of after one giant fetchall
-        import dataclasses as _dcs
-
         rows: list = []
         q = query
         while True:

@@ -26,6 +26,7 @@ scaled out over HBase).
 from __future__ import annotations
 
 import heapq
+import itertools
 from typing import Any, Iterator, Optional, Sequence
 
 from predictionio_tpu.data.event import Event
@@ -183,8 +184,6 @@ class ShardedEventStore(base.EventStore):
             reverse=query.reversed,
         )
         if query.limit is not None and query.limit >= 0:
-            import itertools
-
             return itertools.islice(merged, query.limit)
         return merged
 

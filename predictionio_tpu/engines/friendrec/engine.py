@@ -13,6 +13,7 @@ batched scoring is ONE device matmul-row pass over dense
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Optional
 
 import numpy as np
@@ -130,21 +131,18 @@ class FriendRecModel:
         self._device = None
 
 
-_pair_scores = None  # lazily-jitted (B, K_v)·(B, K_v) → (B,) row dots
-
-
+@lru_cache(maxsize=1)
 def _get_pair_scores():
-    global _pair_scores
-    if _pair_scores is None:
-        import jax
-        import jax.numpy as jnp
+    """Lazily-jitted (B, K_v)·(B, K_v) → (B,) row dots (jax imports stay
+    off the module-import path, like every other engine)."""
+    import jax
+    import jax.numpy as jnp
 
-        @jax.jit
-        def fn(user_rows, item_rows):
-            return jnp.sum(user_rows * item_rows, axis=-1)
+    @jax.jit
+    def fn(user_rows, item_rows):
+        return jnp.sum(user_rows * item_rows, axis=-1)
 
-        _pair_scores = fn
-    return _pair_scores
+    return fn
 
 
 class KeywordSimilarityAlgorithm(Algorithm):

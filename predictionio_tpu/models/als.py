@@ -445,8 +445,8 @@ def train_grid(
     vals = np.asarray(vals, dtype=np.float32)
     staged = stage_windowed(rows, cols, vals, n_users, n_items, base)
     kwargs = dict(staged.static_kwargs)
-    kwargs.pop("lam"), kwargs.pop("alpha")
-    kwargs.pop("pallas_mode"), kwargs.pop("mesh")
+    for grid_axis_or_unsupported in ("lam", "alpha", "pallas_mode", "mesh"):
+        kwargs.pop(grid_axis_or_unsupported)
     ufs, itfs = _train_jit_windowed_grid(
         *staged.device_args[:12],
         jnp.asarray([p.lambda_ for p in params_list], jnp.float32),

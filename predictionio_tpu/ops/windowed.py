@@ -258,6 +258,8 @@ def windowed_gram_b(
         pallas = None  # pallas_call has no GSPMD partitioning rule
     d = k + k * k
     s_rows = WINDOW_ROWS
+    # scan over each part's chunks in lockstep (axis 1 → leading)
+    xs = tuple(jnp.swapaxes(a, 0, 1) for a in (src, w_b, w_g, local))
 
     if pallas is not None:
         from predictionio_tpu.ops import windowed_pallas
@@ -286,7 +288,6 @@ def windowed_gram_b(
             )
             return None, (pb, pg)
 
-        xs = tuple(jnp.swapaxes(a, 0, 1) for a in (src, w_b, w_g, local))
         _, (parts_b, parts_g) = jax.lax.scan(body, None, xs)
         out_b = jax.ops.segment_sum(
             parts_b.reshape(-1, s_rows * k), block_window,
@@ -316,10 +317,6 @@ def windowed_gram_b(
         )  # (P, CB, S, D)
         return None, part
 
-    # scan over each part's chunks in lockstep (axis 1 → leading)
-    xs = tuple(
-        jnp.swapaxes(a, 0, 1) for a in (src, w_b, w_g, local)
-    )
     _, parts = jax.lax.scan(body, None, xs)  # (L, P, CB, S, D)
     # back to part-major global block order to match block_window
     parts = jnp.swapaxes(parts, 0, 1).reshape(-1, s_rows * d)

@@ -77,27 +77,12 @@ def run_dryrun(n_devices: int) -> None:
         assert np.all(np.isfinite(lr.weights))
 
 
-# Child-process bootstrap: neuter any non-CPU PJRT plugin a sitecustomize
-# may have registered before our env vars could take effect, then run the
-# body. Factories are replaced (not popped) so the platform NAMES stay
-# registered — Pallas registers MLIR lowerings for "tpu" at import time
-# and errors on unknown platforms.
+# Child-process bootstrap: force the CPU-only platform (neutering any
+# sitecustomize-registered TPU plugin — see utils/cpuonly.py), then run
+# the body.
 _CHILD_TEMPLATE = """\
-import os
-os.environ["JAX_PLATFORMS"] = "cpu"
-try:
-    import dataclasses as _dc
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge as _xb
-    def _blocked(*_a, **_k):
-        raise RuntimeError("non-CPU backends blocked in dryrun")
-    for _name, _reg in list(getattr(_xb, "_backend_factories", {{}}).items()):
-        if _name != "cpu":
-            _xb._backend_factories[_name] = _dc.replace(
-                _reg, factory=_blocked, fail_quietly=True)
-except Exception:
-    pass
+from predictionio_tpu.utils.cpuonly import force_cpu_platform
+force_cpu_platform()  # device count comes from the parent's XLA_FLAGS
 from predictionio_tpu.parallel.dryrun import run_dryrun
 run_dryrun({n})
 print("DRYRUN_OK")
@@ -115,15 +100,9 @@ def run_dryrun_subprocess(n_devices: int, timeout: float = 900.0) -> None:
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = [
-        f
-        for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-    ]
-    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
-    env["XLA_FLAGS"] = " ".join(flags)
+    from predictionio_tpu.utils.cpuonly import force_cpu_env
+
+    env = force_cpu_env(dict(os.environ), n_devices)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
 
     proc = subprocess.run(

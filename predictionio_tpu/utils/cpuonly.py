@@ -1,0 +1,65 @@
+"""Force a CPU-only virtual-device JAX platform in the current process.
+
+Shared by the test conftest, the multi-chip dryrun child, and the
+multi-host test children — all of which must run an n-device CPU mesh
+even when a sitecustomize has registered a TPU PJRT plugin and set
+`jax_platforms` programmatically (so the JAX_PLATFORMS env var alone is
+ignored). Must be called BEFORE any JAX backend is initialized.
+
+Non-CPU backend factories are REPLACED with a raising stub, not popped:
+Pallas registers MLIR lowerings for the "tpu" platform at import time
+and errors if the platform name is no longer known.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import MutableMapping
+
+
+def force_cpu_env(
+    env: MutableMapping[str, str],
+    n_devices: int,
+    override: bool = True,
+) -> MutableMapping[str, str]:
+    """Set JAX_PLATFORMS/XLA_FLAGS for a CPU n-device platform on an env
+    mapping (os.environ or a child-process env dict). With
+    override=False an already-present device-count flag is honored."""
+    flags = env.get("XLA_FLAGS", "")
+    if override or "xla_force_host_platform_device_count" not in flags:
+        kept = [
+            f
+            for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(kept)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def force_cpu_platform(
+    n_devices: int | None = None, override: bool = True
+) -> None:
+    if n_devices is not None:
+        force_cpu_env(os.environ, n_devices, override=override)
+    else:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    try:  # pragma: no cover - depends on host environment
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        from jax._src import xla_bridge as xb
+
+        def _blocked(*_a, **_k):
+            raise RuntimeError("non-CPU backends are blocked (cpuonly)")
+
+        for name, reg in list(getattr(xb, "_backend_factories", {}).items()):
+            if name != "cpu":
+                xb._backend_factories[name] = dataclasses.replace(
+                    reg, factory=_blocked, fail_quietly=True
+                )
+    except Exception:
+        pass

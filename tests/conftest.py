@@ -2,41 +2,11 @@
 paths are exercised without TPU hardware (reference analogue: Spark
 `local[4]` SharedSparkContext, core/src/test/.../BaseTest.scala:15-55)."""
 
-import os
+from predictionio_tpu.utils.cpuonly import force_cpu_platform
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# If a TPU PJRT plugin was registered at interpreter start (sitecustomize),
-# neuter its factory so lazy backend init can never dial TPU hardware from
-# a unit test — tests must be hermetic CPU-only. The platform NAME must
-# stay registered (not popped): Pallas registers MLIR lowerings for the
-# "tpu" platform at import time and errors on unknown platforms.
-try:  # pragma: no cover - depends on host environment
-    import dataclasses as _dc
-
-    # sitecustomize may have imported jax before this file ran and set
-    # jax_platforms programmatically (e.g. "axon,cpu"); force it back.
-    import jax as _jax
-
-    _jax.config.update("jax_platforms", "cpu")
-
-    from jax._src import xla_bridge as _xb
-
-    def _blocked_backend(*_a, **_k):
-        raise RuntimeError("non-CPU backends are blocked in unit tests")
-
-    for _name, _reg in list(getattr(_xb, "_backend_factories", {}).items()):
-        if _name != "cpu":
-            _xb._backend_factories[_name] = _dc.replace(
-                _reg, factory=_blocked_backend, fail_quietly=True
-            )
-except Exception:
-    pass
+# override=False: an explicitly pre-set device count (e.g. a 16-device
+# repro via XLA_FLAGS) is honored; otherwise the standard 8-device mesh
+force_cpu_platform(n_devices=8, override=False)
 
 import pytest  # noqa: E402
 

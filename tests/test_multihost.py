@@ -44,17 +44,9 @@ def _make_data():
 _CHILD = textwrap.dedent(
     """
     import os, sys
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=4"
-    )
-    from jax._src import xla_bridge as xb
-    for name in list(getattr(xb, "_backend_factories", {})):
-        if name != "cpu":
-            xb._backend_factories.pop(name, None)
+    from predictionio_tpu.utils.cpuonly import force_cpu_platform
+    force_cpu_platform(n_devices=4)
     import jax
-    jax.config.update("jax_platforms", "cpu")
 
     coordinator, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     jax.distributed.initialize(

@@ -141,22 +141,10 @@ def _free_port() -> int:
 
 _CHILD = textwrap.dedent(
     """
-    import dataclasses as _dc
     import os, sys
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=4"
-    )
+    from predictionio_tpu.utils.cpuonly import force_cpu_platform
+    force_cpu_platform(n_devices=4)
     import jax
-    jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge as xb
-    def _blocked(*_a, **_k):
-        raise RuntimeError("blocked")
-    for name, reg in list(getattr(xb, "_backend_factories", {}).items()):
-        if name != "cpu":
-            xb._backend_factories[name] = _dc.replace(
-                reg, factory=_blocked, fail_quietly=True)
 
     coordinator, pid, app_id, out_path = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
