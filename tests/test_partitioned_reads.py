@@ -141,7 +141,7 @@ def _free_port() -> int:
 
 _CHILD = textwrap.dedent(
     """
-    import os, sys
+    import sys
     from predictionio_tpu.utils.cpuonly import force_cpu_platform
     force_cpu_platform(n_devices=4)
     import jax
