@@ -45,6 +45,14 @@ class StorageError(RuntimeError):
     pass
 
 
+class StorageUnreachableError(StorageError):
+    """Connectivity-class failure (daemon down, socket error) — the ONLY
+    StorageError kind retry layers should treat as transient. Application
+    -level failures (auth rejected, malformed query, server-side bug) stay
+    plain StorageError: deterministic, not worth backoff, and not evidence
+    the store is down."""
+
+
 # ---------------------------------------------------------------------------
 # Event store
 # ---------------------------------------------------------------------------

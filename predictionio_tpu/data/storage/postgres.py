@@ -510,7 +510,10 @@ class PostgresEventStore(base.EventStore):
         # shard filter each page is thinned server-call-by-server-call
         # instead of after one giant fetchall
         rows: list = []
-        q = query
+        # frame pages always walk eventTime ASC; normalize `reversed` so
+        # the start_after predicate from _where() paginates forward (a
+        # reversed query would otherwise re-select the first page forever)
+        q = _dcs.replace(query, reversed=False)
         while True:
             where, params = self._where(q)
             page = self._client.query(

@@ -249,6 +249,26 @@ class Storage:
             dao = getter()
             results.append(f"OK {type(dao).__name__}")
         events = self.get_events()
+        if hasattr(events, "health"):
+            # sharded composite: ping every daemon and name the down ones
+            # (the HBase-role availability surface — VERDICT r4 #3)
+            down = []
+            for h in events.health():
+                mark = "OK" if h["alive"] else "DOWN"
+                line = f"{mark} shard {h['shard']} @ {h['address']}"
+                if h["error"]:
+                    line += f" — {h['error']}"
+                results.append(line)
+                if not h["alive"]:
+                    down.append(f"{h['shard']} ({h['address']})")
+            if down:
+                # embed the per-shard report: the raise discards `results`,
+                # and the operator needs exactly these lines when degraded
+                raise StorageError(
+                    "event store shards down: "
+                    + ", ".join(down)
+                    + "\n" + "\n".join(f"  {r}" for r in results)
+                )
         events.init_app(0)
         from predictionio_tpu.data.event import Event
 

@@ -37,6 +37,7 @@ from predictionio_tpu.data.storage.base import (
     EventQuery,
     Model,
     StorageError,
+    StorageUnreachableError,
 )
 
 
@@ -94,7 +95,7 @@ class RemoteClient:
                 conn.close()
                 self._local.conn = None
                 if attempt:
-                    raise StorageError(
+                    raise StorageUnreachableError(
                         f"storage server {self.host}:{self.port} unreachable"
                     )
         if not payload.get("ok"):

@@ -17,39 +17,94 @@ Configure:
 
   PIO_STORAGE_SOURCES_<NAME>_TYPE=sharded
   PIO_STORAGE_SOURCES_<NAME>_SHARDS=host1:port1,host2:port2,...
+  PIO_STORAGE_SOURCES_<NAME>_ALLOW_PARTIAL=1   # optional, see below
+  PIO_STORAGE_SOURCES_<NAME>_RETRIES=2         # optional
 
 Metadata/model repositories are NOT sharded — point them at a single
 source (the reference likewise kept metadata in one store while events
 scaled out over HBase).
+
+Failure contract (the HBase-availability role, StorageClient.scala:37-46
+retry tuning + Storage.scala:335 verifyAllDataObjects):
+
+- Every child call is retried ``RETRIES`` times with exponential backoff
+  before the shard is declared down — transient daemon hiccups (restart,
+  dropped keep-alive) self-heal invisibly.
+- After retries, the call raises :class:`ShardDownError` naming the
+  shard index and address — failures are loud and attributable, never a
+  bare connection error from somewhere inside a merge.
+- ``ALLOW_PARTIAL=1`` opts broadcast READS (un-sharded find, get,
+  aggregate_properties) into degraded mode: a down shard is skipped, a
+  warning is logged, and the affected shard indices are recorded on
+  ``last_degraded_shards`` for the caller to surface. Stats-grade reads
+  keep working through a partial outage; training reads should leave it
+  off (a silent hole in training data is worse than an error). WRITES
+  are never partial: an unreachable home shard always raises.
+- ``health()`` pings every shard and reports per-shard status — wired
+  into ``pio status`` (tools/console.py) the way the reference's deep
+  storage check verifies every data object.
 """
 
 from __future__ import annotations
 
 import heapq
 import itertools
-from typing import Any, Iterator, Optional, Sequence
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Any, Callable, Iterator, Optional, Sequence
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     EventQuery,
     StorageError,
+    StorageUnreachableError,
     shard_of,
 )
+
+# the only failure classes retried/attributed as "shard down": daemon
+# connectivity (StorageUnreachableError from the remote client, raw
+# OSError from direct-composed stores). Application-level StorageErrors
+# (auth rejected, malformed query, server bug) propagate untouched —
+# deterministic, not an outage, and backoff would just add latency.
+_TRANSIENT = (StorageUnreachableError, OSError)
+
+log = logging.getLogger(__name__)
+
+
+class ShardDownError(StorageError):
+    """A shard stayed unreachable through the retry budget.
+
+    Carries the shard identity so operators (and degraded-read callers)
+    know exactly which daemon to look at."""
+
+    def __init__(self, shard_index: int, address: str, cause: Exception):
+        super().__init__(
+            f"shard {shard_index} ({address}) is down: {cause}"
+        )
+        self.shard_index = shard_index
+        self.address = address
+        self.cause = cause
 
 
 class ShardedEventStore(base.EventStore):
     """Entity-hash composite over N child event stores."""
 
+    #: retry schedule base — attempt i sleeps BACKOFF_BASE * 2**i
+    BACKOFF_BASE = 0.05
+
     def __init__(
         self,
         config: Optional[dict] = None,
         stores: Optional[Sequence[base.EventStore]] = None,
+        allow_partial: Optional[bool] = None,
+        retries: Optional[int] = None,
     ):
+        config = config or {}
         if stores is not None:  # direct composition (tests, embedding)
             self._stores = list(stores)
         else:
-            config = config or {}
             spec = config.get("SHARDS", "")
             addrs = [a.strip() for a in spec.split(",") if a.strip()]
             if not addrs:
@@ -60,7 +115,11 @@ class ShardedEventStore(base.EventStore):
 
             # child config inherits everything except SHARDS (AUTH_KEY,
             # TIMEOUT, … — non-localhost daemons REQUIRE --auth-key)
-            child_cfg = {k: v for k, v in config.items() if k != "SHARDS"}
+            child_cfg = {
+                k: v
+                for k, v in config.items()
+                if k not in ("SHARDS", "ALLOW_PARTIAL", "RETRIES")
+            }
             self._stores = []
             for addr in addrs:
                 host, _, port = addr.rpartition(":")
@@ -71,25 +130,165 @@ class ShardedEventStore(base.EventStore):
                 )
         if not self._stores:
             raise StorageError("sharded backend needs at least one shard")
+        self.allow_partial = (
+            allow_partial
+            if allow_partial is not None
+            else str(config.get("ALLOW_PARTIAL", "")).strip()
+            in ("1", "true", "yes")
+        )
+        self.retries = (
+            int(retries)
+            if retries is not None
+            else int(config.get("RETRIES", "2"))
+        )
+        #: shard indices skipped by the most recent degraded broadcast
+        #: read (empty when that read was complete). Best-effort operator
+        #: diagnostic: updated only by broadcast reads, unsynchronized
+        #: across concurrent readers — inspect right after the read whose
+        #: completeness you care about, never for correctness decisions.
+        self.last_degraded_shards: list[int] = []
+        # broadcasts fan out concurrently: one wall-clock round trip for
+        # N shards instead of N sequential ones (ADVICE r4: explicit-id
+        # eviction was O(N) round trips per insert)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, len(self._stores)),
+            thread_name_prefix="shardcast",
+        )
 
     @property
     def n_shards(self) -> int:
         return len(self._stores)
 
-    def _for_entity(self, entity_id: str) -> base.EventStore:
-        return self._stores[shard_of(entity_id, self.n_shards)]
+    def shard_address(self, sx: int) -> str:
+        """Human-readable identity of shard `sx` for errors/health."""
+        s = self._stores[sx]
+        client = getattr(s, "_client", None)
+        if client is not None and hasattr(client, "host"):
+            return f"{client.host}:{client.port}"
+        return f"local[{sx}]:{type(s).__name__}"
 
-    # -- lifecycle (list() defeats all()'s short-circuit: one failing
-    # shard must not leave later shards un-initialized / un-removed) ------
+    def _for_entity(self, entity_id: str) -> int:
+        return shard_of(entity_id, self.n_shards)
+
+    # -- retry / failure core ---------------------------------------------
+    def _shard_call(
+        self, sx: int, fn: Callable, *args, retries: Optional[int] = None
+    ):
+        """Run one child-store call, retrying CONNECTIVITY failures with
+        backoff; after the budget, raise ShardDownError naming the shard.
+        Application-level StorageErrors pass through untouched (see
+        _TRANSIENT). `retries=0` disables re-invocation for calls that
+        are not safe to re-issue (insert: a second invocation mints a
+        fresh RPC req_id, defeating the daemon's dedupe and duplicating
+        the event — the remote client's own same-req-id retry already
+        covers response loss)."""
+        budget = self.retries if retries is None else retries
+        last: Optional[Exception] = None
+        for attempt in range(budget + 1):
+            try:
+                return fn(*args)
+            except _TRANSIENT as e:
+                last = e
+                if attempt < budget:
+                    time.sleep(self.BACKOFF_BASE * (2**attempt))
+        raise ShardDownError(sx, self.shard_address(sx), last)  # type: ignore[arg-type]
+
+    def _broadcast(
+        self,
+        calls: Sequence[tuple[int, Callable, tuple]],
+        partial_ok: bool = False,
+        retries: Optional[int] = None,
+    ) -> dict[int, Any]:
+        """Run (shard, fn, args) calls concurrently; returns {shard:
+        result}. With partial_ok (and allow_partial on), down shards are
+        skipped, logged, and recorded on last_degraded_shards; otherwise
+        the first ShardDownError propagates (after ALL calls finish, so
+        no child is left mid-flight)."""
+        futs = {
+            sx: self._pool.submit(
+                self._shard_call, sx, fn, *args, retries=retries
+            )
+            for sx, fn, args in calls
+        }
+        out: dict[int, Any] = {}
+        degraded: list[int] = []
+        first_err: Optional[Exception] = None
+        for sx, f in futs.items():
+            try:
+                out[sx] = f.result()
+            except ShardDownError as e:
+                if partial_ok and self.allow_partial:
+                    degraded.append(sx)
+                    log.warning("degraded read: skipping %s", e)
+                elif first_err is None:
+                    first_err = e
+            except Exception as e:  # app-level error: still drain the rest
+                # (raising mid-loop would abandon in-flight writes — the
+                # caller could retry or close() against live futures)
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        if partial_ok:
+            self.last_degraded_shards = degraded
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
     def init_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        return all([s.init_app(app_id, channel_id) for s in self._stores])
+        res = self._broadcast(
+            [
+                (sx, s.init_app, (app_id, channel_id))
+                for sx, s in enumerate(self._stores)
+            ]
+        )
+        return all(res.values())
 
     def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        return all([s.remove_app(app_id, channel_id) for s in self._stores])
+        res = self._broadcast(
+            [
+                (sx, s.remove_app, (app_id, channel_id))
+                for sx, s in enumerate(self._stores)
+            ]
+        )
+        return all(res.values())
 
     def close(self) -> None:
         for s in self._stores:
             s.close()
+        self._pool.shutdown(wait=False)
+
+    # -- health ------------------------------------------------------------
+    def health(self) -> list[dict]:
+        """Ping every shard; [{shard, address, alive, error}] per shard.
+
+        One concurrent round — the `pio status` deep check surface
+        (reference: Storage.verifyAllDataObjects, Storage.scala:335)."""
+
+        def probe(sx: int, s: base.EventStore):
+            client = getattr(s, "_client", None)
+            try:
+                if client is not None and hasattr(client, "ping"):
+                    alive = bool(client.ping())
+                    return {"alive": alive, "error": None if alive else "ping failed"}
+                # no transport = in-process child: alive by construction
+                # (any data-level probe would have side effects — e.g.
+                # data_signature(0) creates app-0 tables on SQL stores)
+                return {"alive": True, "error": None}
+            except Exception as e:  # health never raises
+                return {"alive": False, "error": str(e)}
+
+        futs = {
+            sx: self._pool.submit(probe, sx, s)
+            for sx, s in enumerate(self._stores)
+        }
+        return [
+            {
+                "shard": sx,
+                "address": self.shard_address(sx),
+                **futs[sx].result(),
+            }
+            for sx in range(self.n_shards)
+        ]
 
     # -- writes: routed by entity hash ------------------------------------
     def insert(
@@ -99,11 +298,22 @@ class ShardedEventStore(base.EventStore):
         if event.event_id:
             # explicit-id insert (import/replay/overwrite): the id may
             # already live on a DIFFERENT shard if the entity changed —
-            # evict it there or get/delete-by-id would see two copies
-            for s in self._stores:
-                if s is not home:
-                    s.delete(event.event_id, app_id, channel_id)
-        return home.insert(event, app_id, channel_id)
+            # evict it there or get/delete-by-id would see two copies.
+            # Evictions fan out concurrently with the home insert's
+            # prerequisite ordering relaxed to: evict first (all shards in
+            # one wall-clock round), then insert — ~2 round trips total
+            # instead of N sequential (ADVICE r4).
+            self._broadcast(
+                [
+                    (sx, s.delete, (event.event_id, app_id, channel_id))
+                    for sx, s in enumerate(self._stores)
+                    if sx != home
+                ]
+            )
+        return self._shard_call(
+            home, self._stores[home].insert, event, app_id, channel_id,
+            retries=0,
+        )
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
@@ -114,22 +324,35 @@ class ShardedEventStore(base.EventStore):
         groups: dict[int, list[tuple[int, Event]]] = {}
         explicit: list[tuple[int, str]] = []  # (home shard, event_id)
         for pos, e in enumerate(events):
-            sx = shard_of(e.entity_id, self.n_shards)
+            sx = self._for_entity(e.entity_id)
             groups.setdefault(sx, []).append((pos, e))
             if e.event_id:
                 explicit.append((sx, e.event_id))
         # explicit-id replays: evict each id from every NON-home shard in
-        # one bulk delete per shard (see insert())
+        # one bulk delete per shard, all shards concurrently (see insert())
+        evict_calls = []
         for sx in range(self.n_shards):
             ids = [eid for home, eid in explicit if home != sx]
             if ids:
-                self._stores[sx].delete_batch(ids, app_id, channel_id)
+                evict_calls.append(
+                    (sx, self._stores[sx].delete_batch, (ids, app_id, channel_id))
+                )
+        if evict_calls:
+            self._broadcast(evict_calls)
+        write_res = self._broadcast(
+            [
+                (
+                    sx,
+                    self._stores[sx].insert_batch,
+                    ([e for _p, e in pairs], app_id, channel_id),
+                )
+                for sx, pairs in groups.items()
+            ],
+            retries=0,  # re-invoking mints fresh req_ids (see _shard_call)
+        )
         out: list[Optional[str]] = [None] * len(events)
         for sx, pairs in groups.items():
-            ids = self._stores[sx].insert_batch(
-                [e for _p, e in pairs], app_id, channel_id
-            )
-            for (pos, _e), eid in zip(pairs, ids):
+            for (pos, _e), eid in zip(pairs, write_res[sx]):
                 out[pos] = eid
         return out  # type: ignore[return-value]
 
@@ -137,16 +360,49 @@ class ShardedEventStore(base.EventStore):
     def get(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
     ) -> Optional[Event]:
-        for s in self._stores:
-            e = s.get(event_id, app_id, channel_id)
-            if e is not None:
-                return e
+        futs = {
+            self._pool.submit(
+                self._shard_call, sx, s.get, event_id, app_id, channel_id
+            ): sx
+            for sx, s in enumerate(self._stores)
+        }
+        first_err: Optional[ShardDownError] = None
+        degraded: list[int] = []
+        try:
+            for f in as_completed(futs):
+                try:
+                    e = f.result()
+                except ShardDownError as err:
+                    degraded.append(futs[f])
+                    if first_err is None:
+                        first_err = err
+                    continue
+                if e is not None:
+                    # ids are unique across shards: a hit is definitive
+                    # even if another shard is down — return immediately
+                    # rather than waiting out a dead shard's retry budget
+                    return e
+        finally:
+            for f in futs:
+                f.cancel()
+        if first_err is not None and not self.allow_partial:
+            # absence is only provable when every shard answered
+            raise first_err
+        if first_err is not None:
+            self.last_degraded_shards = degraded
+            log.warning("degraded get(%s): %s", event_id, first_err)
         return None
 
     def delete(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
     ) -> bool:
-        return any(s.delete(event_id, app_id, channel_id) for s in self._stores)
+        res = self._broadcast(
+            [
+                (sx, s.delete, (event_id, app_id, channel_id))
+                for sx, s in enumerate(self._stores)
+            ]
+        )
+        return any(res.values())
 
     def delete_batch(
         self,
@@ -158,15 +414,68 @@ class ShardedEventStore(base.EventStore):
         # child is a no-op there) instead of K ids × N shards single RPCs
         # — SelfCleaningDataSource deletes expired events in bulk
         ids = list(event_ids)
-        return sum(
-            s.delete_batch(ids, app_id, channel_id) for s in self._stores
+        res = self._broadcast(
+            [
+                (sx, s.delete_batch, (ids, app_id, channel_id))
+                for sx, s in enumerate(self._stores)
+            ]
         )
+        return sum(res.values())
 
     # -- reads -------------------------------------------------------------
+    def _guarded_stream(
+        self, sx: int, query: EventQuery, partial_ok: bool = False
+    ) -> Iterator[Event]:
+        """Stream one shard's find(), attributing connectivity failures
+        to the shard. Start-of-stream failures (daemon down when the
+        scan begins) retry with backoff on a fresh iterator — nothing
+        has been yielded yet, so a replay is safe. Mid-stream failures
+        (daemon died during the scan) cannot retry without duplicating
+        already-yielded events, so they convert straight to the
+        attributed error. Only broadcast reads (partial_ok) degrade
+        under allow_partial: an entity- or shard-scoped find targets ONE
+        shard, and an empty answer there would silently impersonate
+        'entity has no events'."""
+
+        def down(e: Exception) -> Optional[ShardDownError]:
+            err = ShardDownError(sx, self.shard_address(sx), e)
+            if partial_ok and self.allow_partial:
+                if sx not in self.last_degraded_shards:
+                    self.last_degraded_shards.append(sx)
+                log.warning("degraded read: %s", err)
+                return None
+            return err
+
+        first: Optional[Event] = None
+        it: Optional[Iterator[Event]] = None
+        for attempt in range(self.retries + 1):
+            try:
+                it = iter(self._stores[sx].find(query))
+                first = next(it)
+                break
+            except StopIteration:
+                return
+            except _TRANSIENT as e:
+                if attempt < self.retries:
+                    time.sleep(self.BACKOFF_BASE * (2**attempt))
+                    continue
+                err = down(e)
+                if err is None:
+                    return
+                raise err from e
+        yield first  # type: ignore[misc]
+        try:
+            yield from it  # type: ignore[misc]
+        except _TRANSIENT as e:
+            err = down(e)
+            if err is not None:
+                raise err from e
+
     def find(self, query: EventQuery) -> Iterator[Event]:
         if query.entity_id is not None:
             # entity locality: one shard holds everything for this entity
-            return self._for_entity(query.entity_id).find(query)
+            sx = self._for_entity(query.entity_id)
+            return self._guarded_stream(sx, query)  # never partial
         if (
             query.shard is not None
             and query.shard[1] == self.n_shards
@@ -176,8 +485,12 @@ class ShardedEventStore(base.EventStore):
             # of N lives entirely on child i: a direct single-daemon
             # stream, the zero-crosstalk HBase parallel-scan case (the
             # child still applies the filter; every row passes)
-            return self._stores[query.shard[0]].find(query)
-        streams = [s.find(query) for s in self._stores]
+            return self._guarded_stream(query.shard[0], query)
+        self.last_degraded_shards = []
+        streams = [
+            self._guarded_stream(sx, query, partial_ok=True)
+            for sx in range(self.n_shards)
+        ]
         merged = heapq.merge(
             *streams,
             key=lambda e: (e.event_time, e.event_id or ""),
@@ -188,9 +501,13 @@ class ShardedEventStore(base.EventStore):
         return merged
 
     def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
-        return "|".join(
-            s.data_signature(app_id, channel_id) for s in self._stores
+        res = self._broadcast(
+            [
+                (sx, s.data_signature, (app_id, channel_id))
+                for sx, s in enumerate(self._stores)
+            ]
         )
+        return "|".join(res[sx] for sx in range(self.n_shards))
 
     def aggregate_properties(
         self,
@@ -201,11 +518,16 @@ class ShardedEventStore(base.EventStore):
     ) -> dict:
         # entities are shard-disjoint → per-shard aggregation unions
         # exactly (each child sees an entity's FULL $set/$unset history)
-        out: dict = {}
-        for s in self._stores:
-            out.update(
-                s.aggregate_properties(
-                    app_id, entity_type, channel_id=channel_id, **kw
-                )
+        def agg(s: base.EventStore) -> dict:
+            return s.aggregate_properties(
+                app_id, entity_type, channel_id=channel_id, **kw
             )
+
+        res = self._broadcast(
+            [(sx, agg, (s,)) for sx, s in enumerate(self._stores)],
+            partial_ok=True,
+        )
+        out: dict = {}
+        for sx in sorted(res):
+            out.update(res[sx])
         return out

@@ -12,6 +12,7 @@ batched scoring is ONE device matmul-row pass over dense
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from functools import lru_cache
 from typing import Optional
@@ -120,15 +121,31 @@ class FriendRecModel:
 
     def __post_init__(self):
         self._device = None
+        self._stage_lock = threading.Lock()
 
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_device", None)
+        d.pop("_stage_lock", None)
         return d
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._device = None
+        self._stage_lock = threading.Lock()
+
+    def device(self):
+        # locked: the pipelined dispatcher (server.py pipeline_depth) can
+        # run two batches for one model concurrently; double-staging would
+        # transiently double the profile matrices' HBM footprint
+        with self._stage_lock:
+            if self._device is None:
+                import jax.numpy as jnp
+
+                self._device = (
+                    jnp.asarray(self.user_mat), jnp.asarray(self.item_mat)
+                )
+            return self._device
 
 
 @lru_cache(maxsize=1)
@@ -169,13 +186,7 @@ class KeywordSimilarityAlgorithm(Algorithm):
     def _score(self, model: FriendRecModel, pairs: np.ndarray) -> np.ndarray:
         """(B, 2) [user_idx, item_idx] → (B,) confidences, one device
         dispatch (the reference loops a HashMap per pair)."""
-        import jax.numpy as jnp
-
-        if model._device is None:
-            model._device = (
-                jnp.asarray(model.user_mat), jnp.asarray(model.item_mat)
-            )
-        um, im = model._device
+        um, im = model.device()
         return np.asarray(
             _get_pair_scores()(um[pairs[:, 0]], im[pairs[:, 1]])
         )

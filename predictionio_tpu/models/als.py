@@ -739,8 +739,12 @@ def stage_windowed(
     import time as _time
 
     t0 = _time.perf_counter()
+    # single gate for both staging sharding and mesh pass-through: a
+    # model-parallel-only mesh (dp=1, mp>1) still stages replicated
+    # arrays but must reach the jit so mp row-sharding applies (ADVICE r4)
+    use_mesh = mesh is not None and mesh.devices.size > 1
     n_parts = 1
-    if mesh is not None and mesh.devices.size > 1:
+    if use_mesh:
         from predictionio_tpu.parallel.mesh import DATA_AXIS
 
         n_parts = int(mesh.shape.get(DATA_AXIS, 1))
@@ -792,7 +796,7 @@ def stage_windowed(
     )
     host_prep = _time.perf_counter() - t0
     t0 = _time.perf_counter()
-    if n_parts > 1:
+    if use_mesh:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from predictionio_tpu.parallel.mesh import DATA_AXIS
@@ -805,8 +809,13 @@ def stage_windowed(
                 return None
             # chunk arrays (P, L, CB, B_E) and block_window (P*L*CB,)
             # shard their leading axis over dp; everything else
-            # (degrees, init factors) is replicated
-            sharded = a.ndim == 4 or a.dtype == np.int32 and a.ndim == 1
+            # (degrees, init factors) is replicated. With dp == 1
+            # (mp-only mesh) NOTHING is dp-sharded — the multi-process
+            # slice below would otherwise compute shape[0] // n_procs
+            # = 0 and hand GSPMD an empty local buffer
+            sharded = n_parts > 1 and (
+                a.ndim == 4 or a.dtype == np.int32 and a.ndim == 1
+            )
             spec = (
                 P(DATA_AXIS, *([None] * (a.ndim - 1))) if sharded else P()
             )
@@ -843,7 +852,7 @@ def stage_windowed(
             seed=params.seed,
             # resolved OUTSIDE the jit so the trace cache keys on it
             pallas_mode=resolve_pallas_mode("auto"),
-            mesh=mesh if n_parts > 1 else None,
+            mesh=mesh if use_mesh else None,
         ),
         n_users=n_users,
         n_items=n_items,
