@@ -59,6 +59,71 @@ def _from_ms(ms: int) -> _dt.datetime:
     return _dt.datetime.fromtimestamp(ms / 1000.0, _UTC)
 
 
+def events_to_table(events: Sequence[Event]) -> "pa.Table":
+    """Encode events with the store's parquet schema — shared by the
+    segment writer and `pio export --format parquet` (the reference's
+    EventsToFile parquet mode, tools/.../export/EventsToFile.scala:42)."""
+    return pa.Table.from_pydict(
+        {
+            "event_id": [e.event_id for e in events],
+            "event": [e.event for e in events],
+            "entity_type": [e.entity_type for e in events],
+            "entity_id": [e.entity_id for e in events],
+            "target_entity_type": [e.target_entity_type for e in events],
+            "target_entity_id": [e.target_entity_id for e in events],
+            "properties": [
+                json.dumps(e.properties.to_dict()) for e in events
+            ],
+            "event_time_ms": [_ms(e.event_time) for e in events],
+            "tags": [json.dumps(list(e.tags)) for e in events],
+            "pr_id": [e.pr_id for e in events],
+            "creation_time_ms": [_ms(e.creation_time) for e in events],
+        },
+        schema=_SCHEMA,
+    )
+
+
+def table_to_events(
+    table: "pa.Table", on_error=None, with_index: bool = False
+) -> Iterator[Event]:
+    """Decode a schema-conforming parquet table back to events.
+
+    `on_error(row_index, exc)` turns a malformed row into a warn-and-
+    skip instead of killing the generator (pio import parity with the
+    JSON path's per-line error handling). `with_index` yields
+    (physical_row_index, event) so callers can report a consistent row
+    numbering regardless of skips."""
+    cols = {
+        name: table.column(name).to_pylist() for name in table.schema.names
+    }
+    for i in range(table.num_rows):
+        try:
+            e = _row_to_event(cols, i)
+        except Exception as exc:
+            if on_error is None:
+                raise
+            on_error(i, exc)
+            continue
+        yield (i, e) if with_index else e
+
+
+def _row_to_event(cols: dict, i: int) -> Event:
+    return Event(
+        event=cols["event"][i],
+        entity_type=cols["entity_type"][i],
+        entity_id=cols["entity_id"][i],
+        target_entity_type=cols["target_entity_type"][i],
+        target_entity_id=cols["target_entity_id"][i],
+        properties=DataMap(json.loads(cols["properties"][i])),
+        event_time=_from_ms(cols["event_time_ms"][i]),
+        tags=tuple(json.loads(cols["tags"][i])),
+        pr_id=cols["pr_id"][i],
+        creation_time=_from_ms(cols["creation_time_ms"][i]),
+        event_id=cols["event_id"][i],
+    )
+
+
+
 class ParquetFSEventStore(EventStore):
     FLUSH_THRESHOLD = 4096
 
@@ -138,23 +203,9 @@ class ParquetFSEventStore(EventStore):
         d = self._dir(app_id, channel_id)
         os.makedirs(d, exist_ok=True)
         n = len(self._segments(d))
-        table = pa.Table.from_pydict(
-            {
-                "event_id": [e.event_id for e in buf],
-                "event": [e.event for e in buf],
-                "entity_type": [e.entity_type for e in buf],
-                "entity_id": [e.entity_id for e in buf],
-                "target_entity_type": [e.target_entity_type for e in buf],
-                "target_entity_id": [e.target_entity_id for e in buf],
-                "properties": [json.dumps(e.properties.to_dict()) for e in buf],
-                "event_time_ms": [_ms(e.event_time) for e in buf],
-                "tags": [json.dumps(list(e.tags)) for e in buf],
-                "pr_id": [e.pr_id for e in buf],
-                "creation_time_ms": [_ms(e.creation_time) for e in buf],
-            },
-            schema=_SCHEMA,
+        pq.write_table(
+            events_to_table(buf), os.path.join(d, f"seg-{n:08d}.parquet")
         )
-        pq.write_table(table, os.path.join(d, f"seg-{n:08d}.parquet"))
         buf.clear()
 
     def flush(self) -> None:
@@ -214,23 +265,20 @@ class ParquetFSEventStore(EventStore):
             stones = self._tombstones(self._dir(app_id, channel_id))
         if table is None:
             return
-        cols = {name: table.column(name).to_pylist() for name in table.schema.names}
-        for i in range(table.num_rows):
-            if cols["event_id"][i] in stones:
-                continue
-            yield Event(
-                event=cols["event"][i],
-                entity_type=cols["entity_type"][i],
-                entity_id=cols["entity_id"][i],
-                target_entity_type=cols["target_entity_type"][i],
-                target_entity_id=cols["target_entity_id"][i],
-                properties=DataMap(json.loads(cols["properties"][i])),
-                event_time=_from_ms(cols["event_time_ms"][i]),
-                tags=tuple(json.loads(cols["tags"][i])),
-                pr_id=cols["pr_id"][i],
-                creation_time=_from_ms(cols["creation_time_ms"][i]),
-                event_id=cols["event_id"][i],
+        if stones:
+            import pyarrow.compute as pc
+
+            # filter tombstoned rows BEFORE decoding (json.loads + Event
+            # construction per dead row is pure waste)
+            table = table.filter(
+                pc.invert(
+                    pc.is_in(
+                        table.column("event_id"),
+                        value_set=pa.array(sorted(stones)),
+                    )
+                )
             )
+        yield from table_to_events(table)
 
     def get(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import io
 import json
+import logging
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional, Sequence
@@ -175,6 +177,370 @@ def _half_step_windowed(
         return flat_gram_matvec(a_flat, v)
 
     return batched_cg(matvec, b, x0, cg_iterations)
+
+
+# ---------------------------------------------------------------------------
+# Core solver — dense-W fast path (sub-1%-density rating matrices)
+# ---------------------------------------------------------------------------
+
+# auto-dispatch bound for the bf16 dense rating matrix (ML-20M needs
+# 7.45 GB of a 16 GB chip); PIO_DENSE_ALS=0 disables, =1 forces where it
+# fits, PIO_DENSE_ALS_BYTES overrides the budget
+DENSE_DEFAULT_BYTES = 9_000_000_000
+# below this edge count the windowed path's staging is already cheap and
+# CPU test suites compare against f32-exact references — auto keeps them
+# on the windowed path unless PIO_DENSE_ALS=1 opts in
+DENSE_AUTO_MIN_EDGES = 1_000_000
+
+
+def _dense_half_step(
+    r: jax.Array,
+    fixed: jax.Array,  # factors of the side NOT being solved
+    degree: jax.Array,  # (n_solved_p,) — -1 marks padding rows
+    x0: jax.Array,
+    *,
+    solve_rows: bool,  # True: solve R's row side; False: its column side
+    implicit: bool,
+    lam: float,
+    alpha: float,
+    cg_iterations: int,
+    dense_dtype: str,
+    scale: float = 1.0,
+) -> jax.Array:
+    """One ALS half-step with b/gram built by dense matmuls over R.
+
+    Identical operator assembly + CG to the windowed path — only the
+    edge pass differs (ops/dense.py). Padding rows have all-zero R and
+    b=0, x0=0, so CG freezes them at zero exactly like window padding."""
+    from predictionio_tpu.ops import dense
+
+    k = x0.shape[1]
+    edge_pass = dense.dense_row_pass if solve_rows else dense.dense_col_pass
+    b, corr_flat = edge_pass(
+        r, fixed, implicit=implicit, alpha=alpha, dense_dtype=dense_dtype,
+        scale=scale,
+    )
+    if implicit:
+        gram = f32_gram(fixed)
+        base = gram + lam * jnp.eye(k, dtype=jnp.float32)
+        a_flat = corr_flat + base.reshape(1, k * k)
+    else:
+        reg = lam * jnp.maximum(degree, 1.0)
+        eye_flat = jnp.eye(k, dtype=jnp.float32).reshape(1, k * k)
+        a_flat = corr_flat + reg[:, None] * eye_flat
+
+    def matvec(v):
+        return flat_gram_matvec(a_flat, v)
+
+    return batched_cg(matvec, b, x0, cg_iterations)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "rank", "iterations", "implicit", "cg_iterations", "dense_dtype",
+        "scale",
+    ),
+)
+def _train_jit_dense(
+    r: jax.Array,  # (n_users_p, n_items_p) dense storage-dtype ratings
+    user_deg: jax.Array,  # (n_users_p,), -1 on padding rows
+    item_deg: jax.Array,  # (n_items_p,)
+    uf0=None,
+    itf0=None,
+    *,
+    rank: int,
+    iterations: int,
+    implicit: bool,
+    lam: float,
+    alpha: float,
+    cg_iterations: int,
+    seed: int,
+    dense_dtype: str = "bf16",
+    scale: float = 1.0,
+):
+    """Whole alternating loop on the dense-W path: every half-step is two
+    dense matmuls + the shared flat-operator CG. R enters as a jit
+    ARGUMENT (a loop invariant produced by fused ops would risk the TPU
+    fori-loop miscompile batched_cg's docstring records)."""
+    n_users_p, n_items_p = r.shape
+    if uf0 is not None and itf0 is not None:
+        uf, itf = uf0, itf0
+    else:
+        ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+        uf = (
+            jax.random.normal(ku, (n_users_p, rank), jnp.float32)
+            / jnp.sqrt(rank)
+        ) * (user_deg >= 0)[:, None]
+        itf = (
+            jax.random.normal(ki, (n_items_p, rank), jnp.float32)
+            / jnp.sqrt(rank)
+        ) * (item_deg >= 0)[:, None]
+
+    def body(_, fs):
+        uf, itf = fs
+        uf = _dense_half_step(
+            r, itf, user_deg, uf, solve_rows=True, implicit=implicit,
+            lam=lam, alpha=alpha, cg_iterations=cg_iterations,
+            dense_dtype=dense_dtype, scale=scale,
+        )
+        itf = _dense_half_step(
+            r, uf, item_deg, itf, solve_rows=False, implicit=implicit,
+            lam=lam, alpha=alpha, cg_iterations=cg_iterations,
+            dense_dtype=dense_dtype, scale=scale,
+        )
+        return uf, itf
+
+    return jax.lax.fori_loop(0, iterations, body, (uf, itf))
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "rank", "iterations", "implicit", "cg_iterations", "dense_dtype",
+        "scale",
+    ),
+)
+def _train_jit_dense_grid(
+    r: jax.Array,
+    user_deg: jax.Array,
+    item_deg: jax.Array,
+    lams: jax.Array,  # (G,)
+    alphas: jax.Array,  # (G,)
+    *,
+    rank: int,
+    iterations: int,
+    implicit: bool,
+    cg_iterations: int,
+    seed: int,
+    dense_dtype: str = "bf16",
+    scale: float = 1.0,
+):
+    """(λ, α) grid on the dense path: R is closed over (vmap broadcasts
+    it — ONE device matrix serves every grid point); the weight
+    derivations and solves batch over the grid axis."""
+
+    def one(lam, alpha):
+        return _train_jit_dense(
+            r, user_deg, item_deg,
+            rank=rank, iterations=iterations, implicit=implicit,
+            lam=lam, alpha=alpha, cg_iterations=cg_iterations, seed=seed,
+            dense_dtype=dense_dtype, scale=scale,
+        )
+
+    return jax.vmap(one)(lams, alphas)
+
+
+@dataclass
+class StagedDenseTrain:
+    """A dense-path train with the rating matrix resident on device.
+
+    Mirrors StagedWindowedTrain: built once per training set by
+    `stage_dense`; `run()` re-executes the compiled alternating loop
+    with zero further host→device traffic."""
+
+    device_args: tuple
+    static_kwargs: dict
+    n_users: int
+    n_items: int
+    host_prep_sec: float
+    transfer_sec: float
+
+    def run(self) -> tuple[jax.Array, jax.Array]:
+        return _train_jit_dense(*self.device_args, **self.static_kwargs)
+
+    def factors(self, uf, itf) -> tuple[np.ndarray, np.ndarray]:
+        return np.asarray(uf)[: self.n_users], np.asarray(itf)[: self.n_items]
+
+
+def dense_matrix_bytes(n_users: int, n_items: int, dense_dtype: str = "bf16") -> int:
+    """Padded dense-R footprint — the auto-dispatch gate's input."""
+    from predictionio_tpu.ops.dense import COL_PAD, ROW_BLOCK
+
+    n_u_p = -(-n_users // ROW_BLOCK) * ROW_BLOCK
+    n_i_p = -(-n_items // COL_PAD) * COL_PAD
+    from predictionio_tpu.ops.dense import BYTES_PER_CELL
+
+    return n_u_p * n_i_p * BYTES_PER_CELL.get(dense_dtype, 2)
+
+
+def dense_eligible(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_users: int,
+    n_items: int,
+    params: "ALSParams",
+    mesh=None,
+    dense_dtype: str = "bf16",
+) -> bool:
+    """Gate for the dense-W fast path.
+
+    Requires: env not opting out, rank within the gram-solver bound, no
+    mesh (the sharded dense variant is shard_map'd separately), the
+    padded matrix within the HBM budget, unique (user, item) pairs (a
+    dense cell can hold one rating; duplicate edges are summed by the
+    windowed path, so dup data falls back to preserve semantics), and —
+    explicit mode only — no zero-valued ratings (a dense zero must mean
+    "unobserved"). Auto mode also requires DENSE_AUTO_MIN_EDGES so small
+    (test-scale) trains keep their f32-exact windowed numerics unless
+    PIO_DENSE_ALS=1 opts in."""
+    env = os.environ.get("PIO_DENSE_ALS", "").strip()
+    if env == "0":
+        return False
+    if params.rank > GRAM_SOLVER_MAX_RANK or mesh is not None:
+        return False
+    if env != "1" and len(rows) < DENSE_AUTO_MIN_EDGES:
+        return False
+    budget = int(
+        os.environ.get("PIO_DENSE_ALS_BYTES", DENSE_DEFAULT_BYTES)
+    )
+    if dense_dtype == "bf16":  # the default: predict what auto picks
+        from predictionio_tpu.ops.dense import int8_scale
+
+        if int8_scale(vals) is not None:
+            dense_dtype = "int8"
+    if dense_matrix_bytes(n_users, n_items, dense_dtype) > budget:
+        return False
+    if not params.implicit_prefs and np.any(vals == 0.0):
+        return False
+    key = rows.astype(np.int64) * np.int64(n_items) + cols.astype(np.int64)
+    if np.unique(key).size != len(key):
+        logging.getLogger(__name__).info(
+            "dense ALS path skipped: duplicate (user, item) pairs"
+        )
+        return False
+    return True
+
+
+def stage_dense(
+    rows, cols, vals, n_users, n_items, params,
+    user_deg=None, item_deg=None, init_factors=None,
+    dense_dtype: str = "auto",
+) -> StagedDenseTrain:
+    """Stage the dense-path train: pad dims to the block quanta, push the
+    COO arrays once, densify ON DEVICE (the matrix never crosses the
+    host link), and keep it resident.
+
+    dense_dtype "auto" prefers int8 storage when every rating is exactly
+    representable as round(r·s) for a small scale s (ML-style ratings
+    are) — half the footprint and HBM stream of bf16, with block-local
+    dequantization; otherwise bf16. "f32" is the exactness mode tests
+    compare against the windowed path with."""
+    import time as _time
+
+    from predictionio_tpu.ops.dense import (
+        COL_PAD,
+        ROW_BLOCK,
+        densify,
+        int8_scale,
+    )
+
+    t0 = _time.perf_counter()
+    rows = np.asarray(rows, dtype=np.int32)
+    cols = np.asarray(cols, dtype=np.int32)
+    vals = np.asarray(vals, dtype=np.float32)
+    scale = 1.0
+    if dense_dtype in ("auto", "int8"):
+        s_q = int8_scale(vals)
+        if s_q is not None:
+            dense_dtype, scale = "int8", s_q
+        elif dense_dtype == "int8":
+            raise ValueError(
+                "dense_dtype='int8' but ratings are not exactly int8-"
+                "quantizable; use 'bf16' or 'auto'"
+            )
+        else:
+            dense_dtype = "bf16"
+    n_u_p = -(-n_users // ROW_BLOCK) * ROW_BLOCK
+    n_i_p = -(-n_items // COL_PAD) * COL_PAD
+    if user_deg is None:
+        user_deg = np.zeros(n_users, np.float32)
+        np.add.at(user_deg, rows, 1.0)
+    if item_deg is None:
+        item_deg = np.zeros(n_items, np.float32)
+        np.add.at(item_deg, cols, 1.0)
+
+    def pad_deg(deg, n_padded):
+        out = np.full(n_padded, -1.0, np.float32)  # -1 marks padding
+        out[: len(deg)] = deg
+        return out
+
+    uf0 = itf0 = None
+    if init_factors is not None:
+        uf_in = np.asarray(init_factors[0], np.float32)
+        itf_in = np.asarray(init_factors[1], np.float32)
+        if uf_in.shape != (n_users, params.rank) or itf_in.shape != (
+            n_items, params.rank,
+        ):
+            raise ValueError(
+                "init_factors shapes do not match (n_users/n_items, rank)"
+            )
+        uf0 = np.zeros((n_u_p, params.rank), np.float32)
+        uf0[:n_users] = uf_in
+        itf0 = np.zeros((n_i_p, params.rank), np.float32)
+        itf0[:n_items] = itf_in
+    host_prep = _time.perf_counter() - t0
+
+    t0 = _time.perf_counter()
+    r = densify(
+        jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals),
+        n_rows_p=n_u_p, n_cols_p=n_i_p, dense_dtype=dense_dtype,
+        scale=scale,
+    )
+    device_args = (
+        r,
+        jax.device_put(pad_deg(user_deg, n_u_p)),
+        jax.device_put(pad_deg(item_deg, n_i_p)),
+        jax.device_put(uf0) if uf0 is not None else None,
+        jax.device_put(itf0) if itf0 is not None else None,
+    )
+    # a tiny HOST FETCH, not just block_until_ready: draining the device
+    # queue through a fetch lets the densify transients actually
+    # deallocate before the train program's workspace is allocated —
+    # without it the first big train reproducibly hits RESOURCE_EXHAUSTED
+    # at ML-20M on a 16 GB chip (observed on the axon transport, whose
+    # frees are deferred until a sync point)
+    np.asarray(r[:1, :8])
+    transfer = _time.perf_counter() - t0
+    return StagedDenseTrain(
+        device_args=device_args,
+        static_kwargs=dict(
+            rank=params.rank,
+            iterations=params.iterations,
+            implicit=params.implicit_prefs,
+            lam=params.lambda_,
+            alpha=params.alpha,
+            cg_iterations=params.cg_iterations,
+            seed=params.seed,
+            dense_dtype=dense_dtype,
+            scale=scale,
+        ),
+        n_users=n_users,
+        n_items=n_items,
+        host_prep_sec=host_prep,
+        transfer_sec=transfer,
+    )
+
+
+def _train_dense(
+    rows, cols, vals, n_users, n_items, params,
+    user_deg, item_deg, user_vocab, item_vocab, init_factors,
+    dense_dtype: str = "auto",
+) -> "ALSFactors":
+    staged = stage_dense(
+        rows, cols, vals, n_users, n_items, params,
+        user_deg=user_deg, item_deg=item_deg, init_factors=init_factors,
+        dense_dtype=dense_dtype,
+    )
+    uf, itf = staged.factors(*staged.run())
+    return ALSFactors(
+        user_factors=uf,
+        item_factors=itf,
+        user_vocab=user_vocab or BiMap({}),
+        item_vocab=item_vocab or BiMap({}),
+        params=params,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -443,16 +809,26 @@ def train_grid(
     rows = np.asarray(rows, dtype=np.int32)
     cols = np.asarray(cols, dtype=np.int32)
     vals = np.asarray(vals, dtype=np.float32)
-    staged = stage_windowed(rows, cols, vals, n_users, n_items, base)
-    kwargs = dict(staged.static_kwargs)
-    for grid_axis_or_unsupported in ("lam", "alpha", "pallas_mode", "mesh"):
-        kwargs.pop(grid_axis_or_unsupported)
-    ufs, itfs = _train_jit_windowed_grid(
-        *staged.device_args[:12],
-        jnp.asarray([p.lambda_ for p in params_list], jnp.float32),
-        jnp.asarray([p.alpha for p in params_list], jnp.float32),
-        **kwargs,
-    )
+    lams = jnp.asarray([p.lambda_ for p in params_list], jnp.float32)
+    alphas = jnp.asarray([p.alpha for p in params_list], jnp.float32)
+    if dense_eligible(rows, cols, vals, n_users, n_items, base):
+        # the dense fast path vmaps cleanly: ONE device rating matrix
+        # serves every grid point (weight derivation + solves batch over
+        # the grid axis)
+        staged_d = stage_dense(rows, cols, vals, n_users, n_items, base)
+        kwargs = dict(staged_d.static_kwargs)
+        kwargs.pop("lam"), kwargs.pop("alpha")
+        ufs, itfs = _train_jit_dense_grid(
+            *staged_d.device_args[:3], lams, alphas, **kwargs
+        )
+    else:
+        staged = stage_windowed(rows, cols, vals, n_users, n_items, base)
+        kwargs = dict(staged.static_kwargs)
+        for grid_axis_or_unsupported in ("lam", "alpha", "pallas_mode", "mesh"):
+            kwargs.pop(grid_axis_or_unsupported)
+        ufs, itfs = _train_jit_windowed_grid(
+            *staged.device_args[:12], lams, alphas, **kwargs
+        )
     ufs, itfs = np.asarray(ufs), np.asarray(itfs)
     return [
         ALSFactors(
@@ -604,6 +980,12 @@ def train(
     np.add.at(user_deg, rows, 1.0)
     item_deg = np.zeros(n_items, np.float32)
     np.add.at(item_deg, cols, 1.0)
+
+    if dense_eligible(rows, cols, vals, n_users, n_items, params, mesh):
+        return _train_dense(
+            rows, cols, vals, n_users, n_items, params,
+            user_deg, item_deg, user_vocab, item_vocab, init_factors,
+        )
 
     if params.rank <= GRAM_SOLVER_MAX_RANK:
         return _train_windowed(

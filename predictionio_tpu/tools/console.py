@@ -396,13 +396,39 @@ def cmd_export(args) -> int:
     )
     from predictionio_tpu.data.storage.base import EventQuery
 
+    events_iter = storage.get_events().find(
+        EventQuery(app_id=app.id, channel_id=channel_id)
+    )
     n = 0
-    with open(args.output, "w") as f:
-        for e in storage.get_events().find(
-            EventQuery(app_id=app.id, channel_id=channel_id)
-        ):
-            f.write(e.to_json() + "\n")
-            n += 1
+    if getattr(args, "format", "json") == "parquet":
+        # reference parity: EventsToFile writes json OR parquet
+        # (tools/.../export/EventsToFile.scala:42); batches stream
+        # through one writer so a train-scale export stays O(batch)
+        import pyarrow.parquet as pq
+
+        from predictionio_tpu.data.storage.parquetfs import (
+            _SCHEMA,
+            events_to_table,
+        )
+
+        writer = pq.ParquetWriter(args.output, _SCHEMA)
+        batch: list = []
+        try:
+            for e in events_iter:
+                batch.append(e)
+                n += 1
+                if len(batch) >= 50_000:
+                    writer.write_table(events_to_table(batch))
+                    batch.clear()
+            if batch:
+                writer.write_table(events_to_table(batch))
+        finally:
+            writer.close()
+    else:
+        with open(args.output, "w") as f:
+            for e in events_iter:
+                f.write(e.to_json() + "\n")
+                n += 1
     print(f"[INFO] Exported {n} events to {args.output}")
     return 0
 
@@ -421,18 +447,49 @@ def cmd_import(args) -> int:
     )
     events = []
     errors = 0
-    with open(args.input) as f:
-        for i, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
+    fmt = getattr(args, "format", None)
+    if fmt == "parquet" or (fmt is None and args.input.endswith(".parquet")):
+        # round-trips `pio export --format parquet` (beyond-reference:
+        # FileToEvents reads json only). An explicit --format json
+        # overrides the extension sniff.
+        import pyarrow.parquet as pq
+
+        from predictionio_tpu.data.storage.parquetfs import table_to_events
+
+        def _bad_row(i, exc):
+            nonlocal errors
+            errors += 1
+            print(f"[WARN] row {i}: {exc}", file=sys.stderr)
+
+        try:
+            table = pq.read_table(args.input)
+        except Exception as exc:
+            return _fail(
+                f"{args.input} is not a readable parquet file: {exc}"
+            )
+        # with_index keeps ONE row numbering (physical, 0-based) across
+        # decode and validation warnings, even after skipped rows
+        for i, e in table_to_events(
+            table, on_error=_bad_row, with_index=True
+        ):
             try:
-                e = Event.from_json(line)
                 EventValidation.validate(e)
                 events.append(e)
             except Exception as exc:
-                errors += 1
-                print(f"[WARN] line {i}: {exc}", file=sys.stderr)
+                _bad_row(i, exc)
+    else:
+        with open(args.input) as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = Event.from_json(line)
+                    EventValidation.validate(e)
+                    events.append(e)
+                except Exception as exc:
+                    errors += 1
+                    print(f"[WARN] line {i}: {exc}", file=sys.stderr)
     storage.get_events().write(events, app.id, channel_id)
     print(f"[INFO] Imported {len(events)} events ({errors} malformed lines skipped)")
     return 0 if errors == 0 else 1
@@ -591,15 +648,27 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(func=cmd_status)
 
     # export / import
-    s = sub.add_parser("export", help="export events to JSON lines")
+    s = sub.add_parser(
+        "export", help="export events to JSON lines or parquet"
+    )
     s.add_argument("--app", required=True)
     s.add_argument("--channel")
     s.add_argument("--output", required=True)
+    s.add_argument(
+        "--format", choices=("json", "parquet"), default="json",
+        help="output codec (reference EventsToFile.scala:42 parity)",
+    )
     s.set_defaults(func=cmd_export)
-    s = sub.add_parser("import", help="import events from JSON lines")
+    s = sub.add_parser(
+        "import", help="import events from JSON lines or parquet"
+    )
     s.add_argument("--app", required=True)
     s.add_argument("--channel")
     s.add_argument("--input", required=True)
+    s.add_argument(
+        "--format", choices=("json", "parquet"), default=None,
+        help="input codec (default: sniff .parquet extension, else json)",
+    )
     s.set_defaults(func=cmd_import)
 
     return p

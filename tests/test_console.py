@@ -95,3 +95,109 @@ def test_export_import_roundtrip(cli, tmp_path, capsys):
     exported = [json.loads(l) for l in dst.read_text().splitlines()]
     assert len(exported) == 4
     assert {e["entityId"] for e in exported} == {f"u{i}" for i in range(4)}
+
+
+def test_export_import_parquet_roundtrip(cli, tmp_path, capsys):
+    """`pio export --format parquet` (EventsToFile.scala:42 parity) and
+    the parquet import round trip."""
+    cli("app", "new", "pqapp")
+    capsys.readouterr()
+    src = tmp_path / "events.jsonl"
+    src.write_text(
+        "\n".join(
+            json.dumps(
+                {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": f"u{i}",
+                    "targetEntityType": "item",
+                    "targetEntityId": f"i{i % 3}",
+                    "properties": {"rating": float(i % 5 + 1)},
+                    "eventTime": f"2026-01-0{i + 1}T00:00:00.000Z",
+                }
+            )
+            for i in range(5)
+        )
+    )
+    assert cli("import", "--app", "pqapp", "--input", str(src)) == 0
+    capsys.readouterr()
+
+    pq_out = tmp_path / "events.parquet"
+    assert (
+        cli("export", "--app", "pqapp", "--output", str(pq_out),
+            "--format", "parquet") == 0
+    )
+    assert "Exported 5 events" in capsys.readouterr().out
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(pq_out)
+    assert table.num_rows == 5
+    assert "properties" in table.schema.names
+
+    # round trip into a second app: same events come back
+    cli("app", "new", "pqapp2")
+    capsys.readouterr()
+    assert cli("import", "--app", "pqapp2", "--input", str(pq_out)) == 0
+    json_out = tmp_path / "roundtrip.jsonl"
+    assert cli("export", "--app", "pqapp2", "--output", str(json_out)) == 0
+    back = [json.loads(l) for l in json_out.read_text().splitlines()]
+    assert len(back) == 5
+    assert {b["entityId"] for b in back} == {f"u{i}" for i in range(5)}
+    assert all("rating" in b["properties"] for b in back)
+
+
+def test_pio_shell_namespace_and_piped_exec(fresh_storage, tmp_path, capsys):
+    """pio-shell (reference bin/pio-shell role): preloaded namespace over
+    the configured storage; piped stdin executes in it."""
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.tools import shell
+
+    Storage.set_instance(fresh_storage)
+    try:
+        ns = shell.make_namespace()
+        assert {"storage", "events", "facade", "Event", "EventQuery"} <= set(ns)
+        # the namespace is live: write through it, read back through it
+        ev = ns["Event"](
+            event="$set", entity_type="user", entity_id="u1",
+            properties={"plan": "pro"},
+        )
+        ns["events"].init_app(1)
+        ns["events"].insert(ev, 1)
+        got = list(ns["events"].find(ns["EventQuery"](app_id=1)))
+        assert len(got) == 1 and got[0].entity_id == "u1"
+        ns["help_pio"]()
+        assert "storage" in capsys.readouterr().out
+    finally:
+        Storage.set_instance(None)
+
+
+def test_pio_shell_script_subprocess(tmp_path):
+    """bin/pio-shell end to end: piped script runs with the framework
+    preloaded against env-configured storage."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": str(repo) + os.pathsep + env.get("PYTHONPATH", ""),
+        "PIO_STORAGE_SOURCES_T_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_T_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "T",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "T",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "T",
+    })
+    script = (
+        "events.init_app(1)\n"
+        "events.insert(Event(event='buy', entity_type='user',"
+        " entity_id='u9'), 1)\n"
+        "print('GOT', len(list(events.find(EventQuery(app_id=1)))))\n"
+    )
+    r = subprocess.run(
+        [str(repo / "bin" / "pio-shell")],
+        input=script, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "GOT 1" in r.stdout

@@ -1,0 +1,261 @@
+"""Dense-W ALS fast path (ops/dense.py + models/als.py dense solvers).
+
+The dense path replaces the windowed edge pass with plain dense matmuls
+over a device-resident rating matrix (the below-1%-density TPU move —
+see ops/dense.py). These tests pin: pass-level exactness against numpy,
+end-to-end agreement with the windowed path, the grid variant, resume,
+and the auto-dispatch gate.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models import als
+from predictionio_tpu.ops import dense as dense_ops
+
+
+def _coo(n_users=300, n_items=180, n_edges=6000, seed=0, signed=False):
+    rng = np.random.RandomState(seed)
+    rows = rng.randint(0, n_users, n_edges).astype(np.int32)
+    cols = rng.randint(0, n_items, n_edges).astype(np.int32)
+    key = rows.astype(np.int64) * n_items + cols
+    _, idx = np.unique(key, return_index=True)
+    rows, cols = rows[idx], cols[idx]
+    vals = (rng.randint(1, 11, len(rows)) / 2.0).astype(np.float32)
+    if signed:
+        vals *= rng.choice([-1.0, 1.0], len(rows)).astype(np.float32)
+    return rows, cols, vals
+
+
+def _pad_dims(n_users, n_items):
+    nup = -(-n_users // dense_ops.ROW_BLOCK) * dense_ops.ROW_BLOCK
+    nip = -(-n_items // dense_ops.COL_PAD) * dense_ops.COL_PAD
+    return nup, nip
+
+
+class TestDensePasses:
+    """Pass-level exactness (f32 mode) against a per-edge numpy fold."""
+
+    @pytest.mark.parametrize("implicit", [True, False])
+    @pytest.mark.parametrize("signed", [False, True])
+    def test_row_and_col_pass_match_numpy(self, implicit, signed):
+        import jax.numpy as jnp
+
+        if not implicit and signed:
+            pytest.skip("explicit mode: sign carries through r itself")
+        nu, ni, k, alpha = 100, 70, 8, 2.0
+        rows, cols, vals = _coo(nu, ni, 900, seed=1, signed=signed)
+        rng = np.random.RandomState(2)
+        y = rng.randn(ni, k).astype(np.float32)
+        x = rng.randn(nu, k).astype(np.float32)
+        nup, nip = _pad_dims(nu, ni)
+        yp = np.zeros((nip, k), np.float32)
+        yp[:ni] = y
+        xp = np.zeros((nup, k), np.float32)
+        xp[:nu] = x
+        r = dense_ops.densify(
+            jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals),
+            n_rows_p=nup, n_cols_p=nip, dense_dtype="f32",
+        )
+
+        def w(v):
+            if implicit:
+                return (1.0 + alpha * abs(v)) * (v > 0), alpha * abs(v)
+            return v, 1.0
+
+        b_ref = np.zeros((nu, k))
+        g_ref = np.zeros((nu, k, k))
+        bc_ref = np.zeros((ni, k))
+        gc_ref = np.zeros((ni, k, k))
+        for r_, c_, v_ in zip(rows, cols, vals):
+            w1, wg = w(v_)
+            b_ref[r_] += w1 * y[c_]
+            g_ref[r_] += wg * np.outer(y[c_], y[c_])
+            bc_ref[c_] += w1 * x[r_]
+            gc_ref[c_] += wg * np.outer(x[r_], x[r_])
+
+        b, corr = dense_ops.dense_row_pass(
+            r, jnp.asarray(yp), implicit=implicit, alpha=alpha,
+            dense_dtype="f32",
+        )
+        np.testing.assert_allclose(
+            np.asarray(b)[:nu], b_ref, rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(corr)[:nu].reshape(nu, k, k), g_ref,
+            rtol=1e-4, atol=1e-4,
+        )
+        bc, gc = dense_ops.dense_col_pass(
+            r, jnp.asarray(xp), implicit=implicit, alpha=alpha,
+            dense_dtype="f32",
+        )
+        np.testing.assert_allclose(
+            np.asarray(bc)[:ni], bc_ref, rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(gc)[:ni].reshape(ni, k, k), gc_ref,
+            rtol=1e-4, atol=1e-4,
+        )
+
+
+class TestDenseTrain:
+    @pytest.mark.parametrize("implicit", [True, False])
+    def test_f32_dense_matches_windowed(self, implicit):
+        rows, cols, vals = _coo()
+        p = als.ALSParams(
+            rank=8, iterations=6, implicit_prefs=implicit,
+            alpha=2.0, lambda_=0.05,
+        )
+        ref = als.train(rows, cols, vals, 300, 180, p)  # windowed
+        staged = als.stage_dense(
+            rows, cols, vals, 300, 180, p, dense_dtype="f32"
+        )
+        uf, itf = staged.factors(*staged.run())
+        # same math, different summation order + truncated CG → small
+        # per-element drift compounds over alternating iterations; the
+        # implicit operator is well-conditioned (tight), ALS-WR less so
+        tol = 2e-3 if implicit else 5e-2
+        np.testing.assert_allclose(
+            uf, ref.user_factors, rtol=tol, atol=tol
+        )
+        np.testing.assert_allclose(
+            itf, ref.item_factors, rtol=tol, atol=tol
+        )
+
+    def test_bf16_dense_is_finite_and_close(self):
+        rows, cols, vals = _coo()
+        p = als.ALSParams(rank=8, iterations=6, alpha=2.0, lambda_=0.05)
+        ref = als.train(rows, cols, vals, 300, 180, p)
+        staged = als.stage_dense(
+            rows, cols, vals, 300, 180, p, dense_dtype="bf16"
+        )
+        uf, itf = staged.factors(*staged.run())
+        assert np.all(np.isfinite(uf)) and np.all(np.isfinite(itf))
+        c = np.corrcoef(uf.ravel(), ref.user_factors.ravel())[0, 1]
+        assert c > 0.999
+
+    def test_signed_feedback(self):
+        """Dislikes (r<0): conf uses |r|, pref is 0 — dense weights must
+        reproduce the windowed path's signed-implicit semantics."""
+        rows, cols, vals = _coo(signed=True, seed=5)
+        p = als.ALSParams(rank=6, iterations=5, alpha=1.5, lambda_=0.05)
+        ref = als.train(rows, cols, vals, 300, 180, p)
+        staged = als.stage_dense(
+            rows, cols, vals, 300, 180, p, dense_dtype="f32"
+        )
+        uf, itf = staged.factors(*staged.run())
+        np.testing.assert_allclose(
+            uf, ref.user_factors, rtol=2e-3, atol=2e-3
+        )
+
+    def test_resume_matches_straight_run(self):
+        rows, cols, vals = _coo(seed=7)
+        p_full = als.ALSParams(rank=6, iterations=8)
+        p_half = als.ALSParams(rank=6, iterations=4)
+        full = als.stage_dense(
+            rows, cols, vals, 300, 180, p_full, dense_dtype="f32"
+        )
+        uf_full, itf_full = full.factors(*full.run())
+        first = als.stage_dense(
+            rows, cols, vals, 300, 180, p_half, dense_dtype="f32"
+        )
+        uf1, itf1 = first.factors(*first.run())
+        second = als.stage_dense(
+            rows, cols, vals, 300, 180, p_half,
+            init_factors=(uf1, itf1), dense_dtype="f32",
+        )
+        uf2, itf2 = second.factors(*second.run())
+        np.testing.assert_allclose(uf2, uf_full, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(itf2, itf_full, rtol=1e-3, atol=1e-4)
+
+
+class TestDenseGrid:
+    def test_grid_matches_per_point_runs(self):
+        import jax.numpy as jnp
+
+        rows, cols, vals = _coo(seed=9)
+        lams = [0.01, 0.1, 1.0]
+        base = als.ALSParams(rank=6, iterations=4)
+        staged = als.stage_dense(
+            rows, cols, vals, 300, 180, base, dense_dtype="f32"
+        )
+        kwargs = dict(staged.static_kwargs)
+        kwargs.pop("lam"), kwargs.pop("alpha")
+        ufs, itfs = als._train_jit_dense_grid(
+            *staged.device_args[:3],
+            jnp.asarray(lams, jnp.float32),
+            jnp.asarray([1.0] * len(lams), jnp.float32),
+            **kwargs,
+        )
+        for g, lam in enumerate(lams):
+            p = als.ALSParams(rank=6, iterations=4, lambda_=lam)
+            one = als.stage_dense(
+                rows, cols, vals, 300, 180, p, dense_dtype="f32"
+            )
+            uf, itf = one.factors(*one.run())
+            np.testing.assert_allclose(
+                np.asarray(ufs[g])[:300], uf, rtol=1e-4, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(itfs[g])[:180], itf, rtol=1e-4, atol=1e-5
+            )
+
+
+class TestDenseGate:
+    def test_gate_conditions(self, monkeypatch):
+        rows, cols, vals = _coo(n_edges=500, seed=3)
+        p = als.ALSParams(rank=8)
+        ok = lambda **kw: als.dense_eligible(
+            rows, cols, vals, 300, 180, p, **kw
+        )
+        # auto mode: below the min-edge bar → windowed keeps the wheel
+        monkeypatch.delenv("PIO_DENSE_ALS", raising=False)
+        assert not ok()
+        # forced on: eligible at any size
+        monkeypatch.setenv("PIO_DENSE_ALS", "1")
+        assert ok()
+        # forced off wins
+        monkeypatch.setenv("PIO_DENSE_ALS", "0")
+        assert not ok()
+        monkeypatch.setenv("PIO_DENSE_ALS", "1")
+        # meshes take the windowed/sharded path
+        class FakeMesh:
+            pass
+
+        assert not ok(mesh=FakeMesh())
+        # memory budget
+        monkeypatch.setenv("PIO_DENSE_ALS_BYTES", "1000")
+        assert not ok()
+        monkeypatch.delenv("PIO_DENSE_ALS_BYTES")
+        # duplicate pairs fall back (dense would merge them)
+        dup_rows = np.concatenate([rows, rows[:1]])
+        dup_cols = np.concatenate([cols, cols[:1]])
+        dup_vals = np.concatenate([vals, vals[:1]])
+        assert not als.dense_eligible(
+            dup_rows, dup_cols, dup_vals, 300, 180, p
+        )
+        # explicit with zero-valued ratings falls back
+        z_vals = vals.copy()
+        z_vals[0] = 0.0
+        pe = als.ALSParams(rank=8, implicit_prefs=False)
+        assert not als.dense_eligible(
+            rows, cols, z_vals, 300, 180, pe
+        )
+
+    def test_train_dispatches_dense_when_forced(self, monkeypatch):
+        rows, cols, vals = _coo(n_edges=800, seed=4)
+        called = {}
+        real = als._train_dense
+
+        def spy(*a, **kw):
+            called["yes"] = True
+            return real(*a, **kw)
+
+        monkeypatch.setattr(als, "_train_dense", spy)
+        monkeypatch.setenv("PIO_DENSE_ALS", "1")
+        m = als.train(rows, cols, vals, 300, 180, als.ALSParams(rank=6, iterations=2))
+        assert called.get("yes")
+        assert m.user_factors.shape == (300, 6)
+        assert np.all(np.isfinite(m.user_factors))
