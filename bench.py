@@ -216,13 +216,15 @@ def bench_tpu(rows, cols, vals):
         runs = []
         for _ in range(N_RUNS):
             t0 = time.perf_counter()
-            sync(*staged.run())
+            uf_w, itf_w = staged.run()
+            sync(uf_w, itf_w)
             runs.append(time.perf_counter() - t0)
         runs = runs[1:]  # discard the first timed run
         best = min(runs)
         pallas = staged.static_kwargs["pallas_mode"] is not None
         model_bytes, min_bytes = windowed_bytes_model(staged, pallas)
         return staged, {
+            "_factors_device": (uf_w, itf_w),
             "runs_sec": runs,
             "throughput": [N_EVENTS * ITERATIONS / r for r in runs],
             "device_best_sec": best,
@@ -247,14 +249,14 @@ def bench_tpu(rows, cols, vals):
         d_runs = []
         for _ in range(N_RUNS):
             t0 = time.perf_counter()
-            sync(*staged_d.run())
+            uf_d, itf_d = staged_d.run()
+            sync(uf_d, itf_d)
             d_runs.append(time.perf_counter() - t0)
         d_runs = d_runs[1:]
         best_d = min(d_runs)
         d_dtype = staged_d.static_kwargs["dense_dtype"]
         n_u_p, n_i_p = staged_d.device_args[0].shape
         model_bytes, mxu_flops = dense_models(n_u_p, n_i_p, d_dtype)
-        uf_d, itf_d = staged_d.run()
         dense = {
             "runs_sec": d_runs,
             "throughput": [N_EVENTS * ITERATIONS / r for r in d_runs],
@@ -279,6 +281,7 @@ def bench_tpu(rows, cols, vals):
     _prior_mode = os.environ.get("PIO_PALLAS_WINDOWED")
     staged, main = measure(None)  # default: pallas on TPU, XLA elsewhere
     _, xla = measure("0")
+    xla.pop("_factors_device", None)
     # restore the caller's setting for the e2e train below
     os.environ.pop("PIO_PALLAS_WINDOWED", None)
     if _prior_mode is not None:
@@ -303,7 +306,7 @@ def bench_tpu(rows, cols, vals):
         # cross-check the two implementations at FULL scale (the r4
         # miscompile lesson: only full-scale disagreement catches TPU
         # codegen bugs) — near-1 correlation, and both finite by sync()
-        uf_w, itf_w = staged.factors(*staged.run())
+        uf_w, itf_w = staged.factors(*main.pop("_factors_device"))
         uf_d, itf_d = dense.pop("factors")
         dense["factor_corr_users"] = float(
             np.corrcoef(uf_d.ravel(), uf_w.ravel())[0, 1]
@@ -402,7 +405,29 @@ def bench_grid_tuning():
     for p in params_list:
         als.train(rows, cols, vals, nu, ni, p)
     t_seq = time.perf_counter() - t0
-    return {"grid_sec": t_grid, "seq_sec": t_seq, "speedup": t_seq / t_grid}
+
+    # rank-axis grid (VERDICT r4 #7): 2 ranks x 2 lambdas — per-rank
+    # batched launches over ONE shared staging vs 4 serial trains
+    rank_list = [
+        als.ALSParams(rank=r, iterations=10, lambda_=lam)
+        for r in (RANK, RANK + 6)
+        for lam in (0.01, 0.1)
+    ]
+    als.train_grid(rows, cols, vals, nu, ni, rank_list)  # warm
+    for p in (rank_list[0], rank_list[2]):  # warm both rank shapes
+        als.train(rows, cols, vals, nu, ni, p)
+    t0 = time.perf_counter()
+    als.train_grid(rows, cols, vals, nu, ni, rank_list)
+    t_rgrid = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for p in rank_list:
+        als.train(rows, cols, vals, nu, ni, p)
+    t_rseq = time.perf_counter() - t0
+    return {
+        "grid_sec": t_grid, "seq_sec": t_seq, "speedup": t_seq / t_grid,
+        "rank_grid_sec": t_rgrid, "rank_seq_sec": t_rseq,
+        "rank_grid_speedup": t_rseq / t_rgrid,
+    }
 
 
 def bench_serving_device():
@@ -557,16 +582,23 @@ def bench_serving_framework():
     )
     port = srv.start()
     try:
-        n_clients = 32
-        stats = _hammer_query_server(
-            port,
-            lambda i: json.dumps(
-                {"user": f"u{i % n_users_serve}", "num": 10}
-            ).encode(),
-            n_clients=n_clients,
-            n_per=8,
-        )
-        return dict(stats, clients=n_clients)
+        # client sweep (VERDICT r4 #5): closed-loop clients bound the
+        # batch the dispatcher can fill — on the serialized tunnel each
+        # device round trip serves at most n_clients queries, so qps
+        # should scale with clients until max_batch (64) saturates
+        sweep = []
+        for n_clients in (32, 64, 128):
+            stats = _hammer_query_server(
+                port,
+                lambda i: json.dumps(
+                    {"user": f"u{i % n_users_serve}", "num": 10}
+                ).encode(),
+                n_clients=n_clients,
+                n_per=8 if n_clients <= 64 else 5,
+            )
+            sweep.append(dict(stats, clients=n_clients))
+        best = max(sweep, key=lambda r: r["qps"])
+        return dict(best, sweep=sweep)
     finally:
         srv.stop()
 
@@ -720,22 +752,229 @@ def bench_ur_framework():
     )
     port = srv.start()
     try:
-        stats = _hammer_query_server(
-            port,
-            lambda i: json.dumps(
-                {
-                    "user": f"u{i % n_users_ur}",
-                    "num": 10,
-                    "exclude_seen": True,
-                }
-            ).encode(),
-            n_clients=32,
-            n_per=6,
-            timeout=120.0,
-        )
-        return dict(stats, catalog=n_items_ur)
+        # same client sweep as the ALS serving bench: 32 closed-loop
+        # clients cap batches at 32 (measured ~110 qps at a 273 ms
+        # device round trip); 64+ fill max_batch and should approach
+        # the 64/0.273 ≈ 234 qps direct-path ceiling
+        sweep = []
+        for n_clients in (32, 64, 128):
+            stats = _hammer_query_server(
+                port,
+                lambda i: json.dumps(
+                    {
+                        "user": f"u{i % n_users_ur}",
+                        "num": 10,
+                        "exclude_seen": True,
+                    }
+                ).encode(),
+                n_clients=n_clients,
+                n_per=6 if n_clients <= 64 else 4,
+                timeout=120.0,
+            )
+            sweep.append(dict(stats, clients=n_clients))
+        best = max(sweep, key=lambda r: r["qps"])
+        return dict(best, catalog=n_items_ur, sweep=sweep)
     finally:
         srv.stop()
+
+
+def bench_sharded_ingestion():
+    """Ingest scaling across storage shards (VERDICT r4 #6): the batch
+    endpoint -> entity-hash routing -> per-shard bulk writes, measured
+    against 1, 2 and 4 sqlite-backed storage DAEMONS (real processes,
+    real RPC — the HBase distributed-write role, HBEventsUtil.scala:
+    81-106). Near-linear scaling is the claim the sharded store makes."""
+    import concurrent.futures
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import urllib.request
+
+    from predictionio_tpu.data.api.server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data.storage.base import AccessKey, App
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    def free_port():
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        p = sk.getsockname()[1]
+        sk.close()
+        return p
+
+    def _reap(children):
+        for c in children:
+            c.terminate()
+        for c in children:
+            try:
+                c.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                c.kill()
+                c.wait()
+
+    rng = np.random.RandomState(5)
+    batches_per, batch_size = 12 if SMALL else 60, 50
+
+    def one_config(n_shards: int) -> dict:
+        n_writers = 4 * n_shards  # keep every front end fed
+        tmp = tempfile.mkdtemp(prefix=f"pio_shard_ingest{n_shards}_")
+        procs, ports = [], []
+        try:
+            for tag in range(n_shards):
+                port = free_port()
+                ports.append(port)
+                env = dict(os.environ)
+                env.update({
+                    "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+                    "PIO_STORAGE_SOURCES_SQL_PATH": f"{tmp}/s{tag}.db",
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+                    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+                    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+                })
+                procs.append(subprocess.Popen(
+                    [_sys.executable, "-m",
+                     "predictionio_tpu.data.api.storage_server",
+                     "--host", "127.0.0.1", "--port", str(port)],
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                ))
+            for port in ports:
+                for _ in range(100):
+                    try:
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/health", timeout=1
+                        )
+                        break
+                    except Exception:
+                        time.sleep(0.1)
+            # metadata lives on daemon 0 so MULTIPLE event-server
+            # processes share apps/keys — one front end saturates its
+            # GIL near 9k ev/s, so horizontal ingest scale needs the
+            # reference's shape: N event servers over the shared store
+            shard_spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+            cfg = StorageConfig(
+                sources={
+                    "SH": SourceConfig("SH", "sharded", {
+                        "SHARDS": shard_spec,
+                    }),
+                    "RM": SourceConfig("RM", "remote", {
+                        "HOST": "127.0.0.1", "PORT": str(ports[0]),
+                    }),
+                },
+                repositories={
+                    "METADATA": "RM", "EVENTDATA": "SH",
+                    "MODELDATA": "RM",
+                },
+            )
+            storage = Storage(cfg)
+            app_id = storage.get_meta_data_apps().insert(
+                App(0, "shardingest")
+            )
+            storage.get_events().init_app(app_id)
+            storage.get_meta_data_access_keys().insert(
+                AccessKey(key="BENCHKEY", app_id=app_id, events=())
+            )
+            n_front = n_shards  # one ingest front end per shard
+            fronts, fports = [], []
+            fenv = dict(os.environ)
+            fenv.update({
+                "PIO_STORAGE_SOURCES_SH_TYPE": "sharded",
+                "PIO_STORAGE_SOURCES_SH_SHARDS": shard_spec,
+                "PIO_STORAGE_SOURCES_RM_TYPE": "remote",
+                "PIO_STORAGE_SOURCES_RM_HOST": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_RM_PORT": str(ports[0]),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "RM",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SH",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "RM",
+            })
+            for _f in range(n_front):
+                fp = free_port()
+                fports.append(fp)
+                fronts.append(subprocess.Popen(
+                    [_sys.executable, "-m",
+                     "predictionio_tpu.tools.console", "eventserver",
+                     "--ip", "127.0.0.1", "--port", str(fp)],
+                    env=fenv, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                ))
+            for fp in fports:
+                for _ in range(150):
+                    try:
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{fp}/", timeout=1
+                        )
+                        break
+                    except Exception:
+                        time.sleep(0.1)
+
+            def make_batch():
+                return json.dumps([
+                    {
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": f"u{int(rng.randint(50_000))}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{int(rng.randint(5_000))}",
+                        "properties": {"rating": float(rng.randint(1, 6))},
+                    }
+                    for _ in range(batch_size)
+                ]).encode()
+
+            payloads = [
+                [make_batch() for _ in range(batches_per)]
+                for _ in range(n_writers)
+            ]
+            def writer(w):
+                fp = fports[w % len(fports)]  # writers spread over fronts
+                url = (
+                    f"http://127.0.0.1:{fp}/batch/events.json"
+                    f"?accessKey=BENCHKEY"
+                )
+                for body in payloads[w]:
+                    req = urllib.request.Request(
+                        url, data=body,
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    )
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        r.read()
+
+            try:
+                writer(0)  # warm
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(
+                    n_writers
+                ) as pool:
+                    list(pool.map(writer, range(n_writers)))
+                wall = time.perf_counter() - t0
+                return {
+                    "events_per_sec":
+                        n_writers * batches_per * batch_size / wall,
+                    "front_ends": n_front,
+                }
+            finally:
+                _reap(fronts)
+        finally:
+            _reap(procs)
+
+    shard_counts = (1, 2) if SMALL else (1, 2, 4)
+    # the scaling claim needs real cores: daemons + front ends + writers
+    # all contend for CPU, so on a 1-2 core host more shards only add
+    # context switching — record the host size so the ledger reads
+    # honestly either way
+    return {
+        "host_cpus": os.cpu_count(),
+        "per_shards": [
+            {"shards": n, **one_config(n)} for n in shard_counts
+        ],
+    }
 
 
 def main():
@@ -747,6 +986,7 @@ def main():
     framework = bench_serving_framework()
     ur = bench_ur_framework()
     ingest = bench_event_ingestion()
+    ingest_sharded = bench_sharded_ingestion()
     dense = tpu.get("dense")
     primary = dense if dense is not None else tpu
     thr = primary["throughput"]
@@ -813,19 +1053,39 @@ def main():
         "als_grid_speedup_4pt": round(grid["speedup"], 2),
         "als_grid_sec": round(grid["grid_sec"], 2),
         "als_grid_seq_sec": round(grid["seq_sec"], 2),
+        "als_rank_grid_speedup_2x2": round(grid["rank_grid_speedup"], 2),
+        "als_rank_grid_sec": round(grid["rank_grid_sec"], 2),
+        "als_rank_grid_seq_sec": round(grid["rank_seq_sec"], 2),
         "serving_device_p50_ms": round(dev_p50_ms, 2),
         "serving_device_qps": round(dev_qps, 1),
         "serving_framework_qps": round(framework["qps"], 1),
         "serving_framework_p50_ms": round(framework["p50_ms"], 1),
         "serving_framework_p99_ms": round(framework["p99_ms"], 1),
         "serving_clients": framework["clients"],
+        "serving_client_sweep": [
+            {"clients": r["clients"], "qps": round(r["qps"], 1),
+             "p50_ms": round(r["p50_ms"], 1)}
+            for r in framework["sweep"]
+        ],
         "ur_framework_qps": round(ur["qps"], 1),
         "ur_framework_p50_ms": round(ur["p50_ms"], 1),
         "ur_framework_p99_ms": round(ur["p99_ms"], 1),
+        "ur_clients": ur["clients"],
+        "ur_client_sweep": [
+            {"clients": r["clients"], "qps": round(r["qps"], 1),
+             "p50_ms": round(r["p50_ms"], 1)}
+            for r in ur["sweep"]
+        ],
         "ur_catalog_items": ur["catalog"],
         "ingest_events_per_sec": round(ingest["events_per_sec"], 1),
         "ingest_backend": ingest["backend"],
         "ingest_writers": ingest["writers"],
+        "ingest_sharded_host_cpus": ingest_sharded["host_cpus"],
+        "ingest_sharded_events_per_sec": [
+            {"shards": r["shards"], "front_ends": r["front_ends"],
+             "events_per_sec": round(r["events_per_sec"], 1)}
+            for r in ingest_sharded["per_shards"]
+        ],
         "workload": f"{N_EVENTS} events, {N_USERS}x{N_ITEMS}, rank {RANK}, "
                     f"{ITERATIONS} iters",
     }))

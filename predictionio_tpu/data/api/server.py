@@ -113,7 +113,9 @@ class _Handler(JsonHandler):
         )
 
     # -- event insert core -------------------------------------------------
-    def _insert_event(self, auth: AuthData, obj: dict) -> str:
+    def _admit_event(self, auth: AuthData, obj: dict) -> Event:
+        """Validation + whitelist + input blockers — everything before
+        the storage write (shared by the single and batch paths)."""
         try:
             event = Event.from_json_dict(obj)
             EventValidation.validate(event)
@@ -128,12 +130,20 @@ class _Handler(JsonHandler):
             self.server.plugin_context.run_blockers(obj, ctx)
         except Exception as e:
             raise _HttpError(403, f"event rejected: {e}")
-        event_id = self.server.storage.get_events().insert(
-            event, auth.app_id, auth.channel_id
-        )
+        return event
+
+    def _after_insert(self, auth: AuthData, obj: dict, event: Event) -> None:
+        ctx = {"appId": auth.app_id, "channelId": auth.channel_id}
         self.server.plugin_context.run_sniffers(obj, ctx)
         if self.server.stats is not None:
             self.server.stats.update(auth.app_id, 201, event)
+
+    def _insert_event(self, auth: AuthData, obj: dict) -> str:
+        event = self._admit_event(auth, obj)
+        event_id = self.server.storage.get_events().insert(
+            event, auth.app_id, auth.channel_id
+        )
+        self._after_insert(auth, obj, event)
         return event_id
 
     # -- routes ------------------------------------------------------------
@@ -200,15 +210,49 @@ class _Handler(JsonHandler):
                 f"Batch request must have less than or equal to "
                 f"{MAX_EVENTS_PER_BATCH} events",
             )
-        results = []
-        for obj in objs:
+        # admit everything first, then ONE bulk storage write for the
+        # admitted events: per-event insert() cost one storage RPC each
+        # over the remote/sharded backends — 50 round trips per batch
+        # (the whole point of the batch endpoint is to amortize them)
+        results: list = [None] * len(objs)
+        admitted: list[tuple[int, dict, Event]] = []
+        for pos, obj in enumerate(objs):
             try:
                 if not isinstance(obj, dict):
                     raise _HttpError(400, "event JSON must be an object")
-                event_id = self._insert_event(auth, obj)
-                results.append({"status": 201, "eventId": event_id})
+                admitted.append((pos, obj, self._admit_event(auth, obj)))
             except _HttpError as e:
-                results.append({"status": e.status, "message": e.message})
+                results[pos] = {"status": e.status, "message": e.message}
+        if admitted:
+            from predictionio_tpu.data.storage.sharded import (
+                PartialBatchWriteError,
+            )
+
+            try:
+                ids = self.server.storage.get_events().insert_batch(
+                    [e for _p, _o, e in admitted],
+                    auth.app_id,
+                    auth.channel_id,
+                )
+            except PartialBatchWriteError as e:
+                # per-position truth survives a partial shard outage:
+                # persisted events report 201 (a blanket failure would
+                # invite a full-batch retry that duplicates them)
+                ids = e.ids
+            except Exception as e:
+                for pos, _obj, _ev in admitted:
+                    results[pos] = {"status": 503, "message": str(e)}
+                ids = None
+            if ids is not None:
+                for (pos, obj, event), eid in zip(admitted, ids):
+                    if eid is None:
+                        results[pos] = {
+                            "status": 503,
+                            "message": "storage shard unavailable",
+                        }
+                        continue
+                    results[pos] = {"status": 201, "eventId": eid}
+                    self._after_insert(auth, obj, event)
         self._respond(200, results)
 
     def _get_event(self, auth: AuthData, event_id: str) -> None:

@@ -37,7 +37,8 @@ _DAO_TABLE: dict[str, tuple[str, frozenset[str]]] = {
         "get_events",
         frozenset({
             "init_app", "remove_app", "insert", "insert_batch", "delete",
-            "delete_batch", "get", "find", "data_signature",
+            "delete_batch", "get", "find", "find_entities_batch",
+            "data_signature",
         }),
     ),
     "apps": (
@@ -78,6 +79,10 @@ _DAO_TABLE: dict[str, tuple[str, frozenset[str]]] = {
 class _Handler(BaseHTTPRequestHandler):
     server_version = "pio-storage/1.0"
     protocol_version = "HTTP/1.1"
+
+    # response status line/headers/body are separate writes: without
+    # this, Nagle + the client's delayed ACK stalls every reply ~40 ms
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # route through logging, not stderr
         log.debug("storage-server: " + fmt, *args)

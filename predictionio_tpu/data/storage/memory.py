@@ -103,6 +103,46 @@ class MemoryEventStore(base.EventStore):
         with self._lock:
             return self._table(app_id, channel_id).get(event_id)
 
+    def find_entities_batch(
+        self,
+        app_id,
+        entity_type,
+        entity_ids,
+        channel_id=None,
+        event_names=None,
+        limit_per_entity=None,
+        reversed=True,
+    ):
+        """Bulk serving read: ONE lock pass + per-entity index lookups
+        (the default per-entity loop re-acquires the lock and re-sorts
+        per call)."""
+        ev_set = set(event_names) if event_names is not None else None
+        with self._lock:
+            table = self._table(app_id, channel_id)
+            index = self._index(app_id, channel_id)
+            raw = {
+                eid: [table[i] for i in index.get(eid, ()) if i in table]
+                for eid in dict.fromkeys(entity_ids)
+            }
+        out = {}
+        for eid, events in raw.items():
+            events = [
+                e
+                for e in events
+                if e.entity_type == entity_type
+                and (ev_set is None or e.event in ev_set)
+            ]
+            events.sort(
+                key=lambda e: (e.event_time, e.event_id or ""),
+                reverse=reversed,
+            )
+            out[eid] = (
+                events[:limit_per_entity]
+                if limit_per_entity is not None
+                else events
+            )
+        return out
+
     def find(self, query: EventQuery) -> Iterator[Event]:
         with self._lock:
             table = self._table(query.app_id, query.channel_id)

@@ -73,6 +73,25 @@ _TRANSIENT = (StorageUnreachableError, OSError)
 log = logging.getLogger(__name__)
 
 
+class PartialBatchWriteError(StorageError):
+    """A bulk write landed on some shards but not others.
+
+    `ids` aligns with the input positions: the assigned event_id where
+    the write persisted, None where its shard failed. Callers that
+    report per-event statuses (the event server's batch endpoint) can
+    stay accurate instead of declaring the whole batch failed — a
+    blanket failure invites a client retry that duplicates the events
+    that DID persist."""
+
+    def __init__(self, ids, cause: Exception):
+        n_fail = sum(1 for i in ids if i is None)
+        super().__init__(
+            f"bulk write failed on {n_fail}/{len(ids)} events: {cause}"
+        )
+        self.ids = list(ids)
+        self.cause = cause
+
+
 class ShardDownError(StorageError):
     """A shard stayed unreachable through the retry budget.
 
@@ -339,21 +358,29 @@ class ShardedEventStore(base.EventStore):
                 )
         if evict_calls:
             self._broadcast(evict_calls)
-        write_res = self._broadcast(
-            [
-                (
-                    sx,
-                    self._stores[sx].insert_batch,
-                    ([e for _p, e in pairs], app_id, channel_id),
-                )
-                for sx, pairs in groups.items()
-            ],
-            retries=0,  # re-invoking mints fresh req_ids (see _shard_call)
-        )
+        # per-shard writes fan out concurrently; outcomes are collected
+        # per shard so a partial failure stays attributable per EVENT
+        futs = {
+            sx: self._pool.submit(
+                self._shard_call, sx, self._stores[sx].insert_batch,
+                [e for _p, e in pairs], app_id, channel_id,
+                retries=0,  # re-invoking mints fresh req_ids (_shard_call)
+            )
+            for sx, pairs in groups.items()
+        }
         out: list[Optional[str]] = [None] * len(events)
+        first_err: Optional[Exception] = None
         for sx, pairs in groups.items():
-            for (pos, _e), eid in zip(pairs, write_res[sx]):
+            try:
+                ids = futs[sx].result()
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+                continue
+            for (pos, _e), eid in zip(pairs, ids):
                 out[pos] = eid
+        if first_err is not None:
+            raise PartialBatchWriteError(out, first_err)
         return out  # type: ignore[return-value]
 
     # -- by-id ops: the id does not encode the shard → broadcast -----------
@@ -499,6 +526,43 @@ class ShardedEventStore(base.EventStore):
         if query.limit is not None and query.limit >= 0:
             return itertools.islice(merged, query.limit)
         return merged
+
+    def find_entities_batch(
+        self,
+        app_id,
+        entity_type,
+        entity_ids,
+        channel_id=None,
+        event_names=None,
+        limit_per_entity=None,
+        reversed=True,
+    ):
+        """Entity locality makes this a per-shard fan-out: each shard
+        answers for ITS entities in one bulk call, all shards in one
+        concurrent round (never partial — a missing user history would
+        silently impersonate a cold-start user)."""
+        groups: dict[int, list[str]] = {}
+        for eid in dict.fromkeys(entity_ids):
+            groups.setdefault(self._for_entity(eid), []).append(eid)
+
+        def one(sx: int, ids: list) -> dict:
+            return self._stores[sx].find_entities_batch(
+                app_id,
+                entity_type,
+                ids,
+                channel_id=channel_id,
+                event_names=event_names,
+                limit_per_entity=limit_per_entity,
+                reversed=reversed,
+            )
+
+        res = self._broadcast(
+            [(sx, one, (sx, ids)) for sx, ids in groups.items()]
+        )
+        out: dict = {}
+        for part in res.values():
+            out.update(part)
+        return out
 
     def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
         res = self._broadcast(

@@ -145,6 +145,7 @@ def _half_step_windowed(
     alpha: float,
     cg_iterations: int,
     pallas_mode: Optional[str] = None,
+    mesh=None,
 ) -> jax.Array:
     """One ALS half-step with the windowed one-hot reduction: a single
     fused edge pass builds b and all per-row gram corrections, then CG
@@ -158,7 +159,8 @@ def _half_step_windowed(
         w_b = conf * pref * ok
         w_g = (conf - 1.0) * ok
         b, corr_flat = windowed_gram_b(
-            fixed, src, w_b, w_g, loc, bwin, n_windows, pallas=pallas_mode
+            fixed, src, w_b, w_g, loc, bwin, n_windows,
+            pallas=pallas_mode, mesh=mesh,
         )
         base = gram + lam * jnp.eye(k, dtype=jnp.float32)
         a_flat = corr_flat + base.reshape(1, k * k)
@@ -167,7 +169,8 @@ def _half_step_windowed(
         w_b = val * ok
         w_g = ok
         b, corr_flat = windowed_gram_b(
-            fixed, src, w_b, w_g, loc, bwin, n_windows, pallas=pallas_mode
+            fixed, src, w_b, w_g, loc, bwin, n_windows,
+            pallas=pallas_mode, mesh=mesh,
         )
         reg = lam * jnp.maximum(degree, 1.0)
         eye_flat = jnp.eye(k, dtype=jnp.float32).reshape(1, k * k)
@@ -675,7 +678,9 @@ def _train_jit_windowed(
             replicated,
         )
 
-        pallas_mode = None  # pallas_call has no GSPMD partitioning rule
+        # the pallas kernel no longer downgrades under a mesh: P > 1
+        # runs it shard_map'd over dp (ops/windowed.py) — only pass the
+        # mesh handle through so the edge pass can build the shard_map
         sh = (
             factor_sharding(mesh)
             if mesh.shape.get(MODEL_AXIS, 1) > 1
@@ -685,7 +690,9 @@ def _train_jit_windowed(
         def shard_factors(f):
             return jax.lax.with_sharding_constraint(f, sh)
 
+        half_step_mesh = mesh
     else:
+        half_step_mesh = None
 
         def shard_factors(f):
             return f
@@ -714,13 +721,13 @@ def _train_jit_windowed(
             itf, u_src, u_val, u_ok, u_loc, u_bwin, user_deg, uf,
             n_windows=n_user_windows, implicit=implicit, lam=lam,
             alpha=alpha, cg_iterations=cg_iterations,
-            pallas_mode=pallas_mode,
+            pallas_mode=pallas_mode, mesh=half_step_mesh,
         ))
         itf = shard_factors(_half_step_windowed(
             uf, i_src, i_val, i_ok, i_loc, i_bwin, item_deg, itf,
             n_windows=n_item_windows, implicit=implicit, lam=lam,
             alpha=alpha, cg_iterations=cg_iterations,
-            pallas_mode=pallas_mode,
+            pallas_mode=pallas_mode, mesh=half_step_mesh,
         ))
         return uf, itf
 
@@ -731,7 +738,7 @@ def _train_jit_windowed(
     jax.jit,
     static_argnames=(
         "n_user_windows", "n_item_windows", "rank", "iterations", "implicit",
-        "cg_iterations",
+        "cg_iterations", "pallas_mode",
     ),
 )
 def _train_jit_windowed_grid(
@@ -747,15 +754,17 @@ def _train_jit_windowed_grid(
     implicit: bool,
     cg_iterations: int,
     seed: int,
+    pallas_mode: Optional[str] = None,
 ):
     """N-point (λ, α) grid trained as ONE device program (VERDICT r3 #6).
 
     The staged edge plan is hyperparameter-independent at fixed rank, so
     every grid point shares it (vmap broadcasts — no G× edge copies in
     HBM); the alternating loops and their CG solves run batched over the
-    grid axis. The Pallas edge kernel is excluded (its program_id-based
-    window accumulation does not survive vmap's grid-prepending batching
-    rule); the XLA scan path vmaps soundly."""
+    grid axis. The Pallas edge kernel vmaps too (VERDICT r4 #2): the
+    per-chunk kernel has no cross-grid-step state, so pallas_call's
+    grid-prepending batching rule is sound for it — verified against
+    per-point runs in tests/test_windowed_pallas.py."""
 
     def one(lam, alpha):
         return _train_jit_windowed(
@@ -766,7 +775,7 @@ def _train_jit_windowed_grid(
             n_item_windows=n_item_windows,
             rank=rank, iterations=iterations, implicit=implicit,
             lam=lam, alpha=alpha, cg_iterations=cg_iterations, seed=seed,
-            pallas_mode=None, mesh=None,
+            pallas_mode=pallas_mode, mesh=None,
         )
 
     return jax.vmap(one)(lams, alphas)
@@ -782,64 +791,95 @@ def train_grid(
     user_vocab: Optional[BiMap] = None,
     item_vocab: Optional[BiMap] = None,
 ) -> list["ALSFactors"]:
-    """Train an ALS hyperparameter grid sharing one staged WindowPlan.
+    """Train an ALS hyperparameter grid sharing staged training data.
 
-    Grid points must agree on everything except `lambda_` and `alpha`
-    (rank sets the plan padding; iterations/cg/seed set the program
-    shape). Replaces the reference's strictly serial MetricEvaluator
-    grid (core/.../controller/Engine.scala:758-764) with one staged
-    edge set + batched solves."""
-    base = params_list[0]
-    for p in params_list[1:]:
-        if (
-            p.rank != base.rank
-            or p.iterations != base.iterations
-            or p.cg_iterations != base.cg_iterations
-            or p.implicit_prefs != base.implicit_prefs
-            or p.seed != base.seed
-        ):
+    λ/α vary FREELY within one device program (vmapped solves); rank /
+    iterations / cg_iterations / implicit / seed set program SHAPE, so
+    grid points are grouped by that signature and each group runs as one
+    batched launch — but every group shares ONE staging, because both
+    staged forms are rank-independent (the WindowPlan blocks by
+    destination row only; the dense rating matrix doesn't know about
+    factors at all). A rank×λ grid therefore costs G_rank launches over
+    one staged edge set instead of G_rank·G_λ serial train+stagings
+    (VERDICT r4 #7; reference: the strictly serial MetricEvaluator grid,
+    core/.../controller/Engine.scala:758-764)."""
+    for p in params_list:
+        if p.rank > GRAM_SOLVER_MAX_RANK:
             raise ValueError(
-                "train_grid requires grid points differing only in "
-                "lambda_/alpha"
+                f"train_grid supports rank <= {GRAM_SOLVER_MAX_RANK}"
             )
-    if base.rank > GRAM_SOLVER_MAX_RANK:
-        raise ValueError(
-            f"train_grid supports rank <= {GRAM_SOLVER_MAX_RANK}"
-        )
     rows = np.asarray(rows, dtype=np.int32)
     cols = np.asarray(cols, dtype=np.int32)
     vals = np.asarray(vals, dtype=np.float32)
-    lams = jnp.asarray([p.lambda_ for p in params_list], jnp.float32)
-    alphas = jnp.asarray([p.alpha for p in params_list], jnp.float32)
-    if dense_eligible(rows, cols, vals, n_users, n_items, base):
-        # the dense fast path vmaps cleanly: ONE device rating matrix
-        # serves every grid point (weight derivation + solves batch over
-        # the grid axis)
+
+    # group by program-shape signature, preserving input positions
+    groups: dict[tuple, list[int]] = {}
+    for i, p in enumerate(params_list):
+        key = (
+            p.rank, p.iterations, p.cg_iterations, p.implicit_prefs, p.seed
+        )
+        groups.setdefault(key, []).append(i)
+
+    base = params_list[0]
+    # data-dependent eligibility (pair uniqueness, quantization, budget)
+    # is identical for every group — check once against base, then only
+    # the cheap per-group condition (explicit mode forbids zero ratings)
+    use_dense = dense_eligible(rows, cols, vals, n_users, n_items, base)
+    if use_dense and not all(
+        params_list[ix[0]].implicit_prefs for ix in groups.values()
+    ):
+        has_zero = bool(np.any(vals == 0.0))
+        use_dense = not has_zero or all(
+            params_list[ix[0]].implicit_prefs for ix in groups.values()
+        )
+    staged_d = staged_w = None
+    if use_dense:
+        # ONE device rating matrix serves every grid point and every
+        # rank group (vmap broadcasts; R has no rank axis)
         staged_d = stage_dense(rows, cols, vals, n_users, n_items, base)
-        kwargs = dict(staged_d.static_kwargs)
-        kwargs.pop("lam"), kwargs.pop("alpha")
-        ufs, itfs = _train_jit_dense_grid(
-            *staged_d.device_args[:3], lams, alphas, **kwargs
-        )
     else:
-        staged = stage_windowed(rows, cols, vals, n_users, n_items, base)
-        kwargs = dict(staged.static_kwargs)
-        for grid_axis_or_unsupported in ("lam", "alpha", "pallas_mode", "mesh"):
-            kwargs.pop(grid_axis_or_unsupported)
-        ufs, itfs = _train_jit_windowed_grid(
-            *staged.device_args[:12], lams, alphas, **kwargs
+        staged_w = stage_windowed(rows, cols, vals, n_users, n_items, base)
+
+    out: list[Optional[ALSFactors]] = [None] * len(params_list)
+    for key, idxs in groups.items():
+        rank, iterations, cg_iterations, implicit, seed = key
+        lams = jnp.asarray(
+            [params_list[i].lambda_ for i in idxs], jnp.float32
         )
-    ufs, itfs = np.asarray(ufs), np.asarray(itfs)
-    return [
-        ALSFactors(
-            user_factors=ufs[g][:n_users],
-            item_factors=itfs[g][:n_items],
-            user_vocab=user_vocab or BiMap({}),
-            item_vocab=item_vocab or BiMap({}),
-            params=p,
+        alphas = jnp.asarray(
+            [params_list[i].alpha for i in idxs], jnp.float32
         )
-        for g, p in enumerate(params_list)
-    ]
+        if staged_d is not None:
+            kwargs = dict(staged_d.static_kwargs)
+            kwargs.pop("lam"), kwargs.pop("alpha")
+            kwargs.update(
+                rank=rank, iterations=iterations,
+                cg_iterations=cg_iterations, implicit=implicit, seed=seed,
+            )
+            ufs, itfs = _train_jit_dense_grid(
+                *staged_d.device_args[:3], lams, alphas, **kwargs
+            )
+        else:
+            kwargs = dict(staged_w.static_kwargs)
+            for grid_axis_or_unsupported in ("lam", "alpha", "mesh"):
+                kwargs.pop(grid_axis_or_unsupported)
+            kwargs.update(
+                rank=rank, iterations=iterations,
+                cg_iterations=cg_iterations, implicit=implicit, seed=seed,
+            )
+            ufs, itfs = _train_jit_windowed_grid(
+                *staged_w.device_args[:12], lams, alphas, **kwargs
+            )
+        ufs, itfs = np.asarray(ufs), np.asarray(itfs)
+        for g, i in enumerate(idxs):
+            out[i] = ALSFactors(
+                user_factors=ufs[g][:n_users],
+                item_factors=itfs[g][:n_items],
+                user_vocab=user_vocab or BiMap({}),
+                item_vocab=item_vocab or BiMap({}),
+                params=params_list[i],
+            )
+    return out  # type: ignore[return-value]
 
 
 @partial(

@@ -229,6 +229,7 @@ def windowed_gram_b(
     block_window: jax.Array,  # (n_blocks_p,) int32, part-major, sorted
     n_windows: int,
     pallas: Optional[str] = None,  # resolved mode; None = XLA scan path
+    mesh=None,  # required for the sharded pallas path (P > 1)
 ) -> tuple[jax.Array, jax.Array]:
     """One fused edge pass → (b (N_pad, K), gram_flat (N_pad, K²)).
 
@@ -245,8 +246,13 @@ def windowed_gram_b(
     The segment reduction is either the chunked XLA one-hot matmul below
     (pallas=None) or the fused VMEM kernel in ops/windowed_pallas.py
     (pallas="tpu" / "interpret"), which skips the HBM one-hot and
-    payload entirely. The Pallas kernel is single-device (pallas_call
-    has no GSPMD partitioning rule), so P>1 always takes the XLA path.
+    payload entirely. pallas_call has no GSPMD partitioning rule, so
+    P>1 runs the kernel under shard_map over dp instead (VERDICT r4
+    #2): each device runs the single-part pallas scan on its own
+    contiguous block group, segment-sums its local block partials into
+    the full window space, and ONE psum over dp combines them — the
+    same partial-sum + all-reduce shape GSPMD derives for the XLA path.
+    Requires `mesh`; without it P>1 falls back to the XLA path.
     """
     k = factors.shape[1]
     if src.ndim == 3:  # legacy single-part layout
@@ -254,8 +260,43 @@ def windowed_gram_b(
             a[None] for a in (src, w_b, w_g, local)
         )
     p = src.shape[0]
+    if p > 1 and pallas is not None and mesh is not None:
+        from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+        none4 = jax.sharding.PartitionSpec(None, None, None, None)
+        dp4 = jax.sharding.PartitionSpec(DATA_AXIS, None, None, None)
+
+        def local_pass(f_l, src_l, wb_l, wg_l, lc_l, bwin_l):
+            # each device: the single-part pallas path over ITS blocks
+            # (window ids are global, so local sums land in full rows)
+            b_l, g_l = windowed_gram_b(
+                f_l, src_l, wb_l, wg_l, lc_l, bwin_l, n_windows,
+                pallas=pallas,
+            )
+            return (
+                jax.lax.psum(b_l, DATA_AXIS),
+                jax.lax.psum(g_l, DATA_AXIS),
+            )
+
+        return jax.shard_map(
+            local_pass,
+            mesh=mesh,
+            in_specs=(
+                jax.sharding.PartitionSpec(None, None),  # factors (gathered)
+                dp4, dp4, dp4, dp4,
+                jax.sharding.PartitionSpec(DATA_AXIS),
+            ),
+            out_specs=(
+                jax.sharding.PartitionSpec(None, None),
+                jax.sharding.PartitionSpec(None, None),
+            ),
+            # pallas_call cannot annotate varying-mesh-axes on its
+            # out_shapes; replication is established manually by the
+            # psums above, so disable the checker rather than the kernel
+            check_vma=False,
+        )(factors, src, w_b, w_g, local, block_window)
     if p > 1:
-        pallas = None  # pallas_call has no GSPMD partitioning rule
+        pallas = None  # no mesh handle → XLA path (GSPMD shards it)
     d = k + k * k
     s_rows = WINDOW_ROWS
     # scan over each part's chunks in lockstep (axis 1 → leading)

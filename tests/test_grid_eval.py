@@ -97,17 +97,37 @@ class TestALSGrid:
                 m.item_factors, ref.item_factors, rtol=2e-4, atol=2e-5
             )
 
-    def test_grid_rejects_heterogeneous_statics(self):
+    def test_rank_axis_grid_matches_serial(self):
+        """VERDICT r4 #7: rank×λ grids share one staging — per-rank
+        groups launch batched λ solves and every point must equal its
+        serial train exactly."""
+        from predictionio_tpu.models import als
+
+        rows, cols, vals, nu, ni = self._edges()
+        params_list = [
+            als.ALSParams(rank=r, iterations=3, lambda_=lam)
+            for r in (6, 8)
+            for lam in (0.01, 0.3)
+        ]
+        grid = als.train_grid(rows, cols, vals, nu, ni, params_list)
+        for p, m in zip(params_list, grid):
+            assert m.user_factors.shape == (nu, p.rank)
+            ref = als.train(rows, cols, vals, nu, ni, p)
+            np.testing.assert_allclose(
+                m.user_factors, ref.user_factors, rtol=2e-4, atol=2e-4
+            )
+            np.testing.assert_allclose(
+                m.item_factors, ref.item_factors, rtol=2e-4, atol=2e-4
+            )
+
+    def test_rank_grid_supports_too_high_rank_rejection(self):
         from predictionio_tpu.models import als
 
         rows, cols, vals, nu, ni = self._edges()
         with pytest.raises(ValueError):
             als.train_grid(
                 rows, cols, vals, nu, ni,
-                [
-                    als.ALSParams(rank=6, iterations=3),
-                    als.ALSParams(rank=8, iterations=3),
-                ],
+                [als.ALSParams(rank=40, iterations=2)],
             )
 
     def test_grid_beats_sequential(self):

@@ -551,3 +551,29 @@ def test_daemon_killed_mid_find_names_shard(tmp_path):
             p.terminate()
         for p in procs:
             p.wait(timeout=10)
+
+
+def test_partial_batch_write_reports_per_position():
+    """A bulk write with one dead shard raises PartialBatchWriteError
+    whose ids align per input position — persisted events keep their
+    ids so the batch endpoint can report accurate per-event statuses."""
+    import pytest
+
+    from predictionio_tpu.data.storage.sharded import (
+        PartialBatchWriteError,
+    )
+
+    children = [MemoryEventStore(), _DeadStore()]
+    store = ShardedEventStore(stores=children, retries=0)
+    children[0].init_app(1)
+    events = _events(n=20)
+    with pytest.raises(PartialBatchWriteError) as ei:
+        store.insert_batch(events, 1)
+    ids = ei.value.ids
+    assert len(ids) == 20
+    for e, eid in zip(events, ids):
+        if shard_of(e.entity_id, 2) == 0:
+            assert eid is not None  # persisted on the healthy shard
+        else:
+            assert eid is None
+    assert any(i is None for i in ids) and any(i is not None for i in ids)

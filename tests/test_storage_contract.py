@@ -207,6 +207,26 @@ class TestEventStoreContract:
         assert len(got) == 2
         assert got[0].event_time > got[1].event_time
 
+    def test_find_entities_batch(self, events):
+        """Batched serving read: every listed entity answered in one
+        call, newest-first, per-entity-limited, event-name-filtered."""
+        batch = []
+        for u in ("u1", "u2"):
+            batch.extend(ev("view", u, t=i) for i in range(3))
+            batch.append(ev("buy", u, t=9))
+        events.insert_batch(batch, APP)
+        out = events.find_entities_batch(
+            APP, "user", ["u1", "u2", "ghost"],
+            event_names=["view"], limit_per_entity=2,
+        )
+        assert set(out) == {"u1", "u2", "ghost"}
+        assert out["ghost"] == []
+        for u in ("u1", "u2"):
+            got = out[u]
+            assert len(got) == 2
+            assert all(e.event == "view" and e.entity_id == u for e in got)
+            assert got[0].event_time > got[1].event_time
+
 
 @pytest.fixture(params=["memory", "sqlite", "remote", "postgres", "docfs"])
 def meta(request, tmp_path):

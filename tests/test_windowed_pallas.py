@@ -96,3 +96,61 @@ def test_resolve_pallas_mode(monkeypatch):
     assert resolve_pallas_mode("auto") is None
     assert resolve_pallas_mode("1") is None
     assert resolve_pallas_mode("off") is None
+
+
+def test_sharded_pallas_matches_single_device(monkeypatch):
+    """VERDICT r4 #2: P > 1 no longer silently downgrades to the XLA
+    path — the kernel runs shard_map'd over dp (local pallas scans +
+    one psum) and must train identical factors to the single-device
+    interpret run."""
+    from predictionio_tpu.models import als
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setenv("PIO_PALLAS_WINDOWED", "interpret")
+    rng = np.random.default_rng(3)
+    n_users, n_items, n_edges = 300, 180, 5000
+    rows = rng.integers(0, n_users, n_edges).astype(np.int32)
+    cols = rng.integers(0, n_items, n_edges).astype(np.int32)
+    vals = rng.uniform(0.5, 5.0, n_edges).astype(np.float32)
+    p = als.ALSParams(rank=8, iterations=4)
+
+    single = als.train(rows, cols, vals, n_users, n_items, p)
+    mesh = make_mesh()  # the conftest 8-device CPU mesh
+    assert mesh.devices.size > 1
+    sharded = als.train(rows, cols, vals, n_users, n_items, p, mesh=mesh)
+    np.testing.assert_allclose(
+        sharded.user_factors, single.user_factors, rtol=2e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        sharded.item_factors, single.item_factors, rtol=2e-4, atol=1e-5
+    )
+
+
+def test_grid_runs_pallas_and_matches_per_point(monkeypatch):
+    """VERDICT r4 #2: train_grid no longer excludes the kernel — the
+    vmapped pallas grid must equal per-point pallas runs (the kernel
+    has no cross-grid-step state, so the batching rule is sound)."""
+    from predictionio_tpu.models import als
+
+    monkeypatch.setenv("PIO_PALLAS_WINDOWED", "interpret")
+    rng = np.random.default_rng(4)
+    n_users, n_items, n_edges = 200, 120, 3000
+    rows = rng.integers(0, n_users, n_edges).astype(np.int32)
+    cols = rng.integers(0, n_items, n_edges).astype(np.int32)
+    vals = rng.uniform(0.5, 5.0, n_edges).astype(np.float32)
+    lams = (0.01, 0.3)
+    grid = als.train_grid(
+        rows, cols, vals, n_users, n_items,
+        [als.ALSParams(rank=6, iterations=3, lambda_=lam) for lam in lams],
+    )
+    for lam, m in zip(lams, grid):
+        one = als.train(
+            rows, cols, vals, n_users, n_items,
+            als.ALSParams(rank=6, iterations=3, lambda_=lam),
+        )
+        np.testing.assert_allclose(
+            m.user_factors, one.user_factors, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            m.item_factors, one.item_factors, rtol=1e-4, atol=1e-5
+        )
