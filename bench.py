@@ -156,18 +156,20 @@ def windowed_bytes_model(staged, pallas: bool) -> tuple[float, float]:
 def dense_models(n_u_p: int, n_i_p: int, dense_dtype: str) -> tuple[float, float]:
     """(model_bytes, executed_mxu_flops) for ONE dense-path train.
 
-    HBM model: each half-step streams R once (row pass reads row blocks;
-    col pass reads the same blocks) and materializes the two derived
-    weight tiles per block (write+read, bf16) — 1 R-read + 4 tile-moves
-    per cell per half-step — plus the CG flat-operator sweeps. Executed
-    MXU flops: two (rows x cols x 128-lane) matmuls per half-step (K=10
-    and K^2=100 both occupy one 128-lane MXU tile)."""
+    HBM model ASSUMES XLA fuses the weight-tile derivations into the
+    matmul reads (measurement confirmed it does: an unfused model with
+    write+read of both derived tiles predicted 1.36 TB/train, >2x the
+    HBM roof for the observed 0.6 s — physically impossible, so the
+    tiles never hit HBM). Fused: each half-step reads R twice (once per
+    weight-tile matmul, deriving tiles in registers) + the CG
+    flat-operator sweeps. Executed MXU flops: two
+    (rows x cols x 128-lane) matmuls per half-step (K=10 and K^2=100
+    both occupy one 128-lane MXU tile)."""
     from predictionio_tpu.ops.dense import BYTES_PER_CELL
 
     r_bytes = n_u_p * n_i_p * BYTES_PER_CELL.get(dense_dtype, 2)
-    tile_moves = 4 * n_u_p * n_i_p * 2  # w1+wg, write+read, bf16
     cg_ops = (3 + 1) * (n_u_p + n_i_p) * (RANK * RANK) * 4
-    per_iter = 2 * (r_bytes + tile_moves) + 2 * cg_ops
+    per_iter = 2 * (2 * r_bytes) + 2 * cg_ops
     flops_per_pass = 2 * 2 * n_u_p * n_i_p * 128
     return ITERATIONS * per_iter, ITERATIONS * 2 * flops_per_pass
 
@@ -881,7 +883,12 @@ def bench_sharded_ingestion():
             storage.get_meta_data_access_keys().insert(
                 AccessKey(key="BENCHKEY", app_id=app_id, events=())
             )
-            n_front = n_shards  # one ingest front end per shard
+            # one front end per shard WHEN the host has cores for them
+            # — on a 1-2 core host extra fronts just thrash the
+            # scheduler and the measurement reads as inverse scaling
+            n_front = max(
+                1, min(n_shards, (os.cpu_count() or 1) // 2)
+            )
             fronts, fports = [], []
             fenv = dict(os.environ)
             fenv.update({

@@ -334,6 +334,141 @@ def _train_jit_dense_grid(
     return jax.vmap(one)(lams, alphas)
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "rank", "iterations", "implicit", "cg_iterations", "dense_dtype",
+        "scale", "mesh",
+    ),
+)
+def _train_jit_dense_sharded(
+    r: jax.Array,  # (n_users_p, n_items_p) — row-sharded over dp
+    user_deg: jax.Array,  # (n_users_p,) — row-sharded over dp
+    item_deg: jax.Array,  # (n_items_p,) — replicated
+    uf0=None,  # (n_users_p, rank) row-sharded / None
+    itf0=None,  # (n_items_p, rank) replicated / None
+    *,
+    rank: int,
+    iterations: int,
+    implicit: bool,
+    lam: float,
+    alpha: float,
+    cg_iterations: int,
+    seed: int,
+    dense_dtype: str = "bf16",
+    scale: float = 1.0,
+    mesh=None,
+):
+    """Dense-W alternating loop shard_map'd over the mesh's dp axis.
+
+    The rating matrix is ROW-sharded (each device owns a slab of users);
+    factors stay replicated (they are MBs at ALS sizes — mp sharding
+    would buy nothing and cost all-gathers every half-step, so the mp
+    axis is deliberately unused here). Per iteration:
+
+      user half: each device solves ITS user rows from its local slab —
+                 fully local, zero collectives;
+      item half: each device contracts its slab against its local user
+                 factors into partial (n_items, ·) sums; ONE psum over
+                 dp combines them and every device solves the (small)
+                 item systems redundantly.
+
+    This is the TPU-native shape of MLlib ALS's block distribution: the
+    ratings never move, only the (tiny) factor matrices ride ICI.
+
+    VALIDATION CAVEAT: the alternating fori_loop here reads the large
+    sharded slab inside shard_map — the shape of program the recorded
+    TPU fori-loop miscompile (batched_cg's docstring) bit at FULL scale
+    while small shapes passed. This rig has one chip, so the sharded
+    path is validated on CPU meshes + the dryrun only; the first real
+    multi-chip deployment must re-run the bench's full-scale
+    finiteness + windowed-agreement checks before trusting factors."""
+    from predictionio_tpu.ops import dense as dense_ops
+    from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+    n_users_p, n_items_p = r.shape
+    spec_r = jax.sharding.PartitionSpec(DATA_AXIS, None)
+    spec_v = jax.sharding.PartitionSpec(DATA_AXIS)
+    rep2 = jax.sharding.PartitionSpec(None, None)
+    rep1 = jax.sharding.PartitionSpec(None)
+
+    def local_train(r_l, udeg_l, ideg, uf0_l, itf0_r):
+        n_u_local = r_l.shape[0]
+        d = jax.lax.axis_index(DATA_AXIS)
+        if uf0_l is not None and itf0_r is not None:
+            uf_l, itf = uf0_l, itf0_r
+        else:
+            ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+            # generate the FULL init on every device (replicated
+            # compute, deterministic) and slice the local slab so the
+            # sharded run matches the single-device run exactly
+            uf_full = (
+                jax.random.normal(ku, (n_users_p, rank), jnp.float32)
+                / jnp.sqrt(rank)
+            )
+            uf_l = jax.lax.dynamic_slice_in_dim(
+                uf_full, d * n_u_local, n_u_local
+            ) * (udeg_l >= 0)[:, None]
+            itf = (
+                jax.random.normal(ki, (n_items_p, rank), jnp.float32)
+                / jnp.sqrt(rank)
+            ) * (ideg >= 0)[:, None]
+
+        k = rank
+        eye_flat = jnp.eye(k, dtype=jnp.float32).reshape(1, k * k)
+
+        def body(_, fs):
+            uf_l, itf = fs
+            # user half: local rows, local slab — no collectives
+            uf_l = _dense_half_step(
+                r_l, itf, udeg_l, uf_l, solve_rows=True,
+                implicit=implicit, lam=lam, alpha=alpha,
+                cg_iterations=cg_iterations, dense_dtype=dense_dtype,
+                scale=scale,
+            )
+            # item half: partial sums from the local slab + ONE psum
+            b, corr_flat = dense_ops.dense_col_pass(
+                r_l, uf_l, implicit=implicit, alpha=alpha,
+                dense_dtype=dense_dtype, scale=scale,
+            )
+            b = jax.lax.psum(b, DATA_AXIS)
+            corr_flat = jax.lax.psum(corr_flat, DATA_AXIS)
+            if implicit:
+                gram = jax.lax.psum(f32_gram(uf_l), DATA_AXIS)
+                base = gram + lam * jnp.eye(k, dtype=jnp.float32)
+                a_flat = corr_flat + base.reshape(1, k * k)
+            else:
+                reg = lam * jnp.maximum(ideg, 1.0)
+                a_flat = corr_flat + reg[:, None] * eye_flat
+
+            def matvec(v):
+                return flat_gram_matvec(a_flat, v)
+
+            itf = batched_cg(matvec, b, itf, cg_iterations)
+            return uf_l, itf
+
+        return jax.lax.fori_loop(0, iterations, body, (uf_l, itf))
+
+    # shard_map cannot spec None leaves — close over absent inits
+    if uf0 is None or itf0 is None:
+        fn = lambda r_l, udeg_l, ideg: local_train(
+            r_l, udeg_l, ideg, None, None
+        )
+        args = (r, user_deg, item_deg)
+        in_specs = (spec_r, spec_v, rep1)
+    else:
+        fn = local_train
+        args = (r, user_deg, item_deg, uf0, itf0)
+        in_specs = (spec_r, spec_v, rep1, spec_r, rep2)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(spec_r, rep2),
+        check_vma=False,
+    )(*args)
+
+
 @dataclass
 class StagedDenseTrain:
     """A dense-path train with the rating matrix resident on device.
@@ -350,20 +485,32 @@ class StagedDenseTrain:
     transfer_sec: float
 
     def run(self) -> tuple[jax.Array, jax.Array]:
-        return _train_jit_dense(*self.device_args, **self.static_kwargs)
+        if self.static_kwargs.get("mesh") is not None:
+            return _train_jit_dense_sharded(
+                *self.device_args, **self.static_kwargs
+            )
+        kwargs = {
+            k: v for k, v in self.static_kwargs.items() if k != "mesh"
+        }
+        return _train_jit_dense(*self.device_args, **kwargs)
 
     def factors(self, uf, itf) -> tuple[np.ndarray, np.ndarray]:
         return np.asarray(uf)[: self.n_users], np.asarray(itf)[: self.n_items]
 
 
-def dense_matrix_bytes(n_users: int, n_items: int, dense_dtype: str = "bf16") -> int:
-    """Padded dense-R footprint — the auto-dispatch gate's input."""
-    from predictionio_tpu.ops.dense import COL_PAD, ROW_BLOCK
+def dense_matrix_bytes(
+    n_users: int, n_items: int, dense_dtype: str = "bf16", dp: int = 1
+) -> int:
+    """Padded dense-R footprint — the auto-dispatch gate's input.
+    `dp` > 1 pads rows to whole per-device slabs (stage_dense does)."""
+    from predictionio_tpu.ops.dense import (
+        BYTES_PER_CELL,
+        COL_PAD,
+        ROW_BLOCK,
+    )
 
-    n_u_p = -(-n_users // ROW_BLOCK) * ROW_BLOCK
+    n_u_p = -(-n_users // (ROW_BLOCK * dp)) * (ROW_BLOCK * dp)
     n_i_p = -(-n_items // COL_PAD) * COL_PAD
-    from predictionio_tpu.ops.dense import BYTES_PER_CELL
-
     return n_u_p * n_i_p * BYTES_PER_CELL.get(dense_dtype, 2)
 
 
@@ -379,19 +526,23 @@ def dense_eligible(
 ) -> bool:
     """Gate for the dense-W fast path.
 
-    Requires: env not opting out, rank within the gram-solver bound, no
-    mesh (the sharded dense variant is shard_map'd separately), the
-    padded matrix within the HBM budget, unique (user, item) pairs (a
-    dense cell can hold one rating; duplicate edges are summed by the
-    windowed path, so dup data falls back to preserve semantics), and —
-    explicit mode only — no zero-valued ratings (a dense zero must mean
-    "unobserved"). Auto mode also requires DENSE_AUTO_MIN_EDGES so small
-    (test-scale) trains keep their f32-exact windowed numerics unless
-    PIO_DENSE_ALS=1 opts in."""
+    Requires: env not opting out, rank within the gram-solver bound,
+    single-process execution when a mesh is given (the shard_map'd dense
+    train row-shards R over dp; multi-host R staging is not wired, so
+    multi-host falls back to the windowed path), the padded matrix
+    within the HBM budget, unique (user, item) pairs (a dense cell can
+    hold one rating; duplicate edges are summed by the windowed path, so
+    dup data falls back to preserve semantics), and — explicit mode only
+    — no zero-valued ratings (a dense zero must mean "unobserved").
+    Auto mode also requires DENSE_AUTO_MIN_EDGES so small (test-scale)
+    trains keep their f32-exact windowed numerics unless PIO_DENSE_ALS=1
+    opts in."""
     env = os.environ.get("PIO_DENSE_ALS", "").strip()
     if env == "0":
         return False
-    if params.rank > GRAM_SOLVER_MAX_RANK or mesh is not None:
+    if params.rank > GRAM_SOLVER_MAX_RANK:
+        return False
+    if mesh is not None and jax.process_count() > 1:
         return False
     if env != "1" and len(rows) < DENSE_AUTO_MIN_EDGES:
         return False
@@ -403,7 +554,12 @@ def dense_eligible(
 
         if int8_scale(vals) is not None:
             dense_dtype = "int8"
-    if dense_matrix_bytes(n_users, n_items, dense_dtype) > budget:
+    dp = 1
+    if mesh is not None and getattr(mesh, "devices", None) is not None:
+        from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+        dp = int(mesh.shape.get(DATA_AXIS, 1))
+    if dense_matrix_bytes(n_users, n_items, dense_dtype, dp=dp) > budget:
         return False
     if not params.implicit_prefs and np.any(vals == 0.0):
         return False
@@ -420,6 +576,7 @@ def stage_dense(
     rows, cols, vals, n_users, n_items, params,
     user_deg=None, item_deg=None, init_factors=None,
     dense_dtype: str = "auto",
+    mesh=None,
 ) -> StagedDenseTrain:
     """Stage the dense-path train: pad dims to the block quanta, push the
     COO arrays once, densify ON DEVICE (the matrix never crosses the
@@ -455,7 +612,14 @@ def stage_dense(
             )
         else:
             dense_dtype = "bf16"
-    n_u_p = -(-n_users // ROW_BLOCK) * ROW_BLOCK
+    dp = 1
+    if mesh is not None and mesh.devices.size > 1:
+        from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+        dp = int(mesh.shape.get(DATA_AXIS, 1))
+    # user rows pad to a slab multiple so every dp device scans whole
+    # row blocks of its own slab
+    n_u_p = -(-n_users // (ROW_BLOCK * dp)) * (ROW_BLOCK * dp)
     n_i_p = -(-n_items // COL_PAD) * COL_PAD
     if user_deg is None:
         user_deg = np.zeros(n_users, np.float32)
@@ -491,13 +655,29 @@ def stage_dense(
         n_rows_p=n_u_p, n_cols_p=n_i_p, dense_dtype=dense_dtype,
         scale=scale,
     )
-    device_args = (
-        r,
-        jax.device_put(pad_deg(user_deg, n_u_p)),
-        jax.device_put(pad_deg(item_deg, n_i_p)),
-        jax.device_put(uf0) if uf0 is not None else None,
-        jax.device_put(itf0) if itf0 is not None else None,
-    )
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+        row_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        vec_sh = NamedSharding(mesh, P(DATA_AXIS))
+        rep = NamedSharding(mesh, P())
+        device_args = (
+            jax.device_put(r, row_sh),
+            jax.device_put(pad_deg(user_deg, n_u_p), vec_sh),
+            jax.device_put(pad_deg(item_deg, n_i_p), rep),
+            jax.device_put(uf0, row_sh) if uf0 is not None else None,
+            jax.device_put(itf0, rep) if itf0 is not None else None,
+        )
+    else:
+        device_args = (
+            r,
+            jax.device_put(pad_deg(user_deg, n_u_p)),
+            jax.device_put(pad_deg(item_deg, n_i_p)),
+            jax.device_put(uf0) if uf0 is not None else None,
+            jax.device_put(itf0) if itf0 is not None else None,
+        )
     # a tiny HOST FETCH, not just block_until_ready: draining the device
     # queue through a fetch lets the densify transients actually
     # deallocate before the train program's workspace is allocated —
@@ -518,6 +698,7 @@ def stage_dense(
             seed=params.seed,
             dense_dtype=dense_dtype,
             scale=scale,
+            mesh=mesh if (mesh is not None and mesh.devices.size > 1) else None,
         ),
         n_users=n_users,
         n_items=n_items,
@@ -530,11 +711,12 @@ def _train_dense(
     rows, cols, vals, n_users, n_items, params,
     user_deg, item_deg, user_vocab, item_vocab, init_factors,
     dense_dtype: str = "auto",
+    mesh=None,
 ) -> "ALSFactors":
     staged = stage_dense(
         rows, cols, vals, n_users, n_items, params,
         user_deg=user_deg, item_deg=item_deg, init_factors=init_factors,
-        dense_dtype=dense_dtype,
+        dense_dtype=dense_dtype, mesh=mesh,
     )
     uf, itf = staged.factors(*staged.run())
     return ALSFactors(
@@ -852,6 +1034,7 @@ def train_grid(
         if staged_d is not None:
             kwargs = dict(staged_d.static_kwargs)
             kwargs.pop("lam"), kwargs.pop("alpha")
+            kwargs.pop("mesh", None)  # grids run single-device
             kwargs.update(
                 rank=rank, iterations=iterations,
                 cg_iterations=cg_iterations, implicit=implicit, seed=seed,
@@ -1025,6 +1208,7 @@ def train(
         return _train_dense(
             rows, cols, vals, n_users, n_items, params,
             user_deg, item_deg, user_vocab, item_vocab, init_factors,
+            mesh=mesh,
         )
 
     if params.rank <= GRAM_SOLVER_MAX_RANK:

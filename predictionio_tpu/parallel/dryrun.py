@@ -69,6 +69,31 @@ def run_dryrun(n_devices: int) -> None:
             assert np.all(np.isfinite(factors.user_factors))
             assert np.all(np.isfinite(factors.item_factors))
 
+        # the DEFAULT ML-20M path: shard_map'd dense-W train (R row-
+        # sharded over dp, item-side psum) — dedupe pairs first (the
+        # dense gate requires one rating per cell)
+        keys = np.unique(
+            rows.astype(np.int64) * n_items + cols.astype(np.int64)
+        )
+        d_rows = (keys // n_items).astype(np.int32)
+        d_cols = (keys % n_items).astype(np.int32)
+        d_vals = np.float32(1.0) + (keys % 5).astype(np.float32)
+        prior = _os.environ.get("PIO_DENSE_ALS")
+        _os.environ["PIO_DENSE_ALS"] = "1"
+        try:
+            factors = als.train(
+                d_rows, d_cols, d_vals, n_users, n_items,
+                als.ALSParams(rank=8, iterations=1, cg_iterations=2),
+                mesh=mesh,
+            )
+        finally:
+            _os.environ.pop("PIO_DENSE_ALS", None)
+            if prior is not None:
+                _os.environ["PIO_DENSE_ALS"] = prior
+        assert factors.user_factors.shape == (n_users, 8)
+        assert np.all(np.isfinite(factors.user_factors))
+        assert np.all(np.isfinite(factors.item_factors))
+
         # --- CCO: user-sharded co-occurrence + LLR top-n ---
         n_u, n_i, n_j = 40, 16, 12
         primary = (rng.rand(n_u, n_i) < 0.2).astype(np.float32)
