@@ -19,6 +19,7 @@ Configure:
   PIO_STORAGE_SOURCES_<NAME>_SHARDS=host1:port1,host2:port2,...
   PIO_STORAGE_SOURCES_<NAME>_ALLOW_PARTIAL=1   # optional, see below
   PIO_STORAGE_SOURCES_<NAME>_RETRIES=2         # optional
+  PIO_STORAGE_SOURCES_<NAME>_REPLICAS=2        # optional, see below
 
 Metadata/model repositories are NOT sharded — point them at a single
 source (the reference likewise kept metadata in one store while events
@@ -43,10 +44,22 @@ retry tuning + Storage.scala:335 verifyAllDataObjects):
 - ``health()`` pings every shard and reports per-shard status — wired
   into ``pio status`` (tools/console.py) the way the reference's deep
   storage check verifies every data object.
+- ``REPLICAS=R`` (default 1) writes every event to its home shard AND
+  the next R-1 shards (successor replication, the HBase-region-replica
+  role). Reads then survive a down shard COMPLETELY: an entity- or
+  partition-scoped stream fails over to the successor, and the
+  broadcast merge reads each shard primary-only (``shard=(i, N)``
+  filters server-side — replica copies on successors have a different
+  entity hash and are filtered out) with per-partition failover.
+  Write durability contract: the write succeeds when the PRIMARY
+  commits; replica copy failures degrade redundancy and are logged
+  loudly but do not fail the write (no hinted handoff — a down shard's
+  replicas catch up only via re-import).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import heapq
 import itertools
 import logging
@@ -137,7 +150,9 @@ class ShardedEventStore(base.EventStore):
             child_cfg = {
                 k: v
                 for k, v in config.items()
-                if k not in ("SHARDS", "ALLOW_PARTIAL", "RETRIES")
+                if k not in (
+                    "SHARDS", "ALLOW_PARTIAL", "RETRIES", "REPLICAS"
+                )
             }
             self._stores = []
             for addr in addrs:
@@ -159,6 +174,9 @@ class ShardedEventStore(base.EventStore):
             int(retries)
             if retries is not None
             else int(config.get("RETRIES", "2"))
+        )
+        self.replicas = max(
+            1, min(int(config.get("REPLICAS", "1")), len(self._stores))
         )
         #: shard indices skipped by the most recent degraded broadcast
         #: read (empty when that read was complete). Best-effort operator
@@ -188,6 +206,12 @@ class ShardedEventStore(base.EventStore):
 
     def _for_entity(self, entity_id: str) -> int:
         return shard_of(entity_id, self.n_shards)
+
+    def _replica_chain(self, home: int) -> list[int]:
+        """Home shard first, then its R-1 successors (copy holders)."""
+        return [
+            (home + k) % self.n_shards for k in range(self.replicas)
+        ]
 
     # -- retry / failure core ---------------------------------------------
     def _shard_call(
@@ -314,6 +338,7 @@ class ShardedEventStore(base.EventStore):
         self, event: Event, app_id: int, channel_id: Optional[int] = None
     ) -> str:
         home = self._for_entity(event.entity_id)
+        chain = self._replica_chain(home)
         if event.event_id:
             # explicit-id insert (import/replay/overwrite): the id may
             # already live on a DIFFERENT shard if the entity changed —
@@ -321,7 +346,11 @@ class ShardedEventStore(base.EventStore):
             # Evictions fan out concurrently with the home insert's
             # prerequisite ordering relaxed to: evict first (all shards in
             # one wall-clock round), then insert — ~2 round trips total
-            # instead of N sequential (ADVICE r4).
+            # instead of N sequential (ADVICE r4). Replica holders ARE
+            # evicted too: they receive the fresh copy right after, and
+            # if that copy write fails the id must be ABSENT there, not
+            # stale — a stale copy's entity hash matches a primary
+            # partition and would pass the primary-only read filters.
             self._broadcast(
                 [
                     (sx, s.delete, (event.event_id, app_id, channel_id))
@@ -329,10 +358,43 @@ class ShardedEventStore(base.EventStore):
                     if sx != home
                 ]
             )
-        return self._shard_call(
+        eid = self._shard_call(
             home, self._stores[home].insert, event, app_id, channel_id,
             retries=0,
         )
+        self._replicate([(event.with_id(eid), home)], app_id, channel_id)
+        return eid
+
+    def _replicate(
+        self,
+        primaries: Sequence[tuple[Event, int]],  # (event WITH id, home)
+        app_id: int,
+        channel_id: Optional[int],
+    ) -> None:
+        """Copy committed primaries to their successor shards. Failures
+        degrade redundancy, loudly, without failing the write."""
+        if self.replicas <= 1 or not primaries:
+            return
+        per_follower: dict[int, list[Event]] = {}
+        for e, home in primaries:
+            for sx in self._replica_chain(home)[1:]:
+                per_follower.setdefault(sx, []).append(e)
+        futs = {
+            sx: self._pool.submit(
+                self._shard_call, sx, self._stores[sx].insert_batch,
+                evs, app_id, channel_id, retries=0,
+            )
+            for sx, evs in per_follower.items()
+        }
+        for sx, f in futs.items():
+            try:
+                f.result()
+            except Exception as e:
+                log.error(
+                    "replica write to shard %d failed — %d event(s) "
+                    "have reduced redundancy: %s",
+                    sx, len(per_follower[sx]), e,
+                )
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
@@ -347,8 +409,9 @@ class ShardedEventStore(base.EventStore):
             groups.setdefault(sx, []).append((pos, e))
             if e.event_id:
                 explicit.append((sx, e.event_id))
-        # explicit-id replays: evict each id from every NON-home shard in
-        # one bulk delete per shard, all shards concurrently (see insert())
+        # explicit-id replays: evict each id from every NON-home shard
+        # (replica holders included — see insert()), one bulk delete per
+        # shard, all concurrent
         evict_calls = []
         for sx in range(self.n_shards):
             ids = [eid for home, eid in explicit if home != sx]
@@ -369,6 +432,7 @@ class ShardedEventStore(base.EventStore):
             for sx, pairs in groups.items()
         }
         out: list[Optional[str]] = [None] * len(events)
+        committed: list[tuple[Event, int]] = []
         first_err: Optional[Exception] = None
         for sx, pairs in groups.items():
             try:
@@ -377,8 +441,10 @@ class ShardedEventStore(base.EventStore):
                 if first_err is None:
                     first_err = e
                 continue
-            for (pos, _e), eid in zip(pairs, ids):
+            for (pos, e), eid in zip(pairs, ids):
                 out[pos] = eid
+                committed.append((e.with_id(eid), sx))
+        self._replicate(committed, app_id, channel_id)
         if first_err is not None:
             raise PartialBatchWriteError(out, first_err)
         return out  # type: ignore[return-value]
@@ -405,9 +471,11 @@ class ShardedEventStore(base.EventStore):
                         first_err = err
                     continue
                 if e is not None:
-                    # ids are unique across shards: a hit is definitive
-                    # even if another shard is down — return immediately
-                    # rather than waiting out a dead shard's retry budget
+                    # a hit is definitive even if another shard is down:
+                    # replica copies are evicted-before-rewrite on
+                    # overwrites, so every live copy of an id carries the
+                    # same content — return immediately rather than
+                    # waiting out a dead shard's retry budget
                     return e
         finally:
             for f in futs:
@@ -439,7 +507,10 @@ class ShardedEventStore(base.EventStore):
     ) -> int:
         # one bulk call per child (ids don't encode shards; a miss on one
         # child is a no-op there) instead of K ids × N shards single RPCs
-        # — SelfCleaningDataSource deletes expired events in bulk
+        # — SelfCleaningDataSource deletes expired events in bulk.
+        # NOTE with REPLICAS > 1 the return counts removed COPIES (an
+        # event deleted from home + follower counts twice); attributing
+        # per-event existence would cost a per-id home lookup round.
         ids = list(event_ids)
         res = self._broadcast(
             [
@@ -498,11 +569,61 @@ class ShardedEventStore(base.EventStore):
             if err is not None:
                 raise err from e
 
+    def _failover_stream(
+        self,
+        chain: Sequence[int],
+        query: EventQuery,
+        partial_ok: bool = False,
+    ) -> Iterator[Event]:
+        """Stream `query` from the first LIVE shard in `chain` (home
+        first, then its replica holders — each holds the same data for
+        this query's scope). Failover happens only before the first
+        yield; a mid-stream cut cannot resume on a replica without
+        duplicating already-yielded events, so it propagates (or
+        degrades under allow_partial for broadcast reads)."""
+        last: Optional[ShardDownError] = None
+        for j, sx in enumerate(chain):
+            yielded = False
+            try:
+                for e in self._guarded_stream(sx, query):
+                    yielded = True
+                    yield e
+                return
+            except ShardDownError as err:
+                if yielded:
+                    # mid-stream: a replica cannot resume without
+                    # duplicating already-yielded events — truncate
+                    # (degraded) for broadcast reads, else propagate
+                    if partial_ok and self.allow_partial:
+                        if chain[0] not in self.last_degraded_shards:
+                            self.last_degraded_shards.append(chain[0])
+                        log.warning(
+                            "degraded read: stream cut mid-flight; %s",
+                            err,
+                        )
+                        return
+                    raise
+                last = err
+                if j + 1 < len(chain):
+                    log.warning(
+                        "shard %d down; reading partition from replica "
+                        "on shard %d", sx, chain[j + 1],
+                    )
+        if last is not None:
+            if partial_ok and self.allow_partial:
+                if chain[0] not in self.last_degraded_shards:
+                    self.last_degraded_shards.append(chain[0])
+                log.warning("degraded read: %s", last)
+                return
+            raise last
+
     def find(self, query: EventQuery) -> Iterator[Event]:
         if query.entity_id is not None:
-            # entity locality: one shard holds everything for this entity
+            # entity locality: one shard (plus its replicas) holds
+            # everything for this entity — never partial, but with
+            # REPLICAS > 1 a down home fails over to a copy holder
             sx = self._for_entity(query.entity_id)
-            return self._guarded_stream(sx, query)  # never partial
+            return self._failover_stream(self._replica_chain(sx), query)
         if (
             query.shard is not None
             and query.shard[1] == self.n_shards
@@ -511,13 +632,45 @@ class ShardedEventStore(base.EventStore):
             # the partitioned-read contract uses the SAME hash — shard i
             # of N lives entirely on child i: a direct single-daemon
             # stream, the zero-crosstalk HBase parallel-scan case (the
-            # child still applies the filter; every row passes)
-            return self._guarded_stream(query.shard[0], query)
+            # child still applies the filter, which also selects EXACTLY
+            # partition i's events out of a replica holder on failover)
+            return self._failover_stream(
+                self._replica_chain(query.shard[0]), query
+            )
         self.last_degraded_shards = []
-        streams = [
-            self._guarded_stream(sx, query, partial_ok=True)
-            for sx in range(self.n_shards)
-        ]
+        if self.replicas > 1:
+            # replicas would appear R times in a naive merge — read each
+            # shard PRIMARY-ONLY (shard=(i, N) filters server-side;
+            # copies on successors have a different entity hash) with
+            # per-partition failover. A caller-supplied non-aligned
+            # (j, m) shard filter is applied client-side on top.
+            caller_shard = query.shard
+
+            def partition(i: int) -> Iterator[Event]:
+                # limit pushes down per child (the in-order merge takes
+                # the global top-`limit` from per-child top-`limit`s)
+                # UNLESS a client-side shard re-filter will discard rows
+                q_i = dataclasses.replace(
+                    query,
+                    shard=(i, self.n_shards),
+                    limit=None if caller_shard is not None else query.limit,
+                )
+                stream = self._failover_stream(
+                    self._replica_chain(i), q_i, partial_ok=True
+                )
+                if caller_shard is None:
+                    return stream
+                j, m = caller_shard
+                return (
+                    e for e in stream if shard_of(e.entity_id, m) == j
+                )
+
+            streams = [partition(i) for i in range(self.n_shards)]
+        else:
+            streams = [
+                self._guarded_stream(sx, query, partial_ok=True)
+                for sx in range(self.n_shards)
+            ]
         merged = heapq.merge(
             *streams,
             key=lambda e: (e.event_time, e.event_id or ""),
@@ -540,21 +693,35 @@ class ShardedEventStore(base.EventStore):
         """Entity locality makes this a per-shard fan-out: each shard
         answers for ITS entities in one bulk call, all shards in one
         concurrent round (never partial — a missing user history would
-        silently impersonate a cold-start user)."""
+        silently impersonate a cold-start user; with REPLICAS > 1 a
+        down home shard's whole group fails over to the copy holder)."""
         groups: dict[int, list[str]] = {}
         for eid in dict.fromkeys(entity_ids):
             groups.setdefault(self._for_entity(eid), []).append(eid)
 
-        def one(sx: int, ids: list) -> dict:
-            return self._stores[sx].find_entities_batch(
-                app_id,
-                entity_type,
-                ids,
-                channel_id=channel_id,
-                event_names=event_names,
-                limit_per_entity=limit_per_entity,
-                reversed=reversed,
-            )
+        def one(home: int, ids: list) -> dict:
+            last: Optional[ShardDownError] = None
+            for c in self._replica_chain(home):
+                def call(c=c):
+                    return self._stores[c].find_entities_batch(
+                        app_id,
+                        entity_type,
+                        ids,
+                        channel_id=channel_id,
+                        event_names=event_names,
+                        limit_per_entity=limit_per_entity,
+                        reversed=reversed,
+                    )
+
+                try:
+                    return self._shard_call(c, call)
+                except ShardDownError as e:
+                    last = e
+                    log.warning(
+                        "shard %d down for entity batch; trying replica",
+                        c,
+                    )
+            raise last  # type: ignore[misc]
 
         res = self._broadcast(
             [(sx, one, (sx, ids)) for sx, ids in groups.items()]
@@ -581,17 +748,62 @@ class ShardedEventStore(base.EventStore):
         **kw: Any,
     ) -> dict:
         # entities are shard-disjoint → per-shard aggregation unions
-        # exactly (each child sees an entity's FULL $set/$unset history)
+        # exactly (each child sees an entity's FULL $set/$unset history).
+        # With REPLICAS > 1 each entity is attributed to its HOME shard
+        # only: a successor's copy can be PARTIAL (pre-replication
+        # history, or a logged replica-write failure) and must never
+        # overwrite the home's complete aggregation. A down home's
+        # entities are recovered from the first live successor instead —
+        # best-available, possibly partial, and only reachable when the
+        # broadcast itself was allowed to degrade.
         def agg(s: base.EventStore) -> dict:
             return s.aggregate_properties(
                 app_id, entity_type, channel_id=channel_id, **kw
             )
 
-        res = self._broadcast(
-            [(sx, agg, (s,)) for sx, s in enumerate(self._stores)],
-            partial_ok=True,
-        )
-        out: dict = {}
-        for sx in sorted(res):
-            out.update(res[sx])
+        if self.replicas <= 1:
+            res = self._broadcast(
+                [(sx, agg, (s,)) for sx, s in enumerate(self._stores)],
+                partial_ok=True,
+            )
+            out: dict = {}
+            for sx in sorted(res):
+                out.update(res[sx])
+            return out
+        # replicated: collect failures OURSELVES — a down home whose
+        # successor answered is fully recoverable, so it must not raise
+        # even without ALLOW_PARTIAL (the result is complete)
+        futs = {
+            sx: self._pool.submit(self._shard_call, sx, agg, st)
+            for sx, st in enumerate(self._stores)
+        }
+        res, errs = {}, {}
+        for sx, f in futs.items():
+            try:
+                res[sx] = f.result()
+            except ShardDownError as e:
+                errs[sx] = e
+        degraded: list[int] = []
+        out = {}
+        for sx in range(self.n_shards):
+            src = res.get(sx)
+            if src is None:  # home down: first live successor's copy
+                for c in self._replica_chain(sx)[1:]:
+                    if c in res:
+                        src = res[c]
+                        break
+            if src is None:  # whole chain down: only degradable
+                if not self.allow_partial:
+                    raise errs[sx]
+                degraded.append(sx)
+                log.warning("degraded aggregate: %s", errs[sx])
+                continue
+            out.update(
+                {
+                    k: v
+                    for k, v in src.items()
+                    if self._for_entity(k) == sx
+                }
+            )
+        self.last_degraded_shards = degraded
         return out
