@@ -22,10 +22,11 @@ import json
 import logging
 import threading
 from collections import OrderedDict
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Optional
 
 from predictionio_tpu.data.storage import wire
+from predictionio_tpu.utils.http import ThreadedServer
 from predictionio_tpu.data.storage.registry import Storage
 
 log = logging.getLogger(__name__)
@@ -235,8 +236,11 @@ class StorageServer:
                 "storage server binding %s WITHOUT --auth-key: all app data "
                 "is readable/writable by any network peer", host,
             )
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
-        self.httpd.request_queue_size = 128
+        # ThreadedServer (not raw ThreadingHTTPServer): its CLASS-level
+        # request_queue_size=128 applies before __init__ calls listen()
+        # — a post-construction assignment never did anything, and the
+        # stdlib's backlog of 5 drops bursty concurrent clients
+        self.httpd = ThreadedServer((host, port), _Handler)
         self.httpd.storage = self.storage  # type: ignore[attr-defined]
         self.httpd.auth_key = auth_key  # type: ignore[attr-defined]
         self.httpd.find_page_size = find_page_size  # type: ignore[attr-defined]
