@@ -209,20 +209,52 @@ def _dense_half_step(
     cg_iterations: int,
     dense_dtype: str,
     scale: float = 1.0,
+    pallas_mode=None,
 ) -> jax.Array:
     """One ALS half-step with b/gram built by dense matmuls over R.
 
     Identical operator assembly + CG to the windowed path — only the
-    edge pass differs (ops/dense.py). Padding rows have all-zero R and
-    b=0, x0=0, so CG freezes them at zero exactly like window padding."""
+    edge pass differs: the fused Pallas kernel (ops/dense_pallas.py —
+    ONE R read per pass, both weight tiles derived in VMEM) when
+    `pallas_mode` is set and the storage is int8 with clean tile
+    divisors, else the XLA two-dot scan (ops/dense.py). Padding rows
+    have all-zero R and b=0, x0=0, so CG freezes them at zero exactly
+    like window padding."""
     from predictionio_tpu.ops import dense
 
     k = x0.shape[1]
-    edge_pass = dense.dense_row_pass if solve_rows else dense.dense_col_pass
-    b, corr_flat = edge_pass(
-        r, fixed, implicit=implicit, alpha=alpha, dense_dtype=dense_dtype,
-        scale=scale,
-    )
+    use_kernel = pallas_mode is not None and r.dtype == jnp.int8
+    if use_kernel:
+        from predictionio_tpu.ops import dense_pallas
+
+        rt, ct = dense_pallas.pick_tiles(*r.shape)
+        use_kernel = rt > 0 and ct > 0
+    if use_kernel:
+        y32 = fixed.astype(jnp.float32)
+        z32 = (
+            fixed[:, :, None] * fixed[:, None, :]
+        ).reshape(fixed.shape[0], k * k).astype(jnp.float32)
+        ascale = jnp.asarray(
+            [alpha / scale if implicit else 1.0 / scale], jnp.float32
+        )
+        fused = (
+            dense_pallas.fused_row_pass
+            if solve_rows
+            else dense_pallas.fused_col_pass
+        )
+        b, corr_flat = fused(
+            r, y32, z32, ascale, implicit=implicit,
+            interpret=(pallas_mode == "interpret"),
+            row_tile=rt, col_tile=ct,
+        )
+    else:
+        edge_pass = (
+            dense.dense_row_pass if solve_rows else dense.dense_col_pass
+        )
+        b, corr_flat = edge_pass(
+            r, fixed, implicit=implicit, alpha=alpha,
+            dense_dtype=dense_dtype, scale=scale,
+        )
     if implicit:
         gram = f32_gram(fixed)
         base = gram + lam * jnp.eye(k, dtype=jnp.float32)
@@ -242,7 +274,7 @@ def _dense_half_step(
     jax.jit,
     static_argnames=(
         "rank", "iterations", "implicit", "cg_iterations", "dense_dtype",
-        "scale",
+        "scale", "pallas_mode",
     ),
 )
 def _train_jit_dense(
@@ -261,6 +293,7 @@ def _train_jit_dense(
     seed: int,
     dense_dtype: str = "bf16",
     scale: float = 1.0,
+    pallas_mode=None,
 ):
     """Whole alternating loop on the dense-W path: every half-step is two
     dense matmuls + the shared flat-operator CG. R enters as a jit
@@ -286,11 +319,13 @@ def _train_jit_dense(
             r, itf, user_deg, uf, solve_rows=True, implicit=implicit,
             lam=lam, alpha=alpha, cg_iterations=cg_iterations,
             dense_dtype=dense_dtype, scale=scale,
+            pallas_mode=pallas_mode,
         )
         itf = _dense_half_step(
             r, uf, item_deg, itf, solve_rows=False, implicit=implicit,
             lam=lam, alpha=alpha, cg_iterations=cg_iterations,
             dense_dtype=dense_dtype, scale=scale,
+            pallas_mode=pallas_mode,
         )
         return uf, itf
 
@@ -486,9 +521,12 @@ class StagedDenseTrain:
 
     def run(self) -> tuple[jax.Array, jax.Array]:
         if self.static_kwargs.get("mesh") is not None:
-            return _train_jit_dense_sharded(
-                *self.device_args, **self.static_kwargs
-            )
+            kwargs = {
+                k: v
+                for k, v in self.static_kwargs.items()
+                if k != "pallas_mode"
+            }
+            return _train_jit_dense_sharded(*self.device_args, **kwargs)
         kwargs = {
             k: v for k, v in self.static_kwargs.items() if k != "mesh"
         }
@@ -570,6 +608,12 @@ def dense_eligible(
         )
         return False
     return True
+
+
+def _dense_pallas_mode():
+    from predictionio_tpu.ops import dense_pallas
+
+    return dense_pallas.resolve_mode("auto")
 
 
 def stage_dense(
@@ -698,6 +742,13 @@ def stage_dense(
             seed=params.seed,
             dense_dtype=dense_dtype,
             scale=scale,
+            # resolved OUTSIDE the jit; the grid (vmap) and sharded
+            # (shard_map) variants exclude the kernel for now — pop it
+            pallas_mode=(
+                None
+                if (mesh is not None and mesh.devices.size > 1)
+                else _dense_pallas_mode()
+            ),
             mesh=mesh if (mesh is not None and mesh.devices.size > 1) else None,
         ),
         n_users=n_users,
@@ -1035,6 +1086,7 @@ def train_grid(
             kwargs = dict(staged_d.static_kwargs)
             kwargs.pop("lam"), kwargs.pop("alpha")
             kwargs.pop("mesh", None)  # grids run single-device
+            kwargs.pop("pallas_mode", None)  # vmap excluded for now
             kwargs.update(
                 rank=rank, iterations=iterations,
                 cg_iterations=cg_iterations, implicit=implicit, seed=seed,
