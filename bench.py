@@ -600,9 +600,40 @@ def bench_serving_framework():
             )
             sweep.append(dict(stats, clients=n_clients))
         best = max(sweep, key=lambda r: r["qps"])
-        return dict(best, sweep=sweep)
+        return dict(best, sweep=sweep, obs=_registry_snapshot(srv.metrics))
     finally:
         srv.stop()
+
+
+def _registry_snapshot(registry):
+    """Server-side registry view of the whole bench run (ISSUE 1): the
+    ledger records full latency DISTRIBUTIONS (p50/p95/p99 from histogram
+    buckets) and batch-depth shape, not just the client-side wall-clock
+    means `_hammer_query_server` computes."""
+
+    from predictionio_tpu.obs import BATCH_SIZE_BUCKETS
+
+    def ms(h, q):
+        return round(h.quantile(q) * 1e3, 3)
+
+    serve = registry.histogram("serve_seconds")
+    predict = registry.histogram("predict_seconds")
+    batch = registry.histogram(
+        "batch_size", buckets=BATCH_SIZE_BUCKETS, lower_bound=1
+    )
+    wait = registry.histogram("batch_queue_wait_seconds")
+    return {
+        "requests": serve.count,
+        "serve_ms": {"p50": ms(serve, 0.5), "p95": ms(serve, 0.95),
+                     "p99": ms(serve, 0.99)},
+        "predict_ms": {"p50": ms(predict, 0.5), "p95": ms(predict, 0.95),
+                       "p99": ms(predict, 0.99)},
+        "queue_wait_ms": {"p50": ms(wait, 0.5), "p99": ms(wait, 0.99)},
+        "batches": batch.count,
+        "batch_size": {"p50": round(batch.quantile(0.5), 1),
+                       "p95": round(batch.quantile(0.95), 1),
+                       "mean": round(batch.mean, 2)},
+    }
 
 
 def bench_event_ingestion():
@@ -1068,6 +1099,7 @@ def main():
         "serving_framework_qps": round(framework["qps"], 1),
         "serving_framework_p50_ms": round(framework["p50_ms"], 1),
         "serving_framework_p99_ms": round(framework["p99_ms"], 1),
+        "serving_metrics_registry": framework["obs"],
         "serving_clients": framework["clients"],
         "serving_client_sweep": [
             {"clients": r["clients"], "qps": round(r["qps"], 1),

@@ -69,6 +69,20 @@ def _sanity(obj: Any, what: str, wp: WorkflowParams) -> None:
         obj.sanity_check()
 
 
+def train_stage_histogram():
+    """train_stage_seconds{stage} on the process-default registry — any
+    server in this process (or `pio metrics`) exposes it on scrape. The
+    single declaration point: workflow/core.py records 'persist' through
+    this too, so name/labels can never drift apart."""
+    from predictionio_tpu.obs import get_default_registry
+
+    return get_default_registry().histogram(
+        "train_stage_seconds",
+        "train workflow stage durations (read/prepare/train/persist)",
+        ("stage",),
+    )
+
+
 class Engine(BaseEngine):
     """Binds named class maps for DataSource/Preparator/Algorithms/Serving
     (reference Engine.scala:80)."""
@@ -116,12 +130,19 @@ class Engine(BaseEngine):
     def train(self, ctx: RuntimeContext, engine_params: EngineParams) -> list[Any]:
         import time as _time
 
+        def _record(stage: str, seconds: float) -> None:
+            # both surfaces stay in sync: ctx.stage_timings feeds the
+            # EngineInstance row snapshot, the unified registry feeds
+            # /metrics + `pio metrics` (ISSUE 1: one observability layer)
+            ctx.stage_timings[stage] = seconds
+            train_stage_histogram().observe(seconds, stage=stage)
+
         wp = ctx.workflow_params
         t0 = _time.perf_counter()
         data_source = self.make_data_source(engine_params)
         td = data_source.read_training(ctx)
         _sanity(td, "training data", wp)
-        ctx.stage_timings["read"] = _time.perf_counter() - t0
+        _record("read", _time.perf_counter() - t0)
         if wp.stop_after_read:
             raise StopAfterReadInterruption()
 
@@ -129,7 +150,7 @@ class Engine(BaseEngine):
         preparator = self.make_preparator(engine_params)
         pd = preparator.prepare(ctx, td)
         _sanity(pd, "prepared data", wp)
-        ctx.stage_timings["prepare"] = _time.perf_counter() - t0
+        _record("prepare", _time.perf_counter() - t0)
         if wp.stop_after_prepare:
             raise StopAfterPrepareInterruption()
 
@@ -142,7 +163,7 @@ class Engine(BaseEngine):
             model = algo.train(ctx, pd)
             _sanity(model, f"model of algorithm #{i}", wp)
             models.append(model)
-        ctx.stage_timings["train"] = _time.perf_counter() - t0
+        _record("train", _time.perf_counter() - t0)
         return models
 
     # -- serializable models (reference makeSerializableModels:283) --------

@@ -36,6 +36,7 @@ from predictionio_tpu.data.api.webhooks import (
 from predictionio_tpu.data.event import Event, EventValidation, ValidationError
 from predictionio_tpu.data.storage.base import EventQuery
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import server_registry
 from predictionio_tpu.utils.http import (
     HttpError as _HttpError,
     JsonHandler,
@@ -135,6 +136,9 @@ class _Handler(JsonHandler):
     def _after_insert(self, auth: AuthData, obj: dict, event: Event) -> None:
         ctx = {"appId": auth.app_id, "channelId": auth.channel_id}
         self.server.plugin_context.run_sniffers(obj, ctx)
+        self.server.metrics.counter(
+            "events_ingested_total", "events accepted into storage"
+        ).inc()
         if self.server.stats is not None:
             self.server.stats.update(auth.app_id, 201, event)
 
@@ -155,6 +159,8 @@ class _Handler(JsonHandler):
         try:
             if path == "/" and method == "GET":
                 self._respond(200, {"status": "alive"})
+            elif path == "/metrics" and method == "GET":
+                self._serve_metrics()
             elif path == "/events.json":
                 auth = self._auth(query)
                 if method == "POST":
@@ -354,6 +360,10 @@ class _Server(ThreadedServer):
         self.storage = storage
         self.stats = Stats() if config.stats else None
         self.plugin_context = PluginContext(config.plugins)
+        # unified observability (ISSUE 1): JsonHandler's middleware
+        # records per-request counters/latency here; GET /metrics scrapes
+        self.metrics = server_registry()
+        self.metrics_label = "event"
 
 
 class EventServer(ServerProcess):

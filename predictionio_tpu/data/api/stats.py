@@ -27,8 +27,15 @@ class HourlyStats:
 
 
 class Stats:
-    def __init__(self):
+    """`retention_hours` caps memory: the reference (and the seed port)
+    never pruned hourly buckets, so a long-lived event server leaked one
+    bucket dict per app per hour forever. Pruning happens under the
+    existing lock whenever a new hour bucket is first created — O(kept)
+    and only once per hour per app, not per event."""
+
+    def __init__(self, retention_hours: int = 24):
         self._lock = threading.Lock()
+        self.retention_hours = retention_hours
         # (app_id, hour_iso) → HourlyStats
         self._buckets: dict[tuple[int, str], HourlyStats] = {}
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
@@ -37,11 +44,28 @@ class Stats:
     def _hour(t: _dt.datetime) -> str:
         return t.astimezone(_dt.timezone.utc).strftime("%Y-%m-%dT%H")
 
-    def update(self, app_id: int, status: int, event: Event) -> None:
+    def update(
+        self,
+        app_id: int,
+        status: int,
+        event: Event,
+        now: _dt.datetime | None = None,
+    ) -> None:
         kv = KV(status=status, event=event.event, entity_type=event.entity_type)
-        key = (app_id, self._hour(_dt.datetime.now(_dt.timezone.utc)))
+        ts = now or _dt.datetime.now(_dt.timezone.utc)
+        key = (app_id, self._hour(ts))
         with self._lock:
-            bucket = self._buckets.setdefault(key, HourlyStats())
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = self._buckets[key] = HourlyStats()
+                cutoff = self._hour(
+                    ts - _dt.timedelta(hours=self.retention_hours)
+                )
+                # hour keys are ISO "YYYY-MM-DDTHH": lexicographic order
+                # IS chronological order, so a string compare prunes
+                stale = [k for k in self._buckets if k[1] < cutoff]
+                for k in stale:
+                    del self._buckets[k]
             bucket.counts[kv] += 1
 
     def get(self, app_id: int) -> dict:

@@ -1,0 +1,39 @@
+"""Unified observability: metrics registry, Prometheus exposition,
+request tracing. See registry.py for the design rationale."""
+
+from predictionio_tpu.obs.jaxmon import install_jax_gauges
+from predictionio_tpu.obs.registry import (
+    BATCH_SIZE_BUCKETS,
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    get_default_registry,
+    render_merged,
+)
+from predictionio_tpu.obs.tracing import (
+    current_trace_id,
+    log_access,
+    new_request_id,
+    trace_context,
+)
+
+__all__ = [
+    "BATCH_SIZE_BUCKETS",
+    "DEFAULT_LATENCY_BUCKETS",
+    "MetricsRegistry",
+    "current_trace_id",
+    "get_default_registry",
+    "install_jax_gauges",
+    "log_access",
+    "new_request_id",
+    "render_merged",
+    "server_registry",
+    "trace_context",
+]
+
+
+def server_registry() -> MetricsRegistry:
+    """A fresh per-server registry with the JAX runtime gauges mounted —
+    what every server process binds to its `GET /metrics`."""
+    reg = MetricsRegistry()
+    install_jax_gauges(reg)
+    return reg

@@ -15,6 +15,7 @@ from typing import Optional
 
 from predictionio_tpu.data.storage.base import App
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import server_registry
 from predictionio_tpu.tools import common
 from predictionio_tpu.tools.common import CommandError
 from predictionio_tpu.utils.http import (
@@ -38,6 +39,8 @@ class _Handler(JsonHandler):
         try:
             if path == "/":
                 self._respond(200, {"status": "alive"})
+            elif path == "/metrics":
+                self._serve_metrics()
             elif path == "/cmd/app":
                 apps = self.storage.get_meta_data_apps().get_all()
                 keys = self.storage.get_meta_data_access_keys()
@@ -116,6 +119,8 @@ class _Server(ThreadedServer):
     def __init__(self, addr, storage: Storage):
         super().__init__(addr, _Handler)
         self.storage = storage
+        self.metrics = server_registry()
+        self.metrics_label = "admin"
 
 
 class AdminServer(ServerProcess):

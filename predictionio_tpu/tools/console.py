@@ -380,7 +380,51 @@ def cmd_status(args) -> int:
                 f"[INFO]   {m.id} v{m.version}: {m.engine_factory}"
                 + (f" — {m.description}" if m.description else "")
             )
+    _print_registry_summary()
     print("[INFO] (sleeping 0 seconds) Your system is all ready to go.")
+    return 0
+
+
+def _print_registry_summary() -> None:
+    """Render the process-default registry (train-stage timings etc.) —
+    the same data a server scrape would show, in console form."""
+    from predictionio_tpu.obs import get_default_registry
+
+    snap = get_default_registry().snapshot()
+    interesting = {
+        k: v for k, v in snap.items() if not k.startswith("jax_")
+    }
+    if not interesting:
+        return
+    print("[INFO] Process metrics (registry snapshot):")
+    for name, fam in sorted(interesting.items()):
+        for row in fam["values"]:
+            labels = ",".join(f"{k}={v}" for k, v in row["labels"].items())
+            where = f"{name}{{{labels}}}" if labels else name
+            if fam["type"] == "histogram":
+                print(
+                    f"[INFO]   {where}: count={row['count']} "
+                    f"mean={row['mean'] * 1e3:.1f}ms "
+                    f"p50={row['p50'] * 1e3:.1f}ms "
+                    f"p99={row['p99'] * 1e3:.1f}ms"
+                )
+            else:
+                print(f"[INFO]   {where}: {row['value']:g}")
+
+
+def cmd_metrics(args) -> int:
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(args.url, timeout=10) as r:
+            print(r.read().decode(), end="")
+        return 0
+    if args.summary:
+        _print_registry_summary()
+        return 0
+    from predictionio_tpu.obs import get_default_registry
+
+    print(get_default_registry().render(), end="")
     return 0
 
 
@@ -646,6 +690,23 @@ def build_parser() -> argparse.ArgumentParser:
     # status
     s = sub.add_parser("status", help="verify environment + storage")
     s.set_defaults(func=cmd_status)
+
+    # metrics (ISSUE 1: registry exposition from the console)
+    s = sub.add_parser(
+        "metrics",
+        help="print Prometheus metrics: this process's registry, or a "
+             "running server's /metrics via --url",
+    )
+    s.add_argument(
+        "--url", default=None,
+        help="scrape this URL (e.g. http://127.0.0.1:8000/metrics) "
+             "instead of the local registry",
+    )
+    s.add_argument(
+        "--summary", action="store_true",
+        help="render a human-readable summary instead of exposition text",
+    )
+    s.set_defaults(func=cmd_metrics)
 
     # export / import
     s = sub.add_parser(

@@ -10,6 +10,7 @@ import html
 from typing import Optional
 
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import server_registry
 from predictionio_tpu.utils.http import (
     HttpError,
     JsonHandler,
@@ -27,6 +28,8 @@ class _Handler(JsonHandler):
         try:
             if path == "/":
                 self._respond(200, self._index(), "text/html")
+            elif path == "/metrics":
+                self._serve_metrics()
             elif path.startswith("/engine_instances/") and path.endswith(".html"):
                 iid = path[len("/engine_instances/"):-len(".html")]
                 inst = (
@@ -78,6 +81,8 @@ class _Server(ThreadedServer):
     def __init__(self, addr, storage: Storage):
         super().__init__(addr, _Handler)
         self.storage = storage
+        self.metrics = server_registry()
+        self.metrics_label = "dashboard"
 
 
 class Dashboard(ServerProcess):

@@ -10,9 +10,14 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
+
+import predictionio_tpu.obs.registry as _obs_registry
+import predictionio_tpu.obs.tracing as _obs_tracing
 
 log = logging.getLogger(__name__)
 
@@ -27,7 +32,15 @@ class HttpError(Exception):
 
 
 class JsonHandler(BaseHTTPRequestHandler):
-    """Base handler: drains the body before dispatch, JSON helpers."""
+    """Base handler: drains the body before dispatch, JSON helpers, and
+    the observability middleware — every request is timed, tagged with a
+    trace id (`X-Request-ID` from the client or generated here), counted
+    into the owning server's MetricsRegistry
+    (`http_requests_total{server,method,path,status}` +
+    `http_request_seconds{server,path}`), and access-logged as one JSON
+    record. Servers opt in by setting `metrics` (a MetricsRegistry) and
+    `metrics_label` on their ThreadedServer; trace ids propagate
+    regardless."""
 
     protocol_version = "HTTP/1.1"
     # status line / headers / body are separate socket writes: with
@@ -41,7 +54,92 @@ class JsonHandler(BaseHTTPRequestHandler):
 
     def handle_one_request(self):
         self._raw_body = b""
-        super().handle_one_request()
+        self._trace_token = None
+        try:
+            super().handle_one_request()
+        finally:
+            # keep-alive reuses this thread: clear the request's trace id
+            # so the next request (or idle logging) can't inherit it
+            if self._trace_token is not None:
+                _obs_tracing.reset_trace_id(self._trace_token)
+                self._trace_token = None
+
+    # client-supplied ids are echoed into RESPONSE headers: restrict to a
+    # safe charset/length (a folded header would otherwise smuggle CRLF
+    # bytes through http.client's parser into the response — header
+    # injection / keep-alive desync)
+    _TRACE_ID_RE = re.compile(r"[A-Za-z0-9._:-]{1,128}")
+
+    def parse_request(self):
+        ok = super().parse_request()
+        if ok:
+            self._t0 = time.perf_counter()
+            self._metrics_recorded = False
+            tid = self.headers.get("X-Request-ID") or ""
+            if not self._TRACE_ID_RE.fullmatch(tid):
+                tid = _obs_tracing.new_request_id()
+            self._trace_id = tid
+            self._trace_token = _obs_tracing.set_trace_id(tid)
+        return ok
+
+    # -- observability middleware ------------------------------------------
+    def _route_label(self, path: str) -> str:
+        """Collapse per-entity path segments so metric label cardinality
+        stays bounded (/events/<id>.json → /events/{id}.json)."""
+        parts = path.split("/")
+        if len(parts) >= 3 and parts[1] in ("events", "engine_instances"):
+            for suffix in (".json", ".html"):
+                if parts[2].endswith(suffix):
+                    parts[2] = "{id}" + suffix
+                    break
+            else:
+                parts[2] = "{id}"
+        return "/".join(parts)
+
+    def _record_request(self, status: int) -> None:
+        if getattr(self, "_metrics_recorded", True):
+            return
+        self._metrics_recorded = True
+        duration = time.perf_counter() - self._t0
+        label = getattr(self.server, "metrics_label", "http")
+        path = self._route_label(self.path.split("?")[0].rstrip("/") or "/")
+        # unmatched routes share ONE metric label value: an internet-facing
+        # port gets scanned with unbounded distinct paths, and each would
+        # otherwise mint a fresh counter+histogram child. The access log
+        # keeps the real path — logs have no cardinality constraint.
+        metric_path = "(unmatched)" if status == 404 else path
+        registry = getattr(self.server, "metrics", None)
+        if registry is not None:
+            registry.counter(
+                "http_requests_total",
+                "HTTP requests served",
+                ("server", "method", "path", "status"),
+            ).inc(
+                server=label, method=self.command,
+                path=metric_path, status=status,
+            )
+            registry.histogram(
+                "http_request_seconds",
+                "request wall time, request line to response written",
+                ("server", "path"),
+            ).observe(duration, server=label, path=metric_path)
+        _obs_tracing.log_access(
+            server=label,
+            method=self.command,
+            path=path,
+            status=status,
+            duration_s=duration,
+            trace_id=getattr(self, "_trace_id", None),
+        )
+
+    def _serve_metrics(self) -> None:
+        """GET /metrics: this server's registry merged with the
+        process-default one (train-stage metrics live there)."""
+        text = _obs_registry.render_merged(
+            getattr(self.server, "metrics", None),
+            _obs_registry.get_default_registry(),
+        )
+        self._respond(200, text, "text/plain; version=0.0.4")
 
     def _drain_body(self) -> None:
         length = int(self.headers.get("Content-Length") or 0)
@@ -65,8 +163,12 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", f"{content_type}; charset=UTF-8")
         self.send_header("Content-Length", str(len(data)))
+        trace_id = getattr(self, "_trace_id", None)
+        if trace_id:
+            self.send_header("X-Request-ID", trace_id)
         self.end_headers()
         self.wfile.write(data)
+        self._record_request(status)
 
 
 class ThreadedServer(ThreadingHTTPServer):

@@ -16,6 +16,8 @@ import queue
 import threading
 import urllib.request
 
+from predictionio_tpu.obs.tracing import current_trace_id
+
 
 class RemoteLogHandler(logging.Handler):
     """logging.Handler that ships records to `url` as JSON lines.
@@ -46,14 +48,20 @@ class RemoteLogHandler(logging.Handler):
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
-            self._q.put_nowait(
-                {
-                    "ts": record.created,
-                    "level": record.levelname,
-                    "logger": record.name,
-                    "message": self.format(record),
-                }
-            )
+            entry = {
+                "ts": record.created,
+                "level": record.levelname,
+                "logger": record.name,
+                "message": self.format(record),
+            }
+            # emit() runs on the logging thread, which for server-side
+            # records is the request handler thread — the tracing
+            # contextvar still holds the request's id, so shipped records
+            # correlate with the access log at the collector
+            trace_id = current_trace_id()
+            if trace_id:
+                entry["trace_id"] = trace_id
+            self._q.put_nowait(entry)
         except queue.Full:
             pass  # shedding is the correct failure mode for telemetry
 
@@ -77,6 +85,13 @@ class RemoteLogHandler(logging.Handler):
         try:
             with urllib.request.urlopen(req, timeout=5):
                 pass
+            if self._warned:
+                # a recovered collector logs its recovery (and re-arms
+                # the one-shot warning for the next outage)
+                self._warned = False
+                logging.getLogger("pio.logship").info(
+                    "log shipping to %s recovered", self.url
+                )
             return True
         except Exception as e:
             if not self._warned:

@@ -28,6 +28,7 @@ from predictionio_tpu.core.base import (
 )
 from predictionio_tpu.data.storage.base import EngineInstance, Model
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import get_default_registry
 
 log = logging.getLogger(__name__)
 
@@ -88,6 +89,9 @@ def run_train(
     Returns the COMPLETED EngineInstance row.
     """
     wp = workflow_params or WorkflowParams()
+    from predictionio_tpu.obs.jaxmon import ensure_compile_listener
+
+    ensure_compile_listener()  # count this run's jit compiles on scrape
     engine = resolve_engine(load_symbol(variant["engineFactory"]))
     if engine_params is None:
         engine_params = engine.params_from_variant_json(variant)
@@ -135,10 +139,18 @@ def run_train(
     ctx.instance_id = instance_id
 
     def _record_timings() -> None:
+        # the EngineInstance blob stays as a point-in-time snapshot of
+        # what the unified registry recorded live (ISSUE 1)
         instance.env = dict(instance.env or {})
         instance.env["stage_timings"] = json.dumps(
             {k: round(v, 4) for k, v in ctx.stage_timings.items()}
         )
+
+    def _count_run(status: str) -> None:
+        get_default_registry().counter(
+            "train_runs_total", "train workflows by final status",
+            ("status",),
+        ).inc(status=status)
 
     try:
         instance.status = "TRAINING"
@@ -153,6 +165,7 @@ def run_train(
                 instance.status = "INTERRUPTED"
                 instance.end_time = _dt.datetime.now(_dt.timezone.utc)
                 _record_timings()
+                _count_run("INTERRUPTED")
                 instances.update(instance)
                 return instance
             if wp.save_model:
@@ -163,10 +176,17 @@ def run_train(
                 storage.get_model_data_models().insert(
                     Model(id=instance_id, models=serialize_models(serializable))
                 )
-                ctx.stage_timings["persist"] = _time.perf_counter() - t0
+                persist_sec = _time.perf_counter() - t0
+                ctx.stage_timings["persist"] = persist_sec
+                from predictionio_tpu.controller.engine import (
+                    train_stage_histogram,
+                )
+
+                train_stage_histogram().observe(persist_sec, stage="persist")
         instance.status = "COMPLETED"
         instance.end_time = _dt.datetime.now(_dt.timezone.utc)
         _record_timings()
+        _count_run("COMPLETED")
         instances.update(instance)
         _register_manifest(storage, instance, variant)
         log.info(
@@ -179,6 +199,7 @@ def run_train(
         instance.status = "ABORTED"
         instance.end_time = _dt.datetime.now(_dt.timezone.utc)
         _record_timings()  # partial timings show WHERE the failed run spent time
+        _count_run("ABORTED")
         instances.update(instance)
         raise
 

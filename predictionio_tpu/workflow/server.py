@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
+import html as _html
 import json
 import logging
 import threading
@@ -25,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from predictionio_tpu.controller.params import ParamsError, extract_params
+from predictionio_tpu.obs import BATCH_SIZE_BUCKETS, server_registry
 from predictionio_tpu.core.base import RuntimeContext
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.registry import Storage
@@ -94,6 +96,11 @@ class EngineRuntime:
 def build_runtime(storage: Storage, instance: EngineInstance) -> EngineRuntime:
     """Re-hydrate a COMPLETED instance into a servable runtime (reference
     createServerActorWithEngine, CreateServer.scala:206)."""
+    from predictionio_tpu.obs.jaxmon import ensure_compile_listener
+
+    # hook BEFORE rehydration/warmup: those jit-compile, and the compile
+    # gauges must count them even though no server exists yet
+    ensure_compile_listener()
     engine, engine_params, models = prepare_deploy_models(storage, instance)
     algorithms = engine.make_algorithms(engine_params)
     serving = engine.make_serving(engine_params)
@@ -160,6 +167,8 @@ class _Handler(JsonHandler):
         try:
             if path == "/":
                 self._respond(200, self.server.owner.status_html(), "text/html")
+            elif path == "/metrics":
+                self._serve_metrics()
             elif path == "/reload":
                 self.server.owner.reload()
                 self._respond(200, {"message": "Reload successful"})
@@ -312,7 +321,7 @@ class _BatchDispatcher:
         from concurrent.futures import Future
 
         fut: Future = Future()
-        self._queue.put((query, runtime, fut))
+        self._queue.put((query, runtime, fut, time.perf_counter()))
         return fut.result(timeout=timeout)
 
     def stop(self) -> None:
@@ -325,23 +334,44 @@ class _BatchDispatcher:
 
         while True:
             try:
-                _query, _rt, fut = self._queue.get_nowait()
+                _query, _rt, fut, _t = self._queue.get_nowait()
             except _q.Empty:
                 break
             if not fut.done():
                 fut.set_exception(RuntimeError("query server stopped"))
 
     def _run_group(self, rt: "EngineRuntime", group: list) -> None:
-        queries = [(i, q) for i, (q, _f) in enumerate(group)]
+        queries = [(i, q) for i, (q, _f, _t) in enumerate(group)]
         t0 = time.perf_counter()
+        registry = getattr(self.owner, "metrics", None)
+        if registry is not None:
+            # queue-wait span: submit() to device dispatch — the cost the
+            # adaptive window adds, isolated from device time so batching
+            # PRs can trade one against the other on measured numbers
+            wait_hist = registry.histogram(
+                "batch_queue_wait_seconds",
+                "micro-batch queue wait, submit to device dispatch",
+            )
+            for _q1, _f1, t_submit in group:
+                wait_hist.observe(t0 - t_submit)
+            registry.histogram(
+                "batch_size", "queries per coalesced device batch",
+                buckets=BATCH_SIZE_BUCKETS, lower_bound=1,
+            ).observe(len(group))
         try:
             per_algo = [
                 dict(algo.batch_predict(algo.serving_context, model, queries))
                 for algo, model in zip(rt.algorithms, rt.models)
             ]
             self.last_batch_sec = time.perf_counter() - t0
+            if registry is not None:
+                # device-time span: the whole batch's predict incl. fetch
+                registry.histogram(
+                    "batch_device_seconds",
+                    "device time per coalesced batch (dispatch to fetch)",
+                ).observe(self.last_batch_sec)
             self.owner.bookkeep_predict(self.last_batch_sec, len(group))
-            for i, (q, fut) in enumerate(group):
+            for i, (q, fut, _t) in enumerate(group):
                 try:
                     fut.set_result(
                         rt.serving.serve(q, [pa[i] for pa in per_algo])
@@ -351,7 +381,7 @@ class _BatchDispatcher:
         except Exception:
             # one bad query must not poison the batch: retry individually
             # so each waiter gets its own result or its own error
-            for _i, (q, fut) in enumerate(group):
+            for _i, (q, fut, _t) in enumerate(group):
                 try:
                     predictions = [
                         algo.predict(model, q)
@@ -434,8 +464,10 @@ class _BatchDispatcher:
             # group by runtime snapshot: queries spanning a /reload are
             # served by the runtime they were extracted against
             groups: dict[int, tuple[Any, list]] = {}
-            for query, rt, fut in batch:
-                groups.setdefault(id(rt), (rt, []))[1].append((query, fut))
+            for query, rt, fut, t_submit in batch:
+                groups.setdefault(id(rt), (rt, []))[1].append(
+                    (query, fut, t_submit)
+                )
             for rt, group in groups.values():
                 # poll the semaphore so a stop() during backpressure
                 # doesn't leave this thread blocked forever
@@ -457,7 +489,7 @@ class _BatchDispatcher:
                         with self._active_lock:
                             self._active -= 1
                         self._inflight.release()
-                for _q2, fut in group:
+                for _q2, fut, _t in group:
                     if not fut.done():
                         fut.set_exception(
                             RuntimeError("query server stopped")
@@ -499,14 +531,21 @@ class QueryServer(ServerProcess):
             p for p in self.config.plugins
             if getattr(p, "plugin_type", "") == OUTPUT_SNIFFER
         ]
-        # bookkeeping (reference CreateServer.scala:418-420, 603-610)
-        self._lock = threading.Lock()
-        self.request_count = 0
-        self.avg_serving_sec = 0.0
+        # observability (ISSUE 1): registry histograms replace the
+        # reference's lossy running averages (CreateServer.scala:603-610)
+        # — the old request_count/avg_* attributes survive as properties
+        # derived from the histograms, so nothing downstream loses its API
+        self.metrics = server_registry()
+        self._serve_hist = self.metrics.histogram(
+            "serve_seconds",
+            "end-to-end query serve time (parse to response JSON built)",
+        )
+        self._predict_hist = self.metrics.histogram(
+            "predict_seconds",
+            "device-side predict time per query (model compute + fetch)",
+        )
         self.last_serving_sec = 0.0
-        self.avg_predict_sec = 0.0
         self.last_predict_sec = 0.0
-        self.predict_count = 0
         self.dispatcher: Optional[_BatchDispatcher] = None
         if self.config.micro_batch:
             self.dispatcher = _BatchDispatcher(
@@ -525,6 +564,8 @@ class QueryServer(ServerProcess):
     def _make_server(self) -> _Server:
         server = _Server((self.config.ip, self.config.port), _Handler)
         server.owner = self
+        server.metrics = self.metrics
+        server.metrics_label = "query"
         return server
 
     # -- reload (reference MasterActor ReloadServer, CreateServer.scala:337) --
@@ -537,26 +578,34 @@ class QueryServer(ServerProcess):
         )
         self.runtime = new_runtime  # atomic reference swap
 
-    # -- bookkeeping -------------------------------------------------------
+    # -- bookkeeping (registry-backed; the averages are now derived) -------
     def bookkeep(self, seconds: float) -> None:
-        with self._lock:
-            n = self.request_count
-            self.avg_serving_sec = (self.avg_serving_sec * n + seconds) / (n + 1)
-            self.request_count = n + 1
-            self.last_serving_sec = seconds
+        self.last_serving_sec = seconds
+        self._serve_hist.observe(seconds)
 
     def bookkeep_predict(self, seconds: float, batch_size: int) -> None:
         """Device-side (model compute incl. result fetch) time per query,
         isolated from HTTP/queue overhead so tunnel-RTT-dominated
         end-to-end numbers don't mask device latency."""
         per_query = seconds / max(1, batch_size)
-        with self._lock:
-            n = self.predict_count
-            self.avg_predict_sec = (
-                self.avg_predict_sec * n + per_query
-            ) / (n + 1)
-            self.predict_count = n + 1
-            self.last_predict_sec = per_query
+        self.last_predict_sec = per_query
+        self._predict_hist.observe(per_query)
+
+    @property
+    def request_count(self) -> int:
+        return self._serve_hist.count
+
+    @property
+    def avg_serving_sec(self) -> float:
+        return self._serve_hist.mean
+
+    @property
+    def predict_count(self) -> int:
+        return self._predict_hist.count
+
+    @property
+    def avg_predict_sec(self) -> float:
+        return self._predict_hist.mean
 
     # -- feedback loop (reference CreateServer.scala:534-596) --------------
     def feedback_async(self, query_json: dict, result: Any) -> None:
@@ -598,40 +647,47 @@ class QueryServer(ServerProcess):
 
     # -- status page (reference CreateServer.scala:461-489 Twirl html) -----
     def status_html(self) -> str:
+        """Rendered FROM the metrics registry (averages + p50/p95/p99
+        come from the serve/predict histograms). All engine/instance
+        fields and params reprs are escaped — they carry user-authored
+        strings (engine.json), same as tools/dashboard.py already did."""
+        esc = _html.escape
         rt = self.runtime
         inst = rt.instance
-        with self._lock:
-            count, avg, last = (
-                self.request_count, self.avg_serving_sec, self.last_serving_sec,
-            )
-            avg_p, last_p = self.avg_predict_sec, self.last_predict_sec
+        serve, predict = self._serve_hist, self._predict_hist
+        count = serve.count
+        avg, avg_p = serve.mean, predict.mean
+        last, last_p = self.last_serving_sec, self.last_predict_sec
+        q = lambda h, p: h.quantile(p) * 1000.0  # noqa: E731
         window_ms = (
             self.dispatcher.window_s * 1000.0 if self.dispatcher else 0.0
         )
         algo_rows = "".join(
-            f"<tr><td>{type(a).__name__}</td><td>{name}</td>"
-            f"<td><code>{params!r}</code></td></tr>"
+            f"<tr><td>{esc(type(a).__name__)}</td><td>{esc(name)}</td>"
+            f"<td><code>{esc(repr(params))}</code></td></tr>"
             for a, (name, params) in zip(
                 rt.algorithms, rt.engine_params.algorithm_params_list
             )
         )
-        return f"""<!DOCTYPE html><html><head><title>{inst.engine_id} — predictionio_tpu</title></head>
+        return f"""<!DOCTYPE html><html><head><title>{esc(inst.engine_id)} — predictionio_tpu</title></head>
 <body>
-<h1>Engine {inst.engine_id} ({inst.engine_variant})</h1>
+<h1>Engine {esc(inst.engine_id)} ({esc(inst.engine_variant)})</h1>
 <table>
-<tr><td>Instance</td><td>{inst.id}</td></tr>
-<tr><td>Factory</td><td>{inst.engine_factory}</td></tr>
-<tr><td>Trained</td><td>{inst.end_time}</td></tr>
-<tr><td>Serving since</td><td>{rt.started_at}</td></tr>
+<tr><td>Instance</td><td>{esc(inst.id)}</td></tr>
+<tr><td>Factory</td><td>{esc(inst.engine_factory)}</td></tr>
+<tr><td>Trained</td><td>{esc(str(inst.end_time))}</td></tr>
+<tr><td>Serving since</td><td>{esc(str(rt.started_at))}</td></tr>
 <tr><td>Requests</td><td>{count}</td></tr>
 <tr><td>Average serve time</td><td>{avg * 1000:.3f} ms</td></tr>
+<tr><td>Serve p50 / p95 / p99</td><td>{q(serve, 0.5):.3f} / {q(serve, 0.95):.3f} / {q(serve, 0.99):.3f} ms</td></tr>
 <tr><td>Last serve time</td><td>{last * 1000:.3f} ms</td></tr>
 <tr><td>Average device predict time</td><td>{avg_p * 1000:.3f} ms</td></tr>
+<tr><td>Predict p50 / p95 / p99</td><td>{q(predict, 0.5):.3f} / {q(predict, 0.95):.3f} / {q(predict, 0.99):.3f} ms</td></tr>
 <tr><td>Last device predict time</td><td>{last_p * 1000:.3f} ms</td></tr>
 <tr><td>Serve − predict = HTTP/queue/transport overhead</td><td>{(avg - avg_p) * 1000:.3f} ms</td></tr>
 <tr><td>Micro-batch window (adaptive)</td><td>{window_ms:.2f} ms</td></tr>
 </table>
 <h2>Algorithms</h2>
 <table><tr><th>class</th><th>name</th><th>params</th></tr>{algo_rows}</table>
-<p><a href="/reload">reload model</a></p>
+<p><a href="/reload">reload model</a> · <a href="/metrics">prometheus metrics</a></p>
 </body></html>"""

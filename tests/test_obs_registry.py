@@ -1,0 +1,235 @@
+"""Unified metrics registry (ISSUE 1): counter/gauge/histogram semantics,
+concurrent updates, Prometheus text rendering."""
+
+import math
+import re
+import threading
+
+import pytest
+
+from predictionio_tpu.obs.registry import (
+    BATCH_SIZE_BUCKETS,
+    MetricsRegistry,
+    render_merged,
+)
+
+
+@pytest.fixture()
+def reg():
+    return MetricsRegistry()
+
+
+# -- counters ---------------------------------------------------------------
+
+def test_counter_inc_and_labels(reg):
+    c = reg.counter("reqs_total", "requests", ("path", "status"))
+    c.inc(path="/a", status=200)
+    c.inc(path="/a", status=200)
+    c.inc(3, path="/b", status=404)
+    assert c.value(path="/a", status=200) == 2
+    assert c.value(path="/b", status=404) == 3
+    assert c.value(path="/c", status=500) == 0
+    assert c.total == 5
+
+
+def test_counter_rejects_negative_and_bad_labels(reg):
+    c = reg.counter("c_total", "", ("x",))
+    with pytest.raises(ValueError):
+        c.inc(-1, x="a")
+    with pytest.raises(ValueError):
+        c.inc(y="a")  # undeclared label
+    with pytest.raises(ValueError):
+        c.inc()  # missing label
+
+
+def test_reregistration_same_name_same_family(reg):
+    a = reg.counter("same_total", "", ("x",))
+    b = reg.counter("same_total", "", ("x",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.histogram("same_total")  # type conflict
+    with pytest.raises(ValueError):
+        reg.counter("same_total", "", ("y",))  # label-set conflict
+
+
+def test_histogram_bucket_conflict_is_loud(reg):
+    a = reg.histogram("h_seconds", "", buckets=BATCH_SIZE_BUCKETS)
+    assert reg.histogram("h_seconds", "", buckets=BATCH_SIZE_BUCKETS) is a
+    with pytest.raises(ValueError):
+        reg.histogram("h_seconds")  # different (default latency) buckets
+
+
+# -- gauges -----------------------------------------------------------------
+
+def test_gauge_set_inc_dec(reg):
+    g = reg.gauge("temp", "", ("zone",))
+    g.set(4.5, zone="a")
+    g.inc(zone="a")
+    g.dec(0.5, zone="a")
+    assert g.value(zone="a") == 5.0
+
+
+def test_gauge_callback_sampled_at_read(reg):
+    box = {"v": 1.0}
+    g = reg.gauge_callback("live", "sampled", lambda: box["v"])
+    assert g.value() == 1.0
+    box["v"] = 7.0
+    assert g.value() == 7.0
+    assert "live 7" in reg.render()
+
+
+def test_gauge_callback_failure_reads_zero(reg):
+    def boom():
+        raise RuntimeError("sampling failed")
+
+    g = reg.gauge_callback("bad", "", boom)
+    assert g.value() == 0.0  # scrape must never 500 on a bad sampler
+
+
+# -- histograms -------------------------------------------------------------
+
+def test_histogram_count_sum_mean(reg):
+    h = reg.histogram("lat_seconds", "", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 3
+    assert h.sum == pytest.approx(5.55)
+    assert h.mean == pytest.approx(5.55 / 3)
+
+
+def test_histogram_quantiles_interpolate(reg):
+    h = reg.histogram("q_seconds", "", buckets=(1.0, 2.0, 4.0))
+    for _ in range(100):
+        h.observe(1.5)  # all samples in the (1, 2] bucket
+    # interpolation stays inside the bucket for every quantile
+    assert 1.0 <= h.quantile(0.5) <= 2.0
+    assert 1.0 <= h.quantile(0.99) <= 2.0
+    # empty histogram → 0
+    h2 = reg.histogram("q2_seconds", "")
+    assert h2.quantile(0.5) == 0.0
+
+
+def test_histogram_overflow_bucket(reg):
+    h = reg.histogram("of_seconds", "", buckets=(1.0,))
+    h.observe(100.0)
+    assert h.count == 1
+    # +Inf-bucket samples are estimated at the highest finite edge
+    assert h.quantile(0.5) == 1.0
+    text = reg.render()
+    assert 'of_seconds_bucket{le="1"} 0' in text
+    assert 'of_seconds_bucket{le="+Inf"} 1' in text
+
+
+def test_histogram_lower_bound_for_count_values(reg):
+    h = reg.histogram(
+        "bs", "", buckets=BATCH_SIZE_BUCKETS, lower_bound=1
+    )
+    for _ in range(10):
+        h.observe(1)  # every batch had size 1
+    # quantiles can never dip below the legal minimum (no p50 of 0.5)
+    assert h.quantile(0.5) == 1.0
+    assert h.quantile(0.99) == 1.0
+    with pytest.raises(ValueError):  # lower_bound drift is loud too
+        reg.histogram("bs", "", buckets=BATCH_SIZE_BUCKETS)
+
+
+def test_histogram_labeled(reg):
+    h = reg.histogram(
+        "batch_size", "", ("server",), buckets=BATCH_SIZE_BUCKETS
+    )
+    h.observe(3, server="query")
+    h.observe(64, server="query")
+    assert h.count_of(server="query") == 2
+    assert h.sum_of(server="query") == 67
+
+
+# -- concurrency ------------------------------------------------------------
+
+def test_concurrent_updates_lose_nothing(reg):
+    c = reg.counter("hits_total", "", ("worker",))
+    h = reg.histogram("work_seconds", "", buckets=(0.5, 1.0))
+    n_threads, n_iter = 8, 2000
+
+    def worker(i):
+        for _ in range(n_iter):
+            c.inc(worker=str(i % 2))
+            h.observe(0.25)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total == n_threads * n_iter
+    assert h.count == n_threads * n_iter
+    assert h.sum == pytest.approx(0.25 * n_threads * n_iter)
+
+
+# -- exposition -------------------------------------------------------------
+
+def _parse_samples(text):
+    """Minimal Prometheus text parser: {(name, labelstr): float}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.fullmatch(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)", line)
+        assert m, f"unparseable exposition line: {line!r}"
+        value = float("inf") if m.group(3) == "+Inf" else float(m.group(3))
+        out[(m.group(1), m.group(2) or "")] = value
+    return out
+
+
+def test_prometheus_rendering_full_document(reg):
+    reg.counter("a_total", "things", ("k",)).inc(k='with"quote')
+    reg.gauge("b", "a gauge").set(2.5)
+    h = reg.histogram("c_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(50.0)
+    text = reg.render()
+    # HELP/TYPE lines present for each family
+    for frag in (
+        "# HELP a_total things", "# TYPE a_total counter",
+        "# TYPE b gauge", "# TYPE c_seconds histogram",
+    ):
+        assert frag in text, text
+    samples = _parse_samples(text)
+    # label escaping round-trips
+    assert samples[("a_total", '{k="with\\"quote"}')] == 1
+    assert samples[("b", "")] == 2.5
+    # cumulative buckets are monotone and +Inf equals count
+    b1 = samples[("c_seconds_bucket", '{le="0.1"}')]
+    b2 = samples[("c_seconds_bucket", '{le="1"}')]
+    binf = samples[("c_seconds_bucket", '{le="+Inf"}')]
+    assert b1 <= b2 <= binf
+    assert binf == samples[("c_seconds_count", "")] == 3
+    assert samples[("c_seconds_sum", "")] == pytest.approx(50.55)
+
+
+def test_render_merged_first_registry_wins():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("shared_total", "").inc()
+    b.counter("shared_total", "").inc(10)
+    b.counter("only_b_total", "").inc(2)
+    text = render_merged(a, b)
+    samples = _parse_samples(text)
+    assert samples[("shared_total", "")] == 1  # a shadows b
+    assert samples[("only_b_total", "")] == 2
+    assert text.count("# TYPE shared_total") == 1  # no duplicate families
+
+
+def test_snapshot_shape(reg):
+    reg.counter("n_total", "", ("x",)).inc(x="1")
+    h = reg.histogram("t_seconds", "", buckets=(1.0, 2.0))
+    h.observe(1.5)
+    snap = reg.snapshot()
+    assert snap["n_total"]["type"] == "counter"
+    assert snap["n_total"]["values"][0] == {"labels": {"x": "1"}, "value": 1}
+    row = snap["t_seconds"]["values"][0]
+    assert row["count"] == 1 and row["sum"] == pytest.approx(1.5)
+    for q in ("p50", "p95", "p99"):
+        assert 1.0 <= row[q] <= 2.0
+    assert not math.isnan(row["mean"])

@@ -1,11 +1,14 @@
-"""Observability (VERDICT r2 #6): XLA profiler hook, per-stage timings on
-the EngineInstance row, and remote log shipping (--log-url)."""
+"""Observability: XLA profiler hook, per-stage timings on the
+EngineInstance row, remote log shipping (--log-url), and (ISSUE 1) the
+unified metrics registry — /metrics exposition on every server, trace-id
+propagation, access logs, stats retention."""
 
 import json
 import logging
 import os
 import threading
 import time
+import urllib.request
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import numpy as np
@@ -163,3 +166,290 @@ def test_query_server_ships_logs(storage, collector):
     assert any(
         "serving log line" in r["message"] for r in received
     ), received
+
+
+# -- unified metrics registry + /metrics + tracing (ISSUE 1) ---------------
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return r.status, dict(r.headers), r.read().decode()
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return r.status, dict(r.headers), json.loads(r.read().decode())
+
+
+def _assert_valid_exposition(text):
+    """Every non-comment line must be `name[{labels}] value`, every
+    histogram's +Inf bucket must equal its _count."""
+    import re
+
+    counts, infs = {}, {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.fullmatch(
+            r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)", line
+        )
+        assert m, f"invalid exposition line: {line!r}"
+        name, labels, value = m.groups()
+        if name.endswith("_count"):
+            counts[(name[:-len("_count")], labels or "")] = float(value)
+        if name.endswith("_bucket") and 'le="+Inf"' in (labels or ""):
+            key = re.sub(r',?le="\+Inf"', "", labels).replace("{}", "")
+            infs[(name[:-len("_bucket")], key or "")] = float(value)
+    for key, inf_count in infs.items():
+        assert counts.get(key) == inf_count, (key, inf_count, counts)
+
+
+@pytest.fixture()
+def query_served(storage):
+    from predictionio_tpu.workflow.server import (
+        QueryServer,
+        QueryServerConfig,
+        latest_completed_runtime,
+    )
+
+    run_train(storage, VARIANT)
+    runtime = latest_completed_runtime(storage, "obs", "0", "obs")
+    srv = QueryServer(
+        storage, runtime, QueryServerConfig(ip="127.0.0.1", port=0)
+    )
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+def test_query_server_metrics_scrape(query_served):
+    srv, port = query_served
+    status, _h, _b = _post(
+        f"http://127.0.0.1:{port}/queries.json", {"user": "u0", "num": 2}
+    )
+    assert status == 200
+    status, headers, text = _get(f"http://127.0.0.1:{port}/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    _assert_valid_exposition(text)
+    # acceptance: request counter + latency histogram + the query-server
+    # specific distributions, all in one scrape
+    assert 'http_requests_total{server="query"' in text
+    assert "http_request_seconds_bucket" in text
+    assert "serve_seconds_bucket" in text
+    assert "predict_seconds_bucket" in text
+    assert "batch_size_bucket" in text  # micro-batching is on by default
+    assert "batch_queue_wait_seconds_bucket" in text
+    # JAX runtime gauges sampled on scrape (CPU test backend still counts)
+    assert "jax_jit_compile_count" in text
+    assert "jax_live_buffer_count" in text
+    # train ran in this process → default-registry stages merge into scrape
+    assert 'train_stage_seconds_bucket{stage="train"' in text
+    # the registry replaced the running averages: properties derive from it
+    assert srv.request_count >= 1
+    assert srv.avg_serving_sec > 0
+    assert srv.metrics.histogram("serve_seconds").quantile(0.5) > 0
+
+
+def test_trace_id_round_trips_and_hits_access_log(query_served):
+    _srv, port = query_served
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(json.loads(record.getMessage()))
+
+    access_logger = logging.getLogger("predictionio_tpu.access")
+    handler = _Capture()
+    old_level = access_logger.level
+    access_logger.addHandler(handler)
+    access_logger.setLevel(logging.INFO)
+    try:
+        status, headers, _b = _post(
+            f"http://127.0.0.1:{port}/queries.json", {"user": "u0"},
+            headers={"X-Request-ID": "abc"},
+        )
+        assert status == 200
+        assert headers["X-Request-ID"] == "abc"  # client id echoes back
+        # no client id → server generates one
+        status, headers, _b = _post(
+            f"http://127.0.0.1:{port}/queries.json", {"user": "u0"}
+        )
+        assert len(headers["X-Request-ID"]) == 32
+        # ids outside the safe charset are REPLACED, not echoed — the
+        # header goes back out in the response, so hostile bytes must
+        # never round-trip
+        status, headers, _b = _post(
+            f"http://127.0.0.1:{port}/queries.json", {"user": "u0"},
+            headers={"X-Request-ID": "bad id with spaces"},
+        )
+        assert headers["X-Request-ID"] != "bad id with spaces"
+        assert len(headers["X-Request-ID"]) == 32
+    finally:
+        access_logger.removeHandler(handler)
+        access_logger.setLevel(old_level)
+    by_trace = {r["trace_id"]: r for r in records}
+    assert "abc" in by_trace, records
+    rec = by_trace["abc"]
+    assert rec["server"] == "query"
+    assert rec["path"] == "/queries.json"
+    assert rec["status"] == 200
+    assert rec["duration_ms"] > 0
+
+
+def test_event_server_metrics_scrape(storage):
+    from predictionio_tpu.data.api.server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data.storage.base import AccessKey
+
+    app = storage.get_meta_data_apps().get_by_name("obsapp")
+    storage.get_meta_data_access_keys().insert(
+        AccessKey(key="OBSKEY", app_id=app.id, events=())
+    )
+    es = EventServer(storage, EventServerConfig(ip="127.0.0.1", port=0))
+    port = es.start()
+    try:
+        status, headers, _b = _post(
+            f"http://127.0.0.1:{port}/events.json?accessKey=OBSKEY",
+            {"event": "rate", "entityType": "user", "entityId": "u1"},
+            headers={"X-Request-ID": "evt-1"},
+        )
+        assert status == 201
+        assert headers["X-Request-ID"] == "evt-1"
+        _s, _h, text = _get(f"http://127.0.0.1:{port}/metrics")
+        _assert_valid_exposition(text)
+        assert 'http_requests_total{server="event"' in text
+        assert 'path="/events.json",status="201"' in text
+        assert "http_request_seconds_bucket" in text
+        assert "events_ingested_total 1" in text
+    finally:
+        es.stop()
+
+
+def test_dashboard_and_storage_server_metrics_scrape(storage, tmp_path):
+    from predictionio_tpu.data.api.storage_server import StorageServer
+    from predictionio_tpu.tools.dashboard import Dashboard
+
+    dash = Dashboard(storage, ip="127.0.0.1", port=0)
+    dport = dash.start()
+    ss = StorageServer(storage, host="127.0.0.1", port=0).start()
+    try:
+        _get(f"http://127.0.0.1:{dport}/")  # generate one request
+        _s, _h, text = _get(f"http://127.0.0.1:{dport}/metrics")
+        _assert_valid_exposition(text)
+        assert 'http_requests_total{server="dashboard"' in text
+
+        _get(f"http://127.0.0.1:{ss.port}/health")
+        _s, _h, text = _get(f"http://127.0.0.1:{ss.port}/metrics")
+        _assert_valid_exposition(text)
+        assert 'http_requests_total{server="storage"' in text
+    finally:
+        ss.shutdown()
+        dash.stop()
+
+
+def test_storage_rpc_counter(storage):
+    """RPCs through the remote client land in storage_rpc_total."""
+    from predictionio_tpu.data.api.storage_server import StorageServer
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    ss = StorageServer(storage, host="127.0.0.1", port=0).start()
+    try:
+        remote = Storage(StorageConfig(
+            sources={"R": SourceConfig(
+                "R", "remote", {"HOST": "127.0.0.1", "PORT": str(ss.port)}
+            )},
+            repositories={
+                "METADATA": "R", "EVENTDATA": "R", "MODELDATA": "R",
+            },
+        ))
+        assert remote.get_meta_data_apps().get_by_name("obsapp") is not None
+        _s, _h, text = _get(f"http://127.0.0.1:{ss.port}/metrics")
+        assert 'storage_rpc_total{dao="apps",method="get_by_name"} 1' in text
+    finally:
+        ss.shutdown()
+
+
+def test_stats_retention_cap():
+    """Satellite: hourly Stats buckets are pruned past the retention
+    horizon instead of leaking forever."""
+    import datetime as dt
+
+    from predictionio_tpu.data.api.stats import Stats
+    from predictionio_tpu.data.event import Event
+
+    stats = Stats(retention_hours=24)
+    ev = Event(event="rate", entity_type="user", entity_id="u1")
+    now = dt.datetime.now(dt.timezone.utc)
+    for hours_ago in (30, 26, 25):  # beyond retention
+        stats.update(1, 201, ev, now=now - dt.timedelta(hours=hours_ago))
+    for hours_ago in (23, 1):  # inside retention
+        stats.update(1, 201, ev, now=now - dt.timedelta(hours=hours_ago))
+    stats.update(1, 201, ev, now=now)  # fresh update triggers the prune
+    hours = stats.get(1)["hours"]
+    assert len(hours) == 3, hours  # 23h, 1h, now — the stale three pruned
+    total = sum(c["count"] for h in hours for c in h["counts"])
+    assert total == 3
+    # a second app's fresh bucket is untouched by app-1 churn
+    stats.update(2, 201, ev, now=now)
+    assert len(stats.get(2)["hours"]) == 1
+
+
+def test_logship_trace_id_and_recovery(collector):
+    """Satellite: shipped records carry the active trace id; a recovered
+    collector logs its recovery and re-arms the outage warning."""
+    from predictionio_tpu.obs.tracing import trace_context
+    from predictionio_tpu.utils.logship import RemoteLogHandler
+
+    url, received = collector
+    logger = logging.getLogger("predictionio_tpu.test.traceship")
+    handler = RemoteLogHandler(url, flush_interval=0.05)
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    logger.addHandler(handler)
+    try:
+        with trace_context("trace-xyz"):
+            logger.warning("inside the request")
+        logger.warning("outside any request")
+        deadline = time.time() + 5
+        while len(received) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        logger.removeHandler(handler)
+        handler.close()
+    by_msg = {r["message"]: r for r in received}
+    assert by_msg["inside the request"]["trace_id"] == "trace-xyz"
+    assert "trace_id" not in by_msg["outside any request"]
+
+    # recovery: simulate an outage having warned, then ship successfully
+    handler2 = RemoteLogHandler(url, flush_interval=3600)
+    try:
+        handler2._warned = True
+        recovery = []
+
+        class _Cap(logging.Handler):
+            def emit(self, record):
+                recovery.append(record.getMessage())
+
+        ship_logger = logging.getLogger("pio.logship")
+        cap = _Cap()
+        ship_logger.addHandler(cap)
+        ship_logger.setLevel(logging.INFO)
+        try:
+            assert handler2._ship([{"message": "hello"}])
+        finally:
+            ship_logger.removeHandler(cap)
+        assert handler2._warned is False  # re-armed for the next outage
+        assert any("recovered" in m for m in recovery), recovery
+    finally:
+        handler2.close()
