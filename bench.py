@@ -582,6 +582,14 @@ def bench_serving_framework():
     srv = QueryServer(
         storage, runtime, QueryServerConfig(ip="127.0.0.1", port=0)
     )
+    # span tracing (ISSUE 2): keep EVERY request's trace for the run so
+    # the ledger can embed the slowest request's stage breakdown — the
+    # default tail-sampling knobs would race eviction under 1k+ requests
+    from predictionio_tpu.obs.spans import get_default_recorder
+
+    recorder = get_default_recorder()
+    recorder.sample_rate = 1.0
+    recorder.max_traces = 4096
     port = srv.start()
     try:
         # client sweep (VERDICT r4 #5): closed-loop clients bound the
@@ -600,9 +608,42 @@ def bench_serving_framework():
             )
             sweep.append(dict(stats, clients=n_clients))
         best = max(sweep, key=lambda r: r["qps"])
-        return dict(best, sweep=sweep, obs=_registry_snapshot(srv.metrics))
+        return dict(
+            best, sweep=sweep, obs=_registry_snapshot(srv.metrics),
+            slowest_trace=_slowest_trace_summary(recorder),
+        )
     finally:
         srv.stop()
+
+
+def _slowest_trace_summary(recorder):
+    """Per-stage span breakdown of the slowest sampled /queries.json
+    request (ISSUE 2): where the tail request actually spent its time —
+    micro-batch queue, device dispatch, or serve/transfer — straight off
+    the span recorder, so the ledger's p99 has an explanation attached."""
+    slowest = None
+    for s in recorder.summaries(limit=0):
+        if s.get("path") != "/queries.json":
+            continue
+        if slowest is None or s["duration_ms"] > slowest["duration_ms"]:
+            slowest = s
+    if slowest is None:
+        return None
+    stages: dict = {}
+    for sp in recorder.get_trace(slowest["trace_id"]):
+        if sp.name == "server.request":
+            continue
+        # SUM repeated names (several sequential storage RPCs must read
+        # as their total, not the longest one) so the breakdown tracks
+        # total_ms
+        stages[sp.name] = round(
+            stages.get(sp.name, 0.0) + sp.duration * 1e3, 3
+        )
+    return {
+        "trace_id": slowest["trace_id"],
+        "total_ms": slowest["duration_ms"],
+        "stage_ms": stages,
+    }
 
 
 def _registry_snapshot(registry):
@@ -1100,6 +1141,7 @@ def main():
         "serving_framework_p50_ms": round(framework["p50_ms"], 1),
         "serving_framework_p99_ms": round(framework["p99_ms"], 1),
         "serving_metrics_registry": framework["obs"],
+        "serving_slowest_trace": framework["slowest_trace"],
         "serving_clients": framework["clients"],
         "serving_client_sweep": [
             {"clients": r["clients"], "qps": round(r["qps"], 1),

@@ -83,6 +83,51 @@ def train_stage_histogram():
     )
 
 
+def _bridge_train_stage_spans() -> None:
+    """The train-stage SPANS are the single timing source (ISSUE 2):
+    their durations feed train_stage_seconds{stage} through the span
+    recorder's metric bridge — one observation per stage per train, same
+    count the direct observe used to produce, but now the trace and the
+    histogram can never disagree."""
+    from predictionio_tpu.obs.spans import get_default_recorder
+
+    recorder = get_default_recorder()
+    for stage in ("read", "prepare", "train", "persist"):
+        recorder.bridge(
+            f"train.{stage}",
+            lambda sp, _s=stage: train_stage_histogram().observe(
+                sp.duration, stage=_s
+            ),
+        )
+
+
+_bridge_train_stage_spans()
+
+
+def _stage_span(name: str, **attrs):
+    """A span that also snapshots jaxmon's compile counters across the
+    stage, attributing XLA trace/lower/compile time to the stage that
+    paid it (SURVEY §5: compile cost is the train-latency wildcard)."""
+    from contextlib import contextmanager
+
+    from predictionio_tpu.obs import spans as _spans
+    from predictionio_tpu.obs.jaxmon import compile_snapshot
+
+    @contextmanager
+    def cm():
+        c0, s0 = compile_snapshot()
+        with _spans.span(name, **attrs) as sp:
+            try:
+                yield sp
+            finally:
+                c1, s1 = compile_snapshot()
+                if c1 > c0 or s1 > s0:
+                    sp.attrs["jit_compiles"] = c1 - c0
+                    sp.attrs["jit_compile_sec"] = round(s1 - s0, 4)
+
+    return cm()
+
+
 class Engine(BaseEngine):
     """Binds named class maps for DataSource/Preparator/Algorithms/Serving
     (reference Engine.scala:80)."""
@@ -128,42 +173,43 @@ class Engine(BaseEngine):
 
     # -- train (reference Engine.train:154 + object Engine.train:622) ------
     def train(self, ctx: RuntimeContext, engine_params: EngineParams) -> list[Any]:
-        import time as _time
-
-        def _record(stage: str, seconds: float) -> None:
-            # both surfaces stay in sync: ctx.stage_timings feeds the
-            # EngineInstance row snapshot, the unified registry feeds
-            # /metrics + `pio metrics` (ISSUE 1: one observability layer)
-            ctx.stage_timings[stage] = seconds
-            train_stage_histogram().observe(seconds, stage=stage)
-
+        # stage timings come FROM the spans (ISSUE 2): ctx.stage_timings
+        # feeds the EngineInstance row snapshot, the bridge declared at
+        # module import feeds train_stage_seconds{stage}, and the spans
+        # themselves land in /debug/traces — one measurement, three views
         wp = ctx.workflow_params
-        t0 = _time.perf_counter()
-        data_source = self.make_data_source(engine_params)
-        td = data_source.read_training(ctx)
-        _sanity(td, "training data", wp)
-        _record("read", _time.perf_counter() - t0)
+        with _stage_span("train.read") as sp:
+            data_source = self.make_data_source(engine_params)
+            sp.attrs["datasource"] = type(data_source).__name__
+            td = data_source.read_training(ctx)
+            _sanity(td, "training data", wp)
+        ctx.stage_timings["read"] = sp.duration
         if wp.stop_after_read:
             raise StopAfterReadInterruption()
 
-        t0 = _time.perf_counter()
-        preparator = self.make_preparator(engine_params)
-        pd = preparator.prepare(ctx, td)
-        _sanity(pd, "prepared data", wp)
-        _record("prepare", _time.perf_counter() - t0)
+        with _stage_span("train.prepare") as sp:
+            preparator = self.make_preparator(engine_params)
+            sp.attrs["preparator"] = type(preparator).__name__
+            pd = preparator.prepare(ctx, td)
+            _sanity(pd, "prepared data", wp)
+        ctx.stage_timings["prepare"] = sp.duration
         if wp.stop_after_prepare:
             raise StopAfterPrepareInterruption()
 
-        t0 = _time.perf_counter()
-        algorithms = self.make_algorithms(engine_params)
-        if not algorithms:
-            raise ParamsError("engine has no algorithms configured")
-        models = []
-        for i, algo in enumerate(algorithms):
-            model = algo.train(ctx, pd)
-            _sanity(model, f"model of algorithm #{i}", wp)
-            models.append(model)
-        _record("train", _time.perf_counter() - t0)
+        with _stage_span("train.train") as sp:
+            algorithms = self.make_algorithms(engine_params)
+            if not algorithms:
+                raise ParamsError("engine has no algorithms configured")
+            models = []
+            for i, algo in enumerate(algorithms):
+                with _stage_span(
+                    "train.algorithm", index=i,
+                    algorithm=type(algo).__name__,
+                ):
+                    model = algo.train(ctx, pd)
+                _sanity(model, f"model of algorithm #{i}", wp)
+                models.append(model)
+        ctx.stage_timings["train"] = sp.duration
         return models
 
     # -- serializable models (reference makeSerializableModels:283) --------

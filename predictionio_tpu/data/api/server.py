@@ -161,6 +161,8 @@ class _Handler(JsonHandler):
                 self._respond(200, {"status": "alive"})
             elif path == "/metrics" and method == "GET":
                 self._serve_metrics()
+            elif path == "/debug/traces" and method == "GET":
+                self._serve_debug_traces()
             elif path == "/events.json":
                 auth = self._auth(query)
                 if method == "POST":

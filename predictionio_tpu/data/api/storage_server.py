@@ -95,6 +95,8 @@ class _Handler(JsonHandler):
             self._reply(200, {"status": "alive"})
         elif self.path == "/metrics":
             self._serve_metrics()
+        elif self.path.split("?")[0] == "/debug/traces":
+            self._serve_debug_traces()
         else:
             self._reply(404, {"ok": False, "error": "not found"})
 

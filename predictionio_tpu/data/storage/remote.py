@@ -26,6 +26,8 @@ import threading
 import uuid
 from typing import Any, Iterator, Optional, Sequence
 
+import predictionio_tpu.obs.spans as _spans
+import predictionio_tpu.obs.tracing as _tracing
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base, wire
 from predictionio_tpu.data.storage.base import (
@@ -89,29 +91,46 @@ class RemoteClient:
         headers = {"Content-Type": "application/json"}
         if self.auth_key:
             headers["X-PIO-Storage-Key"] = self.auth_key
-        for attempt in (0, 1):
-            conn = self._conn()
-            try:
-                conn.request("POST", "/rpc", body=body, headers=headers)
-                resp = conn.getresponse()
-                payload = json.loads(resp.read())
-                break
-            except (http.client.HTTPException, OSError):
-                # Covers both pre-delivery failures (send on a dead socket,
-                # idle-closed keep-alive surfacing as a zero-byte response)
-                # and lost responses; the req_id dedupe above makes the
-                # single retry safe in every case.
-                conn.close()
-                self._local.conn = None
-                if attempt:
-                    raise StorageUnreachableError(
-                        f"storage server {self.host}:{self.port} unreachable"
-                    )
-        if not payload.get("ok"):
-            raise StorageError(
-                f"storage rpc {dao}.{method} failed: {payload.get('error')}"
-            )
-        return wire.decode(payload.get("result"))
+        # Client span per DAO RPC (ISSUE 2). Opening it establishes a
+        # trace id if none is active, so `current_trace_id()` below is
+        # always set; the daemon receives it as X-Request-ID — its access
+        # log correlates with the calling request (the PR-1 gap: RPCs
+        # shipped NO id) — and receives this span's id as X-Parent-Span,
+        # so the daemon's own server span parents under this one across
+        # the process boundary.
+        with _spans.get_default_recorder().span(
+            "storage.rpc", dao=dao, method=method,
+            server=f"storage-client:{self.host}:{self.port}",
+        ) as sp:
+            headers["X-Request-ID"] = _tracing.current_trace_id()
+            headers["X-Parent-Span"] = sp.span_id
+            for attempt in (0, 1):
+                conn = self._conn()
+                try:
+                    conn.request("POST", "/rpc", body=body, headers=headers)
+                    resp = conn.getresponse()
+                    payload = json.loads(resp.read())
+                    break
+                except (http.client.HTTPException, OSError):
+                    # Covers both pre-delivery failures (send on a dead
+                    # socket, idle-closed keep-alive surfacing as a
+                    # zero-byte response) and lost responses; the req_id
+                    # dedupe above makes the single retry safe in every
+                    # case.
+                    conn.close()
+                    self._local.conn = None
+                    if attempt:
+                        raise StorageUnreachableError(
+                            f"storage server {self.host}:{self.port} "
+                            f"unreachable"
+                        )
+                    sp.attrs["retried"] = True
+            if not payload.get("ok"):
+                raise StorageError(
+                    f"storage rpc {dao}.{method} failed: "
+                    f"{payload.get('error')}"
+                )
+            return wire.decode(payload.get("result"))
 
     def ping(self) -> bool:
         try:

@@ -1,5 +1,6 @@
 """Unified observability: metrics registry, Prometheus exposition,
-request tracing. See registry.py for the design rationale."""
+request tracing, hierarchical span tracing. See registry.py and
+spans.py for the design rationale."""
 
 from predictionio_tpu.obs.jaxmon import install_jax_gauges
 from predictionio_tpu.obs.registry import (
@@ -8,6 +9,13 @@ from predictionio_tpu.obs.registry import (
     MetricsRegistry,
     get_default_registry,
     render_merged,
+)
+from predictionio_tpu.obs.spans import (
+    Span,
+    SpanRecorder,
+    current_span_id,
+    get_default_recorder,
+    span,
 )
 from predictionio_tpu.obs.tracing import (
     current_trace_id,
@@ -20,13 +28,18 @@ __all__ = [
     "BATCH_SIZE_BUCKETS",
     "DEFAULT_LATENCY_BUCKETS",
     "MetricsRegistry",
+    "Span",
+    "SpanRecorder",
+    "current_span_id",
     "current_trace_id",
+    "get_default_recorder",
     "get_default_registry",
     "install_jax_gauges",
     "log_access",
     "new_request_id",
     "render_merged",
     "server_registry",
+    "span",
     "trace_context",
 ]
 

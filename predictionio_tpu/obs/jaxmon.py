@@ -50,6 +50,14 @@ def ensure_compile_listener() -> None:
         pass  # private API drift: compile gauges stay at 0
 
 
+def compile_snapshot() -> tuple[int, float]:
+    """(compiles seen, seconds spent) so far — train-stage spans diff
+    this across a stage to attribute XLA compile time to the stage that
+    paid it."""
+    with _lock:
+        return _compile_count, _compile_seconds
+
+
 def _compile_count_now() -> float:
     with _lock:
         return float(_compile_count)

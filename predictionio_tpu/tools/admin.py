@@ -41,6 +41,8 @@ class _Handler(JsonHandler):
                 self._respond(200, {"status": "alive"})
             elif path == "/metrics":
                 self._serve_metrics()
+            elif path == "/debug/traces":
+                self._serve_debug_traces()
             elif path == "/cmd/app":
                 apps = self.storage.get_meta_data_apps().get_all()
                 keys = self.storage.get_meta_data_access_keys()

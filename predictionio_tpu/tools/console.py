@@ -12,6 +12,7 @@ Commands:
   accesskey new|list|delete
   train / deploy / eval / eventserver
   status / export / import
+  metrics / trace list|show|export
 """
 
 from __future__ import annotations
@@ -428,6 +429,114 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _fetch_debug_traces(url: str, params: str = "") -> dict:
+    import json as _json
+    import urllib.request
+
+    full = url.rstrip("/") + "/debug/traces" + (f"?{params}" if params else "")
+    with urllib.request.urlopen(full, timeout=10) as r:
+        return _json.loads(r.read().decode())
+
+
+def _print_span_tree(spans: list[dict]) -> None:
+    """Indent spans by parent links; remote/missing parents root the
+    subtree (a storage daemon's fragment viewed on its own)."""
+    ids = {s["span_id"] for s in spans}
+    children: dict = {}
+    roots = []
+    for s in sorted(spans, key=lambda s: s["start"]):
+        parent = s.get("parent_span_id")
+        if parent in ids:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+
+    def walk(s: dict, depth: int) -> None:
+        attrs = s.get("attrs", {})
+        extra = " ".join(
+            f"{k}={v}" for k, v in attrs.items() if k != "server"
+        )
+        flag = " ERROR" if s.get("error") else ""
+        server = attrs.get("server")
+        where = f" [{server}]" if server else ""
+        print(
+            f"[INFO] {'  ' * depth}{s['name']}{where} "
+            f"{s['duration_ms']:.3f} ms{flag}"
+            + (f"  ({extra})" if extra else "")
+        )
+        for c in children.get(s["span_id"], ()):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+
+
+def cmd_trace(args) -> int:
+    """`pio trace list|show|export` — the retained (tail-sampled) traces
+    of a running server (--url http://host:port) or of this process."""
+    import json as _json
+
+    from predictionio_tpu.obs.spans import get_default_recorder
+
+    url = getattr(args, "url", None)
+    action = args.trace_action
+    if action == "list":
+        if url:
+            data = _fetch_debug_traces(url, f"limit={args.limit}")
+            summaries, cfg = data["traces"], data.get("sampling", {})
+        else:
+            rec = get_default_recorder()
+            summaries, cfg = rec.summaries(limit=args.limit), rec.config()
+        print(
+            f"[INFO] {len(summaries)} retained trace(s) "
+            f"(sampling: {cfg})"
+        )
+        for s in summaries:
+            where = f" {s['server']}" if s.get("server") else ""
+            path = f" {s['path']}" if s.get("path") else ""
+            err = " ERROR" if s["error"] else ""
+            print(
+                f"[INFO]   {s['trace_id']}  {s['root']}{where}{path}  "
+                f"{s['duration_ms']:.1f} ms  {s['spans']} spans  "
+                f"kept={s['kept']}{err}"
+            )
+        return 0
+    if action == "show":
+        if url:
+            data = _fetch_debug_traces(url, f"trace_id={args.trace_id}")
+            spans = data["spans"]
+        else:
+            spans = [
+                s.to_dict()
+                for s in get_default_recorder().get_trace(args.trace_id)
+            ]
+        if not spans:
+            return _fail(f"no retained trace {args.trace_id!r}")
+        print(f"[INFO] Trace {args.trace_id} ({len(spans)} spans):")
+        _print_span_tree(spans)
+        return 0
+    # export: Chrome trace-event JSON → open at https://ui.perfetto.dev
+    if url:
+        params = "format=perfetto"
+        if args.trace_id:
+            params = f"trace_id={args.trace_id}&" + params
+        export = _fetch_debug_traces(url, params)
+    else:
+        export = get_default_recorder().perfetto_export(args.trace_id)
+    if not export.get("traceEvents"):
+        return _fail(
+            f"no retained trace {args.trace_id!r}" if args.trace_id
+            else "no retained traces to export"
+        )
+    with open(args.output, "w") as f:
+        _json.dump(export, f)
+    print(
+        f"[INFO] Wrote {len(export['traceEvents'])} trace events to "
+        f"{args.output} — load it at https://ui.perfetto.dev"
+    )
+    return 0
+
+
 def cmd_export(args) -> int:
     storage = _storage()
     app = _get_app(storage, args.app)
@@ -707,6 +816,31 @@ def build_parser() -> argparse.ArgumentParser:
         help="render a human-readable summary instead of exposition text",
     )
     s.set_defaults(func=cmd_metrics)
+
+    # trace (ISSUE 2: span traces from the console)
+    s = sub.add_parser(
+        "trace",
+        help="inspect tail-sampled request traces (local recorder, or a "
+             "running server via --url)",
+    )
+    tsub = s.add_subparsers(dest="trace_action", required=True)
+    tl = tsub.add_parser("list", help="list retained trace summaries")
+    tl.add_argument("--url", help="server base URL, e.g. http://127.0.0.1:8000")
+    tl.add_argument("--limit", type=int, default=20)
+    tl.set_defaults(func=cmd_trace)
+    ts = tsub.add_parser("show", help="print one trace's span tree")
+    ts.add_argument("trace_id")
+    ts.add_argument("--url", help="server base URL")
+    ts.set_defaults(func=cmd_trace)
+    te = tsub.add_parser(
+        "export",
+        help="write Chrome trace-event JSON (open at ui.perfetto.dev)",
+    )
+    te.add_argument("trace_id", nargs="?", default=None,
+                    help="one trace (default: all retained)")
+    te.add_argument("--url", help="server base URL")
+    te.add_argument("--output", required=True)
+    te.set_defaults(func=cmd_trace)
 
     # export / import
     s = sub.add_parser(

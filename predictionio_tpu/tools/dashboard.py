@@ -30,6 +30,8 @@ class _Handler(JsonHandler):
                 self._respond(200, self._index(), "text/html")
             elif path == "/metrics":
                 self._serve_metrics()
+            elif path == "/debug/traces":
+                self._serve_debug_traces()
             elif path.startswith("/engine_instances/") and path.endswith(".html"):
                 iid = path[len("/engine_instances/"):-len(".html")]
                 inst = (

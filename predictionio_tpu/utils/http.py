@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 import predictionio_tpu.obs.registry as _obs_registry
+import predictionio_tpu.obs.spans as _obs_spans
 import predictionio_tpu.obs.tracing as _obs_tracing
 
 log = logging.getLogger(__name__)
@@ -55,11 +56,16 @@ class JsonHandler(BaseHTTPRequestHandler):
     def handle_one_request(self):
         self._raw_body = b""
         self._trace_token = None
+        self._span_token = None
         try:
             super().handle_one_request()
         finally:
             # keep-alive reuses this thread: clear the request's trace id
-            # so the next request (or idle logging) can't inherit it
+            # and span context so the next request (or idle logging)
+            # can't inherit them
+            if self._span_token is not None:
+                _obs_spans.reset_current_span(self._span_token)
+                self._span_token = None
             if self._trace_token is not None:
                 _obs_tracing.reset_trace_id(self._trace_token)
                 self._trace_token = None
@@ -74,18 +80,28 @@ class JsonHandler(BaseHTTPRequestHandler):
         ok = super().parse_request()
         if ok:
             self._t0 = time.perf_counter()
+            self._start_wall = time.time()
             self._metrics_recorded = False
             tid = self.headers.get("X-Request-ID") or ""
             if not self._TRACE_ID_RE.fullmatch(tid):
                 tid = _obs_tracing.new_request_id()
             self._trace_id = tid
             self._trace_token = _obs_tracing.set_trace_id(tid)
+            # span context: X-Parent-Span carries the CALLER's span id
+            # across the process boundary, so this request's root server
+            # span parents under the remote client span (same id charset
+            # rules as the trace id — both echo into downstream headers)
+            psp = self.headers.get("X-Parent-Span") or ""
+            self._parent_span = psp if self._TRACE_ID_RE.fullmatch(psp) else None
+            self._span_id = _obs_spans.new_span_id()
+            self._span_token = _obs_spans.set_current_span(self._span_id)
         return ok
 
     # -- observability middleware ------------------------------------------
     def _route_label(self, path: str) -> str:
         """Collapse per-entity path segments so metric label cardinality
-        stays bounded (/events/<id>.json → /events/{id}.json)."""
+        stays bounded (/events/<id>.json → /events/{id}.json; admin's
+        /cmd/app/<name>[/data] → /cmd/app/{name}[/data])."""
         parts = path.split("/")
         if len(parts) >= 3 and parts[1] in ("events", "engine_instances"):
             for suffix in (".json", ".html"):
@@ -94,6 +110,15 @@ class JsonHandler(BaseHTTPRequestHandler):
                     break
             else:
                 parts[2] = "{id}"
+        elif (
+            len(parts) >= 4
+            and parts[1] == "cmd"
+            and parts[2] in ("app", "channel", "accesskey")
+        ):
+            # per-entity admin routes: the name/id segment is
+            # client-chosen — every distinct app would otherwise mint a
+            # metric child per delete/show
+            parts[3] = "{name}"
         return "/".join(parts)
 
     def _record_request(self, status: int) -> None:
@@ -102,12 +127,15 @@ class JsonHandler(BaseHTTPRequestHandler):
         self._metrics_recorded = True
         duration = time.perf_counter() - self._t0
         label = getattr(self.server, "metrics_label", "http")
-        path = self._route_label(self.path.split("?")[0].rstrip("/") or "/")
+        real_path = self.path.split("?")[0].rstrip("/") or "/"
+        route = self._route_label(real_path)
         # unmatched routes share ONE metric label value: an internet-facing
         # port gets scanned with unbounded distinct paths, and each would
         # otherwise mint a fresh counter+histogram child. The access log
-        # keeps the real path — logs have no cardinality constraint.
-        metric_path = "(unmatched)" if status == 404 else path
+        # and the span keep the real path — logs and the bounded trace
+        # store have no cardinality constraint, and per-entity debugging
+        # needs to see WHICH entity the request touched.
+        metric_path = "(unmatched)" if status == 404 else route
         registry = getattr(self.server, "metrics", None)
         if registry is not None:
             registry.counter(
@@ -126,10 +154,35 @@ class JsonHandler(BaseHTTPRequestHandler):
         _obs_tracing.log_access(
             server=label,
             method=self.command,
-            path=path,
+            path=real_path,
             status=status,
             duration_s=duration,
             trace_id=getattr(self, "_trace_id", None),
+        )
+        # root server span: parents under the caller's span when the
+        # request came with X-Parent-Span (cross-process), else starts
+        # the trace. finalize=True runs the tail-sampling decision over
+        # every span this request's handling recorded.
+        attrs = {
+            "server": label,
+            "method": self.command,
+            "path": real_path,
+            "status": status,
+        }
+        if route != real_path:
+            attrs["route"] = route  # the metric label this request fed
+        _obs_spans.get_default_recorder().record(
+            _obs_spans.Span(
+                trace_id=self._trace_id,
+                span_id=self._span_id,
+                parent_span_id=getattr(self, "_parent_span", None),
+                name="server.request",
+                start=getattr(self, "_start_wall", time.time()),
+                duration=duration,
+                attrs=attrs,
+                error=status >= 500,
+            ),
+            finalize=True,
         )
 
     def _serve_metrics(self) -> None:
@@ -140,6 +193,43 @@ class JsonHandler(BaseHTTPRequestHandler):
             _obs_registry.get_default_registry(),
         )
         self._respond(200, text, "text/plain; version=0.0.4")
+
+    def _serve_debug_traces(self) -> None:
+        """GET /debug/traces — recent retained traces (tail-sampled);
+        `?trace_id=` for one trace's full span list, plus
+        `&format=perfetto` for Chrome trace-event JSON of it. Every
+        JsonHandler server mounts this, same as /metrics."""
+        from urllib.parse import parse_qsl, urlsplit
+
+        qs = dict(parse_qsl(urlsplit(self.path).query))
+        recorder = _obs_spans.get_default_recorder()
+        trace_id = qs.get("trace_id")
+        if qs.get("format") == "perfetto":
+            # with trace_id: that one trace; without: every retained one
+            export = recorder.perfetto_export(trace_id)
+            if trace_id and not export["traceEvents"]:
+                self._respond(404, {"message": f"no trace {trace_id}"})
+                return
+            self._respond(200, export)
+            return
+        if trace_id:
+            spans = recorder.get_trace(trace_id)
+            if not spans:
+                self._respond(404, {"message": f"no trace {trace_id}"})
+                return
+            self._respond(200, {
+                "trace_id": trace_id,
+                "spans": [s.to_dict() for s in spans],
+            })
+            return
+        try:
+            limit = int(qs.get("limit", "50"))
+        except ValueError:
+            limit = 50
+        self._respond(200, {
+            "traces": recorder.summaries(limit=limit),
+            "sampling": recorder.config(),
+        })
 
     def _drain_body(self) -> None:
         length = int(self.headers.get("Content-Length") or 0)

@@ -29,6 +29,7 @@ from predictionio_tpu.core.base import (
 from predictionio_tpu.data.storage.base import EngineInstance, Model
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.obs import get_default_registry
+from predictionio_tpu.obs import spans as _spans
 
 log = logging.getLogger(__name__)
 
@@ -120,7 +121,6 @@ def run_train(
         mesh_conf=variant.get("mesh") or {},
     )
     import contextlib
-    import time as _time
 
     profile_cm: Any = contextlib.nullcontext()
     if wp.profile_dir:
@@ -153,36 +153,51 @@ def run_train(
         ).inc(status=status)
 
     try:
-        instance.status = "TRAINING"
-        instances.update(instance)
-        with profile_cm:
-            try:
-                models = engine.train(ctx, engine_params)
-            except (StopAfterReadInterruption, StopAfterPrepareInterruption) as e:
-                # intentional debug stop-points, not failures (reference
-                # CoreWorkflow.scala:88-93 logs "Training interrupted")
-                log.info("training interrupted by %s", type(e).__name__)
-                instance.status = "INTERRUPTED"
-                instance.end_time = _dt.datetime.now(_dt.timezone.utc)
-                _record_timings()
-                _count_run("INTERRUPTED")
-                instances.update(instance)
-                return instance
-            if wp.save_model:
-                t0 = _time.perf_counter()
-                serializable = engine.make_serializable_models(
-                    ctx, models, engine_params, instance_id
-                )
-                storage.get_model_data_models().insert(
-                    Model(id=instance_id, models=serialize_models(serializable))
-                )
-                persist_sec = _time.perf_counter() - t0
-                ctx.stage_timings["persist"] = persist_sec
-                from predictionio_tpu.controller.engine import (
-                    train_stage_histogram,
-                )
+        # root span of the whole train (ISSUE 2): opens a trace if the
+        # caller didn't (CLI `pio train`), parents every DASE stage span
+        # engine.train emits, and — because an aborted train marks it
+        # errored — guarantees tail sampling retains failed runs
+        with _spans.span(
+            "train", server="train", instance_id=instance_id,
+            engine=instance.engine_id, variant=instance.engine_variant,
+        ):
+            instance.status = "TRAINING"
+            instances.update(instance)
+            with profile_cm:
+                try:
+                    models = engine.train(ctx, engine_params)
+                except (
+                    StopAfterReadInterruption, StopAfterPrepareInterruption
+                ) as e:
+                    # intentional debug stop-points, not failures
+                    # (reference CoreWorkflow.scala:88-93 logs
+                    # "Training interrupted")
+                    log.info("training interrupted by %s", type(e).__name__)
+                    instance.status = "INTERRUPTED"
+                    instance.end_time = _dt.datetime.now(_dt.timezone.utc)
+                    _record_timings()
+                    _count_run("INTERRUPTED")
+                    instances.update(instance)
+                    return instance
+                if wp.save_model:
+                    from predictionio_tpu.controller.engine import (
+                        _stage_span,
+                    )
 
-                train_stage_histogram().observe(persist_sec, stage="persist")
+                    with _stage_span("train.persist") as persist_sp:
+                        serializable = engine.make_serializable_models(
+                            ctx, models, engine_params, instance_id
+                        )
+                        storage.get_model_data_models().insert(
+                            Model(
+                                id=instance_id,
+                                models=serialize_models(serializable),
+                            )
+                        )
+                    # the histogram observation comes from the span via
+                    # the bridge in controller/engine.py; the row snapshot
+                    # keeps reading ctx.stage_timings
+                    ctx.stage_timings["persist"] = persist_sp.duration
         instance.status = "COMPLETED"
         instance.end_time = _dt.datetime.now(_dt.timezone.utc)
         _record_timings()

@@ -25,6 +25,8 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+import predictionio_tpu.obs.spans as _spans
+import predictionio_tpu.obs.tracing as _tracing
 from predictionio_tpu.controller.params import ParamsError, extract_params
 from predictionio_tpu.obs import BATCH_SIZE_BUCKETS, server_registry
 from predictionio_tpu.core.base import RuntimeContext
@@ -169,6 +171,8 @@ class _Handler(JsonHandler):
                 self._respond(200, self.server.owner.status_html(), "text/html")
             elif path == "/metrics":
                 self._serve_metrics()
+            elif path == "/debug/traces":
+                self._serve_debug_traces()
             elif path == "/reload":
                 self.server.owner.reload()
                 self._respond(200, {"message": "Reload successful"})
@@ -317,11 +321,14 @@ class _BatchDispatcher:
     def submit(self, query: Any, runtime: "EngineRuntime", timeout: float = 30.0) -> Any:
         """Submit with the runtime snapshot the handler extracted the query
         against — a /reload mid-window must not serve an old-typed query
-        with the new model."""
+        with the new model. The handler thread's trace/span context rides
+        along so the dispatcher can attribute its queue/device/serve child
+        spans to the right request."""
         from concurrent.futures import Future
 
         fut: Future = Future()
-        self._queue.put((query, runtime, fut, time.perf_counter()))
+        tctx = (_tracing.current_trace_id(), _spans.current_span_id())
+        self._queue.put((query, runtime, fut, time.perf_counter(), tctx))
         return fut.result(timeout=timeout)
 
     def stop(self) -> None:
@@ -334,63 +341,131 @@ class _BatchDispatcher:
 
         while True:
             try:
-                _query, _rt, fut, _t = self._queue.get_nowait()
+                _query, _rt, fut, _t, _c = self._queue.get_nowait()
             except _q.Empty:
                 break
             if not fut.done():
                 fut.set_exception(RuntimeError("query server stopped"))
 
     def _run_group(self, rt: "EngineRuntime", group: list) -> None:
-        queries = [(i, q) for i, (q, _f, _t) in enumerate(group)]
+        queries = [(i, q) for i, (q, _f, _t, _c) in enumerate(group)]
         t0 = time.perf_counter()
+        now_wall = time.time()
         registry = getattr(self.owner, "metrics", None)
-        if registry is not None:
-            # queue-wait span: submit() to device dispatch — the cost the
+        recorder = _spans.get_default_recorder()
+        first_submit = min(t for _q, _f, t, _c in group)
+        # pre-mint the per-query device span ids: storage RPCs issued
+        # DURING batch_predict (e.g. UR history fetches) must parent
+        # under a device span, so its id has to exist before the call
+        dev_ids = [
+            _spans.new_span_id() if tctx[0] else None
+            for _q, _f, _t, tctx in group
+        ]
+
+        def _child(i: int, name: str, start: float, dur: float,
+                   span_id: Optional[str] = None, error: bool = False,
+                   **attrs: Any) -> None:
+            tid, parent = group[i][3]
+            if tid is None:
+                return
+            recorder.record(_spans.Span(
+                trace_id=tid,
+                span_id=span_id or _spans.new_span_id(),
+                parent_span_id=parent,
+                name=name, start=start, duration=dur,
+                attrs={"server": "query", "batch_size": len(group), **attrs},
+                error=error,
+            ))
+
+        for i, (_q, _f, t_submit, _c) in enumerate(group):
+            # queue-wait: submit() to device dispatch — the cost the
             # adaptive window adds, isolated from device time so batching
-            # PRs can trade one against the other on measured numbers
-            wait_hist = registry.histogram(
-                "batch_queue_wait_seconds",
-                "micro-batch queue wait, submit to device dispatch",
-            )
-            for _q1, _f1, t_submit in group:
-                wait_hist.observe(t0 - t_submit)
+            # PRs can trade one against the other on measured numbers.
+            # The span feeds batch_queue_wait_seconds via the recorder's
+            # metric bridge (declared in QueryServer.__init__) — one
+            # observation per query, same as the old direct observe.
+            _child(i, "batch.queue_wait",
+                   now_wall - (t0 - t_submit), t0 - t_submit)
+            # batch-assemble: the drain window, first arrival to dispatch
+            _child(i, "batch.assemble",
+                   now_wall - (t0 - first_submit), t0 - first_submit)
+        if registry is not None:
             registry.histogram(
                 "batch_size", "queries per coalesced device batch",
                 buckets=BATCH_SIZE_BUCKETS, lower_bound=1,
             ).observe(len(group))
+        # batch-level work (one device program for the whole group) runs
+        # under the FIRST traced query's context: its device span adopts
+        # any storage RPC spans the batch's predict issues. One batch,
+        # many traces — the representative trace gets the full picture,
+        # the rest still see their own queue/device/serve timings.
+        rep = next((i for i, d in enumerate(dev_ids) if d), None)
+        tok_t = tok_s = None
+        if rep is not None:
+            tok_t = _tracing.set_trace_id(group[rep][3][0])
+            tok_s = _spans.set_current_span(dev_ids[rep])
         try:
-            per_algo = [
-                dict(algo.batch_predict(algo.serving_context, model, queries))
-                for algo, model in zip(rt.algorithms, rt.models)
-            ]
-            self.last_batch_sec = time.perf_counter() - t0
-            if registry is not None:
-                # device-time span: the whole batch's predict incl. fetch
-                registry.histogram(
-                    "batch_device_seconds",
-                    "device time per coalesced batch (dispatch to fetch)",
-                ).observe(self.last_batch_sec)
-            self.owner.bookkeep_predict(self.last_batch_sec, len(group))
-            for i, (q, fut, _t) in enumerate(group):
-                try:
-                    fut.set_result(
-                        rt.serving.serve(q, [pa[i] for pa in per_algo])
-                    )
-                except Exception as e:  # serve failure is per-query
-                    fut.set_exception(e)
-        except Exception:
-            # one bad query must not poison the batch: retry individually
-            # so each waiter gets its own result or its own error
-            for _i, (q, fut, _t) in enumerate(group):
-                try:
-                    predictions = [
-                        algo.predict(model, q)
-                        for algo, model in zip(rt.algorithms, rt.models)
-                    ]
-                    fut.set_result(rt.serving.serve(q, predictions))
-                except Exception as e:
-                    if not fut.done():
+            try:
+                per_algo = [
+                    dict(algo.batch_predict(
+                        algo.serving_context, model, queries
+                    ))
+                    for algo, model in zip(rt.algorithms, rt.models)
+                ]
+                self.last_batch_sec = time.perf_counter() - t0
+                for i in range(len(group)):
+                    _child(i, "batch.device_dispatch", now_wall,
+                           self.last_batch_sec, span_id=dev_ids[i])
+                if registry is not None:
+                    # device-time histogram stays per coalesced BATCH
+                    # (the per-query device spans above share its wall
+                    # time; bridging them would inflate the count)
+                    registry.histogram(
+                        "batch_device_seconds",
+                        "device time per coalesced batch (dispatch to fetch)",
+                    ).observe(self.last_batch_sec)
+                self.owner.bookkeep_predict(self.last_batch_sec, len(group))
+                for i, (q, fut, _t, _c) in enumerate(group):
+                    t_s = time.perf_counter()
+                    try:
+                        result = rt.serving.serve(
+                            q, [pa[i] for pa in per_algo]
+                        )
+                    except Exception as e:  # serve failure is per-query
+                        dur = time.perf_counter() - t_s
+                        _child(i, "batch.result_transfer",
+                               time.time() - dur, dur, error=True)
                         fut.set_exception(e)
+                        continue
+                    dur = time.perf_counter() - t_s
+                    # result-transfer/serve: per-query fetch + combinator
+                    _child(i, "batch.result_transfer",
+                           time.time() - dur, dur)
+                    fut.set_result(result)
+            except Exception:
+                # one bad query must not poison the batch: retry
+                # individually so each waiter gets its own result or its
+                # own error. The failed device span is recorded errored
+                # so tail sampling always retains these traces.
+                for i in range(len(group)):
+                    _child(i, "batch.device_dispatch", now_wall,
+                           time.perf_counter() - t0, span_id=dev_ids[i],
+                           error=True)
+                for _i, (q, fut, _t, _c) in enumerate(group):
+                    try:
+                        predictions = [
+                            algo.predict(model, q)
+                            for algo, model in zip(rt.algorithms, rt.models)
+                        ]
+                        fut.set_result(rt.serving.serve(q, predictions))
+                    except Exception as e:
+                        if not fut.done():
+                            fut.set_exception(e)
+        finally:
+            if tok_s is not None:
+                _spans.reset_current_span(tok_s)
+            if tok_t is not None:
+                _tracing.reset_trace_id(tok_t)
 
     def _loop(self) -> None:
         import queue as _q
@@ -464,9 +539,9 @@ class _BatchDispatcher:
             # group by runtime snapshot: queries spanning a /reload are
             # served by the runtime they were extracted against
             groups: dict[int, tuple[Any, list]] = {}
-            for query, rt, fut, t_submit in batch:
+            for query, rt, fut, t_submit, tctx in batch:
                 groups.setdefault(id(rt), (rt, []))[1].append(
-                    (query, fut, t_submit)
+                    (query, fut, t_submit, tctx)
                 )
             for rt, group in groups.values():
                 # poll the semaphore so a stop() during backpressure
@@ -489,7 +564,7 @@ class _BatchDispatcher:
                         with self._active_lock:
                             self._active -= 1
                         self._inflight.release()
-                for _q2, fut, _t in group:
+                for _q2, fut, _t, _c in group:
                     if not fut.done():
                         fut.set_exception(
                             RuntimeError("query server stopped")
@@ -544,6 +619,22 @@ class QueryServer(ServerProcess):
             "predict_seconds",
             "device-side predict time per query (model compute + fetch)",
         )
+        # span→metric bridge (ISSUE 2): the dispatcher's queue-wait SPAN
+        # is the single source — its duration feeds this histogram, so
+        # /metrics aggregates and /debug/traces exemplars can't drift
+        self._queue_wait_hist = self.metrics.histogram(
+            "batch_queue_wait_seconds",
+            "micro-batch queue wait, submit to device dispatch",
+        )
+        # one bridge per span name on the process recorder: with two
+        # live QueryServers in one process the newest wins; stop()
+        # unregisters so a stopped server's registry isn't kept alive
+        self._queue_wait_bridge = (
+            lambda sp, _h=self._queue_wait_hist: _h.observe(sp.duration)
+        )
+        _spans.get_default_recorder().bridge(
+            "batch.queue_wait", self._queue_wait_bridge
+        )
         self.last_serving_sec = 0.0
         self.last_predict_sec = 0.0
         self.dispatcher: Optional[_BatchDispatcher] = None
@@ -559,6 +650,9 @@ class QueryServer(ServerProcess):
     def stop(self) -> None:
         if self.dispatcher is not None:
             self.dispatcher.stop()
+        _spans.get_default_recorder().unbridge(
+            "batch.queue_wait", self._queue_wait_bridge
+        )
         super().stop()  # also detaches the log shipper (ServerProcess)
 
     def _make_server(self) -> _Server:

@@ -1,0 +1,346 @@
+"""Span recorder (ISSUE 2): hierarchy + context propagation, tail-based
+sampling retention rules, thread-safety under concurrent traces, the
+span→metric bridge, Perfetto export validity, and the route-label
+cardinality guard."""
+
+import json
+import threading
+
+import pytest
+
+from predictionio_tpu.obs.registry import MetricsRegistry
+from predictionio_tpu.obs.spans import Span, SpanRecorder, new_span_id
+from predictionio_tpu.obs.tracing import trace_context
+
+
+@pytest.fixture()
+def recorder():
+    return SpanRecorder(max_traces=16, slow_ms=10_000, sample_rate=1.0)
+
+
+def test_hierarchy_and_trace_context(recorder):
+    with trace_context("t-1"):
+        with recorder.span("root", server="x") as root:
+            with recorder.span("child") as child:
+                with recorder.span("grandchild") as grand:
+                    pass
+            with recorder.span("sibling") as sib:
+                pass
+    spans = {s.name: s for s in recorder.get_trace("t-1")}
+    assert set(spans) == {"root", "child", "grandchild", "sibling"}
+    assert root.trace_id == "t-1"
+    assert spans["root"].parent_span_id is None
+    assert spans["child"].parent_span_id == root.span_id
+    assert spans["grandchild"].parent_span_id == child.span_id
+    assert spans["sibling"].parent_span_id == root.span_id
+    assert grand.duration >= 0 and sib.duration >= 0
+    summary = recorder.summaries()[0]
+    assert summary["trace_id"] == "t-1"
+    assert summary["root"] == "root"
+    assert summary["spans"] == 4
+
+
+def test_span_without_trace_mints_one(recorder):
+    with recorder.span("lonely") as sp:
+        pass
+    assert sp.trace_id
+    assert recorder.get_trace(sp.trace_id)[0].name == "lonely"
+
+
+def test_explicit_trace_id_flows_to_children(recorder):
+    """A span opened with trace_id=... must establish trace context for
+    everything nested, exactly like an inherited ambient trace."""
+    with recorder.span("root", trace_id="t-explicit") as root:
+        with recorder.span("child") as child:
+            pass
+    assert child.trace_id == "t-explicit"
+    assert child.parent_span_id == root.span_id
+    assert len(recorder.get_trace("t-explicit")) == 2
+
+
+def test_error_marks_span_and_reraises(recorder):
+    with pytest.raises(ValueError):
+        with recorder.span("boom", trace_id="t-err"):
+            raise ValueError("nope")
+    spans = recorder.get_trace("t-err")
+    assert spans and spans[0].error
+    assert recorder.summaries()[0]["error"]
+
+
+# -- tail-based sampling ----------------------------------------------------
+
+
+def test_tail_sampling_drops_boring_keeps_error_and_slow():
+    rec = SpanRecorder(max_traces=16, slow_ms=50, sample_rate=0.0)
+    # boring: fast, no error, sample_rate 0 → dropped
+    with rec.span("fast", trace_id="t-boring"):
+        pass
+    assert rec.get_trace("t-boring") == []
+    # errored → always kept
+    with pytest.raises(RuntimeError):
+        with rec.span("fails", trace_id="t-err"):
+            raise RuntimeError("x")
+    assert rec.summaries()[0]["kept"] == "error"
+    # slow (≥ slow_ms, via a manually recorded duration) → always kept,
+    # even when the SLOW span is a child and the root itself is fast
+    rec.record(Span(
+        trace_id="t-slow", span_id=new_span_id(), name="slow.child",
+        start=0.0, duration=0.120,
+    ))
+    rec.record(Span(
+        trace_id="t-slow", span_id=new_span_id(), name="root",
+        start=0.0, duration=0.001,
+    ), finalize=True)
+    kept = {s["trace_id"]: s for s in rec.summaries()}
+    assert kept["t-slow"]["kept"] == "slow"
+    assert "t-boring" not in kept
+
+
+def test_retention_cap_evicts_oldest():
+    rec = SpanRecorder(max_traces=4, slow_ms=10_000, sample_rate=1.0)
+    for i in range(10):
+        with rec.span("r", trace_id=f"t-{i}"):
+            pass
+    kept = [s["trace_id"] for s in rec.summaries()]
+    assert len(kept) == 4
+    assert set(kept) == {"t-6", "t-7", "t-8", "t-9"}  # oldest evicted
+
+
+def test_reused_trace_id_is_capped_and_still_ages_out():
+    """X-Request-ID is client-controlled: one id replayed forever must
+    neither grow a retained trace unbounded nor pin it against
+    eviction."""
+    rec = SpanRecorder(max_traces=4, slow_ms=10_000, sample_rate=1.0)
+    rec.max_spans_per_trace = 10
+    with rec.span("r", trace_id="t-pinned"):
+        pass
+    for _ in range(50):  # replayed id: merge path
+        with rec.span("r", trace_id="t-pinned"):
+            pass
+    assert len(rec.get_trace("t-pinned")) == 10  # capped
+    for i in range(4):  # fresh traces evict the pinned one despite merges
+        with rec.span("r", trace_id=f"t-new-{i}"):
+            pass
+    assert rec.get_trace("t-pinned") == []
+
+
+def test_unbridge_only_removes_own_callback(recorder):
+    reg = MetricsRegistry()
+    h1 = reg.histogram("h1_seconds", "")
+    h2 = reg.histogram("h2_seconds", "")
+    cb1 = lambda sp: h1.observe(sp.duration)  # noqa: E731
+    cb2 = lambda sp: h2.observe(sp.duration)  # noqa: E731
+    recorder.bridge("x", cb1)
+    recorder.bridge("x", cb2)  # newer server wins
+    recorder.unbridge("x", cb1)  # stale server's teardown: no-op
+    with recorder.span("x", trace_id="t-u1"):
+        pass
+    assert h2.count == 1 and h1.count == 0
+    recorder.unbridge("x", cb2)
+    with recorder.span("x", trace_id="t-u2"):
+        pass
+    assert h2.count == 1  # removed
+
+
+def test_remote_rooted_fragment_defers_instead_of_dropping():
+    """Two servers in one process: the inner daemon's server span (which
+    has a REMOTE parent) finalizes mid-request. With sampling that would
+    drop it, the fragment must be deferred — not discarded — so the
+    outer request's eventual slow/error keep decision sees the full
+    union, queue/assemble spans included."""
+    rec = SpanRecorder(max_traces=16, slow_ms=100, sample_rate=0.0)
+    rec.record(Span(
+        trace_id="t-d", span_id="early", parent_span_id="root-id",
+        name="batch.queue_wait", start=0.0, duration=0.001,
+    ))
+    rec.record(Span(
+        trace_id="t-d", span_id="daemon", parent_span_id="rpc-id",
+        name="server.request", start=0.0, duration=0.001,
+    ), finalize=True)
+    assert rec.get_trace("t-d") == []  # deferred, not retained yet
+    rec.record(Span(  # true root (no parent at all), slow → keep union
+        trace_id="t-d", span_id="root-id", parent_span_id=None,
+        name="server.request", start=0.0, duration=0.5,
+    ), finalize=True)
+    assert {s.span_id for s in rec.get_trace("t-d")} == {
+        "early", "daemon", "root-id",
+    }
+    assert rec.summaries()[0]["kept"] == "slow"
+    # a TRUE-rooted boring trace still drops definitively
+    rec.record(Span(
+        trace_id="t-gone", span_id="r2", parent_span_id=None,
+        name="storage.rpc", start=0.0, duration=0.001,
+    ), finalize=True)
+    assert rec.get_trace("t-gone") == []
+
+
+def test_late_fragment_merges_into_kept_trace(recorder):
+    """Cross-process shape: the remote fragment finalizes first, the
+    client span arrives after — it must join the kept trace, not strand
+    in the active map."""
+    with recorder.span("server.request", trace_id="t-m"):
+        pass
+    recorder.record(Span(
+        trace_id="t-m", span_id=new_span_id(), name="storage.rpc",
+        start=0.0, duration=0.002,
+    ))
+    assert {s.name for s in recorder.get_trace("t-m")} == {
+        "server.request", "storage.rpc",
+    }
+
+
+# -- concurrency ------------------------------------------------------------
+
+
+def test_concurrent_traces_no_cross_request_leakage():
+    """Hammer the recorder from N threads, each running M sequential
+    traces with nested spans (the keep-alive handler-thread shape):
+    every trace must keep exactly its own spans with correct parent
+    links, and no span may leak into a sibling thread's trace."""
+    rec = SpanRecorder(max_traces=1000, slow_ms=10_000, sample_rate=1.0)
+    n_threads, n_traces = 8, 25
+    errors: list[str] = []
+
+    def worker(w: int) -> None:
+        for i in range(n_traces):
+            tid = f"t-{w}-{i}"
+            with trace_context(tid):
+                with rec.span("root", worker=w, i=i) as root:
+                    with rec.span("mid") as mid:
+                        with rec.span("leaf"):
+                            pass
+                if root.trace_id != tid or mid.trace_id != tid:
+                    errors.append(f"{tid}: wrong trace id")
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for w in range(n_threads):
+        for i in range(n_traces):
+            tid = f"t-{w}-{i}"
+            spans = {s.name: s for s in rec.get_trace(tid)}
+            assert set(spans) == {"root", "mid", "leaf"}, (tid, spans)
+            assert all(s.trace_id == tid for s in spans.values())
+            assert spans["root"].parent_span_id is None
+            assert spans["mid"].parent_span_id == spans["root"].span_id
+            assert spans["leaf"].parent_span_id == spans["mid"].span_id
+            assert spans["root"].attrs == {"worker": w, "i": i}
+
+
+# -- metric bridge ----------------------------------------------------------
+
+
+def test_metric_bridge_feeds_histogram(recorder):
+    reg = MetricsRegistry()
+    hist = reg.histogram("bridged_seconds", "from spans")
+    recorder.bridge("stage.x", lambda sp: hist.observe(sp.duration))
+    for _ in range(3):
+        with recorder.span("stage.x", trace_id="t-b"):
+            pass
+    with recorder.span("stage.other", trace_id="t-b2"):
+        pass
+    assert hist.count == 3  # only the declared name feeds it
+    assert hist.sum >= 0
+
+
+def test_bridge_exception_never_breaks_recording(recorder):
+    def bad(sp):
+        raise RuntimeError("metrics hiccup")
+
+    recorder.bridge("fragile", bad)
+    with recorder.span("fragile", trace_id="t-f"):
+        pass
+    assert recorder.get_trace("t-f")  # span recorded despite bridge error
+
+
+# -- perfetto export --------------------------------------------------------
+
+
+def test_perfetto_export_is_valid_chrome_trace_json(recorder):
+    with trace_context("t-p"):
+        with recorder.span("server.request", server="query", path="/q") as r:
+            with recorder.span("batch.device_dispatch", server="query"):
+                with recorder.span(
+                    "storage.rpc", server="storage-client", dao="events"
+                ):
+                    pass
+    export = recorder.perfetto_export("t-p")
+    # round-trips as JSON and has the Chrome trace-event shape
+    parsed = json.loads(json.dumps(export))
+    events = parsed["traceEvents"]
+    assert events
+    x_events = [e for e in events if e["ph"] == "X"]
+    assert len(x_events) == 3
+    for e in events:
+        assert e["ph"] in ("X", "M")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float))
+            assert e["args"]["trace_id"] == "t-p"
+    # span depth maps to tid so children nest under parents
+    by_name = {e["name"]: e for e in x_events}
+    assert by_name["server.request"]["tid"] == 0
+    assert by_name["batch.device_dispatch"]["tid"] == 1
+    assert by_name["storage.rpc"]["tid"] == 2
+    # each originating server gets a named process row
+    procs = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert {"query", "storage-client"} <= procs
+    assert by_name["server.request"]["args"]["span_id"] == r.span_id
+
+
+def test_perfetto_export_all_and_missing(recorder):
+    assert recorder.perfetto_export("nope")["traceEvents"] == []
+    with recorder.span("a", trace_id="t-1"):
+        pass
+    with recorder.span("b", trace_id="t-2"):
+        pass
+    events = recorder.perfetto_export()["traceEvents"]
+    assert {e["args"]["trace_id"] for e in events if e["ph"] == "X"} == {
+        "t-1", "t-2",
+    }
+
+
+# -- route-label cardinality guard (satellite) ------------------------------
+
+
+def test_route_label_cardinality_bounded():
+    """Replay a scan of distinct per-entity paths and assert the metric
+    label set stays bounded: every id/name segment collapses."""
+    from predictionio_tpu.utils.http import JsonHandler
+
+    label = lambda p: JsonHandler._route_label(None, p)  # noqa: E731
+    paths = []
+    for i in range(50):
+        paths += [
+            f"/events/ev-{i}.json",
+            f"/events/ev-{i}",
+            f"/engine_instances/inst-{i}.html",
+            f"/engine_instances/inst-{i}.json",
+            f"/engine_instances/inst-{i}",
+            f"/cmd/app/app-{i}",
+            f"/cmd/app/app-{i}/data",
+            f"/cmd/channel/ch-{i}",
+            f"/cmd/accesskey/key-{i}",
+        ]
+    labels = {label(p) for p in paths}
+    assert labels == {
+        "/events/{id}.json",
+        "/events/{id}",
+        "/engine_instances/{id}.html",
+        "/engine_instances/{id}.json",
+        "/engine_instances/{id}",
+        "/cmd/app/{name}",
+        "/cmd/app/{name}/data",
+        "/cmd/channel/{name}",
+        "/cmd/accesskey/{name}",
+    }
+    # non-entity routes pass through untouched
+    assert label("/queries.json") == "/queries.json"
+    assert label("/cmd/app") == "/cmd/app"
+    assert label("/metrics") == "/metrics"
