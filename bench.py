@@ -40,6 +40,13 @@ micro-batch dispatcher + serve) over a trained recommendation engine on
 the full 26,744-item catalog, hammered by concurrent clients:
 serving_framework_qps / p50 / p99.
 
+Device profiling (ISSUE 3): MFU/roofline numbers now ALSO come from the
+framework's own obs/devprof registry (XLA cost_analysis per executable ×
+measured device seconds). The hand-derived models above stay as the
+cross-check: serving_mfu_framework vs serving_mfu_hand must agree within
+2×, and train_devprof reports the registry's view of the headline train
+executable next to the analytic mfu.
+
 Set PIO_BENCH_SCALE=small for a quick CI-sized run (100K shape).
 """
 
@@ -329,6 +336,32 @@ def bench_tpu(rows, cols, vals):
             main["device_best_sec"] / dense["device_best_sec"]
         )
     main["dense"] = dense
+    # framework-derived train roofline (ISSUE 3): the devprof registry's
+    # view of the headline executable — accumulated over warmup + timed
+    # runs, so mean-shaped where the hand numbers use best-of; the two
+    # are reported side by side, not reconciled
+    from predictionio_tpu.obs import devprof
+
+    # the dense path dispatches als.train_dense_sharded under a mesh —
+    # try both so multi-chip runs don't silently lose the block
+    candidates = (
+        ("als.train_dense", "als.train_dense_sharded")
+        if dense is not None else ("als.train_windowed",)
+    )
+    prof_name = prof = None
+    for prof_name in candidates:
+        prof = devprof.get_profiler().executable(prof_name)
+        if prof is not None:
+            break
+    if prof is not None:
+        main["devprof_train"] = {
+            "executable": prof_name,
+            "mfu_framework": prof.get("mfu"),
+            "hbm_fraction_framework": prof.get("hbm_fraction_of_roof"),
+            "device_seconds": round(prof["device_seconds"], 3),
+            "compile_seconds": prof["compile_seconds"],
+            "invocations": prof["invocations"],
+        }
     return main
 
 
@@ -513,6 +546,45 @@ def _hammer_query_server(port, make_body, n_clients, n_per, timeout=60.0):
     }
 
 
+def _devprof_serving_crosscheck():
+    """Framework-derived serving MFU (obs/devprof: XLA cost_analysis per
+    executable × measured device seconds) cross-checked against the hand
+    model (2·K·I FLOPs per padded batch row, the same arithmetic this
+    file used to own). The bench is now a CONSUMER of the observability
+    layer — the hand number only survives as the agreement check
+    (ISSUE 3 acceptance: within 2×)."""
+    from predictionio_tpu.obs import devprof
+
+    rep = devprof.report()
+    rows = [
+        e for e in rep["executables"] if e["name"].startswith("als.recommend")
+    ]
+    if not rows:
+        return None
+    flops_fw = sum(e["flops_total"] for e in rows)
+    secs = sum(e["device_seconds"] for e in rows)
+    pad = rep["padding"]
+    # hand model: each padded batch row scores the full catalog —
+    # one (1, K) · (K, I) contraction (top-k excluded, same as the
+    # framework's cost-analysis flops are dominated by the matmul).
+    # Warmup dispatches (the bucket ladder) ride outside the padding
+    # counters; they are ~100 rows against the hammered thousands.
+    flops_hand = 2.0 * RANK * N_ITEMS * pad["rows_padded"]
+    peak = rep["platform"].get("peak_flops")
+    if not peak or secs <= 0 or flops_hand <= 0:
+        return None
+    return {
+        "mfu_framework": flops_fw / secs / peak,
+        "mfu_hand": flops_hand / secs / peak,
+        "agreement": flops_fw / flops_hand,
+        "device_seconds": secs,
+        "invocations": sum(e["invocations"] for e in rows),
+        "padding_mean_ratio": pad["mean_padding_ratio"],
+        "padding_wasted_gflops": pad["wasted_flops"] / 1e9,
+        "batches": pad["batches"],
+    }
+
+
 def bench_serving_framework():
     """The real product path (VERDICT r2 #2): QueryServer over a trained
     recommendation engine — HTTP + JSON extraction + micro-batch
@@ -611,6 +683,7 @@ def bench_serving_framework():
         return dict(
             best, sweep=sweep, obs=_registry_snapshot(srv.metrics),
             slowest_trace=_slowest_trace_summary(recorder),
+            devprof=_devprof_serving_crosscheck(),
         )
     finally:
         srv.stop()
@@ -1140,6 +1213,28 @@ def main():
         "serving_framework_qps": round(framework["qps"], 1),
         "serving_framework_p50_ms": round(framework["p50_ms"], 1),
         "serving_framework_p99_ms": round(framework["p99_ms"], 1),
+        # ISSUE 3: framework-derived (devprof registry) vs hand-derived
+        # serving MFU — the acceptance cross-check (agree within 2×)
+        **({
+            "serving_mfu_framework": round(
+                framework["devprof"]["mfu_framework"], 8
+            ),
+            "serving_mfu_hand": round(
+                framework["devprof"]["mfu_hand"], 8
+            ),
+            "serving_mfu_agreement": round(
+                framework["devprof"]["agreement"], 3
+            ),
+            "serving_padding_mean_ratio": round(
+                framework["devprof"]["padding_mean_ratio"], 4
+            ),
+            "serving_padding_wasted_gflops": round(
+                framework["devprof"]["padding_wasted_gflops"], 3
+            ),
+        } if framework.get("devprof") else {}),
+        **({
+            "train_devprof": tpu["devprof_train"],
+        } if tpu.get("devprof_train") else {}),
         "serving_metrics_registry": framework["obs"],
         "serving_slowest_trace": framework["slowest_trace"],
         "serving_clients": framework["clients"],

@@ -105,17 +105,22 @@ _bridge_train_stage_spans()
 
 
 def _stage_span(name: str, **attrs):
-    """A span that also snapshots jaxmon's compile counters across the
-    stage, attributing XLA trace/lower/compile time to the stage that
-    paid it (SURVEY §5: compile cost is the train-latency wildcard)."""
+    """A span that also snapshots jaxmon's compile counters AND the
+    device-profile registry across the stage, attributing XLA
+    trace/lower/compile time plus executed FLOPs / HBM bytes / derived
+    MFU to the stage that paid them (SURVEY §5: compile cost is the
+    train-latency wildcard; ISSUE 3: "the train stage ran at 62% MXU"
+    belongs on the stage span, not in hand math)."""
     from contextlib import contextmanager
 
+    from predictionio_tpu.obs import devprof as _devprof
     from predictionio_tpu.obs import spans as _spans
     from predictionio_tpu.obs.jaxmon import compile_snapshot
 
     @contextmanager
     def cm():
         c0, s0 = compile_snapshot()
+        p0 = _devprof.snapshot()
         with _spans.span(name, **attrs) as sp:
             try:
                 yield sp
@@ -124,6 +129,20 @@ def _stage_span(name: str, **attrs):
                 if c1 > c0 or s1 > s0:
                     sp.attrs["jit_compiles"] = c1 - c0
                     sp.attrs["jit_compile_sec"] = round(s1 - s0, 4)
+                p1 = _devprof.snapshot()
+                d_flops = p1.flops - p0.flops
+                d_bytes = p1.bytes - p0.bytes
+                d_secs = p1.device_seconds - p0.device_seconds
+                if d_flops > 0 or d_secs > 0:
+                    sp.attrs["device_flops"] = d_flops
+                    sp.attrs["device_bytes"] = d_bytes
+                    sp.attrs["device_seconds"] = round(d_secs, 4)
+                    u = _devprof.mfu(d_flops, d_secs)
+                    if u is not None:
+                        sp.attrs["mfu"] = round(u, 6)
+                    h = _devprof.hbm_fraction(d_bytes, d_secs)
+                    if h is not None:
+                        sp.attrs["hbm_fraction_of_roof"] = round(h, 6)
 
     return cm()
 

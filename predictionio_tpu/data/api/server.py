@@ -163,6 +163,8 @@ class _Handler(JsonHandler):
                 self._serve_metrics()
             elif path == "/debug/traces" and method == "GET":
                 self._serve_debug_traces()
+            elif path == "/debug/profile" and method == "GET":
+                self._serve_debug_profile()
             elif path == "/events.json":
                 auth = self._auth(query)
                 if method == "POST":

@@ -97,6 +97,8 @@ class _Handler(JsonHandler):
             self._serve_metrics()
         elif self.path.split("?")[0] == "/debug/traces":
             self._serve_debug_traces()
+        elif self.path.split("?")[0] == "/debug/profile":
+            self._serve_debug_profile()
         else:
             self._reply(404, {"ok": False, "error": "not found"})
 

@@ -36,6 +36,7 @@ from predictionio_tpu.controller.metrics import OptionAverageMetric
 from predictionio_tpu.core.base import RuntimeContext
 from predictionio_tpu.data.store.event_store import EventStoreFacade
 from predictionio_tpu.models import als
+from predictionio_tpu.obs import devprof as _devprof
 
 
 # -- query/result (reference Engine.scala of the template) ------------------
@@ -468,6 +469,11 @@ class ALSAlgorithm(Algorithm):
                 sub_mask = np.concatenate(
                     [sub_mask, np.zeros((bucket - n_real, sub_mask.shape[1]), bool)]
                 )
+        # padding-waste accounting (ISSUE 3) lives HERE, at the pad site:
+        # this is the only place that knows both the live row count
+        # (vocab-known users, not the micro-batch's group size) and the
+        # bucket the device program actually ran at
+        prof0 = _devprof.snapshot()
         scores, items = als.recommend(
             model.factors,
             user_rows,
@@ -475,6 +481,9 @@ class ALSAlgorithm(Algorithm):
             exclude_mask=sub_mask,
             item_factors_device=model.item_factors_device(),
             user_factors_device=model.user_factors_device(),
+        )
+        _devprof.record_batch_padding(
+            n_real, bucket, flops=_devprof.snapshot().flops - prof0.flops
         )
         scores, items = scores[:n_real], items[:n_real]
         inv = model.factors.item_vocab.inverse()

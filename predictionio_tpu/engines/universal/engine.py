@@ -41,6 +41,7 @@ from predictionio_tpu.core.self_cleaning import EventWindow, SelfCleaningDataSou
 from predictionio_tpu.data.store.bimap import BiMap
 from predictionio_tpu.data.store.event_store import EventStoreFacade
 from predictionio_tpu.models import cco
+from predictionio_tpu.obs import devprof as _devprof
 
 log = logging.getLogger(__name__)
 
@@ -389,8 +390,14 @@ class URAlgorithm(Algorithm):
         k_req = min(max((q.num for q in queries), default=10), n_items)
         max_over = max((len(s) for s in overflow.values()), default=0)
         k = topk_bucket(min(k_req + max_over, n_items), n_items, floor=64)
+        # padding-waste accounting (ISSUE 3) at the pad site: n_real live
+        # queries ran in a bsz-shaped device program
+        prof0 = _devprof.snapshot()
         vals, idx = cco.batch_score_topk(
             model.device_tables(), histories, exclude, k
+        )
+        _devprof.record_batch_padding(
+            n_real, bsz, flops=_devprof.snapshot().flops - prof0.flops
         )
         inv = model.item_vocab.inverse()
         out = []

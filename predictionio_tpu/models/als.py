@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.data.store.bimap import BiMap
+from predictionio_tpu.obs import devprof as _devprof
 from predictionio_tpu.ops.segment import (
     batched_cg,
     chunked_edge_matvec,
@@ -332,6 +333,16 @@ def _train_jit_dense(
     return jax.lax.fori_loop(0, iterations, body, (uf, itf))
 
 
+# device profiling (ISSUE 3): each top-level train program is a named
+# executable in the registry. scale_by="iterations" corrects XLA's HLO
+# cost analysis counting the fori_loop body once regardless of trip
+# count (see obs/devprof.py); memory_analysis stays off — these are the
+# multi-second compiles a duplicate AOT compile must not double.
+_train_jit_dense = _devprof.instrument(
+    "als.train_dense", _train_jit_dense, scale_by="iterations"
+)
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -502,6 +513,15 @@ def _train_jit_dense_sharded(
         out_specs=(spec_r, rep2),
         check_vma=False,
     )(*args)
+
+
+_train_jit_dense_grid = _devprof.instrument(
+    "als.train_dense_grid", _train_jit_dense_grid, scale_by="iterations"
+)
+_train_jit_dense_sharded = _devprof.instrument(
+    "als.train_dense_sharded", _train_jit_dense_sharded,
+    scale_by="iterations",
+)
 
 
 @dataclass
@@ -967,6 +987,11 @@ def _train_jit_windowed(
     return jax.lax.fori_loop(0, iterations, body, (uf, itf))
 
 
+_train_jit_windowed = _devprof.instrument(
+    "als.train_windowed", _train_jit_windowed, scale_by="iterations"
+)
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -1012,6 +1037,12 @@ def _train_jit_windowed_grid(
         )
 
     return jax.vmap(one)(lams, alphas)
+
+
+_train_jit_windowed_grid = _devprof.instrument(
+    "als.train_windowed_grid", _train_jit_windowed_grid,
+    scale_by="iterations",
+)
 
 
 def train_grid(
@@ -1222,6 +1253,11 @@ def _train_jit(
 
     uf, itf = jax.lax.fori_loop(0, iterations, body, (uf, itf))
     return uf, itf
+
+
+_train_jit = _devprof.instrument(
+    "als.train_edge", _train_jit, scale_by="iterations"
+)
 
 
 def train(
@@ -1568,6 +1604,17 @@ def _recommend_jit_nomask(
     return jax.lax.top_k(scores, k)
 
 
+# serving kernels opt into full memory_analysis (memory=True): the
+# duplicate AOT compile per signature is ~100 ms and lands in warmup —
+# the bucket ladder pre-compiles every live shape before traffic
+_recommend_jit = _devprof.instrument(
+    "als.recommend_masked", _recommend_jit, memory=True
+)
+_recommend_jit_nomask = _devprof.instrument(
+    "als.recommend", _recommend_jit_nomask, memory=True
+)
+
+
 def recommend(
     model: ALSFactors,
     user_indices: np.ndarray,  # (B,) rows into user_factors
@@ -1607,6 +1654,9 @@ def _similar_jit(query_vecs: jax.Array, item_factors: jax.Array, exclude_mask, k
     qn = query_vecs / (jnp.linalg.norm(query_vecs, axis=-1, keepdims=True) + 1e-9)
     fn = item_factors / (jnp.linalg.norm(item_factors, axis=-1, keepdims=True) + 1e-9)
     return masked_top_k(qn @ fn.T, k, exclude_mask)
+
+
+_similar_jit = _devprof.instrument("als.similar", _similar_jit, memory=True)
 
 
 def similar_items(

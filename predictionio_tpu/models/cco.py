@@ -226,6 +226,15 @@ def _batch_score_topk_jit(
     return jax.lax.top_k(total, k)
 
 
+# device profiling (ISSUE 3): the UR serving hot path is one executable
+# per micro-batch shape; memory=True is safe — warmup covers the ladder
+from predictionio_tpu.obs import devprof as _devprof  # noqa: E402
+
+_batch_score_topk_jit = _devprof.instrument(
+    "cco.batch_score_topk", _batch_score_topk_jit, memory=True
+)
+
+
 def batch_score_topk(
     indicator_tables: list,  # [(corr_idx jnp/np, corr_scores jnp/np, J), ...]
     histories: list,  # per indicator: (B, H) int32 np, -1 padded

@@ -1,7 +1,8 @@
 """Unified observability: metrics registry, Prometheus exposition,
-request tracing, hierarchical span tracing. See registry.py and
-spans.py for the design rationale."""
+request tracing, hierarchical span tracing, device profiling. See
+registry.py, spans.py and devprof.py for the design rationale."""
 
+from predictionio_tpu.obs.devprof import install_devprof_gauges
 from predictionio_tpu.obs.jaxmon import install_jax_gauges
 from predictionio_tpu.obs.registry import (
     BATCH_SIZE_BUCKETS,
@@ -34,6 +35,7 @@ __all__ = [
     "current_trace_id",
     "get_default_recorder",
     "get_default_registry",
+    "install_devprof_gauges",
     "install_jax_gauges",
     "log_access",
     "new_request_id",
@@ -45,8 +47,10 @@ __all__ = [
 
 
 def server_registry() -> MetricsRegistry:
-    """A fresh per-server registry with the JAX runtime gauges mounted —
-    what every server process binds to its `GET /metrics`."""
+    """A fresh per-server registry with the JAX runtime and device-profile
+    gauges mounted — what every server process binds to its
+    `GET /metrics`."""
     reg = MetricsRegistry()
     install_jax_gauges(reg)
+    install_devprof_gauges(reg)
     return reg

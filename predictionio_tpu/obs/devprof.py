@@ -1,0 +1,761 @@
+"""Device profiling: per-executable XLA cost/memory accounting + roofline.
+
+PR 1 metrics say how long a request took and PR 2 spans say where the
+wall time went — but neither says what the DEVICE did with it. This
+module closes that gap (Williams et al.'s Roofline model, CACM 2009,
+applied with Dapper's always-on production posture): every top-level
+jit boundary the framework dispatches (train loops in models/als.py,
+the dense edge passes in ops/dense.py, the serving kernels) is wrapped
+by `instrument(name, fn)`, and the process-global `DeviceProfiler`
+records, per named executable:
+
+- FLOPs / bytes-accessed from XLA's `cost_analysis()` (computed ONCE
+  per compiled signature from the cheap `Lowered` handle — no second
+  backend compile);
+- argument/output bytes from the concrete call, plus temp/generated-
+  code bytes from `memory_analysis()` for wrappers that opt into
+  `memory=True` (this one DOES pay a duplicate backend compile per
+  signature, so only small serving programs enable it — their extra
+  ~100 ms lands in warmup, never in a live query);
+- compile seconds (diffed off jaxmon's compile listener around the
+  first call per signature, which also keeps the first call's compile
+  time OUT of the device-seconds accumulator);
+- invocation counts and cumulative device seconds (dispatch + result
+  ready — the wrapper blocks on the output, which every in-repo call
+  site consumes immediately anyway).
+
+From those it derives MFU (= executed FLOPs/s over the platform peak)
+and HBM %-of-roof against a per-generation peak table (env-overridable
+with PIO_PEAK_FLOPS / PIO_PEAK_HBM_BPS). Loop caveat, measured on this
+jax: XLA's HLO cost analysis counts `fori_loop`/`scan` bodies ONCE
+regardless of trip count, so train wrappers declare
+`scale_by="iterations"` and per-call FLOPs multiply by that static
+kwarg — the correction is framework-owned and recorded in the report
+(`flops_scaled_by`).
+
+Padding waste: the micro-batch dispatcher calls
+`record_batch_padding(real, padded, flops=...)` per device batch; the
+(padded-real)/padded ratio feeds a `batch_padding_ratio` histogram and
+a wasted-FLOPs counter on the process-default registry, so every
+server's `/metrics` and `GET /debug/profile` can say "38% of that
+batch was padding".
+
+Degradation contract (same as obs/jaxmon.py): importing this module
+never imports jax; with jax absent every wrapper is a passthrough and
+`report()` returns an empty profile; cost_analysis/memory_analysis
+raising (private-API drift) zeroes that executable's analysis but
+still counts invocations/seconds — serving must never 500 because
+profiling broke. Set PIO_DEVPROF=0 to disable instrumentation wholesale.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, NamedTuple, Optional
+
+from predictionio_tpu.obs import jaxmon as _jaxmon
+from predictionio_tpu.obs.registry import MetricsRegistry, get_default_registry
+
+# -- platform peaks ---------------------------------------------------------
+
+#: device_kind substring (lowercase) → (peak FLOP/s, peak HBM bytes/s).
+#: TPU numbers are the published per-chip bf16 dense peaks; the CPU row
+#: is a deliberately round server-class fallback so MFU stays a small
+#: honest fraction instead of None on dev boxes. Longest match wins
+#: ("tpu v5 lite" before "tpu v5").
+PEAK_TABLE: dict[str, tuple[float, float]] = {
+    "tpu v2": (45e12, 700e9),
+    "tpu v3": (123e12, 900e9),
+    "tpu v4": (275e12, 1228e9),
+    "tpu v5 lite": (197e12, 819e9),
+    "tpu v5e": (197e12, 819e9),
+    "tpu v5p": (459e12, 2765e9),
+    "tpu v5": (459e12, 2765e9),
+    "tpu v6 lite": (918e12, 1640e9),
+    "tpu v6e": (918e12, 1640e9),
+    "cpu": (2e11, 50e9),
+}
+
+#: batch padding ratio lives in [0, 1); these resolve the interesting
+#: shapes (exact fills at 0, the pow2-bucket half/quarter fills, tails)
+PADDING_RATIO_BUCKETS: tuple[float, ...] = (
+    0.0, 0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 0.984375,
+)
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def platform_info() -> dict:
+    """Platform + resolved peaks. Never imports jax: a data-plane process
+    that hasn't paid the jax import reports platform None (and env
+    overrides still apply, so a fleet can pin peaks centrally)."""
+    platform = kind = None
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            platform, kind = dev.platform, dev.device_kind
+        except Exception:
+            pass
+    peak_flops = _env_float("PIO_PEAK_FLOPS")
+    peak_hbm = _env_float("PIO_PEAK_HBM_BPS")
+    source = "env" if (peak_flops or peak_hbm) else None
+    if peak_flops is None or peak_hbm is None:
+        best = None
+        for key in (kind, platform):
+            if not key:
+                continue
+            lowered = str(key).lower()
+            for entry, peaks in PEAK_TABLE.items():
+                if entry in lowered and (
+                    best is None or len(entry) > len(best[0])
+                ):
+                    best = (entry, peaks)
+            if best is not None:
+                break
+        if best is not None:
+            source = source or "table"
+            if peak_flops is None:
+                peak_flops = best[1][0]
+            if peak_hbm is None:
+                peak_hbm = best[1][1]
+    return {
+        "platform": platform,
+        "device_kind": kind,
+        "peak_flops": peak_flops,
+        "peak_hbm_bps": peak_hbm,
+        "peak_source": source or "none",
+    }
+
+
+def mfu(flops: float, seconds: float) -> Optional[float]:
+    """Executed-FLOPs utilization vs the platform peak, clamped to 1.0
+    (cost-analysis estimates can overshoot on fused programs); None when
+    either input or the peak is unknown."""
+    peak = platform_info()["peak_flops"]
+    if not peak or seconds <= 0 or flops <= 0:
+        return None
+    return min(1.0, flops / seconds / peak)
+
+
+def hbm_fraction(nbytes: float, seconds: float) -> Optional[float]:
+    """HBM-traffic fraction of the platform roof (same contract as mfu)."""
+    peak = platform_info()["peak_hbm_bps"]
+    if not peak or seconds <= 0 or nbytes <= 0:
+        return None
+    return min(1.0, nbytes / seconds / peak)
+
+
+# -- per-executable accounting ---------------------------------------------
+
+
+@dataclass
+class _SigAnalysis:
+    """What XLA said about one compiled signature of an executable."""
+
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    arg_bytes: float = 0.0
+    output_bytes: float = 0.0
+    temp_bytes: float = 0.0
+    code_bytes: float = 0.0
+    cost_ok: bool = False
+    memory_ok: bool = False
+
+
+@dataclass
+class _Exec:
+    name: str
+    scale_by: Optional[str] = None
+    signatures: dict = field(default_factory=dict)  # sig key → _SigAnalysis
+    invocations: int = 0
+    device_seconds: float = 0.0
+    compile_seconds: float = 0.0
+    flops_total: float = 0.0
+    bytes_total: float = 0.0
+
+
+class ProfTotals(NamedTuple):
+    """Cumulative device accounting — DASE stage spans diff this across
+    a stage (the compile_snapshot pattern)."""
+
+    flops: float
+    bytes: float
+    device_seconds: float
+    invocations: int
+
+
+def _leaf_sig(obj: Any) -> Any:
+    shape = getattr(obj, "shape", None)
+    dtype = getattr(obj, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(obj, float):
+        # traced python-float scalars (λ, α sweeps) share one executable;
+        # keying on the value would mint a spurious "signature" per sweep
+        # point. Static floats (rare) just reuse the first analysis.
+        return ("f",)
+    try:
+        hash(obj)
+        return ("v", obj)
+    except TypeError:
+        return ("t", type(obj).__name__)
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    def walk(x: Any) -> Any:
+        if isinstance(x, (tuple, list)):
+            return tuple(walk(v) for v in x)
+        if isinstance(x, dict):
+            return tuple(sorted((k, walk(v)) for k, v in x.items()))
+        return _leaf_sig(x)
+
+    return (walk(args), walk(kwargs))
+
+
+def _arg_nbytes(args: tuple, kwargs: dict) -> float:
+    total = 0.0
+
+    def walk(x: Any) -> None:
+        nonlocal total
+        if isinstance(x, (tuple, list)):
+            for v in x:
+                walk(v)
+        elif isinstance(x, dict):
+            for v in x.values():
+                walk(v)
+        else:
+            n = getattr(x, "nbytes", None)
+            if isinstance(n, (int, float)):
+                total += n
+
+    walk(args)
+    walk(kwargs)
+    return total
+
+
+def _under_trace() -> bool:
+    """True while an outer jit is tracing through the wrapper — nested
+    dispatches must pass straight through (timing tracers is meaningless
+    and blocking them raises)."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import core as _core
+
+        return not _core.trace_state_clean()
+    except Exception:
+        return False
+
+
+#: slot reservation for a signature whose first call is still in flight —
+#: exactly ONE caller runs the (possibly compile-paying) analysis; racing
+#: callers account their invocation with zero flops rather than also
+#: analyzing (a duplicate backend compile on the live serving path)
+_ANALYSIS_PENDING = _SigAnalysis()
+
+
+class DeviceProfiler:
+    """Thread-safe registry of profiled executables."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._execs: dict[str, _Exec] = {}
+
+    # -- recording --------------------------------------------------------
+    def call(self, wrapper: "_Instrumented", args: tuple, kwargs: dict):
+        """Run the wrapped executable once, with best-effort accounting:
+        every profiler step is fenced so a bookkeeping bug degrades to an
+        unprofiled call — the wrapped function itself runs exactly once
+        and its exceptions propagate untouched."""
+        fn = wrapper.__wrapped__
+        rec = None
+        new_sig = pending_race = False
+        sig: tuple = ("?",)
+        t0 = s0 = 0.0
+        try:
+            try:
+                sig = _signature(args, kwargs)
+            except Exception:
+                sig = ("?",)
+            with self._lock:
+                rec = self._execs.get(wrapper.name)
+                if rec is None:
+                    rec = self._execs[wrapper.name] = _Exec(
+                        wrapper.name, scale_by=wrapper.scale_by
+                    )
+                existing = rec.signatures.get(sig)
+                if existing is None:
+                    # reserve the slot: racing first calls must not each
+                    # run _analyze (and its optional duplicate compile)
+                    rec.signatures[sig] = _ANALYSIS_PENDING
+                    new_sig = True
+                elif existing is _ANALYSIS_PENDING:
+                    # another thread's first call is compiling this
+                    # signature right now — this call will block on that
+                    # compile inside jax, so its timing needs the same
+                    # compile-seconds deduction a first call gets
+                    pending_race = True
+            if new_sig:
+                # arm jax's compile listener BEFORE the compiling call so
+                # the compile-seconds diff below actually sees the compile
+                _jaxmon.ensure_compile_listener()
+            _c0, s0 = _jaxmon.compile_snapshot()
+            t0 = time.perf_counter()
+        except Exception:
+            rec = None
+        try:
+            out = fn(*args, **kwargs)
+        except BaseException:
+            # the reserved slot must not poison the signature forever —
+            # a later successful call should get to analyze it
+            if rec is not None and new_sig:
+                with self._lock:
+                    if rec.signatures.get(sig) is _ANALYSIS_PENDING:
+                        del rec.signatures[sig]
+            raise
+        if rec is None:
+            return out
+        try:
+            try:
+                import jax
+
+                out = jax.block_until_ready(out)
+            except Exception:
+                pass
+            dt = time.perf_counter() - t0
+            compile_sec = 0.0
+            analysis = None
+            if new_sig or pending_race:
+                _c1, s1 = _jaxmon.compile_snapshot()
+                # the listener is process-global: overlapping compiles on
+                # OTHER threads land in this diff too — acceptable skew,
+                # bounded by how often fresh signatures race
+                compile_sec = max(0.0, s1 - s0)
+                # compile-paying calls (the first, and racers blocked on
+                # its compile) keep trace/lower/compile time out of the
+                # device-seconds accumulator so MFU reflects steady state
+                dt = max(0.0, dt - compile_sec)
+            if new_sig:
+                analysis = self._analyze(wrapper, fn, args, kwargs, out)
+            scale = 1.0
+            if wrapper.scale_by is not None:
+                try:
+                    scale = float(kwargs.get(wrapper.scale_by) or 1)
+                except (TypeError, ValueError):
+                    scale = 1.0
+            with self._lock:
+                if new_sig:
+                    rec.signatures[sig] = analysis
+                    rec.compile_seconds += compile_sec
+                else:
+                    # racing caller: the analyzer may have finished by
+                    # now — use its numbers, else count flops as zero
+                    analysis = rec.signatures.get(sig)
+                    if analysis is None or analysis is _ANALYSIS_PENDING:
+                        analysis = _ANALYSIS_PENDING
+                rec.invocations += 1
+                rec.device_seconds += dt
+                rec.flops_total += analysis.flops * scale
+                rec.bytes_total += analysis.bytes_accessed * scale
+        except Exception:
+            pass
+        return out
+
+    def _analyze(
+        self, wrapper: "_Instrumented", fn: Any, args: tuple, kwargs: dict,
+        out: Any,
+    ) -> _SigAnalysis:
+        """XLA's view of this signature. Everything is best-effort: the
+        AOT surface (`lower`, `cost_analysis`, `memory_analysis`) is
+        semi-private and has drifted across jax releases — any failure
+        degrades to zeros, never to an exception."""
+        res = _SigAnalysis(
+            arg_bytes=_arg_nbytes(args, kwargs),
+            output_bytes=_arg_nbytes((out,), {}),
+        )
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            return res
+        try:
+            lowered = lower(*args, **kwargs)
+        except Exception:
+            return res
+        try:
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            res.flops = float(ca.get("flops", 0.0) or 0.0)
+            res.bytes_accessed = float(ca.get("bytes accessed", 0.0) or 0.0)
+            res.cost_ok = True
+        except Exception:
+            pass
+        if wrapper.memory_enabled():
+            try:
+                compiled = lowered.compile()
+                ma = compiled.memory_analysis()
+                res.arg_bytes = float(ma.argument_size_in_bytes)
+                res.output_bytes = float(ma.output_size_in_bytes)
+                res.temp_bytes = float(ma.temp_size_in_bytes)
+                res.code_bytes = float(ma.generated_code_size_in_bytes)
+                res.memory_ok = True
+                try:
+                    # post-optimization cost analysis is the more honest
+                    # number when we paid for the compile anyway
+                    ca = compiled.cost_analysis()
+                    if isinstance(ca, (list, tuple)):
+                        ca = ca[0] if ca else {}
+                    if ca.get("flops"):
+                        res.flops = float(ca["flops"])
+                    if ca.get("bytes accessed"):
+                        res.bytes_accessed = float(ca["bytes accessed"])
+                except Exception:
+                    pass
+            except Exception:
+                pass
+        return res
+
+    def record_external(self, name: str, seconds: float,
+                        invocations: int = 1) -> None:
+        """Attribute externally-measured device seconds to a named
+        executable (callers that own their timing, e.g. a dispatcher)."""
+        with self._lock:
+            rec = self._execs.get(name)
+            if rec is None:
+                rec = self._execs[name] = _Exec(name)
+            rec.device_seconds += max(0.0, seconds)
+            rec.invocations += invocations
+
+    # -- reading ----------------------------------------------------------
+    def snapshot(self) -> ProfTotals:
+        with self._lock:
+            return ProfTotals(
+                flops=sum(e.flops_total for e in self._execs.values()),
+                bytes=sum(e.bytes_total for e in self._execs.values()),
+                device_seconds=sum(
+                    e.device_seconds for e in self._execs.values()
+                ),
+                invocations=sum(
+                    e.invocations for e in self._execs.values()
+                ),
+            )
+
+    def executable_count(self) -> int:
+        with self._lock:
+            return len(self._execs)
+
+    def compile_seconds_total(self) -> float:
+        with self._lock:
+            return sum(e.compile_seconds for e in self._execs.values())
+
+    def executable(self, name: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._execs.get(name)
+            if rec is None:
+                return None
+            return self._exec_dict(rec, platform_info())
+
+    def _exec_dict(self, rec: _Exec, plat: dict) -> dict:
+        sigs = [
+            s for s in rec.signatures.values()
+            if s is not _ANALYSIS_PENDING
+        ]
+        latest = sigs[-1] if sigs else _SigAnalysis()
+        out = {
+            "name": rec.name,
+            "signatures": len(rec.signatures),
+            "invocations": rec.invocations,
+            "compile_seconds": round(rec.compile_seconds, 4),
+            "device_seconds": round(rec.device_seconds, 6),
+            "flops_per_call": latest.flops,
+            "bytes_per_call": latest.bytes_accessed,
+            "flops_total": rec.flops_total,
+            "bytes_total": rec.bytes_total,
+            "argument_bytes": latest.arg_bytes,
+            "output_bytes": latest.output_bytes,
+            "temp_bytes": latest.temp_bytes,
+            "generated_code_bytes": latest.code_bytes,
+            "cost_analysis_ok": any(s.cost_ok for s in sigs),
+            "memory_analysis_ok": any(s.memory_ok for s in sigs),
+        }
+        if rec.scale_by is not None:
+            out["flops_scaled_by"] = rec.scale_by
+        # derived roofline fields against the caller-resolved peaks (the
+        # peak table + env + jax.devices lookup is process-constant, so
+        # a report resolves it ONCE, not per executable per field)
+        peak_f, peak_h = plat.get("peak_flops"), plat.get("peak_hbm_bps")
+        if peak_f and rec.device_seconds > 0 and rec.flops_total > 0:
+            out["mfu"] = round(
+                min(1.0, rec.flops_total / rec.device_seconds / peak_f), 8
+            )
+            out["flops_per_sec"] = rec.flops_total / rec.device_seconds
+        if peak_h and rec.device_seconds > 0 and rec.bytes_total > 0:
+            out["hbm_fraction_of_roof"] = round(
+                min(1.0, rec.bytes_total / rec.device_seconds / peak_h), 8
+            )
+            out["hbm_bytes_per_sec"] = rec.bytes_total / rec.device_seconds
+        return out
+
+    def report(self) -> dict:
+        """The `GET /debug/profile` payload: platform + peaks, every
+        profiled executable with derived roofline numbers, padding-waste
+        accounting, and process totals."""
+        plat = platform_info()
+        with self._lock:
+            rows = [self._exec_dict(r, plat) for r in self._execs.values()]
+        rows.sort(key=lambda r: -r["device_seconds"])
+        totals = self.snapshot()
+        peak_f = plat.get("peak_flops")
+        report: dict[str, Any] = {
+            "platform": plat,
+            "executables": rows,
+            "totals": {
+                "flops": totals.flops,
+                "bytes": totals.bytes,
+                "device_seconds": round(totals.device_seconds, 6),
+                "invocations": totals.invocations,
+                "mfu": (
+                    min(1.0, totals.flops / totals.device_seconds / peak_f)
+                    if peak_f and totals.device_seconds > 0
+                    and totals.flops > 0 else None
+                ),
+            },
+            "padding": padding_summary(),
+        }
+        return report
+
+    def clear(self) -> None:
+        with self._lock:
+            self._execs.clear()
+
+
+_profiler = DeviceProfiler()
+
+
+def get_profiler() -> DeviceProfiler:
+    return _profiler
+
+
+def snapshot() -> ProfTotals:
+    """Module-level convenience — the stage-span diff pattern."""
+    return _profiler.snapshot()
+
+
+def report() -> dict:
+    return _profiler.report()
+
+
+def _enabled() -> bool:
+    return os.environ.get("PIO_DEVPROF", "").strip() != "0"
+
+
+# -- the jit-boundary hook --------------------------------------------------
+
+
+class _Instrumented:
+    """Callable wrapper around a jit-compiled function. Transparent when
+    profiling is disabled, jax is absent, or an outer jit is tracing
+    through; attribute access (`.lower`, `.clear_cache`, …) forwards to
+    the wrapped function so AOT users don't notice the wrapper."""
+
+    def __init__(self, name: str, fn: Callable,
+                 scale_by: Optional[str] = None,
+                 memory: bool = False):
+        self.name = name
+        self.__wrapped__ = fn
+        self.scale_by = scale_by
+        self.memory = memory
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def memory_enabled(self) -> bool:
+        env = os.environ.get("PIO_DEVPROF_MEMORY", "").strip()
+        if env == "0":
+            return False
+        if env == "1":
+            return True
+        return self.memory
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if not _enabled() or "jax" not in sys.modules or _under_trace():
+            return self.__wrapped__(*args, **kwargs)
+        # call() fences all its own bookkeeping: the wrapped function
+        # executes exactly once and its exceptions propagate untouched
+        return _profiler.call(self, args, kwargs)
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self.__wrapped__, item)
+
+
+def instrument(name: str, fn: Callable, *, scale_by: Optional[str] = None,
+               memory: bool = False) -> Callable:
+    """Hook a top-level jit boundary into the device profiler.
+
+    `scale_by` names a STATIC kwarg whose value multiplies the analyzed
+    per-call FLOPs/bytes — the fori_loop/scan correction (XLA's HLO cost
+    analysis counts loop bodies once; verified on this jax).
+    `memory=True` opts into full `memory_analysis()` (a duplicate
+    backend compile per signature — small serving programs only)."""
+    return _Instrumented(name, fn, scale_by=scale_by, memory=memory)
+
+
+# -- padding-waste accounting ----------------------------------------------
+
+
+def _padding_hist(reg: MetricsRegistry):
+    """Single declaration point for the padding metrics: the recorder and
+    the summary reader MUST resolve identical definitions (the registry
+    raises on bucket drift between re-registrations)."""
+    return reg.histogram(
+        "batch_padding_ratio",
+        "fraction of each coalesced device batch that was padding",
+        buckets=PADDING_RATIO_BUCKETS,
+    )
+
+
+def _padding_counters(reg: MetricsRegistry):
+    return (
+        reg.counter(
+            "batch_rows_real_total",
+            "live query rows through device batches",
+        ),
+        reg.counter(
+            "batch_rows_padded_total",
+            "total rows (live + padding) through device batches",
+        ),
+        reg.counter(
+            "batch_padding_wasted_flops_total",
+            "device FLOPs spent computing padding rows",
+        ),
+    )
+
+
+def record_batch_padding(real_rows: int, padded_rows: int,
+                         flops: float = 0.0,
+                         registry: Optional[MetricsRegistry] = None) -> None:
+    """Account one padded device batch: `real_rows` live queries ran in a
+    `padded_rows`-shaped program (serving-shape bucketing), so
+    (padded-real)/padded of the work was waste. `flops` is the executed-
+    FLOPs attribution for the batch (typically a devprof snapshot diff
+    across the device call at the pad site — approximate under
+    concurrent batches, exact in aggregate)."""
+    if padded_rows <= 0:
+        return
+    real_rows = max(0, min(real_rows, padded_rows))
+    ratio = (padded_rows - real_rows) / padded_rows
+    reg = registry if registry is not None else get_default_registry()
+    _padding_hist(reg).observe(ratio)
+    real_c, padded_c, wasted_c = _padding_counters(reg)
+    real_c.inc(real_rows)
+    padded_c.inc(padded_rows)
+    if flops > 0 and ratio > 0:
+        wasted_c.inc(flops * ratio)
+
+
+def padding_summary(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The padding section of `report()` — read back off the registry the
+    pad sites record into, so /metrics and /debug/profile can never
+    disagree."""
+    reg = registry if registry is not None else get_default_registry()
+    hist = _padding_hist(reg)
+    real_c, padded_c, wasted_c = _padding_counters(reg)
+    return {
+        "batches": hist.count,
+        "mean_padding_ratio": round(hist.mean, 6),
+        "p50_padding_ratio": round(hist.quantile(0.5), 6),
+        "rows_real": real_c.total,
+        "rows_padded": padded_c.total,
+        "wasted_flops": wasted_c.total,
+    }
+
+
+# -- /metrics gauges --------------------------------------------------------
+
+
+def install_devprof_gauges(registry: MetricsRegistry) -> None:
+    """Mount the profiler's cumulative totals as scrape-time callback
+    gauges (idempotent per registry, same posture as install_jax_gauges)."""
+    registry.gauge_callback(
+        "devprof_executables",
+        "distinct profiled executables in this process",
+        lambda: float(_profiler.executable_count()),
+    )
+    registry.gauge_callback(
+        "devprof_invocations_total",
+        "profiled executable invocations",
+        lambda: float(_profiler.snapshot().invocations),
+    )
+    registry.gauge_callback(
+        "devprof_device_seconds_total",
+        "cumulative device seconds across profiled executables",
+        lambda: _profiler.snapshot().device_seconds,
+    )
+    registry.gauge_callback(
+        "devprof_flops_total",
+        "cumulative executed FLOPs across profiled executables",
+        lambda: _profiler.snapshot().flops,
+    )
+    registry.gauge_callback(
+        "devprof_bytes_total",
+        "cumulative HBM bytes accessed across profiled executables",
+        lambda: _profiler.snapshot().bytes,
+    )
+    registry.gauge_callback(
+        "devprof_compile_seconds_total",
+        "cumulative XLA compile seconds attributed to profiled executables",
+        _profiler.compile_seconds_total,
+    )
+
+    def _lifetime_mfu() -> float:
+        totals = _profiler.snapshot()  # one snapshot: coherent num/denom
+        return mfu(totals.flops, totals.device_seconds) or 0.0
+
+    registry.gauge_callback(
+        "devprof_mfu",
+        "process-lifetime model FLOPs utilization (0 when unknown)",
+        _lifetime_mfu,
+    )
+
+
+# -- on-demand XLA profiler capture ----------------------------------------
+
+_capture_lock = threading.Lock()
+
+
+def capture_trace(directory: str, seconds: float) -> dict:
+    """Open a jax.profiler trace window for `seconds` and write it under
+    `directory` (inspect with tensorboard/xprof/perfetto). Raises
+    RuntimeError when jax is not loaded in this process or a capture is
+    already running — callers map those to 409."""
+    seconds = float(seconds)
+    if not 0.0 < seconds <= 60.0:
+        raise ValueError("capture seconds must be in (0, 60]")
+    if "jax" not in sys.modules:
+        raise RuntimeError(
+            "jax is not loaded in this process — nothing to capture"
+        )
+    if not _capture_lock.acquire(blocking=False):
+        raise RuntimeError("a profiler capture is already running")
+    try:
+        import jax
+
+        jax.profiler.start_trace(directory)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    finally:
+        _capture_lock.release()
+    return {"dir": directory, "seconds": seconds}

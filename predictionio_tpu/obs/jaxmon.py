@@ -58,12 +58,27 @@ def compile_snapshot() -> tuple[int, float]:
         return _compile_count, _compile_seconds
 
 
+def _rearm_if_jax_loaded() -> None:
+    """Late-import gap fix: a process that wires /metrics BEFORE its
+    first jax import used to scrape compile gauges stuck at 0 forever
+    (install_jax_gauges only armed the listener if jax was already in
+    sys.modules). Re-checking at scrape time arms the listener the first
+    time a scrape observes jax loaded — compiles before that scrape are
+    missed, every one after is counted. Still never IMPORTS jax."""
+    import sys
+
+    if "jax" in sys.modules and not _listener_installed:
+        ensure_compile_listener()
+
+
 def _compile_count_now() -> float:
+    _rearm_if_jax_loaded()
     with _lock:
         return float(_compile_count)
 
 
 def _compile_seconds_now() -> float:
+    _rearm_if_jax_loaded()
     with _lock:
         return _compile_seconds
 

@@ -116,6 +116,16 @@ def block_partials(
     )(y_t, w_b, w_g, local)
 
 
+# device profiling (ISSUE 3): only top-level dispatches record (the train
+# loop traces through); cost_analysis of a pallas_call may legitimately
+# report 0 flops — the registry then shows invocations/seconds only
+from predictionio_tpu.obs import devprof as _devprof  # noqa: E402
+
+block_partials = _devprof.instrument(
+    "ops.windowed_block_partials", block_partials
+)
+
+
 def available() -> bool:
     """True when the TPU Pallas lowering can run here."""
     try:

@@ -43,6 +43,8 @@ class _Handler(JsonHandler):
                 self._serve_metrics()
             elif path == "/debug/traces":
                 self._serve_debug_traces()
+            elif path == "/debug/profile":
+                self._serve_debug_profile()
             elif path == "/cmd/app":
                 apps = self.storage.get_meta_data_apps().get_all()
                 keys = self.storage.get_meta_data_access_keys()
@@ -81,6 +83,10 @@ class _Handler(JsonHandler):
                 self._respond(
                     201, {"name": app.name, "id": app.id, "accessKey": key}
                 )
+            elif path == "/debug/profile/capture":
+                # guarded admin mirror of the query server's endpoint —
+                # useful when a train workflow shares this process
+                self._serve_profile_capture()
             else:
                 raise HttpError(404, "Not Found")
         except HttpError as e:

@@ -12,7 +12,7 @@ Commands:
   accesskey new|list|delete
   train / deploy / eval / eventserver
   status / export / import
-  metrics / trace list|show|export
+  metrics / trace list|show|export / profile list|show|capture
 """
 
 from __future__ import annotations
@@ -537,6 +537,112 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def _fetch_profile(url: str) -> dict:
+    import json as _json
+    import urllib.request
+
+    full = url.rstrip("/") + "/debug/profile"
+    with urllib.request.urlopen(full, timeout=10) as r:
+        return _json.loads(r.read().decode())
+
+
+def cmd_profile(args) -> int:
+    """`pio profile list|show|capture` — device-profile accounting of a
+    running server (--url http://host:port) or of this process."""
+    action = args.profile_action
+    url = getattr(args, "url", None)
+    if action == "capture":
+        # on-demand jax.profiler window: remote via the guarded admin
+        # endpoint, or in-process when --dir names a writable directory
+        if url:
+            import json as _json
+            import urllib.error
+            import urllib.request
+
+            req = urllib.request.Request(
+                url.rstrip("/") + "/debug/profile/capture",
+                data=_json.dumps({"seconds": args.seconds}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=args.seconds + 30) as r:
+                    result = _json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")
+                return _fail(f"capture refused ({e.code}): {detail}")
+            print(f"[INFO] XLA profile captured to {result['dir']} "
+                  f"(on the server host; inspect with tensorboard/xprof)")
+            return 0
+        if not args.dir:
+            return _fail("profile capture needs --url or --dir")
+        # the local capture is jax-bound by definition — pay the import
+        # here so capture_trace (which never imports jax itself) can run
+        import jax  # noqa: F401
+
+        from predictionio_tpu.obs import devprof
+
+        result = devprof.capture_trace(args.dir, args.seconds)
+        print(f"[INFO] XLA profile captured to {result['dir']} "
+              f"(inspect with tensorboard --logdir)")
+        return 0
+
+    if url:
+        rep = _fetch_profile(url)
+    else:
+        from predictionio_tpu.obs import devprof
+
+        rep = devprof.report()
+    plat = rep.get("platform", {})
+    if action == "list":
+        peak = plat.get("peak_flops")
+        peak_s = f"{peak / 1e12:g} TFLOP/s" if peak else "unknown"
+        print(
+            f"[INFO] platform={plat.get('platform')} "
+            f"kind={plat.get('device_kind')} peak={peak_s} "
+            f"(source: {plat.get('peak_source')})"
+        )
+        rows = rep.get("executables", [])
+        if not rows:
+            print("[INFO] no profiled executables yet")
+            return 0
+        print(
+            f"[INFO] {'executable':<28} {'calls':>7} {'dev_sec':>9} "
+            f"{'compile_s':>9} {'GFLOP':>10} {'mfu':>9} {'hbm%':>7}"
+        )
+        for r in rows:
+            u = r.get("mfu")
+            h = r.get("hbm_fraction_of_roof")
+            print(
+                f"[INFO] {r['name']:<28} {r['invocations']:>7} "
+                f"{r['device_seconds']:>9.3f} {r['compile_seconds']:>9.2f} "
+                f"{r['flops_total'] / 1e9:>10.2f} "
+                f"{(f'{u:.5f}' if u is not None else '-'):>9} "
+                f"{(f'{100 * h:.1f}' if h is not None else '-'):>7}"
+            )
+        pad = rep.get("padding", {})
+        if pad.get("batches"):
+            print(
+                f"[INFO] padding: {pad['batches']} batches, mean ratio "
+                f"{pad['mean_padding_ratio']:.3f}, wasted "
+                f"{pad['wasted_flops'] / 1e9:.2f} GFLOP"
+            )
+        return 0
+    # show
+    row = next(
+        (r for r in rep.get("executables", []) if r["name"] == args.name),
+        None,
+    )
+    if row is None:
+        return _fail(f"no profiled executable {args.name!r}")
+    print(f"[INFO] {row['name']}:")
+    for k, v in row.items():
+        if k == "name":
+            continue
+        print(f"[INFO]   {k}: {v}")
+    return 0
+
+
 def cmd_export(args) -> int:
     storage = _storage()
     app = _get_app(storage, args.app)
@@ -841,6 +947,30 @@ def build_parser() -> argparse.ArgumentParser:
     te.add_argument("--url", help="server base URL")
     te.add_argument("--output", required=True)
     te.set_defaults(func=cmd_trace)
+
+    # profile (ISSUE 3: device-profile accounting from the console)
+    s = sub.add_parser(
+        "profile",
+        help="per-executable device profiling: XLA cost/memory analysis, "
+             "MFU/roofline, padding waste (local, or a server via --url)",
+    )
+    psub = s.add_subparsers(dest="profile_action", required=True)
+    pl = psub.add_parser("list", help="list profiled executables")
+    pl.add_argument("--url", help="server base URL, e.g. http://127.0.0.1:8000")
+    pl.set_defaults(func=cmd_profile)
+    ps = psub.add_parser("show", help="one executable's full profile")
+    ps.add_argument("name")
+    ps.add_argument("--url", help="server base URL")
+    ps.set_defaults(func=cmd_profile)
+    pc = psub.add_parser(
+        "capture",
+        help="open an on-demand jax.profiler trace window (server needs "
+             "PIO_PROFILE_CAPTURE_DIR set; or --dir for this process)",
+    )
+    pc.add_argument("--url", help="server base URL")
+    pc.add_argument("--dir", help="local output directory (no --url)")
+    pc.add_argument("--seconds", type=float, default=2.0)
+    pc.set_defaults(func=cmd_profile)
 
     # export / import
     s = sub.add_parser(

@@ -32,6 +32,8 @@ class _Handler(JsonHandler):
                 self._serve_metrics()
             elif path == "/debug/traces":
                 self._serve_debug_traces()
+            elif path == "/debug/profile":
+                self._serve_debug_profile()
             elif path.startswith("/engine_instances/") and path.endswith(".html"):
                 iid = path[len("/engine_instances/"):-len(".html")]
                 inst = (

@@ -197,8 +197,11 @@ class JsonHandler(BaseHTTPRequestHandler):
     def _serve_debug_traces(self) -> None:
         """GET /debug/traces — recent retained traces (tail-sampled);
         `?trace_id=` for one trace's full span list, plus
-        `&format=perfetto` for Chrome trace-event JSON of it. Every
-        JsonHandler server mounts this, same as /metrics."""
+        `&format=perfetto` for Chrome trace-event JSON of it;
+        `?min_duration_ms=` / `?error=1` filter the summary listing so
+        operators pull only slow/errored traces without exporting the
+        whole store. Every JsonHandler server mounts this, same as
+        /metrics."""
         from urllib.parse import parse_qsl, urlsplit
 
         qs = dict(parse_qsl(urlsplit(self.path).query))
@@ -226,10 +229,74 @@ class JsonHandler(BaseHTTPRequestHandler):
             limit = int(qs.get("limit", "50"))
         except ValueError:
             limit = 50
+        try:
+            min_ms = float(qs.get("min_duration_ms", 0) or 0)
+        except ValueError:
+            min_ms = 0.0
+        error_only = qs.get("error") in ("1", "true", "yes")
+        if min_ms > 0 or error_only:
+            # filter over the FULL store, then apply the limit — the
+            # newest N unfiltered rows would hide older slow/errored
+            # traces, which are exactly what the filters exist to find
+            summaries = [
+                s for s in recorder.summaries(limit=0)
+                if s["duration_ms"] >= min_ms
+                and (not error_only or s["error"])
+            ]
+            if limit:
+                summaries = summaries[:limit]
+        else:
+            summaries = recorder.summaries(limit=limit)
         self._respond(200, {
-            "traces": recorder.summaries(limit=limit),
+            "traces": summaries,
             "sampling": recorder.config(),
         })
+
+    def _serve_debug_profile(self) -> None:
+        """GET /debug/profile — the device-profiling report: per-
+        executable XLA cost/memory analysis, derived MFU / HBM roofline
+        numbers, and padding-waste accounting. Empty-but-valid on
+        processes that never loaded jax."""
+        from predictionio_tpu.obs import devprof as _devprof
+
+        self._respond(200, _devprof.report())
+
+    def _serve_profile_capture(self) -> None:
+        """POST /debug/profile/capture — on-demand jax.profiler trace
+        window. Guarded: disabled (403) unless the operator set
+        PIO_PROFILE_CAPTURE_DIR on the server process; 409 when jax is
+        not loaded here or a capture is already running. Body:
+        {"seconds": 2.0} (bounded to (0, 60])."""
+        import os as _os
+        import time as _time
+
+        from predictionio_tpu.obs import devprof as _devprof
+
+        cap_dir = _os.environ.get("PIO_PROFILE_CAPTURE_DIR")
+        if not cap_dir:
+            self._respond(403, {
+                "message": "profiler capture is disabled: set "
+                           "PIO_PROFILE_CAPTURE_DIR on this server to "
+                           "enable it"
+            })
+            return
+        body = self._json_body()
+        seconds = 2.0
+        if isinstance(body, dict) and "seconds" in body:
+            try:
+                seconds = float(body["seconds"])
+            except (TypeError, ValueError):
+                raise HttpError(400, "'seconds' must be a number")
+        out_dir = _os.path.join(
+            cap_dir, _time.strftime("capture-%Y%m%d-%H%M%S")
+        )
+        try:
+            result = _devprof.capture_trace(out_dir, seconds)
+        except ValueError as e:
+            raise HttpError(400, str(e))
+        except RuntimeError as e:
+            raise HttpError(409, str(e))
+        self._respond(200, result)
 
     def _drain_body(self) -> None:
         length = int(self.headers.get("Content-Length") or 0)

@@ -173,6 +173,8 @@ class _Handler(JsonHandler):
                 self._serve_metrics()
             elif path == "/debug/traces":
                 self._serve_debug_traces()
+            elif path == "/debug/profile":
+                self._serve_debug_profile()
             elif path == "/reload":
                 self.server.owner.reload()
                 self._respond(200, {"message": "Reload successful"})
@@ -198,6 +200,14 @@ class _Handler(JsonHandler):
                 self._respond(200, {"message": "Reload successful"})
             except Exception as e:
                 log.exception("reload failed")
+                self._respond(500, {"message": str(e)})
+        elif path == "/debug/profile/capture":
+            try:
+                self._serve_profile_capture()
+            except _HttpError as e:
+                self._respond(e.status, {"message": e.message})
+            except Exception as e:
+                log.exception("profiler capture failed")
                 self._respond(500, {"message": str(e)})
         else:
             self._respond(404, {"message": "Not Found"})
@@ -404,6 +414,11 @@ class _BatchDispatcher:
         if rep is not None:
             tok_t = _tracing.set_trace_id(group[rep][3][0])
             tok_s = _spans.set_current_span(dev_ids[rep])
+        # padding-waste accounting (ISSUE 3) is recorded at the PAD SITES
+        # this dispatch drives (engines' _predict_batch, the only places
+        # that know the vocab-known row count and the actual bucket) —
+        # each batch_predict below lands batch_padding_ratio samples and
+        # wasted-FLOPs on the process-default registry.
         try:
             try:
                 per_algo = [

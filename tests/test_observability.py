@@ -453,3 +453,85 @@ def test_logship_trace_id_and_recovery(collector):
         assert any("recovered" in m for m in recovery), recovery
     finally:
         handler2.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 3 satellites: /debug/traces filters + jaxmon late-import re-arm
+# ---------------------------------------------------------------------------
+
+
+def test_debug_traces_filters(storage):
+    """?min_duration_ms= and ?error=1 pull only slow/errored traces."""
+    import uuid
+
+    from predictionio_tpu.obs import spans as _spans
+    from predictionio_tpu.tools.admin import AdminServer
+
+    recorder = _spans.get_default_recorder()
+
+    def mk(name, duration, error):
+        tid = uuid.uuid4().hex
+        recorder.record(
+            _spans.Span(
+                trace_id=tid, span_id=_spans.new_span_id(), name=name,
+                start=time.time(), duration=duration, error=error,
+            ),
+            finalize=True,
+        )
+        return tid
+
+    slow_id = mk("t.slow", 0.9, False)     # kept: slow
+    err_id = mk("t.err", 0.001, True)      # kept: error
+    srv = AdminServer(storage, ip="127.0.0.1", port=0)
+    srv.start()
+    try:
+        def fetch(params):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/traces?{params}",
+                timeout=10,
+            ) as r:
+                return json.loads(r.read().decode())["traces"]
+
+        slow = fetch("min_duration_ms=500")
+        assert any(s["trace_id"] == slow_id for s in slow)
+        assert all(s["duration_ms"] >= 500 for s in slow)
+        errs = fetch("error=1")
+        assert any(s["trace_id"] == err_id for s in errs)
+        assert all(s["error"] for s in errs)
+        both = fetch("error=1&min_duration_ms=500")
+        assert all(
+            s["error"] and s["duration_ms"] >= 500 for s in both
+        )
+        assert not any(s["trace_id"] == err_id for s in both)
+        # filters respect the limit AFTER filtering
+        limited = fetch("min_duration_ms=500&limit=1")
+        assert len(limited) <= 1
+    finally:
+        srv.stop()
+
+
+def test_jaxmon_rearm_at_scrape_time(monkeypatch):
+    """The late-import gap: gauges wired before jax imports must arm the
+    compile listener at scrape time, not stay stuck at 0 forever."""
+    import sys
+
+    from predictionio_tpu.obs import jaxmon
+
+    calls = []
+    monkeypatch.setattr(jaxmon, "_listener_installed", False)
+    monkeypatch.setattr(
+        jaxmon, "ensure_compile_listener", lambda: calls.append(1)
+    )
+    # no jax loaded → scrape must NOT trigger the (expensive) import
+    monkeypatch.delitem(sys.modules, "jax", raising=False)
+    jaxmon._compile_count_now()
+    assert calls == []
+    # jax has since been imported → the next scrape arms the listener
+    sys.modules.setdefault("jax", __import__("types"))
+    try:
+        jaxmon._compile_count_now()
+        jaxmon._compile_seconds_now()
+    finally:
+        if not hasattr(sys.modules.get("jax"), "__version__"):
+            sys.modules.pop("jax", None)
+    assert calls == [1, 1]
