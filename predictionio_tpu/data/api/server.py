@@ -22,10 +22,13 @@ from __future__ import annotations
 import base64
 import datetime as _dt
 import logging
+import os
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 from urllib.parse import parse_qsl, urlsplit
 
+import predictionio_tpu.resilience.faults as _faults
 from predictionio_tpu.data.api.plugins import PluginContext
 from predictionio_tpu.data.api.stats import Stats
 from predictionio_tpu.data.api.webhooks import (
@@ -34,9 +37,13 @@ from predictionio_tpu.data.api.webhooks import (
     ConnectorException,
 )
 from predictionio_tpu.data.event import Event, EventValidation, ValidationError
-from predictionio_tpu.data.storage.base import EventQuery
+from predictionio_tpu.data.storage.base import (
+    EventQuery,
+    StorageUnreachableError,
+)
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.obs import server_registry
+from predictionio_tpu.resilience.wal import EventWAL
 from predictionio_tpu.utils.http import (
     HttpError as _HttpError,
     JsonHandler,
@@ -49,6 +56,12 @@ log = logging.getLogger(__name__)
 MAX_EVENTS_PER_BATCH = 50  # reference EventServer.scala:68
 
 
+def _default_wal_dir() -> str:
+    return os.environ.get("PIO_WAL_DIR") or os.path.join(
+        os.path.expanduser("~"), ".predictionio_tpu", "event-wal"
+    )
+
+
 @dataclass
 class EventServerConfig:
     ip: str = "0.0.0.0"
@@ -57,6 +70,12 @@ class EventServerConfig:
     plugins: list = field(default_factory=list)
     # remote log shipping (reference CreateServer.scala:441-452 --log-url)
     log_url: Optional[str] = None
+    # durable write-ahead spill (ISSUE 4): when storage is unreachable,
+    # accepted events land here (202) and a background thread replays
+    # them once storage recovers. None disables spilling (a storage
+    # outage then 503s, the old behavior).
+    wal_dir: Optional[str] = field(default_factory=_default_wal_dir)
+    wal_replay_interval_s: float = 0.5
 
 
 @dataclass
@@ -94,15 +113,13 @@ class _Handler(JsonHandler):
                     key = None
         if not key:
             raise _HttpError(401, "Missing accessKey.")
-        access_key = self.server.storage.get_meta_data_access_keys().get(key)
+        access_key = self.server.lookup_access_key(key)
         if access_key is None:
             raise _HttpError(401, "Invalid accessKey.")
         channel_id: Optional[int] = None
         channel = query.get("channel")
         if channel:
-            channels = self.server.storage.get_meta_data_channels().get_by_app_id(
-                access_key.app_id
-            )
+            channels = self.server.lookup_channels(access_key.app_id)
             match = [c for c in channels if c.name == channel]
             if not match:
                 raise _HttpError(401, "Invalid channel.")
@@ -142,13 +159,26 @@ class _Handler(JsonHandler):
         if self.server.stats is not None:
             self.server.stats.update(auth.app_id, 201, event)
 
-    def _insert_event(self, auth: AuthData, obj: dict) -> str:
+    def _insert_event(self, auth: AuthData, obj: dict) -> tuple[int, dict]:
+        """Admit + store one event. Returns (status, body): 201 with the
+        assigned eventId on a normal write, 202 with the WAL receipt when
+        storage is unreachable and the event spilled (ISSUE 4 — accepted
+        means durable, never lost, replayed in order once storage
+        recovers)."""
         event = self._admit_event(auth, obj)
-        event_id = self.server.storage.get_events().insert(
-            event, auth.app_id, auth.channel_id
-        )
+        try:
+            _faults.fire("event.insert")
+            event_id = self.server.storage.get_events().insert(
+                event, auth.app_id, auth.channel_id
+            )
+        except (StorageUnreachableError, _faults.FaultInjected) as e:
+            wal_id = self.server.spill(event, auth.app_id, auth.channel_id, e)
+            return 202, {
+                "message": "storage unavailable; event accepted for replay",
+                "walId": wal_id,
+            }
         self._after_insert(auth, obj, event)
-        return event_id
+        return 201, {"eventId": event_id}
 
     # -- routes ------------------------------------------------------------
     def _route(self, method: str) -> None:
@@ -165,6 +195,10 @@ class _Handler(JsonHandler):
                 self._serve_debug_traces()
             elif path == "/debug/profile" and method == "GET":
                 self._serve_debug_profile()
+            elif path == "/debug/faults" and method == "GET":
+                self._serve_debug_faults()
+            elif path == "/debug/faults" and method == "POST":
+                self._serve_debug_faults_set()
             elif path == "/events.json":
                 auth = self._auth(query)
                 if method == "POST":
@@ -205,8 +239,8 @@ class _Handler(JsonHandler):
         obj = self._json_body()
         if not isinstance(obj, dict):
             raise _HttpError(400, "event JSON must be an object")
-        event_id = self._insert_event(auth, obj)
-        self._respond(201, {"eventId": event_id})
+        status, body = self._insert_event(auth, obj)
+        self._respond(status, body)
 
     def _post_batch(self, auth: AuthData) -> None:
         """Per-event statuses; oversize batch rejected whole (reference
@@ -239,6 +273,7 @@ class _Handler(JsonHandler):
             )
 
             try:
+                _faults.fire("event.insert")
                 ids = self.server.storage.get_events().insert_batch(
                     [e for _p, _o, e in admitted],
                     auth.app_id,
@@ -249,6 +284,25 @@ class _Handler(JsonHandler):
                 # persisted events report 201 (a blanket failure would
                 # invite a full-batch retry that duplicates them)
                 ids = e.ids
+            except (StorageUnreachableError, _faults.FaultInjected) as e:
+                # full storage outage: spill every admitted event to the
+                # WAL, per-event 202 — accepted-and-durable, not failed
+                for pos, _obj, ev in admitted:
+                    try:
+                        wal_id = self.server.spill(
+                            ev, auth.app_id, auth.channel_id, e
+                        )
+                        results[pos] = {
+                            "status": 202,
+                            "message": "storage unavailable; event "
+                                       "accepted for replay",
+                            "walId": wal_id,
+                        }
+                    except _HttpError as he:
+                        results[pos] = {
+                            "status": he.status, "message": he.message
+                        }
+                ids = None
             except Exception as e:
                 for pos, _obj, _ev in admitted:
                     results[pos] = {"status": 503, "message": str(e)}
@@ -344,8 +398,8 @@ class _Handler(JsonHandler):
             # fields directly — a malformed payload is a 400, not a 500
             raise _HttpError(400, str(e))
         event_json = {k: v for k, v in event_json.items() if v is not None}
-        event_id = self._insert_event(auth, event_json)
-        self._respond(201, {"eventId": event_id})
+        status, body = self._insert_event(auth, event_json)
+        self._respond(status, body)
 
     # -- verb dispatch -----------------------------------------------------
     def do_GET(self):
@@ -368,12 +422,89 @@ class _Server(ThreadedServer):
         # records per-request counters/latency here; GET /metrics scrapes
         self.metrics = server_registry()
         self.metrics_label = "event"
+        # stale-credential cache (ISSUE 4): with remote metadata, a
+        # storage outage would otherwise break AUTH before the WAL spill
+        # could accept anything — known-good access keys and channel
+        # lists are served stale during the outage (refreshed on every
+        # successful lookup; a never-seen key still 503s, since granting
+        # it unverified would be an auth bypass)
+        self._auth_cache_lock = threading.Lock()
+        self._key_cache: dict = {}  # access key string → AccessKey row
+        self._channel_cache: dict[int, list] = {}  # app_id → [Channel]
+        # write-ahead spill (ISSUE 4)
+        self.wal: Optional[EventWAL] = (
+            EventWAL(config.wal_dir) if config.wal_dir else None
+        )
+        if self.wal is not None:
+            wal = self.wal
+            self.metrics.gauge_callback(
+                "event_wal_pending",
+                "events spilled to the WAL and not yet replayed",
+                lambda: float(wal.pending()),
+            )
+
+    def lookup_access_key(self, key: str):
+        """Access-key row, read through the stale-credential cache: a
+        storage outage serves the last known-good row (keeping ingestion
+        + WAL spill alive), never a never-verified one."""
+        try:
+            ak = self.storage.get_meta_data_access_keys().get(key)
+        except (StorageUnreachableError, _faults.FaultInjected) as e:
+            with self._auth_cache_lock:
+                cached = self._key_cache.get(key)
+            if cached is not None:
+                return cached
+            raise _HttpError(
+                503, f"storage unavailable, cannot authenticate: {e}"
+            )
+        with self._auth_cache_lock:
+            if ak is not None:
+                self._key_cache[key] = ak
+            else:
+                self._key_cache.pop(key, None)  # revocation wins
+        return ak
+
+    def lookup_channels(self, app_id: int) -> list:
+        try:
+            channels = self.storage.get_meta_data_channels().get_by_app_id(
+                app_id
+            )
+        except (StorageUnreachableError, _faults.FaultInjected) as e:
+            with self._auth_cache_lock:
+                cached = self._channel_cache.get(app_id)
+            if cached is not None:
+                return cached
+            raise _HttpError(
+                503, f"storage unavailable, cannot resolve channel: {e}"
+            )
+        with self._auth_cache_lock:
+            self._channel_cache[app_id] = channels
+        return channels
+
+    def spill(self, event: Event, app_id: int, channel_id: Optional[int],
+              cause: Exception) -> str:
+        """Durably spill one accepted event; returns the WAL receipt id.
+        Raises 503 when spilling is disabled — then an outage is still an
+        outage, just a loud one."""
+        if self.wal is None:
+            raise _HttpError(503, f"storage unavailable: {cause}")
+        wal_id = self.wal.append(event, app_id, channel_id)
+        self.metrics.counter(
+            "event_wal_spilled_total",
+            "events spilled to the local WAL during storage outages",
+        ).inc()
+        log.warning(
+            "storage unreachable (%s); event spilled to WAL as %s",
+            cause, wal_id,
+        )
+        return wal_id
 
 
 class EventServer(ServerProcess):
     """Process wrapper: start/stop the ingestion HTTP server (reference
     EventServerActor + Run, EventServer.scala:580-640). config.port=0
-    binds an ephemeral port (tests)."""
+    binds an ephemeral port (tests). A background thread replays the
+    WAL spill once storage answers again (ISSUE 4)."""
 
     _name = "event-server"
 
@@ -385,9 +516,72 @@ class EventServer(ServerProcess):
         super().__init__()
         self.storage = storage or Storage.get_instance()
         self.config = config or EventServerConfig()
+        self._replay_stop: Optional[threading.Event] = None
+        self._replay_thread: Optional[threading.Thread] = None
 
     def _make_server(self) -> _Server:
         return _Server(
             (self.config.ip, self.config.port), self.storage, self.config
         )
     # log shipping (config.log_url) attaches/detaches in ServerProcess
+
+    def start(self) -> int:
+        port = super().start()
+        if self._server is not None and self._server.wal is not None:
+            self._replay_stop = threading.Event()
+            self._replay_thread = threading.Thread(
+                target=self._replay_loop, name="event-wal-replay", daemon=True
+            )
+            self._replay_thread.start()
+        return port
+
+    def stop(self) -> None:
+        if self._replay_stop is not None:
+            self._replay_stop.set()
+            if self._replay_thread is not None:
+                self._replay_thread.join(timeout=5)
+            self._replay_stop = None
+            self._replay_thread = None
+        server = self._server
+        super().stop()
+        if server is not None and server.wal is not None:
+            server.wal.close()
+
+    # -- WAL replay --------------------------------------------------------
+    def _replay_loop(self) -> None:
+        assert self._replay_stop is not None
+        while not self._replay_stop.wait(self.config.wal_replay_interval_s):
+            try:
+                self.replay_wal_once()
+            except Exception:
+                log.exception("WAL replay pass failed; will retry")
+
+    def replay_wal_once(self) -> int:
+        """One ordered replay pass; returns how many events landed.
+        Public so tests (and operators via pio-shell) can drain the WAL
+        without waiting on the timer."""
+        server = self._server
+        if server is None or server.wal is None or not server.wal.pending():
+            return 0
+        store = self.storage.get_events()
+        insert_with_req_id = getattr(store, "insert_with_req_id", None)
+
+        def _insert(event, app_id, channel_id, req_id):
+            # remote backend: the stable req_id makes the replay insert
+            # idempotent end-to-end (daemon-side dedupe); embedded
+            # backends apply directly — the local ack file is the dedupe
+            if insert_with_req_id is not None:
+                insert_with_req_id(event, app_id, channel_id, req_id)
+            else:
+                store.insert(event, app_id, channel_id)
+
+        replayed, err = server.wal.replay(_insert)
+        if replayed:
+            server.metrics.counter(
+                "event_wal_replayed_total",
+                "spilled events successfully replayed into storage",
+            ).inc(replayed)
+            log.info("WAL replay: %d event(s) landed", replayed)
+        if err is not None:
+            log.debug("WAL replay stopped (storage still down?): %s", err)
+        return replayed

@@ -24,9 +24,10 @@ import threading
 from collections import OrderedDict
 from typing import Any, Optional
 
+import predictionio_tpu.resilience.deadline as _deadline
 from predictionio_tpu.data.storage import wire
 from predictionio_tpu.obs import server_registry
-from predictionio_tpu.utils.http import JsonHandler, ThreadedServer
+from predictionio_tpu.utils.http import HttpError, JsonHandler, ThreadedServer
 from predictionio_tpu.data.storage.registry import Storage
 
 log = logging.getLogger(__name__)
@@ -99,13 +100,33 @@ class _Handler(JsonHandler):
             self._serve_debug_traces()
         elif self.path.split("?")[0] == "/debug/profile":
             self._serve_debug_profile()
+        elif self.path.split("?")[0] == "/debug/faults":
+            self._serve_debug_faults()
         else:
             self._reply(404, {"ok": False, "error": "not found"})
 
     def do_POST(self):
         self._drain_body()
+        if self.path.split("?")[0] == "/debug/faults":
+            try:
+                self._serve_debug_faults_set()
+            except HttpError as e:
+                self._respond(e.status, {"message": e.message})
+            return
         if self.path != "/rpc":
             self._reply(404, {"ok": False, "error": "not found"})
+            return
+        # deadline shedding (ISSUE 4): the client's remaining budget rode
+        # in on X-PIO-Deadline (JsonHandler set the ambient deadline) —
+        # an RPC whose caller already gave up must not occupy the DAO
+        if _deadline.expired():
+            # "shed" lets the client re-raise this as DeadlineExceeded
+            # instead of a generic StorageError (a clean shed must not
+            # surface as a 500 upstream)
+            self._reply(200, {
+                "ok": False, "shed": True,
+                "error": "deadline expired; rpc shed",
+            })
             return
         auth_key = self.server.auth_key  # type: ignore[attr-defined]
         if auth_key and self.headers.get("X-PIO-Storage-Key") != auth_key:

@@ -53,6 +53,13 @@ class StorageUnreachableError(StorageError):
     the store is down."""
 
 
+class StorageCircuitOpenError(StorageUnreachableError):
+    """Fail-fast rejection: the endpoint's circuit breaker is open. A
+    subclass of StorageUnreachableError so every transient-failure
+    handler (sharded failover, the event server's WAL spill) treats it
+    as the outage it represents — without a network round trip."""
+
+
 # ---------------------------------------------------------------------------
 # Event store
 # ---------------------------------------------------------------------------

@@ -20,14 +20,18 @@ the stdlib client — no third-party driver needed.
 from __future__ import annotations
 
 import http.client
+import os
 import socket
 import json
 import threading
+import time
 import uuid
 from typing import Any, Iterator, Optional, Sequence
 
 import predictionio_tpu.obs.spans as _spans
 import predictionio_tpu.obs.tracing as _tracing
+import predictionio_tpu.resilience.deadline as _deadline
+import predictionio_tpu.resilience.faults as _faults
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base, wire
 from predictionio_tpu.data.storage.base import (
@@ -39,13 +43,31 @@ from predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     EventQuery,
     Model,
+    StorageCircuitOpenError,
     StorageError,
     StorageUnreachableError,
 )
+from predictionio_tpu.resilience.breaker import get_breaker
+from predictionio_tpu.resilience.retry import RetryPolicy
+
+
+def _cfg(config: dict[str, str], key: str, env: str, default: str) -> str:
+    return config.get(key) or os.environ.get(env) or default
 
 
 class RemoteClient:
-    """Thread-safe RPC client with per-thread persistent connections."""
+    """Thread-safe RPC client with per-thread persistent connections.
+
+    Resilience (ISSUE 4): each call retries with exponential backoff +
+    jitter — capped by the caller's propagated deadline when one is
+    active — behind a per-endpoint circuit breaker shared process-wide.
+    While the breaker is open, calls fail fast with
+    StorageCircuitOpenError (no socket touched); after the cooldown one
+    probe call decides recovery. Knobs per source config or env:
+    RETRY_ATTEMPTS / PIO_STORAGE_RETRY_ATTEMPTS,
+    BREAKER_THRESHOLD / PIO_BREAKER_THRESHOLD,
+    BREAKER_COOLDOWN / PIO_BREAKER_COOLDOWN (seconds).
+    """
 
     def __init__(self, config: dict[str, str]):
         self.host = config.get("HOST", "127.0.0.1")
@@ -53,6 +75,24 @@ class RemoteClient:
         self.auth_key = config.get("AUTH_KEY")
         self.timeout = float(config.get("TIMEOUT", "30"))
         self._local = threading.local()
+        self.retry = RetryPolicy(
+            max_attempts=int(
+                _cfg(config, "RETRY_ATTEMPTS", "PIO_STORAGE_RETRY_ATTEMPTS", "3")
+            ),
+            base_delay=float(
+                _cfg(config, "RETRY_BASE_DELAY", "PIO_STORAGE_RETRY_BASE_DELAY",
+                     "0.05")
+            ),
+        )
+        self.breaker = get_breaker(
+            f"storage:{self.host}:{self.port}",
+            failure_threshold=int(
+                _cfg(config, "BREAKER_THRESHOLD", "PIO_BREAKER_THRESHOLD", "5")
+            ),
+            cooldown_s=float(
+                _cfg(config, "BREAKER_COOLDOWN", "PIO_BREAKER_COOLDOWN", "10")
+            ),
+        )
 
     def _conn(self) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
@@ -71,7 +111,10 @@ class RemoteClient:
             self._local.conn = conn
         return conn
 
-    def call(self, dao: str, method: str, *args: Any, **kwargs: Any) -> Any:
+    def call(
+        self, dao: str, method: str, *args: Any,
+        _req_id: Optional[str] = None, **kwargs: Any,
+    ) -> Any:
         req: dict[str, Any] = {
             "dao": dao,
             "method": method,
@@ -84,8 +127,12 @@ class RemoteClient:
         # replays the recorded outcome instead of re-executing. For inserts
         # that prevents duplicate rows; for delete/update it prevents the
         # retry from observing its own first application (e.g. a re-executed
-        # delete returning False) (ADVICE r2 medium).
-        if not method.startswith(("get", "find")):
+        # delete returning False) (ADVICE r2 medium). Callers with their
+        # own durable retry loop (the event WAL replayer) pass `_req_id`
+        # so re-sends across process restarts dedupe too.
+        if _req_id is not None:
+            req["req_id"] = _req_id
+        elif not method.startswith(("get", "find")):
             req["req_id"] = uuid.uuid4().hex
         body = json.dumps(req, separators=(",", ":")).encode()
         headers = {"Content-Type": "application/json"}
@@ -104,28 +151,103 @@ class RemoteClient:
         ) as sp:
             headers["X-Request-ID"] = _tracing.current_trace_id()
             headers["X-Parent-Span"] = sp.span_id
-            for attempt in (0, 1):
-                conn = self._conn()
-                try:
-                    conn.request("POST", "/rpc", body=body, headers=headers)
-                    resp = conn.getresponse()
-                    payload = json.loads(resp.read())
-                    break
-                except (http.client.HTTPException, OSError):
-                    # Covers both pre-delivery failures (send on a dead
-                    # socket, idle-closed keep-alive surfacing as a
-                    # zero-byte response) and lost responses; the req_id
-                    # dedupe above makes the single retry safe in every
-                    # case.
-                    conn.close()
-                    self._local.conn = None
-                    if attempt:
-                        raise StorageUnreachableError(
-                            f"storage server {self.host}:{self.port} "
-                            f"unreachable"
+            if not self.breaker.allow():
+                sp.attrs["breaker_state"] = self.breaker.state
+                raise StorageCircuitOpenError(
+                    f"storage server {self.host}:{self.port}: circuit "
+                    f"breaker open (failing fast)"
+                )
+            # From here on, allow() may have claimed the half-open probe
+            # slot: EVERY exit must either record a verdict or release
+            # the probe, or the breaker wedges in fail-fast forever.
+            verdict_recorded = False
+            try:
+                # per-call budget: the caller's propagated deadline bounds
+                # the whole retry loop; with none active, the socket
+                # timeout is the only clock. The remaining budget rides to
+                # the daemon as X-PIO-Deadline so it sheds expired work.
+                rem = _deadline.remaining()
+                if rem is not None:
+                    if rem <= 0:
+                        raise _deadline.DeadlineExceeded(
+                            f"storage rpc {dao}.{method}: deadline expired "
+                            f"before dispatch"
                         )
+                    headers[_deadline.HEADER] = str(max(0, int(rem * 1000)))
+                budget = (
+                    time.monotonic() + min(self.timeout, rem)
+                    if rem is not None else None
+                )
+
+                def _attempt(_i: int) -> Any:
+                    action = _faults.fire("storage.rpc", corruptable=True)
+                    conn = self._conn()
+                    try:
+                        conn.request(
+                            "POST", "/rpc", body=body, headers=headers
+                        )
+                        resp = conn.getresponse()
+                        payload = json.loads(resp.read())
+                    except (http.client.HTTPException, OSError):
+                        # Covers both pre-delivery failures (send on a
+                        # dead socket, idle-closed keep-alive surfacing
+                        # as a zero-byte response) and lost responses;
+                        # the req_id dedupe above makes retries safe in
+                        # every case.
+                        conn.close()
+                        self._local.conn = None
+                        raise
+                    if action == "corrupt":
+                        raise StorageError(
+                            f"storage rpc {dao}.{method} failed: "
+                            f"fault-injected corrupt response"
+                        )
+                    return payload
+
+                def _on_retry(i: int, _e: BaseException) -> None:
                     sp.attrs["retried"] = True
+                    sp.attrs["retries"] = i + 1
+
+                try:
+                    payload = self.retry.call(
+                        _attempt,
+                        retry_on=(
+                            http.client.HTTPException, OSError,
+                            _faults.FaultInjected,
+                        ),
+                        deadline=budget,
+                        on_retry=_on_retry,
+                    )
+                except (
+                    http.client.HTTPException, OSError,
+                    _faults.FaultInjected,
+                ) as e:
+                    self.breaker.record_failure()
+                    verdict_recorded = True
+                    sp.attrs["breaker_state"] = self.breaker.state
+                    raise StorageUnreachableError(
+                        f"storage server {self.host}:{self.port} "
+                        f"unreachable: {e}"
+                    ) from e
+                # the endpoint answered — breaker-wise that is health,
+                # even if the answer is an application-level error
+                self.breaker.record_success()
+                verdict_recorded = True
+            finally:
+                if not verdict_recorded:
+                    # aborted without touching the endpoint (deadline
+                    # expiry, injected corruption, garbage response):
+                    # free a claimed probe slot, change nothing else
+                    self.breaker.release_probe()
             if not payload.get("ok"):
+                if payload.get("shed"):
+                    # the daemon refused the work because OUR deadline
+                    # expired in transit — surface it as the deadline
+                    # condition it is, not a generic storage error
+                    raise _deadline.DeadlineExceeded(
+                        f"storage rpc {dao}.{method}: "
+                        f"{payload.get('error')}"
+                    )
                 raise StorageError(
                     f"storage rpc {dao}.{method} failed: "
                     f"{payload.get('error')}"
@@ -133,8 +255,13 @@ class RemoteClient:
             return wire.decode(payload.get("result"))
 
     def ping(self) -> bool:
+        """Liveness probe on a short-lived DEDICATED connection: probing
+        through the pooled data connection can poison it for the next
+        RPC when the peer socket is half-dead (ISSUE 4 satellite), and a
+        2 s timeout keeps health sweeps fast even when the host blackholes
+        packets (the pooled 30 s timeout is sized for data calls)."""
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=2)
         try:
-            conn = self._conn()
             conn.request("GET", "/health")
             resp = conn.getresponse()
             body = resp.read()
@@ -143,8 +270,9 @@ class RemoteClient:
             health = json.loads(body)
             return isinstance(health, dict) and health.get("status") == "alive"
         except (http.client.HTTPException, OSError, ValueError):
-            self._local.conn = None
             return False
+        finally:
+            conn.close()
 
 
 def CLIENT_FACTORY(config: dict[str, str]) -> RemoteClient:
@@ -180,6 +308,18 @@ class RemoteEventStore(_RemoteDao, base.EventStore):
         channel_id: Optional[int] = None,
     ) -> list[str]:
         return self._call("insert_batch", list(events), app_id, channel_id)
+
+    def insert_with_req_id(
+        self, event: Event, app_id: int, channel_id: Optional[int],
+        req_id: str,
+    ) -> str:
+        """Insert with a caller-stable request id: the WAL replayer's
+        re-sends (including across process restarts) hit the daemon's
+        req-id dedupe and replay the recorded outcome instead of
+        duplicating the row (ISSUE 4)."""
+        return self._client.call(
+            self.DAO, "insert", event, app_id, channel_id, _req_id=req_id
+        )
 
     def delete(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None

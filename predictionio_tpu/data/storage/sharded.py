@@ -365,6 +365,27 @@ class ShardedEventStore(base.EventStore):
         self._replicate([(event.with_id(eid), home)], app_id, channel_id)
         return eid
 
+    def insert_with_req_id(
+        self, event: Event, app_id: int, channel_id: Optional[int],
+        req_id: str,
+    ) -> str:
+        """Caller-stable req_id insert for the event-WAL replayer: routed
+        to the home shard's own req-id-deduped insert when the child
+        supports it (remote daemons do), so a replay re-send after a
+        crash cannot duplicate the row on the shard either. Children
+        without the capability fall back to plain insert — the WAL's ack
+        file remains the only dedupe there."""
+        home = self._for_entity(event.entity_id)
+        child = self._stores[home]
+        fn = getattr(child, "insert_with_req_id", None)
+        if fn is None:
+            return self.insert(event, app_id, channel_id)
+        eid = self._shard_call(
+            home, fn, event, app_id, channel_id, req_id, retries=0,
+        )
+        self._replicate([(event.with_id(eid), home)], app_id, channel_id)
+        return eid
+
     def _replicate(
         self,
         primaries: Sequence[tuple[Event, int]],  # (event WITH id, home)

@@ -1,0 +1,43 @@
+"""End-to-end resilience: fault injection, retry, circuit breaking,
+deadline propagation, and the event write-ahead spill (ISSUE 4).
+
+The reference is a Lambda-architecture serving stack whose processes must
+keep answering under partial failure; this package is the one place its
+failure-handling policy lives, threaded through every network and device
+boundary:
+
+- `faults`   — deterministic fault-injection registry; the backbone that
+               makes every other behavior here testable in-process.
+- `retry`    — exponential backoff + jitter under a per-call deadline
+               budget (replaces the old fixed one-retry in the storage
+               client).
+- `breaker`  — per-endpoint circuit breaker (closed/open/half-open with
+               a recovery probe); state transitions emit metrics.
+- `deadline` — `X-PIO-Deadline` header ⇄ ContextVar plumbing so a
+               caller's remaining budget rides along every hop and
+               expired work is shed before it wastes device time.
+- `wal`      — durable local write-ahead log the event server spills
+               accepted events into when storage is unreachable, with
+               ordered replay and req-id dedupe (zero event loss).
+"""
+
+from predictionio_tpu.resilience.breaker import (
+    CircuitBreaker,
+    CircuitOpenError,
+    get_breaker,
+)
+from predictionio_tpu.resilience.deadline import DeadlineExceeded
+from predictionio_tpu.resilience.faults import FaultInjected, FaultSpec
+from predictionio_tpu.resilience.retry import RetryPolicy
+from predictionio_tpu.resilience.wal import EventWAL
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceeded",
+    "EventWAL",
+    "FaultInjected",
+    "FaultSpec",
+    "RetryPolicy",
+    "get_breaker",
+]

@@ -1,0 +1,174 @@
+"""Per-endpoint circuit breaker (closed → open → half-open) (ISSUE 4).
+
+A breaker wraps one remote endpoint. While CLOSED every call passes;
+`failure_threshold` consecutive failures trip it OPEN, after which calls
+fail fast (no network, no retry budget burned) for `cooldown_s`. The
+first call after the cooldown becomes the HALF-OPEN probe: its success
+closes the breaker, its failure re-opens it for another cooldown. Only
+one probe flies at a time — concurrent callers keep failing fast until
+the probe reports.
+
+Every state transition lands on the metrics registry:
+`resilience_breaker_state{endpoint}` (0 closed / 1 open / 2 half-open)
+and `resilience_breaker_transitions_total{endpoint,state}` — the
+acceptance surface `/metrics` scrapes. Call sites additionally stamp the
+state onto their spans (`storage.rpc` carries `breaker_state`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+_STATE_VALUE = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}
+
+
+class CircuitOpenError(Exception):
+    """Fail-fast rejection: the endpoint's breaker is open."""
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = 5,
+        cooldown_s: float = 10.0,
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = name
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_inflight = False
+        if registry is None:
+            from predictionio_tpu.obs.registry import get_default_registry
+
+            registry = get_default_registry()
+        self._state_gauge = registry.gauge(
+            "resilience_breaker_state",
+            "circuit breaker state (0 closed, 1 open, 2 half-open)",
+            ("endpoint",),
+        )
+        self._transitions = registry.counter(
+            "resilience_breaker_transitions_total",
+            "circuit breaker state transitions, by destination state",
+            ("endpoint", "state"),
+        )
+        self._state_gauge.set(0.0, endpoint=name)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # surface the pending half-open without requiring an allow()
+            if (
+                self._state == OPEN
+                and self._opened_at is not None
+                and self._clock() - self._opened_at >= self.cooldown_s
+            ):
+                return HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        """True when a call may proceed (including as the recovery probe)."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            now = self._clock()
+            if self._state == OPEN:
+                if (
+                    self._opened_at is not None
+                    and now - self._opened_at >= self.cooldown_s
+                ):
+                    self._transition(HALF_OPEN)
+                    self._probe_inflight = True
+                    return True
+                return False
+            # HALF_OPEN: exactly one probe at a time
+            if not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def release_probe(self) -> None:
+        """Abandon an allowed call WITHOUT an endpoint verdict — e.g. the
+        caller's own deadline expired before any network I/O, or a local
+        parse error aborted the attempt. Frees the half-open probe slot
+        so recovery probing can continue; without this, an exception
+        escaping between allow() and record_*() would latch the probe
+        and wedge the breaker in fail-fast forever."""
+        with self._lock:
+            self._probe_inflight = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probe_inflight = False
+            if self._state == HALF_OPEN:
+                self._transition(OPEN)  # failed probe: back to cooldown
+                return
+            self._failures += 1
+            if self._state == CLOSED and self._failures >= self.failure_threshold:
+                self._transition(OPEN)
+
+    def _transition(self, to: str) -> None:
+        # caller holds self._lock
+        self._state = to
+        if to == OPEN:
+            self._opened_at = self._clock()
+        elif to == CLOSED:
+            self._opened_at = None
+            self._failures = 0
+        try:
+            self._state_gauge.set(_STATE_VALUE[to], endpoint=self.name)
+            self._transitions.inc(endpoint=self.name, state=to)
+        except Exception:
+            pass  # metrics hiccups must never break the call path
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Convenience wrapper: allow-gate, run, record the outcome."""
+        if not self.allow():
+            raise CircuitOpenError(f"circuit breaker {self.name} is open")
+        try:
+            result = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+
+_breakers: dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def get_breaker(name: str, **kwargs) -> CircuitBreaker:
+    """Process-global breaker per endpoint name: every client in the
+    process shares one view of the endpoint's health (kwargs configure
+    only the first construction)."""
+    with _breakers_lock:
+        b = _breakers.get(name)
+        if b is None:
+            b = _breakers[name] = CircuitBreaker(name, **kwargs)
+        return b
+
+
+def reset_breakers() -> None:
+    """Drop all process-global breakers (tests)."""
+    with _breakers_lock:
+        _breakers.clear()

@@ -1,0 +1,249 @@
+"""Deterministic fault-injection registry (ISSUE 4 tentpole backbone).
+
+Named fault points sit at the boundaries the chaos tests need to break:
+
+  ==================  =====================================================
+  point               fires in
+  ==================  =====================================================
+  ``storage.rpc``     RemoteClient.call, before each network attempt
+  ``event.insert``    event server, before the storage write
+  ``dispatch.device`` micro-batch dispatcher, before batch_predict
+  ``model.load``      deploy-server runtime build, before model rehydration
+  ==================  =====================================================
+
+Each point carries at most one :class:`FaultSpec` — mode ``error``
+(raise :class:`FaultInjected`), ``delay`` (sleep ``param`` seconds, then
+proceed), or ``corrupt`` (the call site substitutes a garbled result; a
+site that cannot corrupt raises instead) — firing with ``probability``
+decided by a **per-point seeded RNG**, so a chaos run replays the exact
+same fault sequence for the same seed and call order.
+
+Configure three ways:
+
+- env at process start: ``PIO_FAULTS=storage.rpc:error:0.2`` (comma-
+  separated specs, grammar ``point:mode:prob[:param]``; optional
+  ``PIO_FAULTS_SEED=N`` for determinism across processes),
+- the guarded ``POST /debug/faults`` admin endpoint on any server
+  (requires ``PIO_FAULTS_ADMIN=1`` on the server process),
+- `pio faults list|set|clear` from the console.
+
+Inert by default: with no spec installed, :func:`fire` is one dict check
+— the RPC hot path pays nothing (guarded by a CI latency check).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+FAULT_POINTS = (
+    "storage.rpc",
+    "event.insert",
+    "dispatch.device",
+    "model.load",
+)
+
+MODES = ("error", "delay", "corrupt")
+
+
+class FaultInjected(Exception):
+    """An injected failure (distinguishable from organic errors)."""
+
+
+class FaultSpecError(ValueError):
+    """A malformed fault spec string or field."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One fault point's behavior. `param` is the sleep seconds for mode
+    ``delay`` (ignored otherwise)."""
+
+    point: str
+    mode: str
+    probability: float
+    param: float = 0.05
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.point not in FAULT_POINTS:
+            raise FaultSpecError(
+                f"unknown fault point {self.point!r} "
+                f"(known: {', '.join(FAULT_POINTS)})"
+            )
+        if self.mode not in MODES:
+            raise FaultSpecError(
+                f"unknown fault mode {self.mode!r} (known: {', '.join(MODES)})"
+            )
+        if not 0.0 <= self.probability <= 1.0:
+            raise FaultSpecError(
+                f"fault probability must be in [0, 1], got {self.probability}"
+            )
+        if self.param < 0:
+            raise FaultSpecError("fault param must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "point": self.point,
+            "mode": self.mode,
+            "probability": self.probability,
+            "param": self.param,
+            "seed": self.seed,
+        }
+
+
+def parse_spec(text: str, seed: Optional[int] = None) -> FaultSpec:
+    """``point:mode:prob[:param]`` → FaultSpec."""
+    parts = text.strip().split(":")
+    if len(parts) not in (3, 4):
+        raise FaultSpecError(
+            f"fault spec {text!r} is not point:mode:prob[:param]"
+        )
+    try:
+        prob = float(parts[2])
+        param = float(parts[3]) if len(parts) == 4 else 0.05
+    except ValueError as e:
+        raise FaultSpecError(f"fault spec {text!r}: {e}")
+    return FaultSpec(parts[0], parts[1], prob, param, seed)
+
+
+def parse_specs(text: str, seed: Optional[int] = None) -> list[FaultSpec]:
+    """Comma-separated spec list (the ``PIO_FAULTS`` grammar)."""
+    return [parse_spec(p, seed) for p in text.split(",") if p.strip()]
+
+
+class FaultRegistry:
+    """Thread-safe point → spec map with per-point deterministic RNGs.
+
+    The specs dict is replaced wholesale on every mutation so `fire` can
+    read it without taking the lock — the inert fast path is one
+    attribute load + truthiness check."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._specs: dict[str, FaultSpec] = {}
+        self._rngs: dict[str, random.Random] = {}
+
+    def install(self, spec: FaultSpec) -> None:
+        with self._lock:
+            specs = dict(self._specs)
+            specs[spec.point] = spec
+            self._rngs[spec.point] = random.Random(spec.seed)
+            self._specs = specs
+
+    def clear(self, point: Optional[str] = None) -> None:
+        with self._lock:
+            if point is None:
+                self._specs = {}
+                self._rngs.clear()
+            else:
+                specs = dict(self._specs)
+                specs.pop(point, None)
+                self._rngs.pop(point, None)
+                self._specs = specs
+
+    def specs(self) -> list[dict[str, Any]]:
+        return [s.to_dict() for s in self._specs.values()]
+
+    def active(self) -> bool:
+        return bool(self._specs)
+
+    def fire(self, point: str, corruptable: bool = False) -> Optional[str]:
+        """Evaluate the fault point. Returns None (no fault), ``"delay"``
+        (after sleeping), or ``"corrupt"`` (the caller substitutes a
+        garbled result); raises :class:`FaultInjected` for mode ``error``
+        — and for ``corrupt`` when the site can't corrupt its result."""
+        specs = self._specs  # lock-free snapshot read; {} when inert
+        if not specs:
+            return None
+        spec = specs.get(point)
+        if spec is None:
+            return None
+        with self._lock:
+            rng = self._rngs.get(point)
+            roll = rng.random() if rng is not None else random.random()
+        if roll >= spec.probability:
+            return None
+        self._count(point, spec.mode)
+        if spec.mode == "delay":
+            time.sleep(spec.param)
+            return "delay"
+        if spec.mode == "corrupt" and corruptable:
+            return "corrupt"
+        raise FaultInjected(f"injected {spec.mode} fault at {point}")
+
+    @staticmethod
+    def _count(point: str, mode: str) -> None:
+        # lazy import: the registry must stay importable (and inert-fast)
+        # without dragging obs into processes that never fault
+        try:
+            from predictionio_tpu.obs.registry import get_default_registry
+
+            get_default_registry().counter(
+                "faults_injected_total",
+                "injected faults fired, by point and mode",
+                ("point", "mode"),
+            ).inc(point=point, mode=mode)
+        except Exception:
+            pass
+
+    def configure_from_env(self, env: Optional[dict] = None) -> None:
+        """Apply ``PIO_FAULTS`` / ``PIO_FAULTS_SEED`` from `env`.
+        Raises FaultSpecError on a malformed grammar — explicit callers
+        (tests, tools) want the loud failure; the import-time invocation
+        below downgrades it to a warning so a typo'd env var cannot
+        crash every server and the CLI alike."""
+        env = env if env is not None else os.environ
+        text = env.get("PIO_FAULTS", "")
+        if not text:
+            return
+        seed_s = env.get("PIO_FAULTS_SEED")
+        try:
+            seed = int(seed_s) if seed_s else None
+        except ValueError:
+            raise FaultSpecError(
+                f"PIO_FAULTS_SEED must be an integer, got {seed_s!r}"
+            )
+        for spec in parse_specs(text, seed):
+            self.install(spec)
+
+
+_default = FaultRegistry()
+try:
+    _default.configure_from_env()
+except FaultSpecError as _e:
+    import logging as _logging
+
+    _logging.getLogger(__name__).warning(
+        "ignoring malformed PIO_FAULTS env (%s); fault registry stays "
+        "inert — fix the spec and restart, or use `pio faults set`", _e,
+    )
+
+
+def registry() -> FaultRegistry:
+    """The process-wide registry every fault point fires against."""
+    return _default
+
+
+def fire(point: str, corruptable: bool = False) -> Optional[str]:
+    return _default.fire(point, corruptable)
+
+
+def install(spec: FaultSpec) -> None:
+    _default.install(spec)
+
+
+def clear(point: Optional[str] = None) -> None:
+    _default.clear(point)
+
+
+def specs() -> list[dict[str, Any]]:
+    return _default.specs()
+
+
+def active() -> bool:
+    return _default.active()

@@ -1,0 +1,215 @@
+"""Durable local write-ahead spill for accepted events (ISSUE 4).
+
+When the event server's storage endpoint is unreachable (breaker open,
+retries exhausted), accepted events land here instead of being dropped:
+one JSON line per record in an append-only segment file, fsync'd before
+the server acks 202. A background replayer drains segments **in arrival
+order** once storage recovers.
+
+Zero loss, zero duplicates:
+
+- every record carries a `req_id` minted at spill time; the replayer
+  hands it to the storage client, whose RPC-level dedupe (the existing
+  req-id machinery in the storage daemon) makes a replayed insert
+  idempotent even if the replayer crashed between applying the write
+  and acking it locally;
+- each successful replay appends the req_id to the segment's `.ack`
+  sidecar (fsync'd), so a restart resumes exactly where it stopped
+  instead of re-sending the whole segment;
+- a fully-acked segment (and its sidecar) is deleted.
+
+Layout under the WAL directory::
+
+    wal-<epoch_ms>-<seq>-<pid>.jsonl      # records: {"req_id", "app_id",
+                                          #   "channel_id", "event", "ts"}
+    wal-<epoch_ms>-<seq>-<pid>.jsonl.ack  # one replayed req_id per line
+
+Segment names lead with a fixed-width epoch-milliseconds stamp so the
+lexicographic directory sort IS creation order — including across
+process restarts, where a pid-first scheme would interleave old and new
+segments by pid digit count.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+
+class EventWAL:
+    def __init__(self, directory: str, fsync: bool = True):
+        self.dir = directory
+        self.fsync = fsync
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()  # append path + pending counter
+        self._replay_lock = threading.Lock()  # one replayer at a time
+        self._seq = 0
+        self._current_path: Optional[str] = None
+        self._current_file = None
+        self._pending = self._scan_pending()
+
+    # -- bookkeeping -------------------------------------------------------
+    def _segments(self) -> list[str]:
+        """Segment paths, oldest first: the fixed-width epoch-ms name
+        prefix makes the lexicographic sort creation-ordered, across
+        restarts and pids alike."""
+        try:
+            names = sorted(
+                n for n in os.listdir(self.dir)
+                if n.startswith("wal-") and n.endswith(".jsonl")
+            )
+        except FileNotFoundError:
+            return []
+        return [os.path.join(self.dir, n) for n in names]
+
+    @staticmethod
+    def _read_records(path: str) -> list[dict[str, Any]]:
+        records = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        # torn tail write from a crash mid-append: the
+                        # record was never acked to the client, skip it
+                        continue
+        except FileNotFoundError:
+            pass
+        return records
+
+    @staticmethod
+    def _read_acks(path: str) -> set[str]:
+        try:
+            with open(path + ".ack") as f:
+                return {line.strip() for line in f if line.strip()}
+        except FileNotFoundError:
+            return set()
+
+    def _scan_pending(self) -> int:
+        n = 0
+        for seg in self._segments():
+            acked = self._read_acks(seg)
+            n += sum(
+                1 for r in self._read_records(seg)
+                if r.get("req_id") not in acked
+            )
+        return n
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    # -- spill -------------------------------------------------------------
+    def append(
+        self, event: Any, app_id: int, channel_id: Optional[int]
+    ) -> str:
+        """Spill one admitted event; returns its replay req_id. The
+        record is flushed (and fsync'd) before return — the 202 ack the
+        caller sends is a durability promise."""
+        req_id = uuid.uuid4().hex
+        rec = {
+            "req_id": req_id,
+            "app_id": app_id,
+            "channel_id": channel_id,
+            "event": event.to_json_dict(),
+            "ts": round(time.time(), 3),
+        }
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            if self._current_file is None:
+                self._seq += 1
+                self._current_path = os.path.join(
+                    self.dir,
+                    f"wal-{int(time.time() * 1000):015d}"
+                    f"-{self._seq:06d}-{os.getpid()}.jsonl",
+                )
+                self._current_file = open(self._current_path, "a")
+            self._current_file.write(line)
+            self._current_file.flush()
+            if self.fsync:
+                os.fsync(self._current_file.fileno())
+            self._pending += 1
+        return req_id
+
+    def _rotate(self) -> None:
+        with self._lock:
+            if self._current_file is not None:
+                self._current_file.close()
+                self._current_file = None
+                self._current_path = None
+
+    # -- replay ------------------------------------------------------------
+    def replay(
+        self,
+        insert_fn: Callable[[Any, int, Optional[int], str], Any],
+        on_replayed: Optional[Callable[[dict], None]] = None,
+    ) -> tuple[int, Optional[Exception]]:
+        """Drain pending records in order through ``insert_fn(event,
+        app_id, channel_id, req_id)``. Stops at the first failure (order
+        preservation — later events must not leapfrog a stuck one) and
+        returns ``(replayed_count, error_or_None)``."""
+        from predictionio_tpu.data.event import Event
+
+        if not self._replay_lock.acquire(blocking=False):
+            return (0, None)  # another replay pass is already running
+        try:
+            self._rotate()  # appends move to a fresh segment
+            replayed = 0
+            for seg in self._segments():
+                with self._lock:
+                    if seg == self._current_path:
+                        # re-opened by an append racing this replay pass:
+                        # deleting a live segment would drop its events —
+                        # the next pass picks it up after rotation
+                        continue
+                records = self._read_records(seg)
+                acked = self._read_acks(seg)
+                todo = [r for r in records if r["req_id"] not in acked]
+                if todo:
+                    ack_f = open(seg + ".ack", "a")
+                    try:
+                        for rec in todo:
+                            event = Event.from_json_dict(rec["event"])
+                            try:
+                                insert_fn(
+                                    event,
+                                    rec["app_id"],
+                                    rec.get("channel_id"),
+                                    rec["req_id"],
+                                )
+                            except Exception as e:
+                                return (replayed, e)
+                            ack_f.write(rec["req_id"] + "\n")
+                            ack_f.flush()
+                            if self.fsync:
+                                os.fsync(ack_f.fileno())
+                            with self._lock:
+                                self._pending -= 1
+                            replayed += 1
+                            if on_replayed is not None:
+                                try:
+                                    on_replayed(rec)
+                                except Exception:
+                                    pass
+                    finally:
+                        ack_f.close()
+                # fully acked: the segment is done, reclaim it
+                for path in (seg, seg + ".ack"):
+                    try:
+                        os.remove(path)
+                    except FileNotFoundError:
+                        pass
+            return (replayed, None)
+        finally:
+            self._replay_lock.release()
+
+    def close(self) -> None:
+        self._rotate()

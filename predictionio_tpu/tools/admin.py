@@ -45,6 +45,8 @@ class _Handler(JsonHandler):
                 self._serve_debug_traces()
             elif path == "/debug/profile":
                 self._serve_debug_profile()
+            elif path == "/debug/faults":
+                self._serve_debug_faults()
             elif path == "/cmd/app":
                 apps = self.storage.get_meta_data_apps().get_all()
                 keys = self.storage.get_meta_data_access_keys()
@@ -87,6 +89,8 @@ class _Handler(JsonHandler):
                 # guarded admin mirror of the query server's endpoint —
                 # useful when a train workflow shares this process
                 self._serve_profile_capture()
+            elif path == "/debug/faults":
+                self._serve_debug_faults_set()
             else:
                 raise HttpError(404, "Not Found")
         except HttpError as e:

@@ -13,6 +13,7 @@ Commands:
   train / deploy / eval / eventserver
   status / export / import
   metrics / trace list|show|export / profile list|show|capture
+  faults list|set|clear
 """
 
 from __future__ import annotations
@@ -643,6 +644,75 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_faults(args) -> int:
+    """`pio faults list|set|clear` — fault-injection registry of this
+    process, or of a running server via --url (its guarded
+    POST /debug/faults; the server needs PIO_FAULTS_ADMIN=1)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.resilience import faults
+
+    url = getattr(args, "url", None)
+    action = args.faults_action
+
+    def _remote(method: str, body: Optional[dict] = None) -> dict:
+        req = urllib.request.Request(
+            url.rstrip("/") + "/debug/faults",
+            data=_json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return _json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise CommandError(f"fault admin refused ({e.code}): {detail}")
+
+    def _print(specs: list) -> None:
+        if not specs:
+            print("[INFO] no active fault specs (registry inert)")
+            return
+        print(f"[INFO] {len(specs)} active fault spec(s):")
+        for s in specs:
+            extra = (
+                f" param={s['param']}" if s["mode"] == "delay" else ""
+            ) + (f" seed={s['seed']}" if s.get("seed") is not None else "")
+            print(
+                f"[INFO]   {s['point']}: {s['mode']} "
+                f"p={s['probability']}{extra}"
+            )
+
+    if action == "list":
+        specs = _remote("GET")["faults"] if url else faults.specs()
+        _print(specs)
+        return 0
+    if action == "set":
+        if url:
+            body: dict = {"set": args.spec}
+            if args.seed is not None:
+                body["seed"] = args.seed
+            _print(_remote("POST", body)["faults"])
+            return 0
+        try:
+            for spec in faults.parse_specs(args.spec, args.seed):
+                faults.install(spec)
+        except faults.FaultSpecError as e:
+            return _fail(str(e))
+        _print(faults.specs())
+        return 0
+    # clear
+    point = getattr(args, "point", None)
+    if url:
+        _print(_remote("POST", {"clear": point if point else True})["faults"])
+        return 0
+    faults.clear(point)
+    _print(faults.specs())
+    return 0
+
+
 def cmd_export(args) -> int:
     storage = _storage()
     app = _get_app(storage, args.app)
@@ -971,6 +1041,34 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--dir", help="local output directory (no --url)")
     pc.add_argument("--seconds", type=float, default=2.0)
     pc.set_defaults(func=cmd_profile)
+
+    # faults (ISSUE 4: chaos/fault-injection admin from the console)
+    s = sub.add_parser(
+        "faults",
+        help="fault-injection registry: list/set/clear named fault points "
+             "(local, or a running server via --url — needs "
+             "PIO_FAULTS_ADMIN=1 on the server)",
+    )
+    fsub = s.add_subparsers(dest="faults_action", required=True)
+    fl = fsub.add_parser("list", help="show active fault specs")
+    fl.add_argument("--url", help="server base URL, e.g. http://127.0.0.1:8000")
+    fl.set_defaults(func=cmd_faults)
+    fs = fsub.add_parser(
+        "set", help="install fault specs: point:mode:prob[:param][,...]"
+    )
+    fs.add_argument(
+        "spec",
+        help="e.g. storage.rpc:error:0.2 or dispatch.device:delay:1.0:0.05",
+    )
+    fs.add_argument("--seed", type=int, default=None,
+                    help="deterministic RNG seed for the fault points")
+    fs.add_argument("--url", help="server base URL")
+    fs.set_defaults(func=cmd_faults)
+    fc = fsub.add_parser("clear", help="clear one fault point, or all")
+    fc.add_argument("point", nargs="?", default=None,
+                    help="fault point to clear (default: all)")
+    fc.add_argument("--url", help="server base URL")
+    fc.set_defaults(func=cmd_faults)
 
     # export / import
     s = sub.add_parser(

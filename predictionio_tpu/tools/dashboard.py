@@ -34,6 +34,8 @@ class _Handler(JsonHandler):
                 self._serve_debug_traces()
             elif path == "/debug/profile":
                 self._serve_debug_profile()
+            elif path == "/debug/faults":
+                self._serve_debug_faults()
             elif path.startswith("/engine_instances/") and path.endswith(".html"):
                 iid = path[len("/engine_instances/"):-len(".html")]
                 inst = (
@@ -55,6 +57,17 @@ class _Handler(JsonHandler):
                 if inst is None:
                     raise HttpError(404, "Not Found")
                 self._respond(200, inst.evaluator_results_json or "{}")
+            else:
+                raise HttpError(404, "Not Found")
+        except HttpError as e:
+            self._respond(e.status, {"message": e.message})
+
+    def do_POST(self):
+        self._drain_body()
+        path = self.path.split("?")[0].rstrip("/")
+        try:
+            if path == "/debug/faults":
+                self._serve_debug_faults_set()
             else:
                 raise HttpError(404, "Not Found")
         except HttpError as e:

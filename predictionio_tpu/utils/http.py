@@ -19,6 +19,7 @@ from typing import Any, Optional
 import predictionio_tpu.obs.registry as _obs_registry
 import predictionio_tpu.obs.spans as _obs_spans
 import predictionio_tpu.obs.tracing as _obs_tracing
+import predictionio_tpu.resilience.deadline as _deadline
 
 log = logging.getLogger(__name__)
 
@@ -57,12 +58,16 @@ class JsonHandler(BaseHTTPRequestHandler):
         self._raw_body = b""
         self._trace_token = None
         self._span_token = None
+        self._deadline_token = None
         try:
             super().handle_one_request()
         finally:
-            # keep-alive reuses this thread: clear the request's trace id
-            # and span context so the next request (or idle logging)
-            # can't inherit them
+            # keep-alive reuses this thread: clear the request's trace id,
+            # span context and deadline so the next request (or idle
+            # logging) can't inherit them
+            if self._deadline_token is not None:
+                _deadline.reset(self._deadline_token)
+                self._deadline_token = None
             if self._span_token is not None:
                 _obs_spans.reset_current_span(self._span_token)
                 self._span_token = None
@@ -95,6 +100,13 @@ class JsonHandler(BaseHTTPRequestHandler):
             self._parent_span = psp if self._TRACE_ID_RE.fullmatch(psp) else None
             self._span_id = _obs_spans.new_span_id()
             self._span_token = _obs_spans.set_current_span(self._span_id)
+            # deadline propagation (ISSUE 4): X-PIO-Deadline carries the
+            # caller's REMAINING budget in ms; it becomes this request's
+            # ambient deadline so handlers can shed expired work and
+            # downstream RPC clients shrink their retry budgets to fit
+            dl = _deadline.parse_header(self.headers.get(_deadline.HEADER))
+            if dl is not None:
+                self._deadline_token = _deadline.set_deadline(dl)
         return ok
 
     # -- observability middleware ------------------------------------------
@@ -298,6 +310,54 @@ class JsonHandler(BaseHTTPRequestHandler):
             raise HttpError(409, str(e))
         self._respond(200, result)
 
+    def _serve_debug_faults(self) -> None:
+        """GET /debug/faults — the process's active fault specs. Every
+        JsonHandler server mounts this next to /metrics (read-only, so
+        ungated; mutation goes through the gated POST below)."""
+        from predictionio_tpu.resilience import faults as _faults
+
+        self._respond(200, {"faults": _faults.specs()})
+
+    def _serve_debug_faults_set(self) -> None:
+        """POST /debug/faults — install/clear fault specs at runtime.
+        Guarded like /debug/profile/capture: 403 unless the operator set
+        PIO_FAULTS_ADMIN=1 on the server process. Body:
+        {"set": "point:mode:prob[:param][,...]", "seed": N} and/or
+        {"clear": "point" | true}."""
+        import os as _os
+
+        from predictionio_tpu.resilience import faults as _faults
+
+        if not _os.environ.get("PIO_FAULTS_ADMIN"):
+            self._respond(403, {
+                "message": "fault-injection admin is disabled: set "
+                           "PIO_FAULTS_ADMIN=1 on this server to enable it"
+            })
+            return
+        body = self._json_body()
+        if not isinstance(body, dict):
+            raise HttpError(400, "fault admin body must be a JSON object")
+        # validate the whole request BEFORE mutating anything: a
+        # malformed `set` must 400 without having executed the `clear`
+        spec_text = body.get("set")
+        specs = []
+        if spec_text:
+            seed = body.get("seed")
+            try:
+                specs = _faults.parse_specs(
+                    spec_text, int(seed) if seed is not None else None
+                )
+            except (_faults.FaultSpecError, TypeError, ValueError) as e:
+                raise HttpError(400, str(e))
+        clear = body.get("clear")
+        if clear is True:
+            _faults.clear()
+        elif isinstance(clear, str):
+            _faults.clear(clear)
+        for spec in specs:
+            _faults.install(spec)
+        self._respond(200, {"faults": _faults.specs()})
+
     def _drain_body(self) -> None:
         length = int(self.headers.get("Content-Length") or 0)
         self._raw_body = self.rfile.read(length) if length else b""
@@ -312,7 +372,8 @@ class JsonHandler(BaseHTTPRequestHandler):
             raise HttpError(400, f"invalid JSON: {e}")
 
     def _respond(
-        self, status: int, body: Any, content_type: str = "application/json"
+        self, status: int, body: Any, content_type: str = "application/json",
+        headers: Optional[dict] = None,
     ) -> None:
         data = (
             body.encode() if isinstance(body, str) else json.dumps(body).encode()
@@ -323,6 +384,8 @@ class JsonHandler(BaseHTTPRequestHandler):
         trace_id = getattr(self, "_trace_id", None)
         if trace_id:
             self.send_header("X-Request-ID", trace_id)
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
         self._record_request(status)

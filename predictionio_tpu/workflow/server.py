@@ -27,7 +27,10 @@ from typing import Any, Optional
 
 import predictionio_tpu.obs.spans as _spans
 import predictionio_tpu.obs.tracing as _tracing
+import predictionio_tpu.resilience.deadline as _deadline
+import predictionio_tpu.resilience.faults as _faults
 from predictionio_tpu.controller.params import ParamsError, extract_params
+from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.obs import BATCH_SIZE_BUCKETS, server_registry
 from predictionio_tpu.core.base import RuntimeContext
 from predictionio_tpu.data.storage.base import EngineInstance
@@ -99,6 +102,12 @@ def build_runtime(storage: Storage, instance: EngineInstance) -> EngineRuntime:
     """Re-hydrate a COMPLETED instance into a servable runtime (reference
     createServerActorWithEngine, CreateServer.scala:206)."""
     from predictionio_tpu.obs.jaxmon import ensure_compile_listener
+
+    # fault point (ISSUE 4): a failed model load/rehydration must leave
+    # the PREVIOUS runtime serving (reload() swaps only on success) —
+    # chaos tests inject here to prove the query server keeps answering
+    # from the last-loaded model when storage/model data is unreachable
+    _faults.fire("model.load")
 
     # hook BEFORE rehydration/warmup: those jit-compile, and the compile
     # gauges must count them even though no server exists yet
@@ -175,6 +184,8 @@ class _Handler(JsonHandler):
                 self._serve_debug_traces()
             elif path == "/debug/profile":
                 self._serve_debug_profile()
+            elif path == "/debug/faults":
+                self._serve_debug_faults()
             elif path == "/reload":
                 self.server.owner.reload()
                 self._respond(200, {"message": "Reload successful"})
@@ -209,6 +220,11 @@ class _Handler(JsonHandler):
             except Exception as e:
                 log.exception("profiler capture failed")
                 self._respond(500, {"message": str(e)})
+        elif path == "/debug/faults":
+            try:
+                self._serve_debug_faults_set()
+            except _HttpError as e:
+                self._respond(e.status, {"message": e.message})
         else:
             self._respond(404, {"message": "Not Found"})
 
@@ -216,6 +232,18 @@ class _Handler(JsonHandler):
         """The serving hot path (reference CreateServer.scala:490-613)."""
         owner = self.server.owner
         t0 = time.perf_counter()
+        # load shedding (ISSUE 4): a query whose propagated deadline
+        # (X-PIO-Deadline, set as the ambient deadline by JsonHandler)
+        # already passed is refused BEFORE parsing, batching, or device
+        # time — the client stopped waiting, any work is pure waste
+        if _deadline.expired():
+            owner.count_shed("deadline")
+            self._respond(
+                503,
+                {"message": "deadline expired; request shed"},
+                headers={"Retry-After": "1"},
+            )
+            return
         try:
             raw = self._raw_body.decode()
             try:
@@ -243,7 +271,9 @@ class _Handler(JsonHandler):
             supplemented = rt.serving.supplement(query)
             try:
                 if owner.dispatcher is not None:
-                    prediction = owner.dispatcher.submit(supplemented, rt)
+                    prediction = owner.dispatcher.submit(
+                        supplemented, rt, deadline=_deadline.current()
+                    )
                 else:
                     tp = time.perf_counter()
                     predictions = [
@@ -275,9 +305,39 @@ class _Handler(JsonHandler):
             self._respond(200, result)
         except _HttpError as e:
             self._respond(e.status, {"message": e.message})
+        except DeadlineExceeded as e:
+            # expired in the queue or dispatch outran its budget: the
+            # honest answer is "retry later", not a 500 (the dispatcher's
+            # drain loop counts the shed, so no double counting here)
+            self._respond(
+                503, {"message": str(e)}, headers={"Retry-After": "1"}
+            )
         except Exception as e:
             log.exception("query failed")
             self._respond(500, {"message": str(e)})
+
+
+class _Pending:
+    """One queued query awaiting a device batch. `deadline` is an
+    absolute time.monotonic() bound (None = unbounded); `cancelled` is
+    set by the submitting handler when its client stopped waiting, so
+    the drain loop skips the entry instead of burning a device dispatch
+    on an answer nobody will read (ISSUE 4 satellite: the old tuple
+    entries had no way to be withdrawn)."""
+
+    __slots__ = (
+        "query", "runtime", "fut", "t_submit", "tctx", "deadline",
+        "cancelled",
+    )
+
+    def __init__(self, query, runtime, fut, t_submit, tctx, deadline):
+        self.query = query
+        self.runtime = runtime
+        self.fut = fut
+        self.t_submit = t_submit
+        self.tctx = tctx
+        self.deadline = deadline
+        self.cancelled = False
 
 
 class _BatchDispatcher:
@@ -328,18 +388,38 @@ class _BatchDispatcher:
         )
         self._thread.start()
 
-    def submit(self, query: Any, runtime: "EngineRuntime", timeout: float = 30.0) -> Any:
+    def submit(
+        self, query: Any, runtime: "EngineRuntime", timeout: float = 30.0,
+        deadline: Optional[float] = None,
+    ) -> Any:
         """Submit with the runtime snapshot the handler extracted the query
         against — a /reload mid-window must not serve an old-typed query
         with the new model. The handler thread's trace/span context rides
         along so the dispatcher can attribute its queue/device/serve child
-        spans to the right request."""
-        from concurrent.futures import Future
+        spans to the right request.
+
+        `deadline` (absolute time.monotonic()) caps the wait; when it
+        passes — or `timeout` elapses — the entry is marked cancelled so
+        the drain loop skips it instead of still dispatching it to the
+        device (the old timeout leak), and DeadlineExceeded surfaces to
+        the handler as a 503 + Retry-After."""
+        import time as _t
+        from concurrent.futures import Future, TimeoutError as _FutTimeout
 
         fut: Future = Future()
         tctx = (_tracing.current_trace_id(), _spans.current_span_id())
-        self._queue.put((query, runtime, fut, time.perf_counter(), tctx))
-        return fut.result(timeout=timeout)
+        p = _Pending(query, runtime, fut, time.perf_counter(), tctx, deadline)
+        self._queue.put(p)
+        wait = timeout
+        if deadline is not None:
+            wait = min(wait, max(0.0, deadline - _t.monotonic()))
+        try:
+            return fut.result(timeout=wait)
+        except _FutTimeout:
+            p.cancelled = True  # drain must not burn device time on this
+            raise DeadlineExceeded(
+                "query abandoned: deadline passed while queued for dispatch"
+            )
 
     def stop(self) -> None:
         self._stop.set()
@@ -351,31 +431,36 @@ class _BatchDispatcher:
 
         while True:
             try:
-                _query, _rt, fut, _t, _c = self._queue.get_nowait()
+                p = self._queue.get_nowait()
             except _q.Empty:
                 break
-            if not fut.done():
-                fut.set_exception(RuntimeError("query server stopped"))
+            if not p.fut.done():
+                p.fut.set_exception(RuntimeError("query server stopped"))
 
     def _run_group(self, rt: "EngineRuntime", group: list) -> None:
-        queries = [(i, q) for i, (q, _f, _t, _c) in enumerate(group)]
+        # last-chance shed: entries can be cancelled (or expire) while
+        # the batch waits on the backpressure semaphore — re-filter at
+        # the moment device time is about to be spent (ISSUE 4)
+        group = self._shed_dead(group)
+        if not group:
+            return
+        queries = [(i, p.query) for i, p in enumerate(group)]
         t0 = time.perf_counter()
         now_wall = time.time()
         registry = getattr(self.owner, "metrics", None)
         recorder = _spans.get_default_recorder()
-        first_submit = min(t for _q, _f, t, _c in group)
+        first_submit = min(p.t_submit for p in group)
         # pre-mint the per-query device span ids: storage RPCs issued
         # DURING batch_predict (e.g. UR history fetches) must parent
         # under a device span, so its id has to exist before the call
         dev_ids = [
-            _spans.new_span_id() if tctx[0] else None
-            for _q, _f, _t, tctx in group
+            _spans.new_span_id() if p.tctx[0] else None for p in group
         ]
 
         def _child(i: int, name: str, start: float, dur: float,
                    span_id: Optional[str] = None, error: bool = False,
                    **attrs: Any) -> None:
-            tid, parent = group[i][3]
+            tid, parent = group[i].tctx
             if tid is None:
                 return
             recorder.record(_spans.Span(
@@ -387,7 +472,8 @@ class _BatchDispatcher:
                 error=error,
             ))
 
-        for i, (_q, _f, t_submit, _c) in enumerate(group):
+        for i, p in enumerate(group):
+            t_submit = p.t_submit
             # queue-wait: submit() to device dispatch — the cost the
             # adaptive window adds, isolated from device time so batching
             # PRs can trade one against the other on measured numbers.
@@ -412,7 +498,7 @@ class _BatchDispatcher:
         rep = next((i for i, d in enumerate(dev_ids) if d), None)
         tok_t = tok_s = None
         if rep is not None:
-            tok_t = _tracing.set_trace_id(group[rep][3][0])
+            tok_t = _tracing.set_trace_id(group[rep].tctx[0])
             tok_s = _spans.set_current_span(dev_ids[rep])
         # padding-waste accounting (ISSUE 3) is recorded at the PAD SITES
         # this dispatch drives (engines' _predict_batch, the only places
@@ -421,6 +507,10 @@ class _BatchDispatcher:
         # wasted-FLOPs on the process-default registry.
         try:
             try:
+                # fault point (ISSUE 4): "error" fails the batch into the
+                # per-query fallback below; "delay" simulates a slow
+                # device, which is what deadline shedding exists for
+                _faults.fire("dispatch.device")
                 per_algo = [
                     dict(algo.batch_predict(
                         algo.serving_context, model, queries
@@ -440,23 +530,23 @@ class _BatchDispatcher:
                         "device time per coalesced batch (dispatch to fetch)",
                     ).observe(self.last_batch_sec)
                 self.owner.bookkeep_predict(self.last_batch_sec, len(group))
-                for i, (q, fut, _t, _c) in enumerate(group):
+                for i, p in enumerate(group):
                     t_s = time.perf_counter()
                     try:
                         result = rt.serving.serve(
-                            q, [pa[i] for pa in per_algo]
+                            p.query, [pa[i] for pa in per_algo]
                         )
                     except Exception as e:  # serve failure is per-query
                         dur = time.perf_counter() - t_s
                         _child(i, "batch.result_transfer",
                                time.time() - dur, dur, error=True)
-                        fut.set_exception(e)
+                        p.fut.set_exception(e)
                         continue
                     dur = time.perf_counter() - t_s
                     # result-transfer/serve: per-query fetch + combinator
                     _child(i, "batch.result_transfer",
                            time.time() - dur, dur)
-                    fut.set_result(result)
+                    p.fut.set_result(result)
             except Exception:
                 # one bad query must not poison the batch: retry
                 # individually so each waiter gets its own result or its
@@ -466,16 +556,20 @@ class _BatchDispatcher:
                     _child(i, "batch.device_dispatch", now_wall,
                            time.perf_counter() - t0, span_id=dev_ids[i],
                            error=True)
-                for _i, (q, fut, _t, _c) in enumerate(group):
+                for p in group:
+                    if p.cancelled:  # client gone mid-batch: skip retry
+                        continue
                     try:
                         predictions = [
-                            algo.predict(model, q)
+                            algo.predict(model, p.query)
                             for algo, model in zip(rt.algorithms, rt.models)
                         ]
-                        fut.set_result(rt.serving.serve(q, predictions))
+                        p.fut.set_result(
+                            rt.serving.serve(p.query, predictions)
+                        )
                     except Exception as e:
-                        if not fut.done():
-                            fut.set_exception(e)
+                        if not p.fut.done():
+                            p.fut.set_exception(e)
         finally:
             if tok_s is not None:
                 _spans.reset_current_span(tok_s)
@@ -551,13 +645,16 @@ class _BatchDispatcher:
                 except _q.Empty:
                     pass
             self.window_s = self.min_window_s  # status display only
+            # drain-time shedding (ISSUE 4): entries whose client already
+            # gave up (cancelled) or whose deadline passed while queued
+            # are dropped HERE — before the backpressure semaphore and
+            # the device dispatch, which is exactly the time they'd waste
+            ready = self._shed_dead(batch)
             # group by runtime snapshot: queries spanning a /reload are
             # served by the runtime they were extracted against
             groups: dict[int, tuple[Any, list]] = {}
-            for query, rt, fut, t_submit, tctx in batch:
-                groups.setdefault(id(rt), (rt, []))[1].append(
-                    (query, fut, t_submit, tctx)
-                )
+            for p in ready:
+                groups.setdefault(id(p.runtime), (p.runtime, []))[1].append(p)
             for rt, group in groups.values():
                 # poll the semaphore so a stop() during backpressure
                 # doesn't leave this thread blocked forever
@@ -579,11 +676,34 @@ class _BatchDispatcher:
                         with self._active_lock:
                             self._active -= 1
                         self._inflight.release()
-                for _q2, fut, _t, _c in group:
-                    if not fut.done():
-                        fut.set_exception(
+                for p in group:
+                    if not p.fut.done():
+                        p.fut.set_exception(
                             RuntimeError("query server stopped")
                         )
+
+    def _shed_dead(self, entries: list) -> list:
+        """Drop cancelled/deadline-expired entries, failing their futures
+        with DeadlineExceeded (→ 503 + Retry-After at the handler) and
+        counting the shed. Returns the still-live entries."""
+        import time as _t
+
+        now_m = _t.monotonic()
+        live = []
+        for p in entries:
+            if p.cancelled or (
+                p.deadline is not None and now_m >= p.deadline
+            ):
+                if not p.fut.done():
+                    p.fut.set_exception(DeadlineExceeded(
+                        "deadline expired before device dispatch"
+                    ))
+                shed = getattr(self.owner, "count_shed", None)
+                if shed is not None:
+                    shed("cancelled" if p.cancelled else "expired_in_queue")
+                continue
+            live.append(p)
+        return live
 
     def _run_group_released(self, rt: "EngineRuntime", group: list) -> None:
         try:
@@ -650,6 +770,13 @@ class QueryServer(ServerProcess):
         _spans.get_default_recorder().bridge(
             "batch.queue_wait", self._queue_wait_bridge
         )
+        # load shedding (ISSUE 4): expired/abandoned queries refused
+        # before device time, by reason
+        self._shed_counter = self.metrics.counter(
+            "queries_shed_total",
+            "queries shed before device dispatch (503 + Retry-After)",
+            ("reason",),
+        )
         self.last_serving_sec = 0.0
         self.last_predict_sec = 0.0
         self.dispatcher: Optional[_BatchDispatcher] = None
@@ -686,6 +813,9 @@ class QueryServer(ServerProcess):
             self.storage, inst.engine_id, inst.engine_version, inst.engine_variant
         )
         self.runtime = new_runtime  # atomic reference swap
+
+    def count_shed(self, reason: str) -> None:
+        self._shed_counter.inc(reason=reason)
 
     # -- bookkeeping (registry-backed; the averages are now derived) -------
     def bookkeep(self, seconds: float) -> None:

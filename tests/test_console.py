@@ -201,3 +201,26 @@ def test_pio_shell_script_subprocess(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "GOT 1" in r.stdout
+
+
+def test_faults_cli_local_registry(cli, capsys):
+    """`pio faults set|list|clear` drives the in-process fault registry
+    (ISSUE 4 tooling satellite)."""
+    from predictionio_tpu.resilience import faults
+
+    try:
+        assert cli("faults", "list") == 0
+        assert "inert" in capsys.readouterr().out
+        assert cli(
+            "faults", "set", "storage.rpc:error:0.25", "--seed", "11"
+        ) == 0
+        out = capsys.readouterr().out
+        assert "storage.rpc: error p=0.25" in out and "seed=11" in out
+        assert {s["point"] for s in faults.specs()} == {"storage.rpc"}
+        assert cli("faults", "set", "bogus.point:error:1.0") == 1  # loud
+        capsys.readouterr()
+        assert cli("faults", "clear", "storage.rpc") == 0
+        assert "inert" in capsys.readouterr().out
+        assert not faults.active()
+    finally:
+        faults.clear()
