@@ -680,13 +680,96 @@ def bench_serving_framework():
             )
             sweep.append(dict(stats, clients=n_clients))
         best = max(sweep, key=lambda r: r["qps"])
+        swap = _bench_hot_swap(srv, storage, port, n_users_serve)
         return dict(
             best, sweep=sweep, obs=_registry_snapshot(srv.metrics),
             slowest_trace=_slowest_trace_summary(recorder),
             devprof=_devprof_serving_crosscheck(),
+            **swap,
         )
     finally:
         srv.stop()
+
+
+def _bench_hot_swap(srv, storage, port, n_users_serve):
+    """Hot-swap cost (ISSUE 5 satellite): canary the served model's own
+    blob as a candidate, then promote it mid-way through a 128-client
+    closed-loop run. `swap_p99_ms` is the run's p99 WITH a promote in
+    the middle; `swap_dropped` counts queries that failed or got no
+    response — the zero-drop contract says it must be 0."""
+    import http.client
+    import threading
+    import concurrent.futures
+
+    from predictionio_tpu.deploy.registry import ModelRegistry
+
+    version = ModelRegistry(storage).register(srv.runtime.instance)
+    srv.start_rollout({
+        "version": version.id, "fraction": 0.3,
+        # the verdict loop must not act on its own — the bench promotes
+        "bake_s": 3600.0, "min_requests": 10**9, "interval_s": 60.0,
+    })
+    n_clients, n_per = 128, 5 if not SMALL else 2
+    total = n_clients * n_per
+    lat: list[float] = []
+    dropped = 0
+    done = 0
+    lock = threading.Lock()
+    promoted = threading.Event()
+
+    def client(c):
+        nonlocal dropped, done
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60.0)
+        try:
+            for j in range(n_per):
+                body = json.dumps({
+                    "user": f"u{(c * n_per + j) % n_users_serve}",
+                    "num": 10,
+                }).encode()
+                t0 = time.perf_counter()
+                try:
+                    conn.request(
+                        "POST", "/queries.json", body=body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    resp.read()
+                    ok = resp.status == 200
+                except Exception:
+                    ok = False
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=60.0
+                    )
+                dt = time.perf_counter() - t0
+                with lock:
+                    done += 1
+                    lat.append(dt)
+                    if not ok:
+                        dropped += 1
+                    if done >= total // 3 and not promoted.is_set():
+                        promoted.set()  # swap lands mid-run, under load
+                        threading.Thread(
+                            target=srv.rollout.promote,
+                            args=("bench hot-swap",), daemon=True,
+                        ).start()
+        finally:
+            conn.close()
+
+    with concurrent.futures.ThreadPoolExecutor(n_clients) as pool:
+        list(pool.map(client, range(n_clients)))
+    # the promote thread is quick, but make sure it finished before stop
+    for _ in range(100):
+        if srv.rollout is not None and srv.rollout.st.state == "promoted":
+            break
+        time.sleep(0.05)
+    lat.sort()
+    return {
+        "swap_p99_ms": lat[int(0.99 * (len(lat) - 1))] * 1e3 if lat else 0.0,
+        "swap_dropped": dropped,
+        "swap_requests": len(lat),
+        "swap_state": srv.rollout.st.state if srv.rollout else "none",
+    }
 
 
 def _slowest_trace_summary(recorder):

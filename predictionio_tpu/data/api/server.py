@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 from urllib.parse import parse_qsl, urlsplit
 
+import predictionio_tpu.resilience.deadline as _deadline
 import predictionio_tpu.resilience.faults as _faults
 from predictionio_tpu.data.api.plugins import PluginContext
 from predictionio_tpu.data.api.stats import Stats
@@ -180,12 +181,48 @@ class _Handler(JsonHandler):
         self._after_insert(auth, obj, event)
         return 201, {"eventId": event_id}
 
+    def _maybe_shed_ingest(self, method: str, path: str) -> bool:
+        """Load shedding on the ingest path (ISSUE 5 satellite, closing
+        the ROADMAP PR-4 follow-up): an event POST whose propagated
+        X-PIO-Deadline already expired is refused 503 + Retry-After
+        before auth/validation/storage are touched — EXCEPT while the
+        WAL has spilled events pending. Pending spill means storage is
+        (or just was) down, so this event would land in the WAL as a
+        202: accepting it is one fsync'd append, while shedding it buys
+        a client retry loop against a server that can't get healthier
+        for the waiting (the 202-into-WAL-is-cheaper rule)."""
+        if method != "POST":
+            return False
+        if not (
+            path in ("/events.json", "/batch/events.json")
+            or path.startswith("/webhooks/")
+        ):
+            return False
+        if not _deadline.expired():
+            return False
+        wal = self.server.wal
+        if wal is not None and wal.pending():
+            return False  # spill mode: never shed what the WAL absorbs
+        self.server.metrics.counter(
+            "events_shed_total",
+            "ingest POSTs refused before storage work, by reason",
+            ("reason",),
+        ).inc(reason="deadline")
+        self._respond(
+            503,
+            {"message": "deadline expired; event shed"},
+            headers={"Retry-After": "1"},
+        )
+        return True
+
     # -- routes ------------------------------------------------------------
     def _route(self, method: str) -> None:
         self._drain_body()
         url = urlsplit(self.path)
         query = dict(parse_qsl(url.query))
         path = url.path.rstrip("/") or "/"
+        if self._maybe_shed_ingest(method, path):
+            return
         try:
             if path == "/" and method == "GET":
                 self._respond(200, {"status": "alive"})

@@ -149,7 +149,12 @@ class EventValidation:
     """
 
     SPECIAL_EVENTS = frozenset({SET_EVENT, UNSET_EVENT, DELETE_EVENT})
-    BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+    # framework-internal entities allowed under the reserved pio_ prefix:
+    # feedback predictions (pio_pr) and the model-lifecycle records
+    # (ISSUE 5) that live in the reserved LIFECYCLE_APP_ID namespace
+    BUILTIN_ENTITY_TYPES = frozenset(
+        {"pio_pr", "pio_model_version", "pio_train_job"}
+    )
 
     @staticmethod
     def is_reserved_prefix(name: str) -> bool:

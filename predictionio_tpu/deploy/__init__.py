@@ -1,0 +1,54 @@
+"""Model lifecycle subsystem (ISSUE 5): version registry, background
+training scheduler, and canary rollout with automatic rollback.
+
+The reference PredictionIO ties a deployment to a single EngineInstance
+blob — `pio train` blocks a console and `pio deploy` loads whatever is
+newest. This package is the piece between training and serving:
+
+- registry.py  — versioned, immutable model records layered on the
+  existing storage backends, with lineage queries and retention GC
+- scheduler.py — persistent job queue + supervised subprocess worker
+  (heartbeats, per-job logs, timeout, retry-with-backoff, periodic
+  retrain); jobs survive restarts by re-reading the queue from storage
+- worker.py    — the train-job subprocess entry point
+- rollout.py   — canary traffic splitting + verdict loop that promotes
+  or rolls back a candidate model on measured serve metrics
+
+Import discipline: like obs/ and resilience/, nothing here may import
+jax at module import time — the scheduler and control-plane endpoints
+run inside data-plane server processes.
+"""
+
+from predictionio_tpu.deploy.registry import (
+    LIFECYCLE_APP_ID,
+    ModelRegistry,
+    ModelVersion,
+    VERSION_STATUSES,
+)
+from predictionio_tpu.deploy.rollout import (
+    RolloutConfig,
+    RolloutController,
+    VariantWindow,
+    verdict,
+)
+from predictionio_tpu.deploy.scheduler import (
+    JobQueue,
+    SchedulerConfig,
+    TrainJob,
+    TrainScheduler,
+)
+
+__all__ = [
+    "LIFECYCLE_APP_ID",
+    "JobQueue",
+    "ModelRegistry",
+    "ModelVersion",
+    "RolloutConfig",
+    "RolloutController",
+    "SchedulerConfig",
+    "TrainJob",
+    "TrainScheduler",
+    "VERSION_STATUSES",
+    "VariantWindow",
+    "verdict",
+]

@@ -1,0 +1,365 @@
+"""Model version registry: versioned, immutable model records layered on
+the existing storage backends (ISSUE 5 tentpole part 1).
+
+No backend grows a new DAO: a record is the fold of ``$set`` events in a
+reserved event-store namespace (`LIFECYCLE_APP_ID`), so every backend
+that can store events — memory, sqlite, parquetfs, remote, sharded —
+already persists the registry, and the event WAL / breaker / retry
+machinery from PR 4 protects registry writes for free. Status changes
+append a new ``$set``; the full event stream of a record is its audit
+trail, and a record fold never mutates an existing event (immutability).
+
+Records carry: id, parent engine instance, params hash, train metrics,
+devprof snapshot, status (``trained|canary|live|rolled_back|archived``),
+and the previous-live lineage pointer. Retention GC keeps live/canary
+records unconditionally and the newest N others per engine variant.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import itertools
+import json
+import logging
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from predictionio_tpu.data.event import SET_EVENT, Event
+from predictionio_tpu.data.storage.base import EngineInstance, EventQuery
+from predictionio_tpu.data.storage.registry import Storage
+
+log = logging.getLogger(__name__)
+
+# Reserved event-store namespace for lifecycle records. Positive and far
+# above any auto-assigned app id (sqlite table names cannot carry a
+# minus sign, and verify_all_data_objects probes/wipes app 0).
+LIFECYCLE_APP_ID = 2_000_000_000
+
+VERSION_ENTITY = "pio_model_version"
+
+VERSION_STATUSES = ("trained", "canary", "live", "rolled_back", "archived")
+
+# process-monotonic tie-breaker: two record updates can land in the same
+# event_time microsecond; the fold orders by (event_time, seq)
+_seq = itertools.count()
+_seq_lock = threading.Lock()
+
+
+def _next_seq() -> int:
+    with _seq_lock:
+        return next(_seq)
+
+
+def _utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+class LifecycleRecordStore:
+    """Shared record layer: entity → last-write-wins field fold over the
+    reserved namespace. ModelRegistry and JobQueue both build on it."""
+
+    def __init__(self, storage: Storage):
+        self.storage = storage
+        self._initialized = False
+
+    def _events(self):
+        store = self.storage.get_events()
+        if not self._initialized:
+            store.init_app(LIFECYCLE_APP_ID)
+            self._initialized = True
+        return store
+
+    def append(self, entity_type: str, entity_id: str, props: dict) -> str:
+        """Append one field-update record (``$set`` event); returns the
+        event id so high-frequency writers (scheduler heartbeats) can
+        compact their previous update away."""
+        return self._events().insert(
+            Event(
+                event=SET_EVENT,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                properties=dict(props, _seq=_next_seq()),
+            ),
+            LIFECYCLE_APP_ID,
+        )
+
+    def discard(self, event_id: str) -> None:
+        """Best-effort delete of one earlier update event (compaction);
+        a failure just leaves an extra event in the fold."""
+        try:
+            self._events().delete(event_id, LIFECYCLE_APP_ID)
+        except Exception:
+            log.debug("record compaction delete failed", exc_info=True)
+
+    def fold(self, entity_type: str, entity_id: Optional[str] = None) -> dict:
+        """entity_id → merged field dict (newest write per field wins)."""
+        evs = list(self._events().find(EventQuery(
+            app_id=LIFECYCLE_APP_ID,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=[SET_EVENT],
+        )))
+        evs.sort(key=lambda e: (
+            e.event_time, e.properties.get_or_else("_seq", 0)
+        ))
+        out: dict[str, dict] = {}
+        for e in evs:
+            d = out.setdefault(e.entity_id, {})
+            d.update(e.properties.to_dict())
+        for d in out.values():
+            d.pop("_seq", None)
+        return out
+
+    def purge(self, entity_type: str, entity_id: str) -> int:
+        """Delete every event of one record; returns how many existed."""
+        store = self._events()
+        ids = [
+            e.event_id for e in store.find(EventQuery(
+                app_id=LIFECYCLE_APP_ID,
+                entity_type=entity_type,
+                entity_id=entity_id,
+            ))
+            if e.event_id
+        ]
+        if not ids:
+            return 0
+        return store.delete_batch(ids, LIFECYCLE_APP_ID)
+
+
+@dataclass
+class ModelVersion:
+    """One immutable trained-model record."""
+
+    id: str
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    instance_id: str  # parent EngineInstance (and MODELDATA blob key)
+    params_hash: str
+    status: str = "trained"
+    created_at: str = ""
+    updated_at: str = ""
+    parent_version: Optional[str] = None  # live version at registration
+    train_metrics: dict[str, Any] = field(default_factory=dict)
+    devprof: dict[str, Any] = field(default_factory=dict)
+    reason: Optional[str] = None  # why rolled_back/archived
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "engine_id": self.engine_id,
+            "engine_version": self.engine_version,
+            "engine_variant": self.engine_variant,
+            "instance_id": self.instance_id,
+            "params_hash": self.params_hash,
+            "status": self.status,
+            "created_at": self.created_at,
+            "updated_at": self.updated_at,
+            "parent_version": self.parent_version,
+            "train_metrics": self.train_metrics,
+            "devprof": self.devprof,
+            "reason": self.reason,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ModelVersion":
+        return ModelVersion(**{
+            k: d.get(k, None if k in ("parent_version", "reason") else "")
+            for k in (
+                "id", "engine_id", "engine_version", "engine_variant",
+                "instance_id", "params_hash", "status", "created_at",
+                "updated_at", "parent_version", "reason",
+            )
+        } | {
+            "train_metrics": d.get("train_metrics") or {},
+            "devprof": d.get("devprof") or {},
+        })
+
+
+def params_hash(instance: EngineInstance) -> str:
+    """Stable hash of the full DASE parameterization — two versions with
+    the same hash were trained with identical stage params."""
+    payload = json.dumps(
+        [
+            instance.engine_factory,
+            instance.data_source_params,
+            instance.preparator_params,
+            instance.algorithms_params,
+            instance.serving_params,
+        ],
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+class ModelRegistry:
+    """CRUD + lineage + retention GC over ModelVersion records."""
+
+    def __init__(self, storage: Storage):
+        self.storage = storage
+        self._store = LifecycleRecordStore(storage)
+
+    # -- writes -----------------------------------------------------------
+    def register(
+        self,
+        instance: EngineInstance,
+        train_metrics: Optional[dict] = None,
+        devprof: Optional[dict] = None,
+    ) -> ModelVersion:
+        """Record a COMPLETED train run as a new ``trained`` version.
+        Lineage: `parent_version` points at the variant's live version at
+        registration time (None for the first)."""
+        if instance.status != "COMPLETED":
+            raise ValueError(
+                f"only COMPLETED instances register; {instance.id} is "
+                f"{instance.status}"
+            )
+        live = self.live_version(
+            instance.engine_id, instance.engine_variant
+        )
+        now = _utcnow().isoformat()
+        metrics = dict(train_metrics or {})
+        if not metrics and instance.env.get("stage_timings"):
+            try:
+                metrics["stage_timings"] = json.loads(
+                    instance.env["stage_timings"]
+                )
+            except (ValueError, TypeError):
+                pass
+        version = ModelVersion(
+            id=f"mv-{uuid.uuid4().hex[:12]}",
+            engine_id=instance.engine_id,
+            engine_version=instance.engine_version,
+            engine_variant=instance.engine_variant,
+            instance_id=instance.id,
+            params_hash=params_hash(instance),
+            status="trained",
+            created_at=now,
+            updated_at=now,
+            parent_version=live.id if live else None,
+            train_metrics=metrics,
+            devprof=dict(devprof or {}),
+        )
+        self._store.append(VERSION_ENTITY, version.id, version.to_dict())
+        return version
+
+    def set_status(
+        self, version_id: str, status: str, reason: Optional[str] = None
+    ) -> ModelVersion:
+        if status not in VERSION_STATUSES:
+            raise ValueError(
+                f"unknown version status {status!r} "
+                f"(known: {', '.join(VERSION_STATUSES)})"
+            )
+        v = self.get(version_id)
+        if v is None:
+            raise KeyError(f"no model version {version_id}")
+        self._store.append(VERSION_ENTITY, version_id, {
+            "status": status,
+            "updated_at": _utcnow().isoformat(),
+            "reason": reason,
+        })
+        v.status, v.reason = status, reason
+        return v
+
+    def promote(self, version_id: str) -> ModelVersion:
+        """Make `version_id` the variant's live version; the previous
+        live one is archived (still servable, still in lineage)."""
+        v = self.get(version_id)
+        if v is None:
+            raise KeyError(f"no model version {version_id}")
+        prev = self.live_version(v.engine_id, v.engine_variant)
+        if prev is not None and prev.id != v.id:
+            self.set_status(prev.id, "archived", reason=f"superseded by {v.id}")
+        return self.set_status(version_id, "live")
+
+    def rollback(self, version_id: str, reason: str) -> ModelVersion:
+        return self.set_status(version_id, "rolled_back", reason=reason)
+
+    # -- reads ------------------------------------------------------------
+    def get(self, version_id: str) -> Optional[ModelVersion]:
+        folded = self._store.fold(VERSION_ENTITY, version_id)
+        d = folded.get(version_id)
+        return ModelVersion.from_dict(d) if d else None
+
+    def list(
+        self,
+        engine_id: Optional[str] = None,
+        engine_variant: Optional[str] = None,
+        status: Optional[str] = None,
+    ) -> list[ModelVersion]:
+        """Newest-first version listing with optional filters."""
+        out = [
+            ModelVersion.from_dict(d)
+            for d in self._store.fold(VERSION_ENTITY).values()
+        ]
+        if engine_id is not None:
+            out = [v for v in out if v.engine_id == engine_id]
+        if engine_variant is not None:
+            out = [v for v in out if v.engine_variant == engine_variant]
+        if status is not None:
+            out = [v for v in out if v.status == status]
+        out.sort(key=lambda v: v.created_at, reverse=True)
+        return out
+
+    def live_version(
+        self, engine_id: str, engine_variant: str
+    ) -> Optional[ModelVersion]:
+        live = self.list(engine_id, engine_variant, status="live")
+        return live[0] if live else None
+
+    def lineage(self, version_id: str) -> list[ModelVersion]:
+        """The ancestry chain, newest first: this version, then the live
+        version it superseded, and so on (cycle-guarded)."""
+        chain: list[ModelVersion] = []
+        seen: set[str] = set()
+        cur = self.get(version_id)
+        while cur is not None and cur.id not in seen:
+            chain.append(cur)
+            seen.add(cur.id)
+            cur = self.get(cur.parent_version) if cur.parent_version else None
+        return chain
+
+    # -- retention GC -----------------------------------------------------
+    def gc(
+        self, keep: int = 5, delete_blobs: bool = False
+    ) -> list[ModelVersion]:
+        """Drop all but the newest `keep` non-serving versions per
+        (engine_id, engine_variant). ``live`` and ``canary`` versions are
+        never collected. With `delete_blobs`, MODELDATA blobs whose
+        instance is referenced by no surviving version are deleted too.
+        Returns the collected versions."""
+        if keep < 0:
+            raise ValueError("keep must be >= 0")
+        by_variant: dict[tuple[str, str], list[ModelVersion]] = {}
+        for v in self.list():
+            by_variant.setdefault(
+                (v.engine_id, v.engine_variant), []
+            ).append(v)
+        collected: list[ModelVersion] = []
+        survivors: list[ModelVersion] = []
+        for versions in by_variant.values():
+            disposable = [
+                v for v in versions if v.status not in ("live", "canary")
+            ]
+            survivors.extend(
+                v for v in versions if v.status in ("live", "canary")
+            )
+            survivors.extend(disposable[:keep])  # list() is newest-first
+            collected.extend(disposable[keep:])
+        kept_instances = {v.instance_id for v in survivors}
+        models = self.storage.get_model_data_models()
+        for v in collected:
+            self._store.purge(VERSION_ENTITY, v.id)
+            if delete_blobs and v.instance_id not in kept_instances:
+                try:
+                    models.delete(v.instance_id)
+                except Exception:
+                    log.exception(
+                        "model blob delete failed for %s (non-fatal)",
+                        v.instance_id,
+                    )
+        return collected

@@ -1,0 +1,438 @@
+"""Canary rollout with automatic rollback (ISSUE 5 tentpole part 3).
+
+The query server holds up to two `EngineRuntime`s — live + candidate —
+and routes a sticky hash-of-request traffic fraction to the candidate.
+Per-variant serve/error histograms land in the server registry under a
+``variant`` label, and a verdict loop compares candidate vs live over a
+sliding window:
+
+- error-rate delta above `max_error_delta`      → roll back
+- candidate p99 / live p99 above `max_p99_ratio` → roll back
+- optional shadow mode: candidate answers a mirrored copy of live
+  traffic off the response path; result disagreement above
+  `1 - min_agreement` → roll back
+- healthy through `bake_s` of traffic            → promote
+
+Promote is an atomic reference hot-swap under the server's runtime-swap
+lock; the old runtime is drained, not dropped — in-flight queries hold
+their runtime snapshot (the dispatcher groups by runtime), so zero
+queries are dropped during either swap. Rollback simply detaches the
+candidate and marks the version ``rolled_back``.
+
+Every knob has a ``PIO_ROLLOUT_*`` env default so operators tune the
+verdict without redeploying.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Optional, TYPE_CHECKING
+
+from predictionio_tpu.deploy.registry import ModelRegistry, ModelVersion
+
+if TYPE_CHECKING:  # avoid the runtime import cycle with workflow.server
+    from predictionio_tpu.workflow.server import EngineRuntime, QueryServer
+
+log = logging.getLogger(__name__)
+
+VARIANT_LIVE = "live"
+VARIANT_CANDIDATE = "candidate"
+
+
+def _env_float(env: dict, key: str, default: float) -> float:
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", key, raw)
+        return default
+
+
+@dataclass
+class RolloutConfig:
+    """Verdict knobs. `from_env` reads ``PIO_ROLLOUT_*`` so a deployment
+    sets policy once; per-rollout overrides ride the start request."""
+
+    fraction: float = 0.1          # candidate traffic share (0..1]
+    window_s: float = 30.0         # sliding comparison window
+    interval_s: float = 1.0        # verdict loop cadence
+    min_requests: int = 20         # candidate samples before judging
+    max_error_delta: float = 0.05  # cand err-rate − live err-rate bound
+    max_p99_ratio: float = 3.0     # cand p99 / live p99 bound
+    bake_s: float = 60.0           # healthy-for-this-long → promote
+    shadow: bool = False           # mirror mode instead of live traffic
+    min_agreement: float = 0.9     # shadow result-agreement floor
+
+    @staticmethod
+    def from_env(
+        env: Optional[dict] = None, **overrides: Any
+    ) -> "RolloutConfig":
+        env = dict(os.environ if env is None else env)
+        cfg = RolloutConfig(
+            fraction=_env_float(env, "PIO_ROLLOUT_FRACTION", 0.1),
+            window_s=_env_float(env, "PIO_ROLLOUT_WINDOW_S", 30.0),
+            interval_s=_env_float(env, "PIO_ROLLOUT_INTERVAL_S", 1.0),
+            min_requests=int(_env_float(env, "PIO_ROLLOUT_MIN_REQUESTS", 20)),
+            max_error_delta=_env_float(
+                env, "PIO_ROLLOUT_MAX_ERROR_DELTA", 0.05
+            ),
+            max_p99_ratio=_env_float(env, "PIO_ROLLOUT_MAX_P99_RATIO", 3.0),
+            bake_s=_env_float(env, "PIO_ROLLOUT_BAKE_S", 60.0),
+            shadow=env.get("PIO_ROLLOUT_SHADOW", "") in ("1", "true", "yes"),
+            min_agreement=_env_float(env, "PIO_ROLLOUT_MIN_AGREEMENT", 0.9),
+        )
+        for k, v in overrides.items():
+            if v is None:
+                continue
+            cur = getattr(cfg, k)
+            if isinstance(cur, bool):
+                # bool("false") is True — parse string spellings so a
+                # shell-templated {"shadow": "false"} cannot silently
+                # turn a live canary into a shadow one
+                if isinstance(v, str):
+                    v = v.strip().lower() in ("1", "true", "yes", "on")
+                else:
+                    v = bool(v)
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(cur)(v))
+        if not 0.0 < cfg.fraction <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], got {cfg.fraction}")
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "fraction": self.fraction, "window_s": self.window_s,
+            "interval_s": self.interval_s,
+            "min_requests": self.min_requests,
+            "max_error_delta": self.max_error_delta,
+            "max_p99_ratio": self.max_p99_ratio, "bake_s": self.bake_s,
+            "shadow": self.shadow, "min_agreement": self.min_agreement,
+        }
+
+
+def sticky_candidate(raw_request: bytes, fraction: float) -> bool:
+    """Hash-of-request routing: the same request body always lands on the
+    same variant (sticky), and the candidate share tracks `fraction`."""
+    return (zlib.crc32(raw_request) % 10_000) < fraction * 10_000
+
+
+class VariantWindow:
+    """Thread-safe sliding window of (wall time, duration, error) serve
+    samples for one variant, plus shadow agree/disagree counts."""
+
+    def __init__(self, window_s: float):
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._samples: collections.deque = collections.deque()
+        self._agree: collections.deque = collections.deque()
+
+    def add(self, duration_s: float, error: bool) -> None:
+        with self._lock:
+            self._samples.append((time.monotonic(), duration_s, error))
+            self._trim()
+
+    def add_agreement(self, agree: bool) -> None:
+        with self._lock:
+            self._agree.append((time.monotonic(), agree))
+            self._trim()
+
+    def _trim(self) -> None:
+        cutoff = time.monotonic() - self.window_s
+        for dq in (self._samples, self._agree):
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            self._trim()
+            samples = list(self._samples)
+            agree = list(self._agree)
+        n = len(samples)
+        errors = sum(1 for _, _, e in samples if e)
+        durations = sorted(d for _, d, _ in samples)
+        p99 = durations[min(n - 1, int(0.99 * n))] if n else 0.0
+        out = {
+            "count": n,
+            "errors": errors,
+            "error_rate": errors / n if n else 0.0,
+            "p50_ms": (
+                durations[n // 2] * 1000.0 if n else 0.0
+            ),
+            "p99_ms": p99 * 1000.0,
+        }
+        if agree:
+            out["agreement"] = sum(1 for _, a in agree if a) / len(agree)
+            out["shadow_count"] = len(agree)
+        return out
+
+
+def verdict(
+    live: dict[str, Any], cand: dict[str, Any], cfg: RolloutConfig,
+    elapsed_s: float,
+) -> tuple[str, str]:
+    """Pure verdict math over two window-stat dicts → (action, reason)
+    with action in {"wait", "promote", "rollback"}. Separated from the
+    controller so the promote/rollback boundaries unit-test without a
+    server."""
+    n = cand.get("shadow_count", 0) if cfg.shadow else cand["count"]
+    if n < cfg.min_requests:
+        return "wait", f"candidate has {n}/{cfg.min_requests} samples"
+    if not cfg.shadow:
+        delta = cand["error_rate"] - live["error_rate"]
+        if delta > cfg.max_error_delta:
+            return "rollback", (
+                f"error-rate delta {delta:.3f} > {cfg.max_error_delta} "
+                f"(candidate {cand['error_rate']:.3f} vs live "
+                f"{live['error_rate']:.3f})"
+            )
+        if live["p99_ms"] > 0 and cand["p99_ms"] > 0:
+            ratio = cand["p99_ms"] / live["p99_ms"]
+            if ratio > cfg.max_p99_ratio:
+                return "rollback", (
+                    f"p99 ratio {ratio:.2f} > {cfg.max_p99_ratio} "
+                    f"(candidate {cand['p99_ms']:.1f}ms vs live "
+                    f"{live['p99_ms']:.1f}ms)"
+                )
+    else:
+        agreement = cand.get("agreement")
+        if agreement is not None and agreement < cfg.min_agreement:
+            return "rollback", (
+                f"shadow agreement {agreement:.3f} < {cfg.min_agreement}"
+            )
+    if elapsed_s >= cfg.bake_s:
+        return "promote", f"healthy through {cfg.bake_s:.0f}s bake"
+    return "wait", f"baking ({elapsed_s:.0f}/{cfg.bake_s:.0f}s)"
+
+
+@dataclass
+class RolloutState:
+    version: ModelVersion
+    config: RolloutConfig
+    state: str = "starting"  # canary|promoted|rolled_back|aborted|failed
+    started_at: float = field(default_factory=time.monotonic)
+    verdict_reason: str = ""
+    last_action: str = "wait"
+
+
+class RolloutController:
+    """Owns one canary's life: build → route → judge → swap or detach."""
+
+    def __init__(
+        self,
+        server: "QueryServer",
+        version: ModelVersion,
+        config: Optional[RolloutConfig] = None,
+    ):
+        self.server = server
+        self.registry = ModelRegistry(server.storage)
+        self.config = config or RolloutConfig.from_env()
+        self.st = RolloutState(version, self.config)
+        self.windows = {
+            VARIANT_LIVE: VariantWindow(self.config.window_s),
+            VARIANT_CANDIDATE: VariantWindow(self.config.window_s),
+        }
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._shadow_inflight = threading.Semaphore(8)
+        # persistent mirror pool (shadow mode only): per-request thread
+        # spawn at serving QPS would churn a thread per mirror
+        self._shadow_pool = (
+            ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="rollout-shadow"
+            )
+            if self.config.shadow else None
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        """Build the candidate runtime and attach it to the server. A
+        build failure (model.load fault, bad blob) leaves the live
+        runtime untouched — the canary never starts."""
+        from predictionio_tpu.workflow.server import (
+            RolloutConflict,
+            build_runtime,
+        )
+
+        # cheap conflict pre-check BEFORE the expensive model build —
+        # attach_rollout re-verifies under the swap lock; this just
+        # avoids deserializing a runtime onto the device only to 409
+        active = self.server.rollout
+        if active is not None and active is not self and active.st.state in (
+            "starting", "canary"
+        ):
+            raise RolloutConflict(
+                f"rollout of {active.st.version.id} is already active"
+            )
+        instance = (
+            self.server.storage.get_meta_data_engine_instances()
+            .get(self.st.version.instance_id)
+        )
+        if instance is None:
+            self.st.state = "failed"
+            raise RuntimeError(
+                f"model version {self.st.version.id} references missing "
+                f"instance {self.st.version.instance_id}"
+            )
+        try:
+            candidate = build_runtime(self.server.storage, instance)
+        except Exception as e:
+            self.st.state = "failed"
+            self.st.verdict_reason = f"candidate build failed: {e}"
+            raise
+        # attach BEFORE the registry status flip: a conflicting active
+        # rollout must abort this start without marking the version.
+        # If the flip (a storage write) then fails, DETACH — otherwise
+        # the server routes traffic to a candidate no verdict loop is
+        # judging, and neither abort nor a new start can clear it.
+        self.server.attach_rollout(self, candidate)
+        try:
+            self.registry.set_status(self.st.version.id, "canary")
+        except Exception:
+            self.st.state = "failed"
+            self.server.complete_rollout(self, promote=False)
+            raise
+        self.st.state = "canary"
+        self.st.started_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, name="rollout-verdict", daemon=True
+        )
+        self._thread.start()
+        log.info(
+            "canary started: version %s at %.0f%% traffic%s",
+            self.st.version.id, self.config.fraction * 100,
+            " (shadow)" if self.config.shadow else "",
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._shadow_pool is not None:
+            self._shadow_pool.shutdown(wait=False)
+
+    # -- serving-path hooks ----------------------------------------------
+    def record(self, variant: str, duration_s: float, error: bool) -> None:
+        w = self.windows.get(variant)
+        if w is not None:
+            w.add(duration_s, error)
+
+    def record_agreement(self, agree: bool) -> None:
+        self.windows[VARIANT_CANDIDATE].add_agreement(agree)
+
+    def try_shadow(self) -> bool:
+        """Bounded-concurrency gate for shadow mirrors (a slow candidate
+        must not pile mirror threads up behind it)."""
+        return self._shadow_inflight.acquire(blocking=False)
+
+    def shadow_done(self) -> None:
+        self._shadow_inflight.release()
+
+    def run_shadow(self, fn) -> None:
+        """Run a mirror off the response path on the persistent pool
+        (per-request thread spawn would churn at serving QPS); falls
+        back to a one-off thread if the pool closed mid-request so the
+        caller's semaphore slot is always released by `fn`."""
+        if self._shadow_pool is not None:
+            try:
+                self._shadow_pool.submit(fn)
+                return
+            except RuntimeError:
+                pass  # pool shut down: the rollout just ended
+        threading.Thread(target=fn, name="rollout-shadow", daemon=True).start()
+
+    # -- verdict loop -----------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                action, reason = self._tick()
+            except Exception:
+                log.exception("rollout verdict tick failed; retrying")
+                continue
+            if action != "wait":
+                return
+
+    def _tick(self) -> tuple[str, str]:
+        live = self.windows[VARIANT_LIVE].stats()
+        cand = self.windows[VARIANT_CANDIDATE].stats()
+        elapsed = time.monotonic() - self.st.started_at
+        action, reason = verdict(live, cand, self.config, elapsed)
+        self.st.last_action, self.st.verdict_reason = action, reason
+        if action == "promote":
+            self.promote(reason)
+        elif action == "rollback":
+            self.rollback(reason)
+        return action, reason
+
+    # -- transitions ------------------------------------------------------
+    def promote(self, reason: str = "operator promote") -> None:
+        """Atomic hot-swap: candidate becomes live under the server's
+        swap lock; the old runtime drains (in-flight queries keep their
+        snapshot) rather than being dropped.
+
+        The serving swap is the source of truth: once it lands, the
+        controller state reflects it even if the registry write fails
+        (a wedged 'canary' state would block every future rollout and
+        invite an abort that marks the NOW-SERVING version rolled_back;
+        `pio models promote` repairs a missed registry flip)."""
+        self._stop.set()
+        self.server.complete_rollout(self, promote=True)
+        self.st.state = "promoted"
+        self.st.verdict_reason = reason
+        try:
+            self.registry.promote(self.st.version.id)
+        except Exception:
+            self.st.verdict_reason = (
+                f"{reason} — REGISTRY UPDATE FAILED; run "
+                f"`pio models promote {self.st.version.id}`"
+            )
+            log.exception(
+                "canary %s promoted in serving, but the registry status "
+                "write failed", self.st.version.id,
+            )
+        log.info("canary promoted: %s (%s)", self.st.version.id, reason)
+
+    def rollback(self, reason: str) -> None:
+        self._stop.set()
+        self.server.complete_rollout(self, promote=False)
+        self.st.state = "rolled_back"
+        self.st.verdict_reason = reason
+        try:
+            self.registry.rollback(self.st.version.id, reason)
+        except Exception:
+            self.st.verdict_reason = (
+                f"{reason} — REGISTRY UPDATE FAILED; run "
+                f"`pio models rollback {self.st.version.id}`"
+            )
+            log.exception(
+                "canary %s detached from serving, but the registry "
+                "status write failed", self.st.version.id,
+            )
+        log.warning("canary rolled back: %s (%s)", self.st.version.id, reason)
+
+    def abort(self, reason: str = "operator abort") -> None:
+        self.rollback(reason)
+        self.st.state = "aborted"
+
+    # -- reporting --------------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        return {
+            "state": self.st.state,
+            "version": self.st.version.to_dict(),
+            "config": self.config.to_dict(),
+            "elapsed_s": round(time.monotonic() - self.st.started_at, 1),
+            "last_action": self.st.last_action,
+            "reason": self.st.verdict_reason,
+            "live": self.windows[VARIANT_LIVE].stats(),
+            "candidate": self.windows[VARIANT_CANDIDATE].stats(),
+        }

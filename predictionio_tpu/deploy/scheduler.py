@@ -1,0 +1,585 @@
+"""Background training scheduler (ISSUE 5 tentpole part 2).
+
+`pio train` blocked a console in the reference; here trains are jobs in
+a persistent queue (same record layer as the model registry, so the
+queue survives server restarts — a new worker re-reads it from storage)
+executed by a supervising worker:
+
+- each job runs ``run_train`` in a **subprocess** (worker.py) so an
+  OOM/segfault in engine code cannot take the scheduler down,
+- the parent heartbeats the job record while the child lives; a worker
+  crash leaves a ``running`` job with a stale heartbeat, and the next
+  scheduler start re-queues it (crash-resume),
+- per-job stdout/stderr land in a log file (`pio jobs logs <id>`),
+- a wall-clock timeout kills runaway trains,
+- infra-class failures (killed child, storage down — exit code ≠ the
+  train-failure code) re-queue with ``resilience.retry`` exponential
+  backoff until `max_attempts`; deterministic train failures fail fast,
+- `period_s` gives cron-style periodic retrain per engine: completion
+  (or final failure) of a periodic job enqueues the next run.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from predictionio_tpu.data.storage.registry import (
+    SourceConfig,
+    Storage,
+    StorageConfig,
+)
+from predictionio_tpu.deploy.registry import LifecycleRecordStore
+from predictionio_tpu.obs import get_default_registry
+from predictionio_tpu.resilience.retry import RetryPolicy
+
+log = logging.getLogger(__name__)
+
+JOB_ENTITY = "pio_train_job"
+
+JOB_STATUSES = ("queued", "running", "completed", "failed")
+
+# worker.py exit codes: train failures are deterministic (retry would
+# reproduce them), anything else is infra and worth a backoff retry
+EXIT_TRAIN_FAILED = 3
+EXIT_INFRA_FAILED = 4
+
+
+def storage_config_to_json(config: StorageConfig) -> dict:
+    """StorageConfig → JSON round-trip so the train subprocess opens the
+    SAME stores as the scheduler (the reference shipped env vars to the
+    spark-submit child; this is the explicit version)."""
+    return {
+        "sources": {
+            name: {"type": s.type, "settings": dict(s.settings)}
+            for name, s in config.sources.items()
+        },
+        "repositories": dict(config.repositories),
+    }
+
+
+def storage_config_from_json(obj: dict) -> StorageConfig:
+    return StorageConfig(
+        sources={
+            name: SourceConfig(name, s["type"], dict(s.get("settings", {})))
+            for name, s in obj.get("sources", {}).items()
+        },
+        repositories=dict(obj.get("repositories", {})),
+    )
+
+
+def _utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _now_iso() -> str:
+    return _utcnow().isoformat()
+
+
+@dataclass
+class TrainJob:
+    """One queued/running/finished train-job record."""
+
+    id: str
+    variant: dict[str, Any]
+    engine_id: str
+    status: str = "queued"
+    created_at: str = ""
+    not_before: float = 0.0  # epoch seconds; backoff/periodic gate
+    started_at: Optional[str] = None
+    finished_at: Optional[str] = None
+    heartbeat_at: float = 0.0  # epoch seconds; parent liveness signal
+    attempt: int = 0
+    max_attempts: int = 3
+    timeout_s: Optional[float] = None
+    period_s: Optional[float] = None  # periodic retrain interval
+    last_error: Optional[str] = None
+    instance_id: Optional[str] = None
+    model_version: Optional[str] = None
+    log_path: Optional[str] = None
+    worker_id: Optional[str] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id, "variant": self.variant,
+            "engine_id": self.engine_id, "status": self.status,
+            "created_at": self.created_at, "not_before": self.not_before,
+            "started_at": self.started_at, "finished_at": self.finished_at,
+            "heartbeat_at": self.heartbeat_at, "attempt": self.attempt,
+            "max_attempts": self.max_attempts, "timeout_s": self.timeout_s,
+            "period_s": self.period_s, "last_error": self.last_error,
+            "instance_id": self.instance_id,
+            "model_version": self.model_version,
+            "log_path": self.log_path, "worker_id": self.worker_id,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "TrainJob":
+        job = TrainJob(
+            id=d["id"], variant=dict(d.get("variant") or {}),
+            engine_id=d.get("engine_id", ""),
+        )
+        for k in (
+            "status", "created_at", "not_before", "started_at",
+            "finished_at", "heartbeat_at", "attempt", "max_attempts",
+            "timeout_s", "period_s", "last_error", "instance_id",
+            "model_version", "log_path", "worker_id",
+        ):
+            if d.get(k) is not None:
+                setattr(job, k, d[k])
+        return job
+
+
+class JobQueue:
+    """Storage-backed job records — shared by the console, the admin
+    server, and the scheduler worker, so a `pio jobs submit` from any
+    host lands in the queue every worker polls."""
+
+    def __init__(self, storage: Storage):
+        self.storage = storage
+        self._store = LifecycleRecordStore(storage)
+
+    def submit(
+        self,
+        variant: dict,
+        engine_id: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+        period_s: Optional[float] = None,
+        max_attempts: int = 3,
+        not_before: float = 0.0,
+        attempt: int = 0,
+    ) -> TrainJob:
+        for key in ("id", "engineFactory"):
+            if key not in variant:
+                raise ValueError(f"engine variant is missing {key!r}")
+
+        # validate numerics AT SUBMIT: a string timeout_s stored raw
+        # would 201 now and wedge the job at claim time (TypeError mid-
+        # supervision leaves it `running` until a scheduler restart)
+        def _num(name: str, val: Any) -> Optional[float]:
+            if val is None:
+                return None
+            try:
+                out = float(val)
+            except (TypeError, ValueError):
+                raise ValueError(f"{name} must be a number, got {val!r}")
+            if out <= 0:
+                raise ValueError(f"{name} must be positive, got {out}")
+            return out
+
+        job = TrainJob(
+            id=f"job-{uuid.uuid4().hex[:12]}",
+            variant=dict(variant),
+            engine_id=engine_id or variant["id"],
+            created_at=_now_iso(),
+            not_before=not_before,
+            timeout_s=_num("timeout_s", timeout_s),
+            period_s=_num("period_s", period_s),
+            max_attempts=max(1, int(max_attempts)),
+            attempt=attempt,
+        )
+        self._store.append(JOB_ENTITY, job.id, job.to_dict())
+        return job
+
+    def update(self, job_id: str, **fields: Any) -> str:
+        return self._store.append(JOB_ENTITY, job_id, fields)
+
+    def heartbeat(self, job_id: str, prev_event_id: Optional[str]) -> str:
+        """Heartbeat with compaction: append the new beat, then delete
+        the previous one — a 1-hour train leaves ONE heartbeat event in
+        the job's fold, not 3600 (the fold is re-read by every queue
+        poll, so unbounded growth there is quadratic storage work)."""
+        eid = self.update(job_id, heartbeat_at=time.time())
+        if prev_event_id:
+            self._store.discard(prev_event_id)
+        return eid
+
+    def get(self, job_id: str) -> Optional[TrainJob]:
+        d = self._store.fold(JOB_ENTITY, job_id).get(job_id)
+        return TrainJob.from_dict(d) if d else None
+
+    def list(self, status: Optional[str] = None) -> list[TrainJob]:
+        jobs = [
+            TrainJob.from_dict(d)
+            for d in self._store.fold(JOB_ENTITY).values()
+        ]
+        if status is not None:
+            jobs = [j for j in jobs if j.status == status]
+        jobs.sort(key=lambda j: j.created_at)
+        return jobs
+
+    def purge(self, job_id: str) -> int:
+        return self._store.purge(JOB_ENTITY, job_id)
+
+    def gc(self, keep: int = 200) -> list[str]:
+        """Purge terminal (completed/failed) job records beyond the
+        newest `keep`. Every queue poll re-folds the full job history,
+        so without retention a periodic retrain (24 jobs/day) grows the
+        scheduler's hot loop without bound. Returns purged ids."""
+        if keep < 0:
+            raise ValueError("keep must be >= 0")
+        terminal = [
+            j for j in self.list()  # oldest-first by created_at
+            if j.status in ("completed", "failed")
+        ]
+        doomed = terminal[: len(terminal) - keep] if keep else terminal
+        for j in doomed:
+            self._store.purge(JOB_ENTITY, j.id)
+        return [j.id for j in doomed]
+
+    def claimable(self, now_epoch: Optional[float] = None) -> list[TrainJob]:
+        now_epoch = time.time() if now_epoch is None else now_epoch
+        return [
+            j for j in self.list(status="queued")
+            if j.not_before <= now_epoch
+        ]
+
+
+@dataclass
+class SchedulerConfig:
+    poll_interval_s: float = 0.5
+    heartbeat_interval_s: float = 1.0
+    # a `running` job whose heartbeat is older than this is an orphan of
+    # a crashed worker and gets re-queued on scheduler start
+    stale_after_s: float = 15.0
+    default_timeout_s: float = 3600.0
+    # terminal job records kept by the periodic retention sweep (the
+    # queue poll re-folds the whole job history, so it must stay bounded)
+    job_retention: int = 200
+    log_dir: Optional[str] = None
+    # infra-failure re-queue backoff (reusing resilience.retry so the
+    # schedule matches the storage client's semantics)
+    retry: RetryPolicy = field(
+        default_factory=lambda: RetryPolicy(
+            max_attempts=3, base_delay=1.0, multiplier=4.0, max_delay=60.0
+        )
+    )
+    # extra env for the child (tests add PYTHONPATH for their engines)
+    child_env: dict[str, str] = field(default_factory=dict)
+
+
+class TrainScheduler:
+    """The worker: claims queued jobs and supervises their subprocesses.
+
+    One scheduler per deployment is the normal shape; the claim protocol
+    is last-write-wins (heartbeats carry the worker id), so a second
+    worker is safe-but-wasteful rather than corrupting."""
+
+    def __init__(
+        self, storage: Storage, config: Optional[SchedulerConfig] = None
+    ):
+        self.storage = storage
+        self.config = config or SchedulerConfig()
+        self.queue = JobQueue(storage)
+        self.worker_id = f"worker-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self._stop = threading.Event()
+        self._abandon = False  # crash simulation: die without bookkeeping
+        self._thread: Optional[threading.Thread] = None
+        self._child: Optional[subprocess.Popen] = None
+        self._child_lock = threading.Lock()
+        self._log_dir = self.config.log_dir or os.path.join(
+            tempfile.gettempdir(), "pio_train_jobs"
+        )
+        self._jobs_counter = get_default_registry().counter(
+            "train_jobs_total", "scheduler job outcomes", ("outcome",)
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._stop.clear()
+        self._abandon = False
+        self._thread = threading.Thread(
+            target=self._loop, name="train-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, kill_child: bool = False) -> None:
+        """Stop polling. `kill_child=True` hard-kills an in-flight train
+        subprocess AND abandons its record unchanged — the chaos-test
+        stand-in for a worker crash (the job stays `running` with a
+        going-stale heartbeat until the next scheduler start resumes
+        it); a plain stop BLOCKS until an in-flight train finishes and
+        is bookkept — returning early would let the interpreter exit
+        kill the daemon supervisor mid-train, orphaning a child whose
+        stale heartbeat then gets the job trained a second time. The
+        wait is bounded by the job's own timeout enforcement."""
+        self._stop.set()
+        if kill_child:
+            self._abandon = True
+            with self._child_lock:
+                child = self._child
+            if child is not None and child.poll() is None:
+                child.kill()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # -- crash resume -----------------------------------------------------
+    def resume_orphans(self) -> list[str]:
+        """Re-queue `running` jobs whose heartbeat went stale (their
+        worker died mid-train). Returns the re-queued job ids."""
+        cutoff = time.time() - self.config.stale_after_s
+        requeued = []
+        for job in self.queue.list(status="running"):
+            if job.heartbeat_at >= cutoff:
+                continue
+            if job.attempt >= job.max_attempts:
+                # a train that keeps killing its worker must not
+                # crash-loop forever: the attempt budget covers orphan
+                # resumes too, not just supervised infra failures
+                log.warning(
+                    "job %s orphaned on final attempt %d/%d; failing",
+                    job.id, job.attempt, job.max_attempts,
+                )
+                self.queue.update(
+                    job.id, status="failed", finished_at=_now_iso(),
+                    last_error="worker crashed mid-train; attempts "
+                               "exhausted",
+                )
+                self._jobs_counter.inc(outcome="failed_infra")
+                # a periodic retrain chain must survive one exhausted
+                # run — the supervised failure path schedules the next
+                # period, and the orphan path owes the same
+                self._schedule_next_period(job)
+                continue
+            log.warning(
+                "job %s orphaned (heartbeat %.1fs stale); re-queuing",
+                job.id, time.time() - job.heartbeat_at,
+            )
+            self.queue.update(
+                job.id, status="queued", worker_id=None,
+                last_error="worker crashed mid-train; re-queued",
+            )
+            self._jobs_counter.inc(outcome="requeued_orphan")
+            requeued.append(job.id)
+        return requeued
+
+    # -- main loop --------------------------------------------------------
+    def _loop(self) -> None:
+        last_resume = 0.0
+        while not self._stop.is_set():
+            # orphan resume runs on start AND periodically: a job whose
+            # post-claim bookkeeping failed on THIS worker (storage
+            # blip) wedges in `running` and must be resumed without
+            # waiting for a process restart
+            if time.monotonic() - last_resume >= self.config.stale_after_s:
+                last_resume = time.monotonic()
+                try:
+                    self.resume_orphans()
+                    self.queue.gc(keep=self.config.job_retention)
+                except Exception:
+                    log.exception("orphan resume/gc failed; continuing")
+            try:
+                ready = self.queue.claimable()
+            except Exception:
+                log.exception("job poll failed (storage down?); retrying")
+                ready = []
+            ran = False
+            for job in ready:
+                if self._stop.is_set():
+                    break
+                try:
+                    self._run_job(job)
+                except Exception:
+                    # a storage/filesystem error mid-supervision must
+                    # not kill the scheduler thread — the job's stale
+                    # heartbeat makes it an orphan the next pass resumes
+                    log.exception("job %s supervision failed", job.id)
+                ran = True
+            if not ran:
+                self._stop.wait(self.config.poll_interval_s)
+
+    def run_pending_once(self) -> int:
+        """Drain currently-claimable jobs synchronously (tests and
+        `pio jobs worker --once`). Returns how many ran."""
+        self.resume_orphans()
+        ready = self.queue.claimable()
+        for job in ready:
+            self._run_job(job)
+        return len(ready)
+
+    # -- job execution ----------------------------------------------------
+    def _run_job(self, job: TrainJob) -> None:
+        os.makedirs(self._log_dir, mode=0o700, exist_ok=True)
+        log_path = os.path.join(self._log_dir, f"{job.id}.log")
+        self.queue.update(
+            job.id, status="running", worker_id=self.worker_id,
+            started_at=_now_iso(), heartbeat_at=time.time(),
+            log_path=log_path, attempt=job.attempt + 1,
+        )
+        job.attempt += 1
+        spec_path = os.path.join(self._log_dir, f"{job.id}.spec.json")
+        result_path = os.path.join(self._log_dir, f"{job.id}.result.json")
+        # the spec carries the storage wiring VERBATIM — including any
+        # source passwords — so it is owner-only and deleted after the
+        # run (the default tempdir log_dir is shared on multi-user hosts)
+        fd = os.open(spec_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump({
+                "job_id": job.id,
+                "storage": storage_config_to_json(self.storage.config),
+                "variant": job.variant,
+                "engine_id": job.engine_id,
+                "result_path": result_path,
+            }, f)
+        try:
+            self._supervise(job, spec_path, result_path, log_path)
+        finally:
+            for p in (spec_path, result_path):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def _supervise(
+        self, job: TrainJob, spec_path: str, result_path: str, log_path: str
+    ) -> None:
+        env = dict(os.environ, **self.config.child_env)
+        timeout_s = job.timeout_s or self.config.default_timeout_s
+        deadline = time.monotonic() + timeout_s
+        timed_out = False
+        try:
+            with open(log_path, "ab") as logf:
+                logf.write(
+                    f"--- attempt {job.attempt} ({_now_iso()}) ---\n".encode()
+                )
+                logf.flush()
+                child = subprocess.Popen(
+                    [sys.executable, "-m", "predictionio_tpu.deploy.worker",
+                     spec_path],
+                    stdout=logf, stderr=subprocess.STDOUT, env=env,
+                )
+            with self._child_lock:
+                self._child = child
+            # heartbeat while the child lives: liveness for crash
+            # detection AND the timeout enforcement point. A clean
+            # stop() does NOT break out — the supervisor keeps
+            # heartbeating (so a restarted scheduler can't mistake this
+            # still-running job for an orphan and train it twice) and
+            # keeps enforcing the timeout until the child exits;
+            # stop(kill_child=True) is the crash path.
+            hb_event: Optional[str] = None
+            try:
+                while True:
+                    try:
+                        rc = child.wait(
+                            timeout=self.config.heartbeat_interval_s
+                        )
+                        break
+                    except subprocess.TimeoutExpired:
+                        if self._abandon:
+                            return  # crashed worker: no bookkeeping at all
+                        try:
+                            hb_event = self.queue.heartbeat(job.id, hb_event)
+                        except Exception:
+                            # transient storage outage must not abort
+                            # supervision of a healthy train — keep
+                            # enforcing the timeout; the beat resumes
+                            # when storage answers again
+                            log.warning(
+                                "job %s heartbeat write failed (storage "
+                                "down?); supervision continues", job.id,
+                                exc_info=True,
+                            )
+                        if time.monotonic() >= deadline:
+                            timed_out = True
+                            child.kill()
+                            rc = child.wait()
+                            break
+            except BaseException:
+                # supervision is dying for real: never leave the child
+                # running unsupervised (it would finish on its own and
+                # the orphan resume would then train the job a 2nd time)
+                if child.poll() is None:
+                    child.kill()
+                    child.wait()
+                raise
+        except FileNotFoundError as e:  # interpreter/module missing
+            self._finish_infra(job, f"could not spawn train worker: {e}")
+            return
+        finally:
+            with self._child_lock:
+                self._child = None
+        if self._abandon:
+            return  # crashed worker: the record keeps its stale heartbeat
+        if timed_out:
+            self._finish_infra(
+                job, f"train exceeded timeout ({timeout_s:.0f}s); killed"
+            )
+            return
+        if rc == 0:
+            try:
+                with open(result_path) as f:
+                    result = json.load(f)
+            except (OSError, ValueError) as e:
+                self._finish_infra(job, f"train result unreadable: {e}")
+                return
+            self.queue.update(
+                job.id, status="completed", finished_at=_now_iso(),
+                instance_id=result.get("instance_id"),
+                model_version=result.get("model_version"),
+                last_error=None,
+            )
+            self._jobs_counter.inc(outcome="completed")
+            self._schedule_next_period(job)
+        elif rc == EXIT_TRAIN_FAILED:
+            # deterministic failure: retrying reproduces it — fail fast
+            self.queue.update(
+                job.id, status="failed", finished_at=_now_iso(),
+                last_error=f"train failed (see {log_path})",
+            )
+            self._jobs_counter.inc(outcome="failed_train")
+            self._schedule_next_period(job)
+        else:
+            self._finish_infra(
+                job, f"train worker exited {rc} (see {log_path})"
+            )
+
+    def _finish_infra(self, job: TrainJob, error: str) -> None:
+        """Infra-class failure: re-queue with backoff, or give up after
+        max_attempts."""
+        if job.attempt >= job.max_attempts:
+            self.queue.update(
+                job.id, status="failed", finished_at=_now_iso(),
+                last_error=f"{error} (attempts exhausted)",
+            )
+            self._jobs_counter.inc(outcome="failed_infra")
+            self._schedule_next_period(job)
+            return
+        backoff = self.config.retry.delay(job.attempt - 1)
+        self.queue.update(
+            job.id, status="queued", last_error=error,
+            not_before=time.time() + backoff, worker_id=None,
+        )
+        self._jobs_counter.inc(outcome="retried")
+        log.warning(
+            "job %s infra failure (%s); retry %d/%d in %.1fs",
+            job.id, error, job.attempt, job.max_attempts, backoff,
+        )
+
+    def _schedule_next_period(self, job: TrainJob) -> None:
+        """Cron-style periodic retrain: a finished periodic job enqueues
+        its next run (fixed-delay schedule — the next run starts
+        `period_s` after this one ENDED, so a slow train can't stack)."""
+        if not job.period_s:
+            return
+        nxt = self.queue.submit(
+            job.variant, engine_id=job.engine_id,
+            timeout_s=job.timeout_s, period_s=job.period_s,
+            max_attempts=job.max_attempts,
+            not_before=time.time() + job.period_s,
+        )
+        log.info(
+            "periodic retrain: job %s scheduled %.0fs after %s finished",
+            nxt.id, job.period_s, job.id,
+        )

@@ -61,13 +61,21 @@ class FaultSpecError(ValueError):
 @dataclass(frozen=True)
 class FaultSpec:
     """One fault point's behavior. `param` is the sleep seconds for mode
-    ``delay`` (ignored otherwise)."""
+    ``delay`` (ignored otherwise). `scope` narrows the spec to call sites
+    that fire the point with a matching scope label (ISSUE 5: rollout
+    needs `dispatch.device@candidate` to flip ONLY the canary variant bad
+    while the live model keeps serving); a scope-less spec keeps the PR-4
+    behavior of matching every fire of the point."""
 
     point: str
     mode: str
     probability: float
     param: float = 0.05
     seed: Optional[int] = None
+    scope: Optional[str] = None
+
+    def key(self) -> str:
+        return self.point if self.scope is None else f"{self.point}@{self.scope}"
 
     def __post_init__(self):
         if self.point not in FAULT_POINTS:
@@ -93,22 +101,24 @@ class FaultSpec:
             "probability": self.probability,
             "param": self.param,
             "seed": self.seed,
+            "scope": self.scope,
         }
 
 
 def parse_spec(text: str, seed: Optional[int] = None) -> FaultSpec:
-    """``point:mode:prob[:param]`` → FaultSpec."""
+    """``point[@scope]:mode:prob[:param]`` → FaultSpec."""
     parts = text.strip().split(":")
     if len(parts) not in (3, 4):
         raise FaultSpecError(
-            f"fault spec {text!r} is not point:mode:prob[:param]"
+            f"fault spec {text!r} is not point[@scope]:mode:prob[:param]"
         )
     try:
         prob = float(parts[2])
         param = float(parts[3]) if len(parts) == 4 else 0.05
     except ValueError as e:
         raise FaultSpecError(f"fault spec {text!r}: {e}")
-    return FaultSpec(parts[0], parts[1], prob, param, seed)
+    point, _, scope = parts[0].partition("@")
+    return FaultSpec(point, parts[1], prob, param, seed, scope or None)
 
 
 def parse_specs(text: str, seed: Optional[int] = None) -> list[FaultSpec]:
@@ -131,11 +141,12 @@ class FaultRegistry:
     def install(self, spec: FaultSpec) -> None:
         with self._lock:
             specs = dict(self._specs)
-            specs[spec.point] = spec
-            self._rngs[spec.point] = random.Random(spec.seed)
+            specs[spec.key()] = spec
+            self._rngs[spec.key()] = random.Random(spec.seed)
             self._specs = specs
 
     def clear(self, point: Optional[str] = None) -> None:
+        """Clear one spec key (``point`` or ``point@scope``), or all."""
         with self._lock:
             if point is None:
                 self._specs = {}
@@ -152,19 +163,35 @@ class FaultRegistry:
     def active(self) -> bool:
         return bool(self._specs)
 
-    def fire(self, point: str, corruptable: bool = False) -> Optional[str]:
+    def fire(
+        self, point: str, corruptable: bool = False,
+        scope: Optional[str] = None, scoped_only: bool = False,
+    ) -> Optional[str]:
         """Evaluate the fault point. Returns None (no fault), ``"delay"``
         (after sleeping), or ``"corrupt"`` (the caller substitutes a
         garbled result); raises :class:`FaultInjected` for mode ``error``
-        — and for ``corrupt`` when the site can't corrupt its result."""
+        — and for ``corrupt`` when the site can't corrupt its result.
+
+        A `scope` label matches ``point@scope`` specs first, then falls
+        through to the scope-less spec; `scoped_only=True` skips the
+        fall-through — for call sites (the dispatcher's per-query
+        fallback) that must keep their PR-4 behavior under scope-less
+        specs but still honor a variant-targeted one."""
         specs = self._specs  # lock-free snapshot read; {} when inert
         if not specs:
             return None
-        spec = specs.get(point)
+        key = point
+        spec = specs.get(f"{point}@{scope}") if scope is not None else None
+        if spec is not None:
+            key = spec.key()
+        elif scoped_only:
+            return None
+        else:
+            spec = specs.get(point)
         if spec is None:
             return None
         with self._lock:
-            rng = self._rngs.get(point)
+            rng = self._rngs.get(key)
             roll = rng.random() if rng is not None else random.random()
         if roll >= spec.probability:
             return None
@@ -229,8 +256,11 @@ def registry() -> FaultRegistry:
     return _default
 
 
-def fire(point: str, corruptable: bool = False) -> Optional[str]:
-    return _default.fire(point, corruptable)
+def fire(
+    point: str, corruptable: bool = False,
+    scope: Optional[str] = None, scoped_only: bool = False,
+) -> Optional[str]:
+    return _default.fire(point, corruptable, scope, scoped_only)
 
 
 def install(spec: FaultSpec) -> None:

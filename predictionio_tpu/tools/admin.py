@@ -7,14 +7,33 @@ experimental REST mirror of the console's app commands:
   POST   /cmd/app              → create app {"name": ...}
   DELETE /cmd/app/{name}       → delete app
   DELETE /cmd/app/{name}/data  → wipe app event data
+
+Model-lifecycle control plane (ISSUE 5) — all storage-backed, so any
+admin server over the shared stores sees the same queue/registry:
+  GET    /jobs                 → list train jobs (?status= filter)
+  POST   /jobs                 → submit {"variant": {...}, "period_s"?, ...}
+  GET    /jobs/{id}            → one job record
+  GET    /jobs/{id}/logs       → the job's log file (text)
+  GET    /models               → model versions (?engine=&status= filters)
+  GET    /models/{id}          → one version (+lineage)
+  POST   /models/{id}/promote  → mark live (previous live → archived)
+  POST   /models/{id}/rollback → mark rolled_back {"reason"?}
+  GET    /rollout              → registry view of canary/live versions
+  POST   /rollout              → proxy start/abort/status to a query
+                                 server: {"url", "action", ...}
 """
 
 from __future__ import annotations
 
+import json
+import urllib.error
+import urllib.request
 from typing import Optional
 
 from predictionio_tpu.data.storage.base import App
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.deploy.registry import ModelRegistry
+from predictionio_tpu.deploy.scheduler import JobQueue
 from predictionio_tpu.obs import server_registry
 from predictionio_tpu.tools import common
 from predictionio_tpu.tools.common import CommandError
@@ -33,9 +52,15 @@ class _Handler(JsonHandler):
     def storage(self) -> Storage:
         return self.server.storage
 
+    def _query_params(self) -> dict[str, str]:
+        from urllib.parse import parse_qsl, urlsplit
+
+        return dict(parse_qsl(urlsplit(self.path).query))
+
     def do_GET(self):
         self._drain_body()
         path = self.path.split("?")[0].rstrip("/") or "/"
+        parts = [p for p in path.split("/") if p]
         try:
             if path == "/":
                 self._respond(200, {"status": "alive"})
@@ -47,6 +72,12 @@ class _Handler(JsonHandler):
                 self._serve_debug_profile()
             elif path == "/debug/faults":
                 self._serve_debug_faults()
+            elif parts[:1] == ["jobs"]:
+                self._get_jobs(parts)
+            elif parts[:1] == ["models"]:
+                self._get_models(parts)
+            elif path == "/rollout":
+                self._get_rollout()
             elif path == "/cmd/app":
                 apps = self.storage.get_meta_data_apps().get_all()
                 keys = self.storage.get_meta_data_access_keys()
@@ -85,6 +116,14 @@ class _Handler(JsonHandler):
                 self._respond(
                     201, {"name": app.name, "id": app.id, "accessKey": key}
                 )
+            elif path == "/jobs":
+                self._post_job()
+            elif path.startswith("/models/"):
+                self._post_model(
+                    [p for p in path.split("/") if p]
+                )
+            elif path == "/rollout":
+                self._post_rollout()
             elif path == "/debug/profile/capture":
                 # guarded admin mirror of the query server's endpoint —
                 # useful when a train workflow shares this process
@@ -112,6 +151,165 @@ class _Handler(JsonHandler):
         except HttpError as e:
             self._respond(e.status, {"message": e.message})
 
+    # -- model lifecycle control plane (ISSUE 5) ---------------------------
+    def _get_jobs(self, parts: list[str]) -> None:
+        queue = self.server.job_queue
+        if len(parts) == 1:
+            status = self._query_params().get("status")
+            self._respond(
+                200, [j.to_dict() for j in queue.list(status=status)]
+            )
+            return
+        job = queue.get(parts[1])
+        if job is None:
+            raise HttpError(404, f"no job {parts[1]!r}")
+        if len(parts) == 2:
+            self._respond(200, job.to_dict())
+        elif len(parts) == 3 and parts[2] == "logs":
+            if not job.log_path:
+                raise HttpError(404, f"job {job.id} has no log yet")
+            try:
+                with open(job.log_path, "rb") as f:
+                    data = f.read().decode(errors="replace")
+            except OSError as e:
+                raise HttpError(404, f"job log unreadable: {e}")
+            self._respond(200, data, "text/plain")
+        else:
+            raise HttpError(404, "Not Found")
+
+    def _post_job(self) -> None:
+        obj = self._json_body()
+        if not isinstance(obj, dict) or not isinstance(
+            obj.get("variant"), dict
+        ):
+            raise HttpError(400, "job body must carry a 'variant' object")
+        try:
+            job = self.server.job_queue.submit(
+                obj["variant"],
+                engine_id=obj.get("engine_id"),
+                timeout_s=obj.get("timeout_s"),
+                period_s=obj.get("period_s"),
+                max_attempts=int(obj.get("max_attempts", 3)),
+            )
+        except (ValueError, TypeError) as e:
+            raise HttpError(400, str(e))
+        self._respond(201, job.to_dict())
+
+    def _get_models(self, parts: list[str]) -> None:
+        registry = self.server.model_registry
+        if len(parts) == 1:
+            q = self._query_params()
+            self._respond(200, [
+                v.to_dict()
+                for v in registry.list(
+                    engine_id=q.get("engine"), status=q.get("status")
+                )
+            ])
+            return
+        version = registry.get(parts[1])
+        if version is None:
+            raise HttpError(404, f"no model version {parts[1]!r}")
+        self._respond(200, dict(
+            version.to_dict(),
+            lineage=[v.id for v in registry.lineage(version.id)],
+        ))
+
+    def _post_model(self, parts: list[str]) -> None:
+        if len(parts) != 3 or parts[2] not in ("promote", "rollback"):
+            raise HttpError(404, "Not Found")
+        registry = self.server.model_registry
+        body = self._json_body()
+        reason = (
+            body.get("reason") if isinstance(body, dict) else None
+        ) or "operator request"
+        try:
+            if parts[2] == "promote":
+                version = registry.promote(parts[1])
+            else:
+                version = registry.rollback(parts[1], reason)
+        except KeyError as e:
+            raise HttpError(404, str(e.args[0] if e.args else e))
+        self._respond(200, version.to_dict())
+
+    def _get_rollout(self) -> None:
+        """Registry-side rollout view: what is live and what is baking,
+        per engine variant (the query server's /rollout/status has the
+        live traffic windows)."""
+        versions = self.server.model_registry.list()  # one fold
+        self._respond(200, {
+            "canary": [
+                v.to_dict() for v in versions if v.status == "canary"
+            ],
+            "live": [v.to_dict() for v in versions if v.status == "live"],
+        })
+
+    def _post_rollout(self) -> None:
+        """Proxy a rollout action to the query server that owns the
+        runtimes: {"url": "http://host:8000", "action":
+        "start|abort|status", ...verdict overrides}.
+
+        Guarded like POST /debug/faults: fetching a caller-supplied URL
+        from the admin server is an SSRF primitive, so the proxy is
+        disabled unless the operator set PIO_ROLLOUT_PROXY=1 (the `pio
+        rollout` console talks to the query server directly and needs
+        no gate)."""
+        import os as _os
+        from urllib.parse import urlsplit
+
+        if not _os.environ.get("PIO_ROLLOUT_PROXY"):
+            raise HttpError(403, "rollout proxy is disabled: set "
+                                 "PIO_ROLLOUT_PROXY=1 on this server to "
+                                 "enable it")
+        obj = self._json_body()
+        if not isinstance(obj, dict) or not obj.get("url"):
+            raise HttpError(400, "rollout body must carry the query "
+                                 "server 'url'")
+        action = obj.get("action", "start")
+        if action not in ("start", "abort", "status"):
+            raise HttpError(400, f"unknown rollout action {action!r}")
+        parts = urlsplit(obj["url"])
+        # scheme+host+port only: a url with a path/query would smuggle
+        # the appended /rollout/<action> into someone else's route
+        if parts.scheme not in ("http", "https") or not parts.netloc or (
+            parts.path not in ("", "/") or parts.query or parts.fragment
+        ):
+            raise HttpError(
+                400, "rollout 'url' must be http(s)://host[:port] only"
+            )
+        base = f"{parts.scheme}://{parts.netloc}"
+        payload = {
+            k: v for k, v in obj.items() if k not in ("url", "action")
+        }
+        try:
+            if action == "status":
+                req = urllib.request.Request(f"{base}/rollout/status")
+            else:
+                req = urllib.request.Request(
+                    f"{base}/rollout/{action}",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                raw = r.read().decode(errors="replace")
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    # wrong port (an HTML server 200s): a clean 502
+                    # beats an uncaught parse error dropping the socket
+                    raise HttpError(
+                        502, f"query server returned non-JSON: {raw[:200]}"
+                    )
+                self._respond(r.status, body)
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            try:
+                self._respond(e.code, json.loads(body))
+            except ValueError:
+                self._respond(e.code, {"message": body})
+        except OSError as e:
+            raise HttpError(502, f"query server unreachable: {e}")
+
     def _app(self, name: str) -> App:
         app = self.storage.get_meta_data_apps().get_by_name(name)
         if app is None:
@@ -131,6 +329,10 @@ class _Server(ThreadedServer):
     def __init__(self, addr, storage: Storage):
         super().__init__(addr, _Handler)
         self.storage = storage
+        # one registry/queue per server, not per request: their
+        # init_app memoization (a storage round trip) lives on them
+        self.model_registry = ModelRegistry(storage)
+        self.job_queue = JobQueue(storage)
         self.metrics = server_registry()
         self.metrics_label = "admin"
 

@@ -14,6 +14,8 @@ Commands:
   status / export / import
   metrics / trace list|show|export / profile list|show|capture
   faults list|set|clear
+  jobs submit|list|show|logs|worker / models list|show|promote|rollback|gc
+  rollout start|status|abort
 """
 
 from __future__ import annotations
@@ -680,8 +682,14 @@ def cmd_faults(args) -> int:
             extra = (
                 f" param={s['param']}" if s["mode"] == "delay" else ""
             ) + (f" seed={s['seed']}" if s.get("seed") is not None else "")
+            # print the full registry key (point@scope): it round-trips
+            # into `pio faults clear <key>` — printing the bare point
+            # for a scoped spec would name a key that clears nothing
+            name = s["point"] + (
+                f"@{s['scope']}" if s.get("scope") else ""
+            )
             print(
-                f"[INFO]   {s['point']}: {s['mode']} "
+                f"[INFO]   {name}: {s['mode']} "
                 f"p={s['probability']}{extra}"
             )
 
@@ -710,6 +718,230 @@ def cmd_faults(args) -> int:
         return 0
     faults.clear(point)
     _print(faults.specs())
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# model lifecycle (ISSUE 5): jobs / models / rollout
+# ---------------------------------------------------------------------------
+
+
+def cmd_jobs(args) -> int:
+    """`pio jobs submit|list|show|logs|worker` — the background training
+    queue. Storage-backed: submit from any host sharing the stores; a
+    `worker` (here or embedded elsewhere) picks jobs up."""
+    from predictionio_tpu.deploy.scheduler import (
+        JobQueue,
+        SchedulerConfig,
+        TrainScheduler,
+    )
+
+    storage = _storage()
+    queue = JobQueue(storage)
+    action = args.jobs_action
+    if action == "submit":
+        from predictionio_tpu.workflow.core import load_variant
+
+        try:
+            variant = load_variant(args.variant)
+            job = queue.submit(
+                variant,
+                timeout_s=args.timeout,
+                period_s=args.period,
+                max_attempts=args.max_attempts,
+            )
+        except (OSError, ValueError) as e:
+            return _fail(str(e))
+        print(f"[INFO] submitted train job {job.id} "
+              f"(engine {job.engine_id})")
+        if job.period_s:
+            print(f"[INFO] periodic retrain every {job.period_s:.0f}s")
+        return 0
+    if action == "list":
+        jobs = queue.list(status=getattr(args, "status", None))
+        if not jobs:
+            print("[INFO] no train jobs")
+            return 0
+        print(f"[INFO] {len(jobs)} train job(s):")
+        for j in jobs:
+            extra = f" attempt={j.attempt}/{j.max_attempts}"
+            if j.model_version:
+                extra += f" version={j.model_version}"
+            if j.last_error:
+                extra += f" error={j.last_error!r}"
+            print(f"[INFO]   {j.id} [{j.status}] engine={j.engine_id}"
+                  f" created={j.created_at}{extra}")
+        return 0
+    if action == "gc":
+        purged = queue.gc(keep=args.keep)
+        print(f"[INFO] purged {len(purged)} terminal job record(s)"
+              + (f": {', '.join(purged)}" if purged else ""))
+        return 0
+    if action in ("show", "logs"):
+        job = queue.get(args.job_id)
+        if job is None:
+            return _fail(f"no job {args.job_id!r}")
+        if action == "show":
+            import json as _json
+
+            print(_json.dumps(job.to_dict(), indent=2))
+            return 0
+        if not job.log_path:
+            return _fail(f"job {job.id} has no log yet")
+        try:
+            with open(job.log_path, errors="replace") as f:
+                sys.stdout.write(f.read())
+        except OSError as e:
+            return _fail(f"job log unreadable: {e}")
+        return 0
+    # worker
+    cfg = SchedulerConfig()
+    if args.log_dir:
+        cfg.log_dir = args.log_dir
+    scheduler = TrainScheduler(storage, cfg)
+    if args.once:
+        n = scheduler.run_pending_once()
+        print(f"[INFO] ran {n} pending job(s)")
+        return 0
+    scheduler.start()
+    print(f"[INFO] train scheduler running as {scheduler.worker_id} "
+          "(Ctrl-C to stop)")
+    try:
+        while True:
+            import time as _time
+
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        print("[INFO] stopping scheduler (in-flight train finishes)")
+        scheduler.stop()
+        return 0
+
+
+def cmd_models(args) -> int:
+    """`pio models list|show|promote|rollback|gc` — the version registry."""
+    from predictionio_tpu.deploy.registry import ModelRegistry
+
+    registry = ModelRegistry(_storage())
+    action = args.models_action
+    if action == "list":
+        versions = registry.list(
+            engine_id=getattr(args, "engine", None),
+            status=getattr(args, "status", None),
+        )
+        if not versions:
+            print("[INFO] no registered model versions")
+            return 0
+        print(f"[INFO] {len(versions)} model version(s):")
+        for v in versions:
+            note = f" ({v.reason})" if v.reason else ""
+            print(f"[INFO]   {v.id} [{v.status}] "
+                  f"{v.engine_id}/{v.engine_variant} "
+                  f"instance={v.instance_id} params={v.params_hash}"
+                  f" created={v.created_at}{note}")
+        return 0
+    if action == "gc":
+        collected = registry.gc(
+            keep=args.keep, delete_blobs=args.delete_blobs
+        )
+        print(f"[INFO] collected {len(collected)} version(s)"
+              + (f": {', '.join(v.id for v in collected)}"
+                 if collected else ""))
+        return 0
+    version = registry.get(args.version_id)
+    if version is None:
+        return _fail(f"no model version {args.version_id!r}")
+    if action == "show":
+        import json as _json
+
+        print(_json.dumps(version.to_dict(), indent=2))
+        lineage = registry.lineage(version.id)
+        if len(lineage) > 1:
+            print("[INFO] lineage: " + " <- ".join(v.id for v in lineage))
+        return 0
+    if action == "promote":
+        v = registry.promote(version.id)
+        print(f"[INFO] {v.id} is now live")
+        return 0
+    # rollback
+    v = registry.rollback(version.id, args.reason or "operator rollback")
+    print(f"[INFO] {v.id} marked rolled_back")
+    return 0
+
+
+def cmd_rollout(args) -> int:
+    """`pio rollout start|status|abort` — drive a canary on a running
+    query server (--url)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    action = args.rollout_action
+
+    def _call(path: str, body: Optional[dict] = None) -> dict:
+        req = urllib.request.Request(
+            base + path,
+            data=_json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+            method="POST" if body is not None else "GET",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return _json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            try:
+                detail = _json.loads(detail).get("message", detail)
+            except ValueError:
+                pass
+            raise CommandError(f"query server refused ({e.code}): {detail}")
+        except OSError as e:
+            raise CommandError(f"query server unreachable at {base}: {e}")
+
+    def _print_status(st: dict) -> None:
+        print(f"[INFO] rollout state: {st.get('state')}")
+        if st.get("state") == "none":
+            return
+        v = st.get("version") or {}
+        cfg = st.get("config") or {}
+        print(f"[INFO]   version: {v.get('id')} "
+              f"({v.get('engine_id')}/{v.get('engine_variant')})")
+        print(f"[INFO]   traffic: {cfg.get('fraction', 0) * 100:.0f}%"
+              + (" shadow" if cfg.get("shadow") else ""))
+        if st.get("reason"):
+            print(f"[INFO]   verdict: {st.get('last_action')} "
+                  f"— {st['reason']}")
+        for variant in ("live", "candidate"):
+            s = st.get(variant) or {}
+            agreement = (
+                f" agreement={s['agreement']:.3f}"
+                if "agreement" in s else ""
+            )
+            print(f"[INFO]   {variant}: n={s.get('count', 0)} "
+                  f"err={s.get('error_rate', 0):.3f} "
+                  f"p99={s.get('p99_ms', 0):.1f}ms{agreement}")
+
+    try:
+        if action == "start":
+            body: dict = {}
+            if args.version:
+                body["version"] = args.version
+            for k in ("fraction", "bake_s", "min_requests"):
+                val = getattr(args, k, None)
+                if val is not None:
+                    body[k] = val
+            if args.shadow:
+                body["shadow"] = True
+            _print_status(_call("/rollout/start", body))
+        elif action == "abort":
+            _print_status(
+                _call("/rollout/abort", {"reason": args.reason or
+                                         "operator abort"})
+            )
+        else:
+            _print_status(_call("/rollout/status"))
+    except CommandError as e:
+        return _fail(str(e))
     return 0
 
 
@@ -1069,6 +1301,98 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fault point to clear (default: all)")
     fc.add_argument("--url", help="server base URL")
     fc.set_defaults(func=cmd_faults)
+
+    # model lifecycle (ISSUE 5): jobs / models / rollout
+    s = sub.add_parser(
+        "jobs", help="background training job queue"
+    )
+    jsub = s.add_subparsers(dest="jobs_action", required=True)
+    js = jsub.add_parser("submit", help="queue a train job")
+    js.add_argument("--variant", default="engine.json",
+                    help="engine variant JSON path (default engine.json)")
+    js.add_argument("--timeout", type=float, default=None,
+                    help="wall-clock train timeout in seconds")
+    js.add_argument("--period", type=float, default=None,
+                    help="periodic retrain interval in seconds")
+    js.add_argument("--max-attempts", type=int, default=3,
+                    help="infra-failure retries before the job fails")
+    js.set_defaults(func=cmd_jobs)
+    jl = jsub.add_parser("list", help="list train jobs")
+    jl.add_argument("--status",
+                    choices=("queued", "running", "completed", "failed"))
+    jl.set_defaults(func=cmd_jobs)
+    jo = jsub.add_parser("show", help="one job's full record")
+    jo.add_argument("job_id")
+    jo.set_defaults(func=cmd_jobs)
+    jg = jsub.add_parser("logs", help="print a job's train log")
+    jg.add_argument("job_id")
+    jg.set_defaults(func=cmd_jobs)
+    jj = jsub.add_parser("gc", help="purge old terminal job records")
+    jj.add_argument("--keep", type=int, default=200,
+                    help="completed/failed records to keep")
+    jj.set_defaults(func=cmd_jobs)
+    jw = jsub.add_parser(
+        "worker", help="run the train scheduler worker loop"
+    )
+    jw.add_argument("--log-dir", default=None,
+                    help="per-job log directory")
+    jw.add_argument("--once", action="store_true",
+                    help="drain currently-queued jobs, then exit")
+    jw.set_defaults(func=cmd_jobs)
+
+    s = sub.add_parser(
+        "models", help="model version registry"
+    )
+    msub = s.add_subparsers(dest="models_action", required=True)
+    ml = msub.add_parser("list", help="list model versions")
+    ml.add_argument("--engine", help="filter by engine id")
+    ml.add_argument(
+        "--status",
+        choices=("trained", "canary", "live", "rolled_back", "archived"),
+    )
+    ml.set_defaults(func=cmd_models)
+    mo = msub.add_parser("show", help="one version's record + lineage")
+    mo.add_argument("version_id")
+    mo.set_defaults(func=cmd_models)
+    mp = msub.add_parser("promote", help="mark a version live")
+    mp.add_argument("version_id")
+    mp.set_defaults(func=cmd_models)
+    mr = msub.add_parser("rollback", help="mark a version rolled_back")
+    mr.add_argument("version_id")
+    mr.add_argument("--reason", default=None)
+    mr.set_defaults(func=cmd_models)
+    mg = msub.add_parser("gc", help="retention GC over old versions")
+    mg.add_argument("--keep", type=int, default=5,
+                    help="non-serving versions kept per engine variant")
+    mg.add_argument("--delete-blobs", action="store_true",
+                    help="also delete unreferenced MODELDATA blobs")
+    mg.set_defaults(func=cmd_models)
+
+    s = sub.add_parser(
+        "rollout", help="canary rollout on a running query server"
+    )
+    rsub = s.add_subparsers(dest="rollout_action", required=True)
+    rs = rsub.add_parser("start", help="start a canary")
+    rs.add_argument("--url", default="http://localhost:8000",
+                    help="query server base URL")
+    rs.add_argument("--version", default=None,
+                    help="model version id (default: newest trained)")
+    rs.add_argument("--fraction", type=float, default=None,
+                    help="candidate traffic share (0..1]")
+    rs.add_argument("--bake-s", dest="bake_s", type=float, default=None,
+                    help="healthy seconds before auto-promote")
+    rs.add_argument("--min-requests", dest="min_requests", type=int,
+                    default=None, help="candidate samples before judging")
+    rs.add_argument("--shadow", action="store_true",
+                    help="mirror traffic instead of splitting it")
+    rs.set_defaults(func=cmd_rollout)
+    rt = rsub.add_parser("status", help="rollout status")
+    rt.add_argument("--url", default="http://localhost:8000")
+    rt.set_defaults(func=cmd_rollout)
+    ra = rsub.add_parser("abort", help="abort the active canary")
+    ra.add_argument("--url", default="http://localhost:8000")
+    ra.add_argument("--reason", default=None)
+    ra.set_defaults(func=cmd_rollout)
 
     # export / import
     s = sub.add_parser(

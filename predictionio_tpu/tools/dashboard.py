@@ -91,7 +91,43 @@ class _Handler(JsonHandler):
 <table border="1" cellpadding="4">
 <tr><th>ID</th><th>Started</th><th>Evaluation</th><th>Result</th><th>Reports</th></tr>
 {rows}
-</table></body></html>"""
+</table>
+{self._lifecycle_html()}
+</body></html>"""
+
+    def _lifecycle_html(self) -> str:
+        """Model-lifecycle panel (ISSUE 5): versions newest-first with
+        rollout state; active canaries lead the table. Registry fields
+        carry operator-authored strings (reasons), so everything is
+        escaped."""
+        from predictionio_tpu.deploy.registry import ModelRegistry
+
+        try:
+            registry = getattr(self.server, "model_registry", None)
+            if registry is None:
+                registry = ModelRegistry(self.server.storage)
+                self.server.model_registry = registry
+            versions = registry.list()
+        except Exception:
+            return "<h1>Model lifecycle</h1><p>(registry unavailable)</p>"
+        if not versions:
+            return "<h1>Model lifecycle</h1><p>(no registered versions)</p>"
+        order = {"canary": 0, "live": 1}
+        versions.sort(key=lambda v: order.get(v.status, 2))
+        rows = "".join(
+            f"<tr><td>{html.escape(v.id)}</td>"
+            f"<td>{html.escape(v.engine_id)}/{html.escape(v.engine_variant)}</td>"
+            f"<td><b>{html.escape(v.status)}</b></td>"
+            f"<td>{html.escape(v.created_at)}</td>"
+            f"<td>{html.escape(v.params_hash)}</td>"
+            f"<td>{html.escape(v.reason or '')}</td></tr>"
+            for v in versions
+        )
+        return f"""<h1>Model lifecycle</h1>
+<table border="1" cellpadding="4">
+<tr><th>Version</th><th>Engine</th><th>Status</th><th>Created</th><th>Params hash</th><th>Note</th></tr>
+{rows}
+</table>"""
 
 
 class _Server(ThreadedServer):

@@ -115,7 +115,10 @@ class JsonHandler(BaseHTTPRequestHandler):
         stays bounded (/events/<id>.json → /events/{id}.json; admin's
         /cmd/app/<name>[/data] → /cmd/app/{name}[/data])."""
         parts = path.split("/")
-        if len(parts) >= 3 and parts[1] in ("events", "engine_instances"):
+        if len(parts) >= 3 and parts[1] in ("jobs", "models"):
+            # lifecycle control plane: job/version ids are unbounded
+            parts[2] = "{id}"
+        elif len(parts) >= 3 and parts[1] in ("events", "engine_instances"):
             for suffix in (".json", ".html"):
                 if parts[2].endswith(suffix):
                     parts[2] = "{id}" + suffix

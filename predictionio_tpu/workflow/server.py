@@ -33,7 +33,7 @@ from predictionio_tpu.controller.params import ParamsError, extract_params
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.obs import BATCH_SIZE_BUCKETS, server_registry
 from predictionio_tpu.core.base import RuntimeContext
-from predictionio_tpu.data.storage.base import EngineInstance
+from predictionio_tpu.data.storage.base import EngineInstance, StorageError
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.utils.http import (
     HttpError as _HttpError,
@@ -178,6 +178,8 @@ class _Handler(JsonHandler):
         try:
             if path == "/":
                 self._respond(200, self.server.owner.status_html(), "text/html")
+            elif path == "/rollout/status":
+                self._respond(200, self.server.owner.rollout_status())
             elif path == "/metrics":
                 self._serve_metrics()
             elif path == "/debug/traces":
@@ -187,8 +189,12 @@ class _Handler(JsonHandler):
             elif path == "/debug/faults":
                 self._serve_debug_faults()
             elif path == "/reload":
-                self.server.owner.reload()
-                self._respond(200, {"message": "Reload successful"})
+                try:
+                    self.server.owner.reload()
+                except RolloutConflict as e:
+                    self._respond(409, {"message": str(e)})
+                else:
+                    self._respond(200, {"message": "Reload successful"})
             elif path == "/stop":
                 self._respond(200, {"message": "Shutting down"})
                 threading.Thread(
@@ -209,8 +215,34 @@ class _Handler(JsonHandler):
             try:
                 self.server.owner.reload()
                 self._respond(200, {"message": "Reload successful"})
+            except RolloutConflict as e:
+                self._respond(409, {"message": str(e)})
             except Exception as e:
                 log.exception("reload failed")
+                self._respond(500, {"message": str(e)})
+        elif path in ("/rollout/start", "/rollout/abort"):
+            try:
+                body = self._json_body()
+                if not isinstance(body, dict):
+                    body = {}
+                if path == "/rollout/start":
+                    self._respond(
+                        200, self.server.owner.start_rollout(body)
+                    )
+                else:
+                    self._respond(
+                        200, self.server.owner.abort_rollout(
+                            body.get("reason") or "operator abort"
+                        )
+                    )
+            except _HttpError as e:
+                self._respond(e.status, {"message": e.message})
+            except ValueError as e:
+                self._respond(400, {"message": str(e)})
+            except RolloutConflict as e:
+                self._respond(409, {"message": str(e)})
+            except Exception as e:
+                log.exception("rollout request failed")
                 self._respond(500, {"message": str(e)})
         elif path == "/debug/profile/capture":
             try:
@@ -244,13 +276,19 @@ class _Handler(JsonHandler):
                 headers={"Retry-After": "1"},
             )
             return
+        variant: Optional[str] = None  # set once pick_runtime routes
+        variant_booked = False
         try:
             raw = self._raw_body.decode()
             try:
                 query_json = json.loads(raw or "null")
             except json.JSONDecodeError as e:
                 raise _HttpError(400, f"invalid query JSON: {e}")
-            rt = owner.runtime  # snapshot — /reload swaps atomically
+            # canary routing (ISSUE 5): sticky hash-of-request fraction
+            # goes to the candidate runtime; snapshot semantics match
+            # /reload — the query is extracted and served against ONE
+            # runtime even if a swap lands mid-flight
+            rt, variant = owner.pick_runtime(self._raw_body)
             custom_from = getattr(
                 rt.query_serializer, "query_from_json", None
             )
@@ -291,11 +329,20 @@ class _Handler(JsonHandler):
                 custom_to(prediction) if custom_to is not None
                 else _to_jsonable(prediction)
             )
+            # shadow agreement compares the SERIALIZED result before
+            # output blockers run — blockers may stamp per-request data
+            # (ids, timestamps) that would read as disagreement
+            shadow_reference = result
 
             for plugin in owner.output_blockers:
                 result = plugin.process(query_json, result, {})
 
             owner.bookkeep(time.perf_counter() - t0)
+            owner.bookkeep_variant(
+                variant, time.perf_counter() - t0, error=False
+            )
+            variant_booked = True
+            owner.maybe_shadow(self._raw_body, query_json, shadow_reference)
             owner.feedback_async(query_json, result)
             for plugin in owner.output_sniffers:
                 try:
@@ -304,16 +351,44 @@ class _Handler(JsonHandler):
                     log.exception("output sniffer failed")
             self._respond(200, result)
         except _HttpError as e:
+            # post-routing 4xx DO feed the verdict windows: a candidate
+            # whose stricter query class 400s its whole traffic
+            # fraction — while live serves the same bodies 200 — shows
+            # up as a candidate-only error delta and triggers the
+            # rollback it deserves (without this it never reaches
+            # min_requests and fails its fraction forever). PRE-routing
+            # failures (undecodable body, malformed JSON) stay out of
+            # BOTH windows — they never reached either variant, and
+            # booking them to one side would skew the delta.
+            if variant is not None:
+                owner.bookkeep_variant(
+                    variant, time.perf_counter() - t0, error=True
+                )
             self._respond(e.status, {"message": e.message})
         except DeadlineExceeded as e:
             # expired in the queue or dispatch outran its budget: the
             # honest answer is "retry later", not a 500 (the dispatcher's
-            # drain loop counts the shed, so no double counting here)
+            # drain loop counts the shed, so no double counting here).
+            # Sheds feed the windows too: global overload sheds both
+            # variants proportionally (delta ≈ 0), but a pathologically
+            # slow candidate shedding only ITS fraction must be judged.
+            if variant is not None:
+                owner.bookkeep_variant(
+                    variant, time.perf_counter() - t0, error=True
+                )
             self._respond(
                 503, {"message": str(e)}, headers={"Retry-After": "1"}
             )
         except Exception as e:
             log.exception("query failed")
+            if variant is not None and not variant_booked:
+                # a failure AFTER the success bookkeeping (broken pipe
+                # writing the 200) must not record the same request a
+                # second time as an error — the canary verdict would
+                # see inflated candidate error rates on client hangups
+                owner.bookkeep_variant(
+                    variant, time.perf_counter() - t0, error=True
+                )
             self._respond(500, {"message": str(e)})
 
 
@@ -505,12 +580,21 @@ class _BatchDispatcher:
         # that know the vocab-known row count and the actual bucket) —
         # each batch_predict below lands batch_padding_ratio samples and
         # wasted-FLOPs on the process-default registry.
+        # the group's variant scopes the fault point below and attributes
+        # fallback errors to the right canary window (ISSUE 5); duck-typed
+        # like count_shed — test harnesses drive this loop with minimal
+        # owner doubles
+        variant_of = getattr(self.owner, "variant_of", None)
+        variant = variant_of(rt) if variant_of is not None else "live"
         try:
             try:
                 # fault point (ISSUE 4): "error" fails the batch into the
                 # per-query fallback below; "delay" simulates a slow
-                # device, which is what deadline shedding exists for
-                _faults.fire("dispatch.device")
+                # device, which is what deadline shedding exists for.
+                # The scope label (ISSUE 5) lets chaos tests target one
+                # rollout variant: `dispatch.device@candidate:...` flips
+                # only canary batches bad while live batches sail through
+                _faults.fire("dispatch.device", scope=variant)
                 per_algo = [
                     dict(algo.batch_predict(
                         algo.serving_context, model, queries
@@ -560,6 +644,16 @@ class _BatchDispatcher:
                     if p.cancelled:  # client gone mid-batch: skip retry
                         continue
                     try:
+                        # scoped_only: a scope-less dispatch.device spec
+                        # keeps the PR-4 semantic (batch fails, per-query
+                        # fallback succeeds); a variant-scoped spec also
+                        # fails the fallback so the targeted variant's
+                        # queries error visibly — the canary verdict's
+                        # error-rate input
+                        _faults.fire(
+                            "dispatch.device", scope=variant,
+                            scoped_only=True,
+                        )
                         predictions = [
                             algo.predict(model, p.query)
                             for algo, model in zip(rt.algorithms, rt.models)
@@ -714,6 +808,11 @@ class _BatchDispatcher:
             self._inflight.release()
 
 
+class RolloutConflict(RuntimeError):
+    """A rollout operation conflicts with the server's current state
+    (one already active, or none to abort) — a 409 at the HTTP edge."""
+
+
 class _Server(ThreadedServer):
     owner: "QueryServer"
 
@@ -777,6 +876,30 @@ class QueryServer(ServerProcess):
             "queries shed before device dispatch (503 + Retry-After)",
             ("reason",),
         )
+        # canary rollout (ISSUE 5): per-variant serve/error metrics under
+        # a `variant` label — p99s come from the labeled histogram, the
+        # verdict loop reads its own sliding windows
+        self._variant_serve_hist = self.metrics.histogram(
+            "variant_serve_seconds",
+            "end-to-end serve time by rollout variant",
+            ("variant",),
+        )
+        self._variant_requests = self.metrics.counter(
+            "variant_requests_total", "queries served by rollout variant",
+            ("variant",),
+        )
+        self._variant_errors = self.metrics.counter(
+            "variant_errors_total",
+            "failed queries (4xx/5xx/shed) by rollout variant",
+            ("variant",),
+        )
+        # runtime-swap lock (ISSUE 5 satellite): /reload and rollout
+        # promote/abort all mutate the served-runtime references; the
+        # lock serializes them so two concurrent reloads cannot
+        # interleave build_runtime with the swap
+        self._swap_lock = threading.RLock()
+        self.candidate: Optional[EngineRuntime] = None
+        self.rollout = None  # Optional[RolloutController]
         self.last_serving_sec = 0.0
         self.last_predict_sec = 0.0
         self.dispatcher: Optional[_BatchDispatcher] = None
@@ -790,6 +913,8 @@ class QueryServer(ServerProcess):
             )
 
     def stop(self) -> None:
+        if self.rollout is not None:
+            self.rollout.stop()
         if self.dispatcher is not None:
             self.dispatcher.stop()
         _spans.get_default_recorder().unbridge(
@@ -807,15 +932,233 @@ class QueryServer(ServerProcess):
     # -- reload (reference MasterActor ReloadServer, CreateServer.scala:337) --
     def reload(self) -> None:
         """Hot-swap to the latest COMPLETED instance; in-flight queries keep
-        the old runtime snapshot."""
-        inst = self.runtime.instance
-        new_runtime = latest_completed_runtime(
-            self.storage, inst.engine_id, inst.engine_version, inst.engine_variant
-        )
-        self.runtime = new_runtime  # atomic reference swap
+        the old runtime snapshot. Serialized under the runtime-swap lock
+        (ISSUE 5 satellite): two concurrent reloads — or a reload racing
+        a rollout promote — must not interleave build_runtime with the
+        reference swap."""
+        with self._swap_lock:
+            rollout = self.rollout
+            if rollout is not None and rollout.st.state in (
+                "starting", "canary"
+            ):
+                # a reload would silently change the verdict baseline
+                # mid-bake AND be overwritten by the promote swap —
+                # abort the canary first, then reload
+                raise RolloutConflict(
+                    f"rollout of {rollout.st.version.id} is active; "
+                    "abort it before reloading"
+                )
+            inst = self.runtime.instance
+            new_runtime = latest_completed_runtime(
+                self.storage, inst.engine_id, inst.engine_version,
+                inst.engine_variant,
+            )
+            self.runtime = new_runtime  # atomic reference swap
 
     def count_shed(self, reason: str) -> None:
         self._shed_counter.inc(reason=reason)
+
+    # -- canary rollout (ISSUE 5) ------------------------------------------
+    def pick_runtime(self, raw_request: bytes) -> tuple[EngineRuntime, str]:
+        """Route one request: a sticky hash-of-request fraction lands on
+        the candidate while a non-shadow rollout is active. Snapshot the
+        references ONCE — a concurrent swap must not split a request
+        across two runtimes."""
+        from predictionio_tpu.deploy.rollout import sticky_candidate
+
+        candidate, rollout = self.candidate, self.rollout
+        if (
+            candidate is not None
+            and rollout is not None
+            and not rollout.config.shadow
+            and sticky_candidate(raw_request, rollout.config.fraction)
+        ):
+            return candidate, "candidate"
+        return self.runtime, "live"
+
+    def variant_of(self, rt: EngineRuntime) -> str:
+        return "candidate" if rt is self.candidate else "live"
+
+    def bookkeep_variant(
+        self, variant: str, seconds: float, error: bool
+    ) -> None:
+        self._variant_serve_hist.observe(seconds, variant=variant)
+        self._variant_requests.inc(variant=variant)
+        if error:
+            self._variant_errors.inc(variant=variant)
+        rollout = self.rollout
+        if rollout is not None:
+            rollout.record(variant, seconds, error)
+
+    def maybe_shadow(self, raw: bytes, query_json: Any, result: Any) -> None:
+        """Shadow mode: mirror a fraction of live traffic to the
+        candidate OFF the response path and score result agreement.
+        The mirror runs the CANDIDATE's full serving path — its own
+        query extraction and serving.supplement, not live's — so a
+        candidate whose supplement/serializer is broken (or legitimately
+        different) is judged on its own behavior. Bounded concurrency;
+        mirror failures count as candidate errors."""
+        from predictionio_tpu.deploy.rollout import sticky_candidate
+
+        candidate, rollout = self.candidate, self.rollout
+        if (
+            candidate is None
+            or rollout is None
+            or not rollout.config.shadow
+            or not sticky_candidate(raw, rollout.config.fraction)
+            or not rollout.try_shadow()
+        ):
+            return
+
+        def mirror():
+            t0 = time.perf_counter()
+            try:
+                custom_from = getattr(
+                    candidate.query_serializer, "query_from_json", None
+                )
+                if custom_from is not None:
+                    query = custom_from(query_json)
+                elif candidate.query_class is not None:
+                    query = extract_params(candidate.query_class, query_json)
+                else:
+                    query = query_json
+                supplemented = candidate.serving.supplement(query)
+                if self.dispatcher is not None:
+                    prediction = self.dispatcher.submit(
+                        supplemented, candidate
+                    )
+                else:
+                    predictions = [
+                        algo.predict(model, supplemented)
+                        for algo, model in zip(
+                            candidate.algorithms, candidate.models
+                        )
+                    ]
+                    prediction = candidate.serving.serve(
+                        supplemented, predictions
+                    )
+                # serialize exactly as the live path does (custom
+                # serializer included) so agreement compares like with
+                # like — raw _to_jsonable vs a custom result_to_json
+                # would read as 100% disagreement on such engines
+                custom_to = getattr(
+                    candidate.query_serializer, "result_to_json", None
+                )
+                shadow_result = (
+                    custom_to(prediction) if custom_to is not None
+                    else _to_jsonable(prediction)
+                )
+                rollout.record(
+                    "candidate", time.perf_counter() - t0, error=False
+                )
+                rollout.record_agreement(shadow_result == result)
+            except Exception:
+                rollout.record(
+                    "candidate", time.perf_counter() - t0, error=True
+                )
+                rollout.record_agreement(False)
+            finally:
+                rollout.shadow_done()
+
+        rollout.run_shadow(mirror)
+
+    def attach_rollout(self, controller, candidate: EngineRuntime) -> None:
+        """Called by RolloutController.start() once the candidate runtime
+        built successfully."""
+        with self._swap_lock:
+            if self.rollout is not None and self.rollout.st.state in (
+                "starting", "canary"
+            ):
+                raise RolloutConflict(
+                    f"rollout of {self.rollout.st.version.id} is already "
+                    "active"
+                )
+            self.candidate = candidate
+            self.rollout = controller
+
+    def complete_rollout(self, controller, promote: bool) -> None:
+        """Atomic end of a canary: promote hot-swaps candidate → live
+        (the old runtime drains — in-flight queries keep their snapshot,
+        zero dropped); rollback just detaches the candidate."""
+        with self._swap_lock:
+            if self.rollout is not controller:
+                return  # stale controller (a newer rollout replaced it)
+            if promote and self.candidate is not None:
+                self.runtime = self.candidate
+            self.candidate = None
+
+    def start_rollout(self, body: dict) -> dict:
+        """POST /rollout/start: canary a registered model version. With
+        no explicit version, the newest `trained` version of the served
+        engine variant is used."""
+        from predictionio_tpu.deploy.registry import ModelRegistry
+        from predictionio_tpu.deploy.rollout import (
+            RolloutConfig,
+            RolloutController,
+        )
+
+        registry = ModelRegistry(self.storage)
+        vid = body.get("version")
+        if vid:
+            version = registry.get(vid)
+            if version is None:
+                raise ValueError(f"no model version {vid!r}")
+        else:
+            inst = self.runtime.instance
+            trained = registry.list(
+                inst.engine_id, inst.engine_variant, status="trained"
+            )
+            if not trained:
+                raise ValueError(
+                    f"no trained model version for {inst.engine_id}/"
+                    f"{inst.engine_variant} — train (or `pio jobs submit`) "
+                    "first"
+                )
+            version = trained[0]
+        overrides = {
+            k: body[k]
+            for k in (
+                "fraction", "window_s", "interval_s", "min_requests",
+                "max_error_delta", "max_p99_ratio", "bake_s", "shadow",
+                "min_agreement",
+            )
+            if k in body
+        }
+        config = RolloutConfig.from_env(**overrides)
+        controller = RolloutController(self, version, config)
+        try:
+            controller.start()
+        except (RolloutConflict, StorageError):
+            # conflicts map to 409; a storage outage is the SERVER's
+            # trouble (500), not a malformed request — automation that
+            # treats 4xx as non-retryable must not be told 400 for it
+            raise
+        except Exception as e:
+            # candidate build failed (model.load fault, missing blob):
+            # the canary never started and live serving is untouched
+            raise ValueError(f"canary start failed: {e}")
+        return controller.status()
+
+    def abort_rollout(self, reason: str) -> dict:
+        rollout = self.rollout
+        if rollout is None or rollout.st.state != "canary":
+            raise RolloutConflict("no active rollout to abort")
+        # stop the verdict thread FIRST, then re-check: the loop may
+        # have promoted/rolled back between our check and the join — an
+        # abort after that must not mark the now-live version rolled_back
+        rollout.stop()
+        if rollout.st.state != "canary":
+            raise RolloutConflict(
+                f"rollout already {rollout.st.state}; nothing to abort"
+            )
+        rollout.abort(reason)
+        return rollout.status()
+
+    def rollout_status(self) -> dict:
+        rollout = self.rollout
+        if rollout is None:
+            return {"state": "none"}
+        return rollout.status()
 
     # -- bookkeeping (registry-backed; the averages are now derived) -------
     def bookkeep(self, seconds: float) -> None:

@@ -231,6 +231,26 @@ class SumServing(Serving):
 # -- engines ----------------------------------------------------------------
 
 
+@dataclass
+class SlowDSP:
+    id: int = 0
+    sleep_s: float = 30.0
+
+
+class SlowDataSource(DataSource):
+    """Sleeps through read_training — scheduler chaos tests kill the
+    train subprocess while it sits here."""
+
+    def __init__(self, params: SlowDSP):
+        self.params = params
+
+    def read_training(self, ctx):
+        import time
+
+        time.sleep(self.params.sleep_s)
+        return TrainingData(id=self.params.id)
+
+
 class Engine0Factory(EngineFactory):
     def apply(self):
         return Engine(
@@ -244,6 +264,11 @@ class Engine0Factory(EngineFactory):
 class PersistentEngineFactory(EngineFactory):
     def apply(self):
         return Engine(DataSource0, Preparator0, PersistentAlgo, FirstServing)
+
+
+class SlowEngineFactory(EngineFactory):
+    def apply(self):
+        return Engine(SlowDataSource, Preparator0, Algo0, FirstServing)
 
 
 class UnserializableEngineFactory(EngineFactory):

@@ -1,0 +1,651 @@
+"""Model lifecycle subsystem tests (ISSUE 5): registry CRUD/lineage/GC,
+scheduler happy path + crash-resume + timeout + periodic retrain,
+canary verdict math, the runtime-swap lock regression, variant-scoped
+fault specs, and event-server ingest shedding."""
+
+import datetime as _dt
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.storage.base import AccessKey, App, EngineInstance
+from predictionio_tpu.deploy.registry import ModelRegistry
+from predictionio_tpu.deploy.rollout import (
+    RolloutConfig,
+    VariantWindow,
+    sticky_candidate,
+    verdict,
+)
+from predictionio_tpu.deploy.scheduler import (
+    JobQueue,
+    SchedulerConfig,
+    TrainScheduler,
+    storage_config_from_json,
+    storage_config_to_json,
+)
+from predictionio_tpu.resilience import faults
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_DIR = os.path.dirname(TESTS_DIR)
+
+VARIANT = {
+    "id": "lc",
+    "engineFactory": "sample_engine.Engine0Factory",
+    "datasource": {"params": {"id": 1}},
+    "preparator": {"params": {"id": 2}},
+    "algorithms": [{"name": "algo0", "params": {"id": 3}}],
+    "serving": {},
+}
+
+SLOW_VARIANT = {
+    "id": "lcslow",
+    "engineFactory": "sample_engine.SlowEngineFactory",
+    "datasource": {"params": {"id": 1, "sleep_s": 20.0}},
+    "preparator": {"params": {"id": 2}},
+    "algorithms": [{"name": "", "params": {"id": 3}}],
+}
+
+
+def _instance(iid: str, variant: str = "lc", status: str = "COMPLETED"):
+    now = _dt.datetime.now(_dt.timezone.utc)
+    return EngineInstance(
+        id=iid, status=status, start_time=now, end_time=now,
+        engine_id=variant, engine_version="0", engine_variant=variant,
+        engine_factory="sample_engine.Engine0Factory",
+        algorithms_params=json.dumps([{"name": "algo0", "params": {"id": 3}}]),
+    )
+
+
+def _scheduler_config(tmp_path, **kw) -> SchedulerConfig:
+    cfg = SchedulerConfig(
+        poll_interval_s=0.1,
+        heartbeat_interval_s=0.2,
+        stale_after_s=1.0,
+        log_dir=str(tmp_path / "job-logs"),
+        child_env={
+            "PYTHONPATH": os.pathsep.join([REPO_DIR, TESTS_DIR]),
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _wait_for(predicate, timeout=60.0, interval=0.1, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# registry CRUD / lineage / GC
+# ---------------------------------------------------------------------------
+
+
+class TestModelRegistry:
+    def test_register_requires_completed(self, fresh_storage):
+        reg = ModelRegistry(fresh_storage)
+        with pytest.raises(ValueError):
+            reg.register(_instance("i0", status="ABORTED"))
+
+    def test_crud_and_status_transitions(self, fresh_storage):
+        reg = ModelRegistry(fresh_storage)
+        v1 = reg.register(_instance("i1"))
+        assert v1.status == "trained" and v1.parent_version is None
+        assert reg.get(v1.id).to_dict() == v1.to_dict()
+        assert reg.get("mv-nope") is None
+
+        reg.promote(v1.id)
+        assert reg.get(v1.id).status == "live"
+        assert reg.live_version("lc", "lc").id == v1.id
+
+        # lineage: versions registered while v1 is live point at it
+        v2 = reg.register(_instance("i2"))
+        assert v2.parent_version == v1.id
+        assert [v.id for v in reg.lineage(v2.id)] == [v2.id, v1.id]
+
+        # promote v2: v1 archived, not dropped
+        reg.promote(v2.id)
+        assert reg.get(v1.id).status == "archived"
+        assert reg.live_version("lc", "lc").id == v2.id
+
+        reg.rollback(v2.id, "bad p99")
+        assert reg.get(v2.id).status == "rolled_back"
+        assert reg.get(v2.id).reason == "bad p99"
+
+        with pytest.raises(ValueError):
+            reg.set_status(v1.id, "bogus")
+        with pytest.raises(KeyError):
+            reg.set_status("mv-nope", "live")
+
+    def test_list_filters(self, fresh_storage):
+        reg = ModelRegistry(fresh_storage)
+        a = reg.register(_instance("ia", variant="va"))
+        b = reg.register(_instance("ib", variant="vb"))
+        reg.promote(b.id)
+        assert {v.id for v in reg.list()} == {a.id, b.id}
+        assert [v.id for v in reg.list(engine_id="va")] == [a.id]
+        assert [v.id for v in reg.list(status="live")] == [b.id]
+
+    def test_gc_retention(self, fresh_storage):
+        from predictionio_tpu.data.storage.base import Model
+
+        reg = ModelRegistry(fresh_storage)
+        models = fresh_storage.get_model_data_models()
+        versions = []
+        for i in range(5):
+            models.insert(Model(id=f"g{i}", models=b"blob"))
+            versions.append(reg.register(_instance(f"g{i}")))
+            time.sleep(0.002)  # distinct created_at ordering
+        reg.promote(versions[0].id)  # oldest is live → GC-immune
+        collected = reg.gc(keep=2, delete_blobs=True)
+        # live v0 kept; newest 2 of the rest (v4, v3) kept; v1, v2 collected
+        assert {v.id for v in collected} == {versions[1].id, versions[2].id}
+        survivors = {v.id for v in reg.list()}
+        assert survivors == {versions[0].id, versions[3].id, versions[4].id}
+        assert models.get("g1") is None and models.get("g2") is None
+        assert models.get("g0") is not None  # live blob survives
+
+
+# ---------------------------------------------------------------------------
+# scheduler: queue persistence, subprocess runs, crash-resume
+# ---------------------------------------------------------------------------
+
+
+class TestJobQueue:
+    def test_storage_config_roundtrip(self, fresh_storage):
+        restored = storage_config_from_json(
+            storage_config_to_json(fresh_storage.config)
+        )
+        assert restored.repositories == fresh_storage.config.repositories
+        assert set(restored.sources) == set(fresh_storage.config.sources)
+        src = next(iter(restored.sources.values()))
+        assert src.type == fresh_storage.config.sources[src.name].type
+
+    def test_submit_and_backoff_gate(self, fresh_storage):
+        q = JobQueue(fresh_storage)
+        with pytest.raises(ValueError):
+            q.submit({"id": "x"})  # engineFactory missing
+        j = q.submit(VARIANT, timeout_s=9, period_s=60)
+        got = q.get(j.id)
+        assert got.status == "queued" and got.timeout_s == 9
+        assert got.variant == VARIANT and got.period_s == 60
+        assert [x.id for x in q.claimable()] == [j.id]
+        q.update(j.id, not_before=time.time() + 3600)
+        assert q.claimable() == []  # backoff gate holds it back
+
+    def test_gc_keeps_active_and_newest_terminal(self, fresh_storage):
+        q = JobQueue(fresh_storage)
+        jobs = []
+        for i in range(5):
+            jobs.append(q.submit(VARIANT))
+            time.sleep(0.002)  # distinct created_at ordering
+        q.update(jobs[0].id, status="completed")
+        q.update(jobs[1].id, status="failed")
+        q.update(jobs[2].id, status="completed")
+        q.update(jobs[3].id, status="running")
+        purged = q.gc(keep=1)
+        # running/queued immune; oldest terminal records beyond keep go
+        assert purged == [jobs[0].id, jobs[1].id]
+        assert {j.id for j in q.list()} == {
+            jobs[2].id, jobs[3].id, jobs[4].id
+        }
+
+    def test_queue_survives_reopen(self, fresh_storage):
+        """The queue is storage rows, not process state: a second
+        JobQueue over the same stores sees the submitted job."""
+        j = JobQueue(fresh_storage).submit(VARIANT)
+        assert JobQueue(fresh_storage).get(j.id).variant == VARIANT
+
+
+class TestSchedulerSubprocess:
+    def test_job_trains_and_registers_version(self, fresh_storage, tmp_path):
+        q = JobQueue(fresh_storage)
+        job = q.submit(VARIANT)
+        sched = TrainScheduler(fresh_storage, _scheduler_config(tmp_path))
+        sched.start()
+        try:
+            _wait_for(
+                lambda: q.get(job.id).status == "completed",
+                timeout=90, what="job completion",
+            )
+        finally:
+            sched.stop()
+        done = q.get(job.id)
+        assert done.instance_id and done.model_version
+        assert done.log_path and os.path.exists(done.log_path)
+        inst = fresh_storage.get_meta_data_engine_instances().get(
+            done.instance_id
+        )
+        assert inst is not None and inst.status == "COMPLETED"
+        version = ModelRegistry(fresh_storage).get(done.model_version)
+        assert version is not None and version.status == "trained"
+        assert version.instance_id == done.instance_id
+
+        # ...and it shows up in `pio models list` (acceptance criterion)
+        from predictionio_tpu.data.storage.registry import Storage
+        from predictionio_tpu.tools import console
+
+        Storage.set_instance(fresh_storage)
+        try:
+            assert console.main(["models", "list"]) == 0
+        finally:
+            Storage.set_instance(None)
+
+    def test_worker_crash_requeues_and_completes(
+        self, fresh_storage, tmp_path
+    ):
+        """Kill the worker mid-train: the job record stays `running`
+        with a stale heartbeat; the next scheduler start re-queues it
+        and it completes (the job itself is retried with a FAST variant
+        by updating nothing — the slow sleep is in read_training, and
+        the rerun simply runs it again, so keep the sleep short enough
+        to finish)."""
+        q = JobQueue(fresh_storage)
+        slow = dict(SLOW_VARIANT)
+        slow["datasource"] = {"params": {"id": 1, "sleep_s": 3.0}}
+        job = q.submit(slow, max_attempts=3)
+        cfg = _scheduler_config(tmp_path)
+        sched1 = TrainScheduler(fresh_storage, cfg)
+        sched1.start()
+        try:
+            _wait_for(
+                lambda: q.get(job.id).status == "running",
+                timeout=30, what="job to start",
+            )
+            # let the child get INTO the train (past interpreter boot)
+            # then crash the worker: child killed, record untouched
+            time.sleep(0.5)
+        finally:
+            sched1.stop(kill_child=True)
+        stuck = q.get(job.id)
+        assert stuck.status == "running"  # nobody cleaned up — a crash
+
+        time.sleep(cfg.stale_after_s + 0.2)  # heartbeat goes stale
+        sched2 = TrainScheduler(fresh_storage, cfg)
+        assert sched2.resume_orphans() == [job.id]
+        assert q.get(job.id).status == "queued"
+        sched2.start()
+        try:
+            _wait_for(
+                lambda: q.get(job.id).status == "completed",
+                timeout=120, what="re-queued job completion",
+            )
+        finally:
+            sched2.stop()
+        done = q.get(job.id)
+        assert done.model_version
+        assert ModelRegistry(fresh_storage).get(done.model_version)
+
+    def test_timeout_kills_and_fails_after_attempts(
+        self, fresh_storage, tmp_path
+    ):
+        q = JobQueue(fresh_storage)
+        job = q.submit(SLOW_VARIANT, timeout_s=6.0, max_attempts=1)
+        sched = TrainScheduler(fresh_storage, _scheduler_config(tmp_path))
+        ran = sched.run_pending_once()
+        assert ran == 1
+        done = q.get(job.id)
+        assert done.status == "failed"
+        assert "timeout" in (done.last_error or "")
+
+    def test_train_failure_fails_fast_no_retry(self, fresh_storage, tmp_path):
+        bad = dict(VARIANT, datasource={"params": {"id": 1, "error": True}})
+        q = JobQueue(fresh_storage)
+        job = q.submit(bad, max_attempts=3)
+        sched = TrainScheduler(fresh_storage, _scheduler_config(tmp_path))
+        sched.run_pending_once()
+        done = q.get(job.id)
+        # deterministic train failure: failed on attempt 1, not re-queued
+        assert done.status == "failed" and done.attempt == 1
+        with open(done.log_path, errors="replace") as f:
+            assert "dirty" in f.read()  # sanity_check's message, per-job log
+
+    def test_periodic_retrain_enqueues_next_run(
+        self, fresh_storage, tmp_path
+    ):
+        q = JobQueue(fresh_storage)
+        job = q.submit(VARIANT, period_s=3600.0)
+        sched = TrainScheduler(fresh_storage, _scheduler_config(tmp_path))
+        sched.run_pending_once()
+        assert q.get(job.id).status == "completed"
+        queued = q.list(status="queued")
+        assert len(queued) == 1
+        nxt = queued[0]
+        assert nxt.variant == VARIANT and nxt.period_s == 3600.0
+        assert nxt.not_before > time.time() + 3000  # gated a period out
+        assert q.claimable() == []
+
+
+# ---------------------------------------------------------------------------
+# canary verdict math
+# ---------------------------------------------------------------------------
+
+
+def _stats(count=100, error_rate=0.0, p99_ms=10.0, **extra):
+    return dict(
+        count=count, errors=int(count * error_rate),
+        error_rate=error_rate, p50_ms=p99_ms / 2, p99_ms=p99_ms, **extra
+    )
+
+
+class TestVerdictMath:
+    CFG = RolloutConfig(
+        fraction=0.1, min_requests=20, max_error_delta=0.05,
+        max_p99_ratio=3.0, bake_s=60.0,
+    )
+
+    def test_waits_below_min_requests(self):
+        action, _ = verdict(_stats(), _stats(count=19), self.CFG, 1e6)
+        assert action == "wait"
+
+    def test_error_delta_boundary(self):
+        # delta exactly at the bound is allowed; above it rolls back
+        ok, _ = verdict(
+            _stats(error_rate=0.01), _stats(error_rate=0.06), self.CFG, 0
+        )
+        assert ok == "wait"
+        bad, reason = verdict(
+            _stats(error_rate=0.01), _stats(error_rate=0.07), self.CFG, 0
+        )
+        assert bad == "rollback" and "error-rate" in reason
+
+    def test_p99_ratio_boundary(self):
+        ok, _ = verdict(
+            _stats(p99_ms=10.0), _stats(p99_ms=30.0), self.CFG, 0
+        )
+        assert ok == "wait"
+        bad, reason = verdict(
+            _stats(p99_ms=10.0), _stats(p99_ms=31.0), self.CFG, 0
+        )
+        assert bad == "rollback" and "p99" in reason
+
+    def test_promote_after_bake(self):
+        assert verdict(_stats(), _stats(), self.CFG, 59.9)[0] == "wait"
+        assert verdict(_stats(), _stats(), self.CFG, 60.0)[0] == "promote"
+
+    def test_shadow_agreement(self):
+        cfg = RolloutConfig(
+            min_requests=10, shadow=True, min_agreement=0.9, bake_s=60.0
+        )
+        live = _stats()
+        ok, _ = verdict(
+            live, _stats(agreement=0.95, shadow_count=50), cfg, 0
+        )
+        assert ok == "wait"
+        bad, reason = verdict(
+            live, _stats(agreement=0.5, shadow_count=50), cfg, 0
+        )
+        assert bad == "rollback" and "agreement" in reason
+        # shadow judges on mirror volume, not on (zero) routed traffic
+        wait, _ = verdict(
+            live, _stats(count=0, shadow_count=5), cfg, 0
+        )
+        assert wait == "wait"
+
+    def test_window_stats_and_stickiness(self):
+        w = VariantWindow(window_s=30.0)
+        for i in range(100):
+            w.add(0.010 if i else 0.200, error=(i % 10 == 0))
+        st = w.stats()
+        assert st["count"] == 100 and st["errors"] == 10
+        assert st["error_rate"] == pytest.approx(0.1)
+        assert st["p99_ms"] >= st["p50_ms"] > 0
+        # sticky routing: deterministic per body, splits the keyspace
+        bodies = [f'{{"user":"u{i}"}}'.encode() for i in range(400)]
+        picks = [sticky_candidate(b, 0.5) for b in bodies]
+        assert picks == [sticky_candidate(b, 0.5) for b in bodies]
+        assert 100 < sum(picks) < 300  # ~50% split
+
+
+# ---------------------------------------------------------------------------
+# variant-scoped fault specs (the e2e's instrument)
+# ---------------------------------------------------------------------------
+
+
+class TestScopedFaults:
+    def teardown_method(self):
+        faults.clear()
+
+    def test_scoped_grammar_roundtrip(self):
+        spec = faults.parse_spec("dispatch.device@candidate:error:1.0")
+        assert spec.point == "dispatch.device"
+        assert spec.scope == "candidate"
+        assert spec.key() == "dispatch.device@candidate"
+        # unscoped stays unscoped
+        assert faults.parse_spec("model.load:error:0.5").scope is None
+
+    def test_scoped_spec_fires_only_for_matching_scope(self):
+        faults.install(
+            faults.FaultSpec("dispatch.device", "error", 1.0,
+                             scope="candidate")
+        )
+        assert faults.fire("dispatch.device") is None  # no scope given
+        assert faults.fire("dispatch.device", scope="live") is None
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("dispatch.device", scope="candidate")
+
+    def test_unscoped_spec_matches_any_scope_unless_scoped_only(self):
+        faults.install(faults.FaultSpec("dispatch.device", "error", 1.0))
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("dispatch.device", scope="live")
+        # scoped_only: the fallback path ignores scope-less specs
+        assert faults.fire(
+            "dispatch.device", scope="live", scoped_only=True
+        ) is None
+
+
+# ---------------------------------------------------------------------------
+# runtime-swap lock: concurrent reloads must not interleave build_runtime
+# ---------------------------------------------------------------------------
+
+
+class TestReloadSwapLock:
+    def test_concurrent_reloads_serialize(self, fresh_storage, monkeypatch):
+        from predictionio_tpu.workflow import server as server_mod
+        from predictionio_tpu.workflow.core import run_train
+        from predictionio_tpu.workflow.server import (
+            QueryServer,
+            QueryServerConfig,
+            latest_completed_runtime,
+        )
+
+        run_train(fresh_storage, VARIANT)
+        runtime = latest_completed_runtime(fresh_storage, "lc", "0", "lc")
+        srv = QueryServer(
+            fresh_storage, runtime,
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        events: list[str] = []
+        real = server_mod.latest_completed_runtime
+
+        def slow_build(*a, **kw):
+            events.append("enter")
+            time.sleep(0.05)
+            out = real(*a, **kw)
+            events.append("exit")
+            return out
+
+        monkeypatch.setattr(
+            server_mod, "latest_completed_runtime", slow_build
+        )
+        threads = [
+            threading.Thread(target=srv.reload) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        # serialized: enter/exit strictly alternate — no interleaving
+        assert events == ["enter", "exit", "enter", "exit"]
+
+
+# ---------------------------------------------------------------------------
+# admin-server control plane
+# ---------------------------------------------------------------------------
+
+
+class TestAdminControlPlane:
+    @pytest.fixture()
+    def admin(self, fresh_storage):
+        from predictionio_tpu.tools.admin import AdminServer
+
+        srv = AdminServer(fresh_storage, ip="127.0.0.1", port=0)
+        port = srv.start()
+        yield fresh_storage, port
+        srv.stop()
+
+    def _req(self, port, path, body=None, method=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data,
+            headers={"Content-Type": "application/json"},
+            method=method or ("POST" if data is not None else "GET"),
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                raw = r.read().decode()
+                try:
+                    return r.status, json.loads(raw)
+                except ValueError:
+                    return r.status, raw
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null")
+
+    def test_jobs_endpoints(self, admin, tmp_path):
+        storage, port = admin
+        status, body = self._req(port, "/jobs", {"variant": VARIANT,
+                                                 "period_s": 60})
+        assert status == 201 and body["status"] == "queued"
+        job_id = body["id"]
+        status, listing = self._req(port, "/jobs")
+        assert status == 200 and [j["id"] for j in listing] == [job_id]
+        status, one = self._req(port, f"/jobs/{job_id}")
+        assert status == 200 and one["period_s"] == 60
+        assert self._req(port, "/jobs/job-nope")[0] == 404
+        # no log yet → 404; after the record points at a real file → 200
+        assert self._req(port, f"/jobs/{job_id}/logs")[0] == 404
+        log_file = tmp_path / "j.log"
+        log_file.write_text("train output here")
+        JobQueue(storage).update(job_id, log_path=str(log_file))
+        status, text = self._req(port, f"/jobs/{job_id}/logs")
+        assert status == 200 and "train output" in text
+        assert self._req(port, "/jobs", {"nope": 1})[0] == 400
+
+    def test_models_and_rollout_endpoints(self, admin):
+        storage, port = admin
+        reg = ModelRegistry(storage)
+        v1 = reg.register(_instance("a1"))
+        v2 = reg.register(_instance("a2"))
+        status, listing = self._req(port, "/models")
+        assert status == 200 and {v["id"] for v in listing} == {v1.id, v2.id}
+        status, one = self._req(port, f"/models/{v1.id}")
+        assert status == 200 and one["lineage"] == [v1.id]
+        status, body = self._req(port, f"/models/{v1.id}/promote", {})
+        assert status == 200 and body["status"] == "live"
+        status, body = self._req(
+            port, f"/models/{v2.id}/rollback", {"reason": "nope"}
+        )
+        assert status == 200 and body["status"] == "rolled_back"
+        assert self._req(port, "/models/mv-nope/promote", {})[0] == 404
+        status, ro = self._req(port, "/rollout")
+        assert status == 200
+        assert [v["id"] for v in ro["live"]] == [v1.id]
+        assert ro["canary"] == []
+        # proxy: gated off by default (SSRF surface), validated when on
+        assert self._req(
+            port, "/rollout", {"url": "http://127.0.0.1:9"}
+        )[0] == 403
+        os.environ["PIO_ROLLOUT_PROXY"] = "1"
+        try:
+            assert self._req(port, "/rollout", {"action": "start"})[0] == 400
+            assert self._req(
+                port, "/rollout",
+                {"url": "http://127.0.0.1:9/evil?x=", "action": "status"},
+            )[0] == 400  # host-only urls; no path/query smuggling
+            status, _ = self._req(
+                port, "/rollout",
+                {"url": "http://127.0.0.1:9", "action": "status"},
+            )
+            assert status == 502
+        finally:
+            del os.environ["PIO_ROLLOUT_PROXY"]
+
+
+# ---------------------------------------------------------------------------
+# event-server ingest shedding (ROADMAP PR-4 follow-up)
+# ---------------------------------------------------------------------------
+
+
+class TestIngestShedding:
+    @pytest.fixture()
+    def event_server(self, fresh_storage, tmp_path):
+        from predictionio_tpu.data.api.server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        app_id = fresh_storage.get_meta_data_apps().insert(
+            App(id=0, name="shedapp")
+        )
+        fresh_storage.get_events().init_app(app_id)
+        fresh_storage.get_meta_data_access_keys().insert(
+            AccessKey(key="SHEDKEY", app_id=app_id, events=())
+        )
+        srv = EventServer(fresh_storage, EventServerConfig(
+            ip="127.0.0.1", port=0, wal_dir=str(tmp_path / "wal"),
+            wal_replay_interval_s=30.0,  # replay stays out of the way
+        ))
+        port = srv.start()
+        yield srv, port
+        faults.clear()
+        srv.stop()
+
+    def _post(self, port, deadline_ms=None):
+        headers = {"Content-Type": "application/json"}
+        if deadline_ms is not None:
+            headers["X-PIO-Deadline"] = str(deadline_ms)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/events.json?accessKey=SHEDKEY",
+            data=json.dumps({
+                "event": "buy", "entityType": "user", "entityId": "u1",
+            }).encode(),
+            headers=headers, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, dict(r.headers), json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read() or b"null")
+
+    def test_expired_deadline_is_shed_503(self, event_server):
+        srv, port = event_server
+        status, headers, body = self._post(port, deadline_ms=0)
+        assert status == 503
+        assert headers.get("Retry-After") == "1"
+        assert "shed" in body["message"]
+        # healthy requests still land
+        status, _, body = self._post(port)
+        assert status == 201 and "eventId" in body
+
+    def test_spill_mode_never_sheds(self, event_server):
+        """With storage down and the WAL absorbing events, an expired
+        POST still gets the 202-into-WAL treatment — a fsync'd append
+        beats a client retry loop against a degraded server."""
+        srv, port = event_server
+        faults.install(faults.FaultSpec("event.insert", "error", 1.0))
+        status, _, body = self._post(port)  # first spill: WAL now pending
+        assert status == 202 and "walId" in body
+        status, _, body = self._post(port, deadline_ms=0)
+        assert status == 202 and "walId" in body  # NOT shed
+        faults.clear()

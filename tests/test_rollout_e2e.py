@@ -1,0 +1,333 @@
+"""Canary rollout end-to-end (ISSUE 5 acceptance): live traffic through
+a 10%-ish canary on the real recommendation engine, a deliberately
+faulted candidate (variant-scoped PR-4 fault points), automatic
+rollback with zero dropped queries, and a zero-drop promote hot-swap."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.deploy.registry import ModelRegistry
+from predictionio_tpu.resilience import faults
+from predictionio_tpu.workflow.core import run_train
+from predictionio_tpu.workflow.server import (
+    QueryServer,
+    QueryServerConfig,
+    build_runtime,
+)
+
+VARIANT = {
+    "id": "roll",
+    "engineFactory": "predictionio_tpu.engines.recommendation.RecommendationEngine",
+    "datasource": {"params": {"app_name": "rollapp"}},
+    "algorithms": [
+        {"name": "als", "params": {"rank": 8, "num_iterations": 4}}
+    ],
+}
+
+
+def _seed(storage, n_users=8, seed=0):
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="rollapp"))
+    events = storage.get_events()
+    events.init_app(app_id)
+    rng = np.random.RandomState(seed)
+    batch = []
+    for u in range(n_users):
+        for _ in range(20):
+            i = rng.randint(0, 5) + (u % 2) * 5
+            batch.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties={"rating": 5.0},
+            ))
+    events.insert_batch(batch, app_id)
+    return app_id
+
+
+def _post(port, path, body, timeout=20):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "null")
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=20
+    ) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+@pytest.fixture()
+def served(fresh_storage):
+    """A live query server (model A) plus a registered `trained` model
+    version (model B) ready to canary."""
+    _seed(fresh_storage)
+    inst_a = run_train(fresh_storage, VARIANT)  # model A → live
+    inst_b = run_train(fresh_storage, VARIANT)  # model B → the candidate
+    version_b = ModelRegistry(fresh_storage).register(inst_b)
+    runtime = build_runtime(fresh_storage, inst_a)
+    srv = QueryServer(
+        fresh_storage, runtime,
+        QueryServerConfig(ip="127.0.0.1", port=0, batch_window_ms=1.0),
+    )
+    port = srv.start()
+    yield fresh_storage, srv, port, version_b
+    faults.clear()
+    srv.stop()
+
+
+class Hammer:
+    """Closed-loop client pool recording every (status, body) — the
+    zero-dropped-queries ledger: every submitted query must come back as
+    an HTTP response, never a connection error or a stopped-server 500."""
+
+    def __init__(self, port, n_clients=8):
+        self.port = port
+        self.n_clients = n_clients
+        self.results: list[tuple[int, dict]] = []
+        self.transport_errors: list[str] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def _run(self, cid):
+        i = 0
+        while not self._stop.is_set():
+            i += 1
+            try:
+                # vary user AND num: sticky routing hashes the raw body,
+                # so the body space must be wide enough for a 10%
+                # fraction to catch a share of it
+                status, body = _post(
+                    self.port, "/queries.json",
+                    {
+                        "user": f"u{(cid * 131 + i) % 8}",
+                        "num": (cid * 17 + i) % 50 + 1,
+                    },
+                )
+                with self._lock:
+                    self.results.append((status, body))
+            except Exception as e:  # dropped: no HTTP response at all
+                with self._lock:
+                    self.transport_errors.append(repr(e))
+
+    def __enter__(self):
+        for c in range(self.n_clients):
+            t = threading.Thread(target=self._run, args=(c,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.results), list(self.transport_errors)
+
+
+def _wait_for(predicate, timeout=60.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestCanaryE2E:
+    def test_faulted_candidate_rolls_back_zero_dropped(self, served):
+        """The headline acceptance: 10% canary, candidate flipped bad
+        via the PR-4 fault registry scoped to the candidate variant
+        (dispatch.device@candidate), verdict loop rolls back on the
+        error-rate delta, live keeps serving, zero dropped queries
+        through canary start AND the rollback swap."""
+        storage, srv, port, version_b = served
+        # flip the candidate bad BEFORE any canary traffic flows
+        faults.install(faults.FaultSpec(
+            "dispatch.device", "error", 1.0, scope="candidate"
+        ))
+        with Hammer(port) as hammer:
+            time.sleep(0.3)  # live-only traffic flows across canary start
+            status, body = _post(port, "/rollout/start", {
+                "version": version_b.id,
+                "fraction": 0.1,
+                "interval_s": 0.2,
+                "window_s": 20.0,
+                "min_requests": 5,
+                "bake_s": 120.0,
+                "max_error_delta": 0.2,
+            })
+            assert status == 200, body
+            assert body["state"] == "canary"
+            assert (
+                ModelRegistry(storage).get(version_b.id).status == "canary"
+            )
+            _wait_for(
+                lambda: _get(port, "/rollout/status")[1]["state"]
+                == "rolled_back",
+                timeout=90, what="automatic rollback",
+            )
+            time.sleep(0.5)  # post-rollback traffic across the swap
+        results, transport_errors = hammer.snapshot()
+        st, rollout = _get(port, "/rollout/status")
+        assert rollout["state"] == "rolled_back"
+        assert "error-rate" in rollout["reason"]
+        assert (
+            ModelRegistry(storage).get(version_b.id).status == "rolled_back"
+        )
+        assert ModelRegistry(storage).get(version_b.id).reason
+
+        # zero dropped: every query got an HTTP response
+        assert transport_errors == []
+        assert len(results) > 50
+        # the only failures are the injected candidate faults — live
+        # traffic (and all traffic after rollback) served 200
+        bad = [(s, b) for s, b in results if s != 200]
+        assert all(
+            s == 500 and "injected" in (b or {}).get("message", "")
+            for s, b in bad
+        ), bad[:3]
+        assert any(s == 200 for s, _ in results)
+        # candidate routing really happened (the verdict had evidence)
+        assert rollout["candidate"]["count"] >= 5
+        assert rollout["candidate"]["error_rate"] > 0.2
+
+        # the fault spec is still installed and scoped: post-rollback
+        # serving is clean because no candidate exists anymore
+        tail_status, _ = _post(
+            port, "/queries.json", {"user": "u1", "num": 3}
+        )
+        assert tail_status == 200
+
+    def test_canary_start_failure_leaves_live_serving(self, served):
+        """model.load fault at canary start: build_runtime fails, the
+        rollout never attaches, live traffic is untouched."""
+        storage, srv, port, version_b = served
+        faults.install(faults.FaultSpec("model.load", "error", 1.0))
+        with Hammer(port, n_clients=4) as hammer:
+            time.sleep(0.2)
+            status, body = _post(port, "/rollout/start", {
+                "version": version_b.id, "fraction": 0.5,
+            })
+            assert status == 400
+            assert "canary start failed" in body["message"]
+            time.sleep(0.3)
+        results, transport_errors = hammer.snapshot()
+        assert transport_errors == []
+        assert results and all(s == 200 for s, _ in results)
+        assert _get(port, "/rollout/status")[1]["state"] == "none"
+        assert srv.candidate is None
+        # the version is NOT stuck in canary
+        assert ModelRegistry(storage).get(version_b.id).status == "trained"
+
+    def test_healthy_canary_promotes_with_zero_drop_hot_swap(self, served):
+        """Healthy candidate bakes and auto-promotes: atomic hot-swap
+        under live traffic, zero dropped queries, registry flips to
+        live and the server serves the candidate's instance."""
+        storage, srv, port, version_b = served
+        old_instance = srv.runtime.instance.id
+        with Hammer(port) as hammer:
+            status, body = _post(port, "/rollout/start", {
+                "version": version_b.id,
+                "fraction": 0.4,
+                "interval_s": 0.2,
+                "window_s": 20.0,
+                "min_requests": 5,
+                "bake_s": 1.5,
+            })
+            assert status == 200, body
+            _wait_for(
+                lambda: _get(port, "/rollout/status")[1]["state"]
+                == "promoted",
+                timeout=90, what="automatic promote",
+            )
+            time.sleep(0.5)  # traffic across the hot-swap
+        results, transport_errors = hammer.snapshot()
+        assert transport_errors == []
+        assert results and all(s == 200 for s, _ in results), [
+            r for r in results if r[0] != 200
+        ][:3]
+        assert srv.runtime.instance.id == version_b.instance_id
+        assert srv.runtime.instance.id != old_instance
+        assert srv.candidate is None
+        reg = ModelRegistry(storage)
+        assert reg.get(version_b.id).status == "live"
+        # per-variant metrics landed under the variant label
+        hist = srv.metrics.histogram(
+            "variant_serve_seconds", labelnames=("variant",)
+        )
+        assert hist.count_of(variant="candidate") > 0
+        assert hist.count_of(variant="live") > 0
+
+    def test_shadow_mode_mirrors_and_promotes_on_agreement(self, served):
+        """Shadow rollout: candidate answers mirrored copies of live
+        traffic off the response path (its own extract/supplement run),
+        live serves 100% of real traffic, and identical models agree →
+        auto-promote on the bake."""
+        storage, srv, port, version_b = served
+        with Hammer(port) as hammer:
+            status, body = _post(port, "/rollout/start", {
+                "version": version_b.id,
+                "fraction": 0.5,
+                "interval_s": 0.2,
+                "window_s": 20.0,
+                "min_requests": 5,
+                "bake_s": 1.5,
+                "shadow": True,
+            })
+            assert status == 200, body
+            _wait_for(
+                lambda: _get(port, "/rollout/status")[1]["state"]
+                == "promoted",
+                timeout=90, what="shadow promote",
+            )
+        results, transport_errors = hammer.snapshot()
+        assert transport_errors == []
+        assert results and all(s == 200 for s, _ in results)
+        st, rollout = _get(port, "/rollout/status")
+        cand = rollout["candidate"]
+        assert cand.get("shadow_count", 0) >= 5
+        assert cand.get("agreement", 0) > 0.9  # same blob → same answers
+        assert ModelRegistry(storage).get(version_b.id).status == "live"
+
+    def test_operator_abort_detaches_candidate(self, served):
+        storage, srv, port, version_b = served
+        status, body = _post(port, "/rollout/start", {
+            "version": version_b.id, "fraction": 0.2, "bake_s": 300.0,
+        })
+        assert status == 200, body
+        # double start conflicts while one is active
+        status, body = _post(port, "/rollout/start", {
+            "version": version_b.id,
+        })
+        assert status == 409
+        status, body = _post(
+            port, "/rollout/abort", {"reason": "bad vibes"}
+        )
+        assert status == 200 and body["state"] == "aborted"
+        assert srv.candidate is None
+        assert (
+            ModelRegistry(storage).get(version_b.id).status == "rolled_back"
+        )
+        # nothing to abort now
+        status, _ = _post(port, "/rollout/abort", {})
+        assert status == 409
