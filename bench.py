@@ -772,6 +772,279 @@ def _bench_hot_swap(srv, storage, port, n_users_serve):
     }
 
 
+def bench_multitenant():
+    """Multi-tenant serving (ISSUE 6): 1 hog + 3 well-behaved tenants on
+    ONE query server. Measures isolation (well-behaved p99 vs its solo
+    baseline, goodput spread across the well-behaved set, zero in-quota
+    drops) and model-cache economics (6 tenants through a 3-slot cache:
+    hit rate + transparent reload count)."""
+    import concurrent.futures
+    import http.client
+    import threading
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+    from predictionio_tpu.tenancy import Tenant, TenantMux, TenantStore
+    from predictionio_tpu.workflow.core import run_train
+    from predictionio_tpu.workflow.server import (
+        QueryServer,
+        QueryServerConfig,
+        latest_completed_runtime,
+    )
+
+    cfg = StorageConfig(
+        sources={"MEM": SourceConfig("MEM", "memory", {})},
+        repositories={
+            "METADATA": "MEM", "EVENTDATA": "MEM", "MODELDATA": "MEM",
+        },
+    )
+    storage = Storage(cfg)
+    app_id = storage.get_meta_data_apps().insert(App(0, "mtbench"))
+    events = storage.get_events()
+    events.init_app(app_id)
+    n_users, n_items = (500, 2000) if not SMALL else (100, 400)
+    rng = np.random.RandomState(17)
+    batch: list[Event] = []
+    for i in range(n_items):
+        batch.append(Event(
+            event="rate", entity_type="user",
+            entity_id=f"u{int(rng.randint(n_users))}",
+            target_entity_type="item", target_entity_id=f"i{i}",
+            properties={"rating": float(rng.randint(1, 6))},
+        ))
+    for _ in range(n_users * 10):
+        batch.append(Event(
+            event="rate", entity_type="user",
+            entity_id=f"u{int(rng.randint(n_users))}",
+            target_entity_type="item",
+            target_entity_id=f"i{int(rng.zipf(1.4)) % n_items}",
+            properties={"rating": float(rng.randint(1, 6))},
+        ))
+    for lo in range(0, len(batch), 10_000):
+        events.insert_batch(batch[lo:lo + 10_000], app_id)
+    variant = {
+        "id": "mtbench",
+        "engineFactory":
+            "predictionio_tpu.engines.recommendation.RecommendationEngine",
+        "datasource": {"params": {"app_name": "mtbench"}},
+        "algorithms": [
+            {"name": "als", "params": {"rank": RANK, "num_iterations": 3}}
+        ],
+    }
+    run_train(storage, variant)
+
+    store = TenantStore(storage)
+    goods = ["good1", "good2", "good3"]
+    # the hog gets qps + concurrency quotas (its overage 429s instead of
+    # queueing — admission control is half the isolation story, the
+    # weighted-fair batching is the other half); the well-behaved
+    # tenants are unlimited — every one of their queries is in-quota
+    # and must be answered
+    store.upsert(Tenant(
+        id="hog", engine_id="mtbench", qps=200.0, max_concurrency=8,
+        # the device-seconds cap is the quota that actually protects
+        # neighbors on a saturated device: the hog may burn at most
+        # ~15% of one device's seconds per wall second
+        device_seconds_per_s=0.15,
+    ))
+    for g in goods:
+        store.upsert(Tenant(id=g, engine_id="mtbench"))
+
+    def hammer_tenant(port, tenant, n_clients, n_per, results, label):
+        """Closed-loop per-tenant load; records (latency, status)."""
+        def client(c):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=60.0
+            )
+            try:
+                for j in range(n_per):
+                    body = json.dumps({
+                        "user": f"u{(c * n_per + j) % n_users}", "num": 10,
+                    }).encode()
+                    t0 = time.perf_counter()
+                    try:
+                        conn.request(
+                            "POST", f"/tenants/{tenant}/queries.json",
+                            body=body,
+                            headers={"Content-Type": "application/json"},
+                        )
+                        resp = conn.getresponse()
+                        resp.read()
+                        status = resp.status
+                    except Exception:
+                        status = 0
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=60.0
+                        )
+                    results[label].append(
+                        (time.perf_counter() - t0, status)
+                    )
+            finally:
+                conn.close()
+        with concurrent.futures.ThreadPoolExecutor(n_clients) as pool:
+            list(pool.map(client, range(n_clients)))
+
+    def p99_ms(rows):
+        lat = sorted(r[0] for r in rows if r[1] == 200)
+        return lat[int(0.99 * (len(lat) - 1))] * 1e3 if lat else 0.0
+
+    # -- phase 1+2: isolation under a hog --------------------------------
+    runtime = latest_completed_runtime(storage, "mtbench", "0", "mtbench")
+    # max_window is tuned down for multi-tenant serving: the adaptive
+    # drain-linger exists to deepen SINGLE-runtime batches, but tenant
+    # groups dispatch per-runtime anyway, so lingering 60 ms only adds
+    # queue wait to every tenant's p99 without merging any device work
+    srv = QueryServer(
+        storage, runtime,
+        QueryServerConfig(ip="127.0.0.1", port=0, max_window_ms=8.0),
+    )
+    mux = TenantMux(
+        storage, metrics=srv.metrics, cache_capacity=8, refresh_s=1.0,
+        sync_s=3600.0,
+    )
+    srv.attach_tenancy(mux)
+    port = srv.start()
+    try:
+        import collections
+
+        results: dict = collections.defaultdict(list)
+        n_per = 25 if not SMALL else 4
+        # warm every tenant first: the first query per tenant pays the
+        # model-cache load (by design) and the jit bucket ladder — the
+        # isolation measurement is about steady-state scheduling, not
+        # cold starts
+        for t in ("good1", "good2", "good3", "hog"):
+            hammer_tenant(port, t, 1, 2, results, "warmup")
+        # solo baseline: one well-behaved tenant, quiet server
+        hammer_tenant(port, "good1", 4, n_per, results, "solo")
+        solo_p99 = p99_ms(results["solo"])
+
+        # no-hog baseline: all three good tenants at their normal pace.
+        # On small hosts the closed-loop client threads themselves
+        # contend with the server for CPU, so the hog's MARGINAL impact
+        # (contended vs this) is the honest isolation number next to
+        # the raw solo ratio
+        threads = [
+            threading.Thread(
+                target=hammer_tenant,
+                args=(port, g, 4, n_per, results, f"nohog-{g}"),
+            )
+            for g in goods
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        nohog_p99 = max(p99_ms(results[f"nohog-{g}"]) for g in goods)
+
+        # contended: the hog floods while the three good tenants keep
+        # their modest pace — weighted-fair batching + quota admission
+        # are what keeps the good tenants' numbers flat
+        # 12 hog clients: enough to keep the hog's concurrency quota
+        # saturated (8) and its qps overage 429ing, without drowning a
+        # small host in client threads that steal the server's own CPU
+        hog_clients = 12 if not SMALL else 8
+        threads = [threading.Thread(
+            target=hammer_tenant,
+            args=(port, "hog", hog_clients, n_per * 2, results, "hog"),
+        )]
+        for g in goods:
+            threads.append(threading.Thread(
+                target=hammer_tenant,
+                args=(port, g, 4, n_per, results, g),
+            ))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+        good_p99 = {g: p99_ms(results[g]) for g in goods}
+        goodput = {
+            g: sum(1 for r in results[g] if r[1] == 200) / wall
+            for g in goods
+        }
+        in_quota_dropped = sum(
+            1 for g in goods for r in results[g] if r[1] != 200
+        )
+        hog_ok = sum(1 for r in results["hog"] if r[1] == 200)
+        hog_429 = sum(1 for r in results["hog"] if r[1] == 429)
+        worst_p99 = max(good_p99.values())
+        isolation = {
+            "solo_p99_ms": round(solo_p99, 1),
+            "nohog_p99_ms": round(nohog_p99, 1),
+            "contended_p99_ms": round(worst_p99, 1),
+            "p99_ratio": round(worst_p99 / solo_p99, 2) if solo_p99 else 0,
+            "hog_impact_ratio": round(
+                worst_p99 / nohog_p99, 2
+            ) if nohog_p99 else 0,
+            "goodput_qps": {
+                g: round(q, 1) for g, q in goodput.items()
+            },
+            "goodput_ratio": round(
+                max(goodput.values()) / min(goodput.values()), 2
+            ) if min(goodput.values()) > 0 else 0,
+            "in_quota_dropped": in_quota_dropped,
+            "hog_served": hog_ok,
+            "hog_rejected_429": hog_429,
+            "hog_goodput_qps": round(hog_ok / wall, 1),
+        }
+    finally:
+        srv.stop()
+
+    # -- phase 3: cache economics — 6 live models through 3 slots --------
+    cache_tenants = [f"cache{i}" for i in range(6)]
+    for c in cache_tenants:
+        store.upsert(Tenant(id=c, engine_id="mtbench"))
+    runtime = latest_completed_runtime(storage, "mtbench", "0", "mtbench")
+    srv = QueryServer(
+        storage, runtime, QueryServerConfig(ip="127.0.0.1", port=0)
+    )
+    mux = TenantMux(
+        storage, metrics=srv.metrics, cache_capacity=3, refresh_s=1.0,
+        sync_s=3600.0,
+    )
+    srv.attach_tenancy(mux)
+    port = srv.start()
+    try:
+        import collections
+
+        results = collections.defaultdict(list)
+        # zipf-ish access skew: hot tenants mostly hit, cold ones cycle
+        # through the LRU — the shape a real fleet has
+        passes = 3 if not SMALL else 2
+        order = []
+        for p in range(passes):
+            for i, c in enumerate(cache_tenants):
+                order += [c] * (3 if i < 2 else 1)
+        for c in order:
+            hammer_tenant(port, c, 1, 1, results, c)
+        served = sum(
+            1 for c in cache_tenants for r in results[c] if r[1] == 200
+        )
+        stats = mux.cache.stats()
+        cache_out = {
+            "live_models": len(cache_tenants),
+            "capacity": stats["capacity"],
+            "served": served,
+            "hit_rate": round(stats["hit_rate"], 3),
+            "reloads": stats["reloads"],
+            "evictions": stats["evictions"],
+            "resident": stats["resident"],
+        }
+        assert served == len(order), "cache phase dropped queries"
+    finally:
+        srv.stop()
+    return {"isolation": isolation, "cache": cache_out}
+
+
 def _slowest_trace_summary(recorder):
     """Per-stage span breakdown of the slowest sampled /queries.json
     request (ISSUE 2): where the tail request actually spent its time —
@@ -1219,6 +1492,7 @@ def main():
     grid = bench_grid_tuning()
     dev_p50_ms, dev_qps = bench_serving_device()
     framework = bench_serving_framework()
+    multitenant = bench_multitenant()
     ur = bench_ur_framework()
     ingest = bench_event_ingestion()
     ingest_sharded = bench_sharded_ingestion()
@@ -1326,6 +1600,24 @@ def main():
              "p50_ms": round(r["p50_ms"], 1)}
             for r in framework["sweep"]
         ],
+        # ISSUE 6: multi-tenant isolation (1 hog + 3 well-behaved on one
+        # server) and model-cache economics (6 live models, 3 slots)
+        "mt_solo_p99_ms": multitenant["isolation"]["solo_p99_ms"],
+        "mt_nohog_p99_ms": multitenant["isolation"]["nohog_p99_ms"],
+        "mt_contended_p99_ms": multitenant["isolation"]["contended_p99_ms"],
+        "mt_p99_ratio": multitenant["isolation"]["p99_ratio"],
+        "mt_hog_impact_ratio": multitenant["isolation"]["hog_impact_ratio"],
+        "mt_goodput_qps": multitenant["isolation"]["goodput_qps"],
+        "mt_goodput_ratio": multitenant["isolation"]["goodput_ratio"],
+        "mt_in_quota_dropped": multitenant["isolation"]["in_quota_dropped"],
+        "mt_hog_served": multitenant["isolation"]["hog_served"],
+        "mt_hog_rejected_429": multitenant["isolation"]["hog_rejected_429"],
+        "mt_hog_goodput_qps": multitenant["isolation"]["hog_goodput_qps"],
+        "mt_cache_live_models": multitenant["cache"]["live_models"],
+        "mt_cache_capacity": multitenant["cache"]["capacity"],
+        "mt_cache_hit_rate": multitenant["cache"]["hit_rate"],
+        "mt_cache_reloads": multitenant["cache"]["reloads"],
+        "mt_cache_evictions": multitenant["cache"]["evictions"],
         "ur_framework_qps": round(ur["qps"], 1),
         "ur_framework_p50_ms": round(ur["p50_ms"], 1),
         "ur_framework_p99_ms": round(ur["p99_ms"], 1),

@@ -150,10 +150,14 @@ class EventValidation:
 
     SPECIAL_EVENTS = frozenset({SET_EVENT, UNSET_EVENT, DELETE_EVENT})
     # framework-internal entities allowed under the reserved pio_ prefix:
-    # feedback predictions (pio_pr) and the model-lifecycle records
-    # (ISSUE 5) that live in the reserved LIFECYCLE_APP_ID namespace
+    # feedback predictions (pio_pr), the model-lifecycle records (ISSUE
+    # 5), and the tenancy/rollout-state records (ISSUE 6) — all living
+    # in the reserved LIFECYCLE_APP_ID namespace
     BUILTIN_ENTITY_TYPES = frozenset(
-        {"pio_pr", "pio_model_version", "pio_train_job"}
+        {
+            "pio_pr", "pio_model_version", "pio_train_job",
+            "pio_tenant", "pio_rollout",
+        }
     )
 
     @staticmethod

@@ -40,6 +40,10 @@ LIFECYCLE_APP_ID = 2_000_000_000
 
 VERSION_ENTITY = "pio_model_version"
 
+# rollout-state records (rollout.py owns the logic; the name lives here
+# so registry-side compaction can reach it without an import cycle)
+ROLLOUT_ENTITY = "pio_rollout"
+
 VERSION_STATUSES = ("trained", "canary", "live", "rolled_back", "archived")
 
 # process-monotonic tie-breaker: two record updates can land in the same
@@ -112,6 +116,84 @@ class LifecycleRecordStore:
         for d in out.values():
             d.pop("_seq", None)
         return out
+
+    def compact(
+        self, entity_type: str, entity_id: str, min_events: int = 2,
+        min_age_s: float = 60.0,
+    ) -> int:
+        """Fold one record's update events into a single snapshot event
+        (fold → snapshot), deleting the older ones. Every reader of the
+        record layer re-folds history — `/models`, the queue poll, the
+        mux's tenant refresh — so long-lived records must stay O(1)
+        events, not O(updates). Returns how many events were removed.
+
+        Crash-safe ordering: the snapshot (which carries every folded
+        field, so it wins last-write-wins on all of them) is appended
+        BEFORE the old events are deleted — a crash in between leaves
+        redundant events whose fold is unchanged.
+
+        Concurrent-writer guard: only QUIESCENT records compact — a
+        record updated within `min_age_s` is skipped, because a write
+        landing between this fold read and the snapshot append would be
+        outranked by the snapshot and silently reverted (e.g. a job's
+        `completed` flip racing the scheduler's retention sweep would
+        resurrect it as `running`). Active records are exactly the ones
+        still being written; the sweep gets them on a later pass."""
+        store = self._events()
+        evs = list(store.find(EventQuery(
+            app_id=LIFECYCLE_APP_ID,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=[SET_EVENT],
+        )))
+        if len(evs) < max(2, min_events):
+            return 0
+        if min_age_s > 0:
+            newest = max(e.event_time for e in evs)
+            age = (_utcnow() - newest).total_seconds()
+            if age < min_age_s:
+                return 0
+        evs.sort(key=lambda e: (
+            e.event_time, e.properties.get_or_else("_seq", 0)
+        ))
+        merged: dict[str, Any] = {}
+        for e in evs:
+            merged.update(e.properties.to_dict())
+        merged.pop("_seq", None)
+        self.append(entity_type, entity_id, merged)
+        ids = [e.event_id for e in evs if e.event_id]
+        if ids:
+            store.delete_batch(ids, LIFECYCLE_APP_ID)
+        return len(ids)
+
+    def compact_all(
+        self, entity_type: str, min_events: int = 8,
+        min_age_s: float = 60.0,
+    ) -> int:
+        """Compact every QUIESCENT record of `entity_type` whose fold
+        spans at least `min_events` events (see `compact` for the
+        concurrent-writer guard). Returns total events removed."""
+        counts: dict[str, int] = {}
+        for e in self._events().find(EventQuery(
+            app_id=LIFECYCLE_APP_ID,
+            entity_type=entity_type,
+            event_names=[SET_EVENT],
+        )):
+            counts[e.entity_id] = counts.get(e.entity_id, 0) + 1
+        removed = 0
+        for entity_id, n in counts.items():
+            if n >= max(2, min_events):
+                try:
+                    removed += self.compact(
+                        entity_type, entity_id, min_events=min_events,
+                        min_age_s=min_age_s,
+                    )
+                except Exception:
+                    log.exception(
+                        "compaction of %s/%s failed (non-fatal)",
+                        entity_type, entity_id,
+                    )
+        return removed
 
     def purge(self, entity_type: str, entity_id: str) -> int:
         """Delete every event of one record; returns how many existed."""
@@ -323,6 +405,24 @@ class ModelRegistry:
             cur = self.get(cur.parent_version) if cur.parent_version else None
         return chain
 
+    def compact(
+        self, min_events: int = 8, min_age_s: float = 60.0
+    ) -> int:
+        """Registry-fold compaction (fold → snapshot event): bound the
+        event count behind `/models`, the mux's prefetch reads, and the
+        rollout resume pre-checks as tenant count × version × rollout
+        history grows. Returns events removed."""
+        removed = self._store.compact_all(
+            VERSION_ENTITY, min_events=min_events, min_age_s=min_age_s
+        )
+        # rollout-state records accumulate 2-3 events per canary per
+        # scope forever — every QueryServer.start and mux sync re-folds
+        # them, so they need the same retention discipline
+        removed += self._store.compact_all(
+            ROLLOUT_ENTITY, min_events=min_events, min_age_s=min_age_s
+        )
+        return removed
+
     # -- retention GC -----------------------------------------------------
     def gc(
         self, keep: int = 5, delete_blobs: bool = False
@@ -362,4 +462,7 @@ class ModelRegistry:
                         "model blob delete failed for %s (non-fatal)",
                         v.instance_id,
                     )
+        # retention + compaction together keep the fold bounded in both
+        # dimensions: record COUNT (gc) and events PER record (snapshot)
+        self.compact()
         return collected

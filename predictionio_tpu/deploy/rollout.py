@@ -35,7 +35,12 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Optional, TYPE_CHECKING
 
-from predictionio_tpu.deploy.registry import ModelRegistry, ModelVersion
+from predictionio_tpu.deploy.registry import (
+    ROLLOUT_ENTITY,
+    LifecycleRecordStore,
+    ModelRegistry,
+    ModelVersion,
+)
 
 if TYPE_CHECKING:  # avoid the runtime import cycle with workflow.server
     from predictionio_tpu.workflow.server import EngineRuntime, QueryServer
@@ -44,6 +49,11 @@ log = logging.getLogger(__name__)
 
 VARIANT_LIVE = "live"
 VARIANT_CANDIDATE = "candidate"
+
+# persisted rollout state (ISSUE 6 satellite, PR-5 follow-up): one
+# ROLLOUT_ENTITY record per rollout scope on the shared record layer, so
+# a query-server restart mid-canary re-adopts the bake instead of
+# silently dropping it
 
 
 def _env_float(env: dict, key: str, default: float) -> float:
@@ -220,6 +230,7 @@ class RolloutState:
     config: RolloutConfig
     state: str = "starting"  # canary|promoted|rolled_back|aborted|failed
     started_at: float = field(default_factory=time.monotonic)
+    started_wall: float = 0.0  # epoch seconds; survives restarts
     verdict_reason: str = ""
     last_action: str = "wait"
 
@@ -232,11 +243,17 @@ class RolloutController:
         server: "QueryServer",
         version: ModelVersion,
         config: Optional[RolloutConfig] = None,
+        scope: Optional[str] = None,
     ):
         self.server = server
         self.registry = ModelRegistry(server.storage)
         self.config = config or RolloutConfig.from_env()
         self.st = RolloutState(version, self.config)
+        # persistence scope: one active rollout per scope. The default
+        # is the engine variant (a query server serves one); tenant
+        # rollouts pass "tenant/<id>" so they persist independently
+        self.scope = scope or f"{version.engine_id}/{version.engine_variant}"
+        self._records = LifecycleRecordStore(server.storage)
         self.windows = {
             VARIANT_LIVE: VariantWindow(self.config.window_s),
             VARIANT_CANDIDATE: VariantWindow(self.config.window_s),
@@ -253,11 +270,31 @@ class RolloutController:
             if self.config.shadow else None
         )
 
+    # -- persistence ------------------------------------------------------
+    def _persist(self, **fields: Any) -> None:
+        """Best-effort rollout-state write: a storage blip must not
+        fail the rollout itself — at worst a restart misses one
+        transition and the resume path re-checks the registry anyway."""
+        try:
+            self._records.append(ROLLOUT_ENTITY, self.scope, fields)
+        except Exception:
+            log.warning(
+                "rollout state persist failed for scope %s (storage "
+                "down?); restart re-adoption may miss this transition",
+                self.scope, exc_info=True,
+            )
+
     # -- lifecycle --------------------------------------------------------
-    def start(self) -> None:
+    def start(self, resume_started_wall: Optional[float] = None) -> None:
         """Build the candidate runtime and attach it to the server. A
         build failure (model.load fault, bad blob) leaves the live
-        runtime untouched — the canary never starts."""
+        runtime untouched — the canary never starts.
+
+        `resume_started_wall` re-adopts a persisted mid-canary rollout
+        after a restart: bake progress is credited from the original
+        wall-clock start, so a canary 50s into a 60s bake doesn't
+        restart its bake from zero (it DOES need fresh verdict-window
+        samples — the windows are in-memory by design)."""
         from predictionio_tpu.workflow.server import (
             RolloutConflict,
             build_runtime,
@@ -296,13 +333,43 @@ class RolloutController:
         # judging, and neither abort nor a new start can clear it.
         self.server.attach_rollout(self, candidate)
         try:
-            self.registry.set_status(self.st.version.id, "canary")
+            # TENANT scopes only: a version another tenant already
+            # promoted stays "live" — tenants of one engine canary the
+            # same trained version by default, and flipping the shared
+            # record back to "canary" would erase the variant's live
+            # pointer out from under the tenants serving it. The
+            # default scope still always flips: its resume path is
+            # strict (status must be "canary"), so skipping the flip
+            # there would make a server-scope bake unresumable.
+            cur = (
+                self.registry.get(self.st.version.id)
+                if self.scope.startswith("tenant/") else None
+            )
+            if cur is None or cur.status != "live":
+                self.registry.set_status(self.st.version.id, "canary")
         except Exception:
             self.st.state = "failed"
             self.server.complete_rollout(self, promote=False)
             raise
         self.st.state = "canary"
-        self.st.started_at = time.monotonic()
+        now_wall = time.time()
+        if (
+            resume_started_wall is not None
+            and 0 < resume_started_wall <= now_wall
+        ):
+            self.st.started_at = time.monotonic() - (
+                now_wall - resume_started_wall
+            )
+            self.st.started_wall = resume_started_wall
+        else:
+            self.st.started_at = time.monotonic()
+            self.st.started_wall = now_wall
+        self._persist(
+            state="canary",
+            version_id=self.st.version.id,
+            config=self.config.to_dict(),
+            started_wall=self.st.started_wall,
+        )
         self._thread = threading.Thread(
             target=self._loop, name="rollout-verdict", daemon=True
         )
@@ -400,6 +467,7 @@ class RolloutController:
                 "canary %s promoted in serving, but the registry status "
                 "write failed", self.st.version.id,
             )
+        self._persist(state="promoted", verdict_reason=reason)
         log.info("canary promoted: %s (%s)", self.st.version.id, reason)
 
     def rollback(self, reason: str) -> None:
@@ -418,11 +486,13 @@ class RolloutController:
                 "canary %s detached from serving, but the registry "
                 "status write failed", self.st.version.id,
             )
+        self._persist(state="rolled_back", verdict_reason=reason)
         log.warning("canary rolled back: %s (%s)", self.st.version.id, reason)
 
     def abort(self, reason: str = "operator abort") -> None:
         self.rollback(reason)
         self.st.state = "aborted"
+        self._persist(state="aborted", verdict_reason=reason)
 
     # -- reporting --------------------------------------------------------
     def status(self) -> dict[str, Any]:
@@ -436,3 +506,82 @@ class RolloutController:
             "live": self.windows[VARIANT_LIVE].stats(),
             "candidate": self.windows[VARIANT_CANDIDATE].stats(),
         }
+
+
+def resume_rollout(server, scope: Optional[str] = None):
+    """Re-adopt a persisted mid-canary rollout after a restart (PR-5
+    follow-up). `server` is anything RolloutController can drive — the
+    QueryServer itself or a tenant rollout host. Returns the re-started
+    controller, or None when there is nothing (or nothing valid) to
+    resume.
+
+    Double-checked against the registry: the persisted record says
+    "canary", but if the version's registry status moved on (another
+    server promoted/rolled it back while this one was down), the stale
+    record is ignored — the registry is the source of truth."""
+    storage = server.storage
+    if scope is None:
+        inst = server.runtime.instance
+        scope = f"{inst.engine_id}/{inst.engine_variant}"
+    rec = (
+        LifecycleRecordStore(storage)
+        .fold(ROLLOUT_ENTITY, scope)
+        .get(scope)
+    )
+    if not rec or rec.get("state") != "canary":
+        return None
+    version = ModelRegistry(storage).get(rec.get("version_id") or "")
+    if version is None:
+        stale = "version record missing from the registry"
+    elif scope.startswith("tenant/"):
+        # tenant scopes share version records (two tenants of one
+        # engine canary the same trained version by default), so the
+        # GLOBAL status field cannot prove THIS scope's rollout
+        # finished: another tenant promoting the shared version flips
+        # it to "live" while this scope is still mid-bake. Only
+        # globally disqualifying states stop a tenant resume —
+        # rolled_back (judged bad somewhere) and archived (retention
+        # may have collected the blob).
+        stale = (
+            f"version {version.id} is {version.status}"
+            if version.status in ("rolled_back", "archived") else None
+        )
+    else:
+        # the default scope IS the variant's one serving scope: any
+        # move off "canary" means this rollout finished elsewhere
+        stale = (
+            f"version {version.id} is {version.status}"
+            if version.status != "canary" else None
+        )
+    if stale is not None:
+        # retire the stale per-scope record: left as "canary" it would
+        # be re-considered — and its baseline warmed and pinned — on
+        # every restart and sync pass forever
+        try:
+            LifecycleRecordStore(storage).append(
+                ROLLOUT_ENTITY, scope,
+                {"state": "aborted", "verdict_reason": f"not resumed: {stale}"},
+            )
+        except Exception:
+            log.warning(
+                "could not retire stale rollout record for scope %s",
+                scope, exc_info=True,
+            )
+        log.warning(
+            "persisted rollout for scope %s not resumed: %s", scope, stale
+        )
+        return None
+    try:
+        config = RolloutConfig.from_env(**(rec.get("config") or {}))
+    except (TypeError, ValueError):
+        log.warning(
+            "persisted rollout config for scope %s is malformed; "
+            "resuming with env defaults", scope,
+        )
+        config = RolloutConfig.from_env()
+    controller = RolloutController(server, version, config, scope=scope)
+    controller.start(resume_started_wall=rec.get("started_wall"))
+    log.info(
+        "re-adopted persisted rollout of %s (scope %s)", version.id, scope
+    )
+    return controller

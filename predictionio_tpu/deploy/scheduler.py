@@ -235,6 +235,9 @@ class JobQueue:
         doomed = terminal[: len(terminal) - keep] if keep else terminal
         for j in doomed:
             self._store.purge(JOB_ENTITY, j.id)
+        # compact the survivors: status transitions accumulate ~5 events
+        # per job, and every queue poll re-folds the whole history
+        self._store.compact_all(JOB_ENTITY)
         return [j.id for j in doomed]
 
     def claimable(self, now_epoch: Optional[float] = None) -> list[TrainJob]:
@@ -249,6 +252,12 @@ class JobQueue:
 class SchedulerConfig:
     poll_interval_s: float = 0.5
     heartbeat_interval_s: float = 1.0
+    # concurrency knob (ISSUE 6 satellite, PR-5 follow-up): N train
+    # subprocesses in flight at once, so many tenants' periodic retrains
+    # don't serialize behind one worker. Jobs for the SAME engine stay
+    # serialized — two concurrent trains of one engine would race the
+    # latest-COMPLETED pointer their deploys read.
+    max_concurrent: int = 1
     # a `running` job whose heartbeat is older than this is an orphan of
     # a crashed worker and gets re-queued on scheduler start
     stale_after_s: float = 15.0
@@ -285,8 +294,14 @@ class TrainScheduler:
         self._stop = threading.Event()
         self._abandon = False  # crash simulation: die without bookkeeping
         self._thread: Optional[threading.Thread] = None
-        self._child: Optional[subprocess.Popen] = None
+        # per-job children + claim bookkeeping: with max_concurrent > 1
+        # several supervisions run at once on a worker pool
+        self._children: dict[str, subprocess.Popen] = {}
         self._child_lock = threading.Lock()
+        self._pool: Optional[Any] = None
+        self._claim_lock = threading.Lock()
+        self._running_ids: set[str] = set()
+        self._running_engines: set[str] = set()
         self._log_dir = self.config.log_dir or os.path.join(
             tempfile.gettempdir(), "pio_train_jobs"
         )
@@ -296,33 +311,47 @@ class TrainScheduler:
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
         self._stop.clear()
         self._abandon = False
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(1, int(self.config.max_concurrent)),
+                thread_name_prefix="train-supervise",
+            )
         self._thread = threading.Thread(
             target=self._loop, name="train-scheduler", daemon=True
         )
         self._thread.start()
 
     def stop(self, kill_child: bool = False) -> None:
-        """Stop polling. `kill_child=True` hard-kills an in-flight train
-        subprocess AND abandons its record unchanged — the chaos-test
-        stand-in for a worker crash (the job stays `running` with a
-        going-stale heartbeat until the next scheduler start resumes
-        it); a plain stop BLOCKS until an in-flight train finishes and
-        is bookkept — returning early would let the interpreter exit
-        kill the daemon supervisor mid-train, orphaning a child whose
-        stale heartbeat then gets the job trained a second time. The
-        wait is bounded by the job's own timeout enforcement."""
+        """Stop polling. `kill_child=True` hard-kills every in-flight
+        train subprocess AND abandons their records unchanged — the
+        chaos-test stand-in for a worker crash (jobs stay `running` with
+        going-stale heartbeats until the next scheduler start resumes
+        them); a plain stop BLOCKS until in-flight trains finish and
+        are bookkept — returning early would let the interpreter exit
+        kill the daemon supervisor mid-train, orphaning children whose
+        stale heartbeats then get the jobs trained a second time. The
+        wait is bounded by each job's own timeout enforcement."""
         self._stop.set()
         if kill_child:
             self._abandon = True
             with self._child_lock:
-                child = self._child
-            if child is not None and child.poll() is None:
-                child.kill()
+                children = list(self._children.values())
+            for child in children:
+                if child.poll() is None:
+                    child.kill()
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._pool is not None:
+            # abandoned supervisions return fast (their children are
+            # dead and bookkeeping is skipped); clean ones block here
+            # until the in-flight trains are bookkept
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     # -- crash resume -----------------------------------------------------
     def resume_orphans(self) -> list[str]:
@@ -384,20 +413,58 @@ class TrainScheduler:
             except Exception:
                 log.exception("job poll failed (storage down?); retrying")
                 ready = []
-            ran = False
+            dispatched = False
             for job in ready:
                 if self._stop.is_set():
                     break
-                try:
-                    self._run_job(job)
-                except Exception:
-                    # a storage/filesystem error mid-supervision must
-                    # not kill the scheduler thread — the job's stale
-                    # heartbeat makes it an orphan the next pass resumes
-                    log.exception("job %s supervision failed", job.id)
-                ran = True
-            if not ran:
+                if self._dispatch(job):
+                    dispatched = True
+            if not dispatched:
                 self._stop.wait(self.config.poll_interval_s)
+
+    def _dispatch(self, job: TrainJob) -> bool:
+        """Claim `job` onto the supervision pool if capacity and the
+        per-engine serialization allow it. Claims are capped at
+        max_concurrent so a burst of submissions doesn't pile jobs into
+        a `running`-but-not-started limbo behind the pool queue."""
+        with self._claim_lock:
+            if (
+                len(self._running_ids) >= max(
+                    1, int(self.config.max_concurrent)
+                )
+                or job.id in self._running_ids
+                or job.engine_id in self._running_engines
+            ):
+                return False
+            self._running_ids.add(job.id)
+            self._running_engines.add(job.engine_id)
+
+        def run() -> None:
+            try:
+                self._run_job(job)
+            except Exception:
+                # a storage/filesystem error mid-supervision must not
+                # kill the worker — the job's stale heartbeat makes it
+                # an orphan the next resume pass re-queues
+                log.exception("job %s supervision failed", job.id)
+            finally:
+                with self._claim_lock:
+                    self._running_ids.discard(job.id)
+                    self._running_engines.discard(job.engine_id)
+
+        pool = self._pool
+        if pool is None:
+            # no pool (synchronous path): run inline
+            run()
+            return True
+        try:
+            pool.submit(run)
+        except RuntimeError:  # pool already shut down (stop raced)
+            with self._claim_lock:
+                self._running_ids.discard(job.id)
+                self._running_engines.discard(job.engine_id)
+            return False
+        return True
 
     def run_pending_once(self) -> int:
         """Drain currently-claimable jobs synchronously (tests and
@@ -460,7 +527,11 @@ class TrainScheduler:
                     stdout=logf, stderr=subprocess.STDOUT, env=env,
                 )
             with self._child_lock:
-                self._child = child
+                self._children[job.id] = child
+                if self._abandon and child.poll() is None:
+                    # stop(kill_child=True) raced the spawn: this child
+                    # must die too, or it finishes unsupervised
+                    child.kill()
             # heartbeat while the child lives: liveness for crash
             # detection AND the timeout enforcement point. A clean
             # stop() does NOT break out — the supervisor keeps
@@ -509,7 +580,7 @@ class TrainScheduler:
             return
         finally:
             with self._child_lock:
-                self._child = None
+                self._children.pop(job.id, None)
         if self._abandon:
             return  # crashed worker: the record keeps its stale heartbeat
         if timed_out:

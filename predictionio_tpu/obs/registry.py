@@ -282,6 +282,14 @@ class MetricsRegistry:
                         f"histogram {name!r} re-registered with different "
                         f"buckets"
                     )
+                if kw.get("callback") is not None and isinstance(
+                    fam, GaugeFamily
+                ):
+                    # newest callback wins: a re-attached component
+                    # (e.g. a fresh TenantMux after a server restart in
+                    # the same process) must not leave /metrics reading
+                    # — and keeping alive — the dead instance's closure
+                    fam.callback = kw["callback"]
                 return fam
             fam = cls(name, help_text, tuple(labelnames), **kw)
             self._families[name] = fam

@@ -1,0 +1,48 @@
+"""Multi-tenant serving plane (ISSUE 6): one query-server fleet, many
+engines/models, fair shares, and per-tenant quotas.
+
+The reference system is multi-app all the way down its storage (apps,
+channels, access keys) yet serves one engine per deploy process. This
+package multiplexes N tenants onto one server:
+
+- tenants.py — tenant records (engine variant + weight + quotas) on the
+  shared lifecycle record store; every process sees the same tenant set
+- fair.py    — deficit-round-robin weighted-fair queue in front of the
+  micro-batch dispatcher (a hog tenant cannot starve the batch
+  assembler)
+- quota.py   — qps / concurrency / device-seconds admission control
+  (over-quota → 429 + Retry-After, distinct from deadline 503s)
+- cache.py   — LRU model cache with registry-driven prefetch, pinned
+  canaries, and never-evict-in-flight leases
+- mux.py     — the multiplexer the QueryServer attaches: admission,
+  routing, per-tenant metrics (bounded labels), per-tenant canary
+  rollouts reusing deploy/rollout.py unchanged
+
+Import discipline: like obs/, resilience/, and deploy/, nothing here
+may import jax at module import time — the mux lives inside server
+processes whose data-plane paths must never pay the jax import.
+"""
+
+from predictionio_tpu.tenancy.cache import CacheEntry, ModelCache, ModelLoadError
+from predictionio_tpu.tenancy.fair import FairQueue
+from predictionio_tpu.tenancy.mux import TenantMux, UnknownTenant
+from predictionio_tpu.tenancy.quota import (
+    QuotaEnforcer,
+    QuotaExceeded,
+    TokenBucket,
+)
+from predictionio_tpu.tenancy.tenants import Tenant, TenantStore
+
+__all__ = [
+    "CacheEntry",
+    "FairQueue",
+    "ModelCache",
+    "ModelLoadError",
+    "QuotaEnforcer",
+    "QuotaExceeded",
+    "Tenant",
+    "TenantMux",
+    "TenantStore",
+    "TokenBucket",
+    "UnknownTenant",
+]

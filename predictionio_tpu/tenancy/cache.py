@@ -1,0 +1,285 @@
+"""LRU model cache: N tenant runtimes resident on one query server.
+
+Hundreds of tenants cannot all keep device-resident factor matrices at
+once — the cache holds up to `capacity` built `EngineRuntime`s keyed by
+tenant and rebuilds evicted ones on demand (a miss is a model load, not
+an error). Driven by the PR-5 version registry:
+
+- each entry remembers the model version it was built from; the sync
+  pass (`sync`) detects a promote and **prefetches** the new live
+  version into a fresh runtime, swapping it in without a miss,
+- entries serving an active canary are **pinned** (a rollout's verdict
+  windows would be garbage if its baseline runtime vanished mid-bake),
+- a runtime with in-flight queries (``refs > 0``) is NEVER evicted —
+  the dispatcher groups by runtime snapshot, and queries keep their
+  lease until bookkeeping finishes (the /reload drain semantic),
+- eviction is LRU over the remaining entries; when everything is pinned
+  or in flight the cache runs soft-over-capacity rather than failing
+  admissions.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class ModelLoadError(RuntimeError):
+    """The tenant's model could not be resolved or built."""
+
+
+class CacheEntry:
+    """One resident tenant runtime."""
+
+    __slots__ = (
+        "tenant_id", "version_key", "runtime", "refs", "pinned",
+        "last_used", "loaded_at",
+    )
+
+    def __init__(self, tenant_id: str, version_key: str, runtime: Any):
+        self.tenant_id = tenant_id
+        self.version_key = version_key
+        self.runtime = runtime
+        self.refs = 0
+        self.pinned = False
+        self.last_used = time.monotonic()
+        self.loaded_at = time.monotonic()
+
+
+class ModelCache:
+    """Tenant id → runtime, bounded by `capacity` resident entries."""
+
+    def __init__(
+        self,
+        storage,
+        capacity: int = 4,
+        build: Optional[Callable[[Any], Any]] = None,
+    ):
+        self.storage = storage
+        self.capacity = max(1, int(capacity))
+        self._build_fn = build
+        self._lock = threading.Lock()
+        self._entries: dict[str, CacheEntry] = {}
+        # per-tenant build locks: a slow model load must serialize the
+        # SAME tenant's concurrent misses (one build, many waiters) but
+        # never block other tenants' hits
+        self._load_locks: dict[str, threading.Lock] = {}
+        self._seen: set[str] = set()  # tenants ever loaded → miss vs reload
+        self.hits = 0
+        self.misses = 0
+        self.reloads = 0
+        self.evictions = 0
+
+    # -- resolution ---------------------------------------------------------
+    def resolve_version(self, tenant) -> tuple[str, Any]:
+        """(version_key, engine_instance) the tenant should serve: the
+        registry's live version when one exists, else the newest
+        COMPLETED instance of the tenant's variant."""
+        from predictionio_tpu.deploy.registry import ModelRegistry
+
+        live = ModelRegistry(self.storage).live_version(
+            tenant.engine_id, tenant.engine_variant
+        )
+        instances = self.storage.get_meta_data_engine_instances()
+        if live is not None:
+            inst = instances.get(live.instance_id)
+            if inst is not None:
+                return live.id, inst
+            log.warning(
+                "tenant %s: live version %s references missing instance "
+                "%s; falling back to latest completed",
+                tenant.id, live.id, live.instance_id,
+            )
+        inst = instances.get_latest_completed(
+            tenant.engine_id, tenant.engine_version, tenant.engine_variant
+        )
+        if inst is None:
+            raise ModelLoadError(
+                f"tenant {tenant.id!r} has no servable model for "
+                f"{tenant.engine_id}/{tenant.engine_variant} — train first"
+            )
+        return f"inst:{inst.id}", inst
+
+    def _build(self, instance) -> Any:
+        if self._build_fn is not None:
+            return self._build_fn(instance)
+        from predictionio_tpu.workflow.server import build_runtime
+
+        return build_runtime(self.storage, instance)
+
+    # -- the serving path ---------------------------------------------------
+    def acquire(self, tenant) -> CacheEntry:
+        """Hit or load the tenant's runtime; bumps the in-flight ref.
+        Callers MUST `release` the returned entry when the query's
+        bookkeeping is done."""
+        with self._lock:
+            entry = self._entries.get(tenant.id)
+            if entry is not None:
+                entry.refs += 1
+                entry.last_used = time.monotonic()
+                self.hits += 1
+                return entry
+            load_lock = self._load_locks.setdefault(
+                tenant.id, threading.Lock()
+            )
+        with load_lock:
+            # double-check: another thread may have finished the load
+            # while this one waited on the per-tenant lock
+            with self._lock:
+                entry = self._entries.get(tenant.id)
+                if entry is not None:
+                    entry.refs += 1
+                    entry.last_used = time.monotonic()
+                    self.hits += 1
+                    return entry
+                self.misses += 1
+                if tenant.id in self._seen:
+                    self.reloads += 1  # evicted earlier: transparent reload
+            version_key, instance = self.resolve_version(tenant)
+            try:
+                runtime = self._build(instance)
+            except Exception as e:
+                raise ModelLoadError(
+                    f"tenant {tenant.id!r} model load failed: {e}"
+                ) from e
+            with self._lock:
+                entry = CacheEntry(tenant.id, version_key, runtime)
+                entry.refs = 1
+                self._entries[tenant.id] = entry
+                self._seen.add(tenant.id)
+                self._evict_locked()
+                return entry
+
+    def release(self, entry: CacheEntry) -> None:
+        with self._lock:
+            if entry.refs > 0:
+                entry.refs -= 1
+
+    def acquire_and_release(self, tenant) -> None:
+        """Warm the tenant's entry without keeping a lease (rollout
+        start wants the live baseline resident before traffic splits)."""
+        self.release(self.acquire(tenant))
+
+    def warm_and_pin(self, tenant) -> None:
+        """Warm AND pin in one step: the entry is pinned while the
+        acquire lease still holds it, so there is no window where the
+        freshly-warmed baseline is evictable (a rollout's candidate
+        build takes seconds — plenty of time for other tenants' misses
+        to LRU the baseline out if the pin came later)."""
+        entry = self.acquire(tenant)
+        try:
+            with self._lock:
+                entry.pinned = True
+                cur = self._entries.get(entry.tenant_id)
+                if cur is not None and cur is not entry:
+                    cur.pinned = True  # a concurrent swap replaced it
+        finally:
+            self.release(entry)
+
+    # -- registry-driven prefetch / rollout hooks ---------------------------
+    def put_runtime(
+        self, tenant_id: str, runtime: Any, version_key: str
+    ) -> None:
+        """Swap in an already-built runtime (rollout promote: the baked
+        candidate becomes the tenant's resident entry; the old runtime
+        drains as its in-flight leases release)."""
+        with self._lock:
+            old = self._entries.get(tenant_id)
+            entry = CacheEntry(tenant_id, version_key, runtime)
+            if old is not None:
+                entry.pinned = old.pinned
+            self._entries[tenant_id] = entry
+            self._seen.add(tenant_id)
+            self._evict_locked()
+
+    def pin(self, tenant_id: str, on: bool = True) -> None:
+        with self._lock:
+            entry = self._entries.get(tenant_id)
+            if entry is not None:
+                entry.pinned = on
+
+    def invalidate(self, tenant_id: str) -> None:
+        """Drop the tenant's entry AND its bookkeeping: under tenant
+        churn the per-tenant load lock and the seen-set would otherwise
+        grow one object per tenant id ever served, forever. (A load in
+        flight keeps its own reference to the popped lock; the worst
+        case is one duplicate build for a tenant recreated mid-load.)"""
+        with self._lock:
+            self._entries.pop(tenant_id, None)
+            self._load_locks.pop(tenant_id, None)
+            self._seen.discard(tenant_id)
+
+    def sync(self, tenants) -> int:
+        """Prefetch-on-promote: for each RESIDENT tenant whose registry
+        live version moved, build the new runtime off the serving path
+        and swap it in. Returns how many runtimes were refreshed. (Only
+        resident tenants refresh — loading every registered tenant
+        would defeat the capacity bound.)"""
+        refreshed = 0
+        for tenant in tenants:
+            with self._lock:
+                entry = self._entries.get(tenant.id)
+            if entry is None:
+                continue
+            try:
+                version_key, instance = self.resolve_version(tenant)
+            except ModelLoadError:
+                continue  # nothing servable now; keep what's loaded
+            if version_key == entry.version_key:
+                continue
+            try:
+                runtime = self._build(instance)
+            except Exception:
+                log.exception(
+                    "tenant %s: prefetch of %s failed; serving the "
+                    "previous runtime", tenant.id, version_key,
+                )
+                continue
+            self.put_runtime(tenant.id, runtime, version_key)
+            refreshed += 1
+        return refreshed
+
+    # -- eviction -----------------------------------------------------------
+    def _evict_locked(self) -> None:
+        while len(self._entries) > self.capacity:
+            victims = [
+                e for e in self._entries.values()
+                if e.refs == 0 and not e.pinned
+            ]
+            if not victims:
+                # everything pinned or in flight: run soft-over-capacity
+                # (refusing admissions would turn a cache bound into an
+                # availability outage)
+                return
+            victim = min(victims, key=lambda e: e.last_used)
+            del self._entries[victim.tenant_id]
+            self.evictions += 1
+
+    # -- reporting ----------------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "capacity": self.capacity,
+                "resident": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "reloads": self.reloads,
+                "evictions": self.evictions,
+                "hit_rate": (self.hits / lookups) if lookups else 0.0,
+                "entries": {
+                    tid: {
+                        "version": e.version_key,
+                        "refs": e.refs,
+                        "pinned": e.pinned,
+                        "idle_s": round(
+                            time.monotonic() - e.last_used, 1
+                        ),
+                    }
+                    for tid, e in self._entries.items()
+                },
+            }

@@ -1,0 +1,648 @@
+"""Engine multiplexer: one query server, many tenant runtimes.
+
+`TenantMux` is the tenant-aware serving plane the query server attaches
+(`QueryServer.attach_tenancy`). Per request it:
+
+1. **admits** — resolves the tenant record (TTL-cached fold of the
+   shared tenant store) and enforces its quotas (qps / concurrency /
+   device-seconds → :class:`QuotaExceeded` → 429 + Retry-After at the
+   HTTP edge, distinct from deadline 503s),
+2. **routes** — acquires the tenant's runtime from the LRU model cache
+   (transparent reload on miss), or the tenant's canary candidate when
+   a per-tenant rollout is active (sticky fraction, same
+   `deploy.rollout` controller the single-tenant path uses, unchanged),
+3. **bookkeeps** — per-tenant serve histograms/counters under a
+   `tenant` label bounded by the cardinality guard, and feeds the
+   tenant's rollout verdict windows.
+
+A background sync thread refreshes tenant records, re-adopts persisted
+mid-canary rollouts after a restart, and prefetches newly-promoted
+versions into the cache (registry-driven swap, no serving-path miss).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+from predictionio_tpu.tenancy.cache import ModelCache, ModelLoadError
+from predictionio_tpu.tenancy.quota import QuotaEnforcer, QuotaExceeded
+from predictionio_tpu.tenancy.tenants import Tenant, TenantStore
+from predictionio_tpu.utils.env import env_float
+
+log = logging.getLogger(__name__)
+
+# bounded per-tenant metric labels: beyond this many distinct tenants,
+# the rest share one overflow label — the same discipline _route_label
+# applies to path labels (a scrape page must stay bounded no matter how
+# many tenants a fleet hosts)
+OVERFLOW_LABEL = "(other)"
+
+
+class UnknownTenant(KeyError):
+    """No such (enabled) tenant — a 404 at the HTTP edge."""
+
+
+class _TenantRolloutHost:
+    """The QueryServer-shaped host one tenant's RolloutController drives
+    (deploy/rollout.py is reused UNCHANGED): it exposes `storage`,
+    `rollout`, `candidate`, and the attach/complete seam — promote swaps
+    the baked candidate into the model cache instead of a server-global
+    runtime reference."""
+
+    def __init__(self, mux: "TenantMux", tenant_id: str):
+        self._mux = mux
+        self.tenant_id = tenant_id
+        self.storage = mux.storage
+        self.rollout = None
+        self.candidate = None
+        self._lock = threading.RLock()
+
+    def attach_rollout(self, controller, candidate) -> None:
+        from predictionio_tpu.workflow.server import RolloutConflict
+
+        with self._lock:
+            if self.rollout is not None and self.rollout.st.state in (
+                "starting", "canary"
+            ):
+                raise RolloutConflict(
+                    f"tenant {self.tenant_id}: rollout of "
+                    f"{self.rollout.st.version.id} is already active"
+                )
+            self.candidate = candidate
+            self.rollout = controller
+        # the baseline runtime must survive the whole bake — its verdict
+        # window is half the comparison
+        self._mux.cache.pin(self.tenant_id, on=True)
+
+    def complete_rollout(self, controller, promote: bool) -> None:
+        with self._lock:
+            if self.rollout is not controller:
+                return  # stale controller: a newer rollout replaced it
+            candidate = self.candidate
+            self.candidate = None
+        if promote and candidate is not None:
+            self._mux.cache.put_runtime(
+                self.tenant_id, candidate,
+                version_key=controller.st.version.id,
+            )
+        self._mux.cache.pin(self.tenant_id, on=False)
+
+
+class TenantMux:
+    """The multiplexer one QueryServer owns. Thread-safe; every public
+    method is driven from handler/dispatcher threads."""
+
+    def __init__(
+        self,
+        storage,
+        metrics=None,
+        cache_capacity: Optional[int] = None,
+        refresh_s: Optional[float] = None,
+        sync_s: Optional[float] = None,
+        label_max: Optional[int] = None,
+    ):
+        from predictionio_tpu.obs import get_default_registry
+
+        self.storage = storage
+        self.store = TenantStore(storage)
+        self.cache = ModelCache(
+            storage,
+            capacity=int(
+                cache_capacity
+                if cache_capacity is not None
+                else env_float("PIO_TENANT_CACHE_SIZE", 4)
+            ),
+        )
+        self.quota = QuotaEnforcer()
+        self.refresh_s = (
+            refresh_s if refresh_s is not None
+            else env_float("PIO_TENANT_REFRESH_S", 5.0)
+        )
+        self.sync_s = (
+            sync_s if sync_s is not None
+            else env_float("PIO_TENANT_SYNC_S", 10.0)
+        )
+        self._label_max = int(
+            label_max if label_max is not None
+            else env_float("PIO_TENANT_METRIC_MAX", 50)
+        )
+        self._labels: set[str] = set()
+        self._lock = threading.RLock()
+        self._tenants: dict[str, Tenant] = {}
+        self._refreshed_at = 0.0
+        self._hosts: dict[str, _TenantRolloutHost] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._resumed = False
+        # per-tenant consecutive re-adoption failures; capped so a dead
+        # baseline cannot keep the first-sync resume pass churning
+        self._resume_attempts: dict[str, int] = {}
+        # tenants observed deleted whose state still needs releasing;
+        # retried until clean (a mid-canary delete defers to the sync
+        # pass, which aborts the orphaned rollout off the hot path)
+        self._removed_pending: set[str] = set()
+        self._last_compact = 0.0
+
+        self.metrics = metrics or get_default_registry()
+        self._requests = self.metrics.counter(
+            "tenant_requests_total",
+            "queries served per tenant (label set bounded)",
+            ("tenant", "outcome"),
+        )
+        self._serve_hist = self.metrics.histogram(
+            "tenant_serve_seconds",
+            "end-to-end serve time per tenant",
+            ("tenant",),
+        )
+        self._quota_rejected = self.metrics.counter(
+            "tenant_quota_rejected_total",
+            "admissions refused per tenant and quota resource (429s)",
+            ("tenant", "resource"),
+        )
+        self._device_seconds = self.metrics.counter(
+            "tenant_device_seconds_total",
+            "device time charged per tenant",
+            ("tenant",),
+        )
+        for name, fn in (
+            ("tenant_cache_resident", lambda: self.cache.stats()["resident"]),
+            ("tenant_cache_hits_total", lambda: self.cache.hits),
+            ("tenant_cache_misses_total", lambda: self.cache.misses),
+            ("tenant_cache_reloads_total", lambda: self.cache.reloads),
+            ("tenant_cache_evictions_total", lambda: self.cache.evictions),
+        ):
+            self.metrics.gauge_callback(
+                name, "tenant model cache state", fn
+            )
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        """Begin the background sync loop (refresh + rollout re-adopt +
+        registry-driven prefetch)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._sync_loop, name="tenant-sync", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for host in list(self._hosts.values()):
+            if host.rollout is not None:
+                host.rollout.stop()
+        # freeze the cache gauges to their final values: the registry
+        # (usually the process-global default) holds callbacks closing
+        # over this instance, and left in place they would keep the
+        # dead mux — and every resident runtime in its cache — reachable
+        # for the rest of the process
+        try:
+            stats = self.cache.stats()
+            for name, val in (
+                ("tenant_cache_resident", float(stats["resident"])),
+                ("tenant_cache_hits_total", float(self.cache.hits)),
+                ("tenant_cache_misses_total", float(self.cache.misses)),
+                ("tenant_cache_reloads_total", float(self.cache.reloads)),
+                ("tenant_cache_evictions_total",
+                 float(self.cache.evictions)),
+            ):
+                self.metrics.gauge_callback(
+                    name, "tenant model cache state", lambda v=val: v
+                )
+        except Exception:
+            log.exception("cache gauge freeze on stop failed")
+
+    def _sync_loop(self) -> None:
+        # first pass runs immediately: a restarted server must re-adopt
+        # persisted tenant canaries before traffic decides their fate
+        while True:
+            try:
+                self.sync()
+            except Exception:
+                log.exception("tenant sync pass failed; retrying")
+            if self._stop.wait(self.sync_s):
+                return
+
+    def sync(self) -> None:
+        """One background pass: refresh records, resume persisted
+        rollouts once, prefetch promoted versions for resident tenants,
+        finish deferred deleted-tenant cleanup, and (throttled)
+        compact the tenant/rollout record folds."""
+        ok = self.refresh(force=True)
+        with self._lock:
+            tenants = list(self._tenants.values())
+        if not self._resumed and ok:
+            # latch only after a clean pass over a SUCCESSFUL refresh:
+            # a storage blip during the first sync would otherwise
+            # consume the one re-adoption attempt while iterating zero
+            # tenants, silently dropping every persisted mid-canary
+            # bake for the life of the process. Failed per-tenant
+            # resumes stay eligible too (same retry-until-clean
+            # discipline as _removed_pending) — but bounded: a
+            # PERMANENTLY unservable baseline (blob GC'd, instance
+            # purged) would otherwise re-fold records and re-attempt
+            # the failing build every sync_s forever
+            failed = False
+            for tenant in tenants:
+                if self._resume_attempts.get(tenant.id, 0) >= 3:
+                    continue
+                try:
+                    self._resume_rollout(tenant)
+                    self._resume_attempts.pop(tenant.id, None)
+                except Exception:
+                    n = self._resume_attempts.get(tenant.id, 0) + 1
+                    self._resume_attempts[tenant.id] = n
+                    if n >= 3:
+                        log.error(
+                            "tenant %s rollout re-adopt failed %d times; "
+                            "giving up until the next restart (the "
+                            "persisted record is kept — abort the "
+                            "rollout or delete the record to clear it)",
+                            tenant.id, n,
+                        )
+                    else:
+                        failed = True
+                    log.exception(
+                        "tenant %s rollout re-adopt failed", tenant.id
+                    )
+            self._resumed = not failed
+        self.cache.sync(tenants)
+        self._cleanup_removed(abort_active=True)
+        # record-fold retention (same discipline as the scheduler's
+        # sweep): quota edits and rollout transitions accumulate events
+        # that every refresh/resume re-folds. Throttled — compaction
+        # itself re-reads the folds it bounds.
+        if time.monotonic() - self._last_compact >= 600.0:
+            self._last_compact = time.monotonic()
+            try:
+                from predictionio_tpu.deploy.registry import (
+                    ROLLOUT_ENTITY,
+                    LifecycleRecordStore,
+                )
+
+                self.store.compact()
+                LifecycleRecordStore(self.storage).compact_all(
+                    ROLLOUT_ENTITY
+                )
+            except Exception:
+                log.exception("tenant record compaction failed")
+
+    def _resume_rollout(self, tenant: Tenant) -> None:
+        from predictionio_tpu.deploy.rollout import resume_rollout
+
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+        from predictionio_tpu.deploy.rollout import ROLLOUT_ENTITY
+
+        scope = f"tenant/{tenant.id}"
+        host = self._hosts.get(tenant.id)
+        if host is not None and host.rollout is not None:
+            return
+        # cheap pre-check before touching the cache: only a persisted
+        # mid-canary record justifies loading this tenant's model now
+        rec = (
+            LifecycleRecordStore(self.storage)
+            .fold(ROLLOUT_ENTITY, scope)
+            .get(scope)
+        )
+        if not rec or rec.get("state") != "canary":
+            return
+        host = self._host(tenant.id)
+        # warm AND pin the baseline FIRST, exactly like start_rollout:
+        # a re-adopted bake whose baseline can be evicted mid-window
+        # would bias the verdict (live p99 inflated by rebuilds)
+        self.cache.warm_and_pin(tenant)
+        try:
+            controller = resume_rollout(host, scope=scope)
+        except Exception:
+            self.cache.pin(tenant.id, on=False)
+            raise
+        if controller is None:
+            self.cache.pin(tenant.id, on=False)
+        if controller is not None:
+            log.info(
+                "tenant %s: re-adopted mid-canary rollout of %s",
+                tenant.id, controller.st.version.id,
+            )
+
+    # -- tenant records -----------------------------------------------------
+    def refresh(self, force: bool = False) -> bool:
+        """Returns True when the tenant snapshot is fresh (or within
+        TTL), False when this pass could not reach storage."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._refreshed_at < self.refresh_s:
+                return True
+            self._refreshed_at = now
+        try:
+            tenants = {t.id: t for t in self.store.list()}
+        except Exception:
+            # a storage blip must not fail serving: admit() calls this
+            # inline on the TTL boundary, and an escaping error here
+            # would drop the client's connection even though the
+            # tenant's model is resident and could answer. Serve from
+            # the cached snapshot; the next refresh retries.
+            log.warning(
+                "tenant refresh failed (storage down?); serving from "
+                "the cached tenant snapshot", exc_info=True,
+            )
+            return False
+        with self._lock:
+            self._removed_pending |= set(self._tenants) - set(tenants)
+            self._tenants = tenants
+        for t in tenants.values():
+            self.quota.configure(t)
+        self._cleanup_removed(abort_active=False)
+        return True
+
+    def _cleanup_removed(self, abort_active: bool) -> None:
+        """Release everything a deleted tenant held: quota buckets (a
+        same-id recreate must not inherit a dead tenant's device-seconds
+        debt), the resident runtime, and the rollout host. A tenant
+        deleted MID-CANARY can't make verdict progress (its traffic now
+        404s), so the sync pass (`abort_active=True`, off the serving
+        hot path — abort joins the verdict thread) aborts the orphaned
+        rollout; until then the id stays pending and cleanup retries."""
+        with self._lock:
+            pending = set(self._removed_pending)
+        for tid in pending:
+            with self._lock:
+                recreated = tid in self._tenants
+                host = self._hosts.get(tid)
+            rollout = host.rollout if host is not None else None
+            active = rollout is not None and rollout.st.state in (
+                "starting", "canary"
+            )
+            if active and not recreated:
+                if not abort_active:
+                    continue  # deferred to the sync pass
+                try:
+                    rollout.stop()
+                    if rollout.st.state == "canary":
+                        rollout.abort("tenant deleted")
+                except Exception:
+                    log.exception(
+                        "abort of deleted tenant %s rollout failed; "
+                        "will retry", tid,
+                    )
+                    continue
+            # recreated tenants get FRESH state too — the deleted
+            # incarnation's buckets/runtime must not leak across
+            self.quota.drop(tid)
+            if not (recreated and active):
+                # a recreate mid-canary keeps the resident baseline:
+                # the rollout's pin lives on that cache entry, and
+                # invalidating it would leave the rebuilt baseline
+                # evictable for the rest of the bake — the verdict
+                # bias warm_and_pin exists to prevent
+                self.cache.invalidate(tid)
+            if recreated:
+                with self._lock:
+                    t = self._tenants.get(tid)
+                if t is not None:
+                    self.quota.configure(t)  # no unlimited window
+            with self._lock:
+                if not (recreated and active):
+                    self._hosts.pop(tid, None)
+                self._removed_pending.discard(tid)
+
+    def tenant(self, tenant_id: str) -> Optional[Tenant]:
+        self.refresh()
+        with self._lock:
+            return self._tenants.get(tenant_id)
+
+    def tenant_weight(self, tenant_id: Optional[str]) -> float:
+        """Fair-scheduler weight lookup (the dispatcher's FairQueue
+        calls this per drain decision — cached dict read only)."""
+        if tenant_id is None:
+            return 1.0
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+        return t.weight if t is not None else 1.0
+
+    def label(self, tenant_id: str) -> str:
+        """Bounded metric label: the first `label_max` distinct tenants
+        get their own label value; the rest share the overflow label so
+        tenant churn cannot blow up /metrics cardinality."""
+        with self._lock:
+            if tenant_id in self._labels:
+                return tenant_id
+            if len(self._labels) < self._label_max:
+                self._labels.add(tenant_id)
+                return tenant_id
+        return OVERFLOW_LABEL
+
+    # -- admission (quotas) -------------------------------------------------
+    def admit(self, tenant_id: str) -> Tenant:
+        """Resolve + quota-admit one request. Raises UnknownTenant (404)
+        or QuotaExceeded (429). A successful admit holds one concurrency
+        slot until `done`."""
+        tenant = self.tenant(tenant_id)
+        if tenant is None or not tenant.enabled:
+            raise UnknownTenant(tenant_id)
+        try:
+            self.quota.admit(tenant_id)
+        except QuotaExceeded as e:
+            self._quota_rejected.inc(
+                tenant=self.label(tenant_id), resource=e.resource
+            )
+            raise
+        return tenant
+
+    def done(self, tenant_id: str, lease) -> None:
+        """The request's ``finally``: release the cache lease and the
+        concurrency slot."""
+        if lease is not None:
+            self.cache.release(lease)
+        self.quota.release(tenant_id)
+
+    # -- routing ------------------------------------------------------------
+    def route(self, tenant: Tenant, raw_request: bytes):
+        """→ (runtime, variant, cache_lease). Candidate traffic rides
+        the tenant's active rollout fraction, sticky by request hash —
+        the exact sticky_candidate the single-tenant path uses."""
+        from predictionio_tpu.deploy.rollout import sticky_candidate
+
+        host = self._hosts.get(tenant.id)
+        if host is not None:
+            rollout, candidate = host.rollout, host.candidate
+            if (
+                candidate is not None
+                and rollout is not None
+                and not rollout.config.shadow
+                and sticky_candidate(raw_request, rollout.config.fraction)
+            ):
+                return candidate, "candidate", None
+        entry = self.cache.acquire(tenant)
+        return entry.runtime, "live", entry
+
+    def is_candidate(self, runtime) -> bool:
+        """Fault-scope support: is this runtime some tenant's canary
+        candidate? (The dispatcher labels batches by variant.) Snapshot
+        under the lock — rollout starts grow the host dict while the
+        dispatcher iterates."""
+        with self._lock:
+            hosts = list(self._hosts.values())
+        for host in hosts:
+            if host.candidate is runtime:
+                return True
+        return False
+
+    # -- bookkeeping --------------------------------------------------------
+    def bookkeep(
+        self, tenant_id: str, variant: str, seconds: float, error: bool
+    ) -> None:
+        lbl = self.label(tenant_id)
+        self._serve_hist.observe(seconds, tenant=lbl)
+        self._requests.inc(
+            tenant=lbl, outcome="error" if error else "ok"
+        )
+        host = self._hosts.get(tenant_id)
+        if host is not None and host.rollout is not None:
+            host.rollout.record(variant, seconds, error)
+
+    def charge_device_seconds(self, tenant_id: str, seconds: float) -> None:
+        self.quota.charge_device(tenant_id, seconds)
+        self._device_seconds.inc(seconds, tenant=self.label(tenant_id))
+
+    # -- per-tenant rollouts ------------------------------------------------
+    def _host(self, tenant_id: str) -> _TenantRolloutHost:
+        with self._lock:
+            host = self._hosts.get(tenant_id)
+            if host is None:
+                host = self._hosts[tenant_id] = _TenantRolloutHost(
+                    self, tenant_id
+                )
+            return host
+
+    def start_rollout(self, tenant_id: str, body: dict) -> dict:
+        """Canary a registered version for ONE tenant; every other
+        tenant's traffic is untouched. Reuses RolloutController
+        unchanged against the tenant's host adapter."""
+        from predictionio_tpu.deploy.registry import ModelRegistry
+        from predictionio_tpu.deploy.rollout import (
+            RolloutConfig,
+            RolloutController,
+        )
+
+        tenant = self.tenant(tenant_id)
+        if tenant is None:
+            raise UnknownTenant(tenant_id)
+        registry = ModelRegistry(self.storage)
+        vid = body.get("version")
+        if vid:
+            version = registry.get(vid)
+            if version is None:
+                raise ValueError(f"no model version {vid!r}")
+        else:
+            trained = registry.list(
+                tenant.engine_id, tenant.engine_variant, status="trained"
+            )
+            if not trained:
+                raise ValueError(
+                    f"no trained model version for {tenant.engine_id}/"
+                    f"{tenant.engine_variant} — train first"
+                )
+            version = trained[0]
+        overrides = {
+            k: body[k]
+            for k in (
+                "fraction", "window_s", "interval_s", "min_requests",
+                "max_error_delta", "max_p99_ratio", "bake_s", "shadow",
+                "min_agreement",
+            )
+            if k in body
+        }
+        config = RolloutConfig.from_env(**overrides)
+        if config.shadow:
+            # nothing feeds a tenant rollout's agreement window (the
+            # mux has no mirror path yet — ROADMAP follow-up), so a
+            # shadow canary would never reach min_requests and wedge in
+            # 'canary' with the baseline pinned forever. Refuse loudly.
+            raise ValueError(
+                "tenant rollouts do not support shadow mode yet; "
+                "use a traffic fraction"
+            )
+        host = self._host(tenant_id)
+        controller = RolloutController(
+            host, version, config, scope=f"tenant/{tenant_id}"
+        )
+        # warm AND pin the live baseline BEFORE the (slow) candidate
+        # build — pinning later would leave the baseline evictable for
+        # seconds under capacity pressure; unpin if the start fails
+        # and no other rollout holds the pin
+        self.cache.warm_and_pin(tenant)
+        try:
+            controller.start()
+        except Exception:
+            active = host.rollout
+            if active is None or active.st.state not in (
+                "starting", "canary"
+            ):
+                self.cache.pin(tenant_id, on=False)
+            raise
+        return controller.status()
+
+    def rollout_status(self, tenant_id: str) -> dict:
+        host = self._hosts.get(tenant_id)
+        if host is None or host.rollout is None:
+            return {"state": "none", "tenant": tenant_id}
+        return dict(host.rollout.status(), tenant=tenant_id)
+
+    def abort_rollout(self, tenant_id: str, reason: str) -> dict:
+        from predictionio_tpu.workflow.server import RolloutConflict
+
+        host = self._hosts.get(tenant_id)
+        rollout = host.rollout if host is not None else None
+        if rollout is None or rollout.st.state != "canary":
+            raise RolloutConflict(
+                f"tenant {tenant_id}: no active rollout to abort"
+            )
+        rollout.stop()
+        if rollout.st.state != "canary":
+            raise RolloutConflict(
+                f"rollout already {rollout.st.state}; nothing to abort"
+            )
+        rollout.abort(reason)
+        return dict(rollout.status(), tenant=tenant_id)
+
+    # -- reporting ----------------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        self.refresh()
+        with self._lock:
+            tenants = dict(self._tenants)
+            hosts = dict(self._hosts)
+        quota = self.quota.snapshot()
+        return {
+            "tenants": {
+                tid: {
+                    **t.to_dict(),
+                    "quota": quota.get(tid),
+                    "rollout": (
+                        hosts[tid].rollout.st.state
+                        if tid in hosts and hosts[tid].rollout is not None
+                        else "none"
+                    ),
+                }
+                for tid, t in tenants.items()
+            },
+            "cache": self.cache.stats(),
+        }
+
+    def tenant_status(self, tenant_id: str) -> dict[str, Any]:
+        tenant = self.tenant(tenant_id)
+        if tenant is None:
+            raise UnknownTenant(tenant_id)
+        cache = self.cache.stats()
+        return {
+            **tenant.to_dict(),
+            "quota": self.quota.snapshot(tenant_id).get(tenant_id),
+            "resident": tenant_id in cache["entries"],
+            "rollout": self.rollout_status(tenant_id),
+        }

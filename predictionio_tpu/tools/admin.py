@@ -21,6 +21,14 @@ admin server over the shared stores sees the same queue/registry:
   GET    /rollout              → registry view of canary/live versions
   POST   /rollout              → proxy start/abort/status to a query
                                  server: {"url", "action", ...}
+
+Multi-tenant control plane (ISSUE 6) — tenant records are storage-backed
+too, so every query server's multiplexer sees edits within its refresh:
+  GET    /tenants              → list tenants
+  POST   /tenants              → create/update {"id", "engine_id", ...}
+  GET    /tenants/{id}         → one tenant record
+  POST   /tenants/{id}/quota   → set weight/qps/concurrency/device quota
+  DELETE /tenants/{id}         → delete tenant
 """
 
 from __future__ import annotations
@@ -34,6 +42,7 @@ from predictionio_tpu.data.storage.base import App
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.deploy.registry import ModelRegistry
 from predictionio_tpu.deploy.scheduler import JobQueue
+from predictionio_tpu.tenancy.tenants import QUOTA_FIELDS, Tenant, TenantStore
 from predictionio_tpu.obs import server_registry
 from predictionio_tpu.tools import common
 from predictionio_tpu.tools.common import CommandError
@@ -76,6 +85,8 @@ class _Handler(JsonHandler):
                 self._get_jobs(parts)
             elif parts[:1] == ["models"]:
                 self._get_models(parts)
+            elif parts[:1] == ["tenants"]:
+                self._get_tenants(parts)
             elif path == "/rollout":
                 self._get_rollout()
             elif path == "/cmd/app":
@@ -118,6 +129,12 @@ class _Handler(JsonHandler):
                 )
             elif path == "/jobs":
                 self._post_job()
+            elif path == "/tenants":
+                self._post_tenant()
+            elif path.startswith("/tenants/"):
+                self._post_tenant_quota(
+                    [p for p in path.split("/") if p]
+                )
             elif path.startswith("/models/"):
                 self._post_model(
                     [p for p in path.split("/") if p]
@@ -146,6 +163,8 @@ class _Handler(JsonHandler):
                     self._delete_data(parts[2])
                 else:
                     raise HttpError(404, "Not Found")
+            elif len(parts) == 2 and parts[0] == "tenants":
+                self._delete_tenant(parts[1])
             else:
                 raise HttpError(404, "Not Found")
         except HttpError as e:
@@ -230,6 +249,57 @@ class _Handler(JsonHandler):
         except KeyError as e:
             raise HttpError(404, str(e.args[0] if e.args else e))
         self._respond(200, version.to_dict())
+
+    # -- multi-tenant control plane (ISSUE 6) ------------------------------
+    def _get_tenants(self, parts: list[str]) -> None:
+        store = self.server.tenant_store
+        if len(parts) == 1:
+            self._respond(200, [t.to_dict() for t in store.list()])
+            return
+        if len(parts) != 2:
+            raise HttpError(404, "Not Found")
+        tenant = store.get(parts[1])
+        if tenant is None:
+            raise HttpError(404, f"no tenant {parts[1]!r}")
+        self._respond(200, tenant.to_dict())
+
+    def _post_tenant(self) -> None:
+        obj = self._json_body()
+        if not isinstance(obj, dict):
+            raise HttpError(400, "tenant body must be a JSON object")
+        try:
+            tenant = Tenant.from_dict(obj)
+        except (TypeError, ValueError) as e:
+            raise HttpError(400, str(e))
+        existed = self.server.tenant_store.get(tenant.id) is not None
+        self.server.tenant_store.upsert(tenant)
+        self._respond(200 if existed else 201, tenant.to_dict())
+
+    def _post_tenant_quota(self, parts: list[str]) -> None:
+        if len(parts) != 3 or parts[2] != "quota":
+            raise HttpError(404, "Not Found")
+        obj = self._json_body()
+        if not isinstance(obj, dict):
+            raise HttpError(400, "quota body must be a JSON object")
+        fields = {k: obj[k] for k in QUOTA_FIELDS if k in obj}
+        if not fields:
+            raise HttpError(
+                400,
+                f"quota body needs at least one of {', '.join(QUOTA_FIELDS)}",
+            )
+        try:
+            tenant = self.server.tenant_store.set_quota(parts[1], **fields)
+        except KeyError:
+            raise HttpError(404, f"no tenant {parts[1]!r}")
+        except (TypeError, ValueError) as e:
+            raise HttpError(400, str(e))
+        self._respond(200, tenant.to_dict())
+
+    def _delete_tenant(self, tenant_id: str) -> None:
+        removed = self.server.tenant_store.delete(tenant_id)
+        if not removed:
+            raise HttpError(404, f"no tenant {tenant_id!r}")
+        self._respond(200, {"message": f"tenant {tenant_id!r} deleted"})
 
     def _get_rollout(self) -> None:
         """Registry-side rollout view: what is live and what is baking,
@@ -329,10 +399,11 @@ class _Server(ThreadedServer):
     def __init__(self, addr, storage: Storage):
         super().__init__(addr, _Handler)
         self.storage = storage
-        # one registry/queue per server, not per request: their
+        # one registry/queue/store per server, not per request: their
         # init_app memoization (a storage round trip) lives on them
         self.model_registry = ModelRegistry(storage)
         self.job_queue = JobQueue(storage)
+        self.tenant_store = TenantStore(storage)
         self.metrics = server_registry()
         self.metrics_label = "admin"
 

@@ -868,6 +868,89 @@ def cmd_models(args) -> int:
     return 0
 
 
+def cmd_tenants(args) -> int:
+    """`pio tenants list|show|new|set-quota|delete` — the multi-tenant
+    serving control plane. Storage-backed: every query server's
+    multiplexer picks edits up within its refresh interval."""
+    import json as _json
+
+    from predictionio_tpu.tenancy.tenants import Tenant, TenantStore
+
+    store = TenantStore(_storage())
+    action = args.tenants_action
+    if action == "list":
+        tenants = store.list()
+        if not tenants:
+            print("[INFO] no tenants")
+            return 0
+        print(f"[INFO] {len(tenants)} tenant(s):")
+        for t in tenants:
+            quota = ", ".join(
+                f"{k}={v}"
+                for k, v in (
+                    ("qps", t.qps),
+                    ("conc", t.max_concurrency),
+                    ("dev_s/s", t.device_seconds_per_s),
+                )
+                if v is not None
+            ) or "unlimited"
+            print(f"[INFO]   {t.id} engine={t.engine_id}/"
+                  f"{t.engine_variant} weight={t.weight} quota=[{quota}]"
+                  + ("" if t.enabled else " DISABLED"))
+        return 0
+    if action == "new":
+        try:
+            tenant = store.upsert(Tenant(
+                id=args.tenant_id,
+                engine_id=args.engine,
+                engine_version=args.engine_version,
+                engine_variant=args.variant or args.engine,
+                weight=args.weight,
+                qps=args.qps,
+                max_concurrency=args.max_concurrency,
+                device_seconds_per_s=args.device_seconds,
+                description=args.description or "",
+            ))
+        except ValueError as e:
+            return _fail(str(e))
+        print(f"[INFO] tenant {tenant.id} -> "
+              f"{tenant.engine_id}/{tenant.engine_variant}")
+        return 0
+    if action == "delete":
+        if not store.delete(args.tenant_id):
+            return _fail(f"no tenant {args.tenant_id!r}")
+        print(f"[INFO] tenant {args.tenant_id} deleted")
+        return 0
+    tenant = store.get(args.tenant_id)
+    if tenant is None:
+        return _fail(f"no tenant {args.tenant_id!r}")
+    if action == "show":
+        print(_json.dumps(tenant.to_dict(), indent=2))
+        return 0
+    # set-quota
+    fields = {
+        k: v
+        for k, v in (
+            ("weight", args.weight),
+            ("qps", args.qps),
+            ("max_concurrency", args.max_concurrency),
+            ("device_seconds_per_s", args.device_seconds),
+        )
+        if v is not None
+    }
+    if not fields:
+        return _fail("set-quota needs at least one of --weight/--qps/"
+                     "--max-concurrency/--device-seconds")
+    try:
+        tenant = store.set_quota(args.tenant_id, **fields)
+    except (KeyError, ValueError) as e:
+        return _fail(str(e))
+    print(f"[INFO] tenant {tenant.id} quota updated: weight={tenant.weight}"
+          f" qps={tenant.qps} conc={tenant.max_concurrency}"
+          f" dev_s/s={tenant.device_seconds_per_s}")
+    return 0
+
+
 def cmd_rollout(args) -> int:
     """`pio rollout start|status|abort` — drive a canary on a running
     query server (--url)."""
@@ -1367,6 +1450,43 @@ def build_parser() -> argparse.ArgumentParser:
     mg.add_argument("--delete-blobs", action="store_true",
                     help="also delete unreferenced MODELDATA blobs")
     mg.set_defaults(func=cmd_models)
+
+    s = sub.add_parser(
+        "tenants", help="multi-tenant serving control plane"
+    )
+    tnsub = s.add_subparsers(dest="tenants_action", required=True)
+    tn = tnsub.add_parser("list", help="list tenants")
+    tn.set_defaults(func=cmd_tenants)
+    tn = tnsub.add_parser("show", help="one tenant's full record")
+    tn.add_argument("tenant_id")
+    tn.set_defaults(func=cmd_tenants)
+    tn = tnsub.add_parser("new", help="create or update a tenant")
+    tn.add_argument("tenant_id")
+    tn.add_argument("--engine", required=True, help="engine id to serve")
+    tn.add_argument("--engine-version", dest="engine_version", default="0")
+    tn.add_argument("--variant", default=None,
+                    help="engine variant (default: the engine id)")
+    tn.add_argument("--weight", type=float, default=1.0,
+                    help="fair-share weight in the batch scheduler")
+    tn.add_argument("--qps", type=float, default=None)
+    tn.add_argument("--max-concurrency", dest="max_concurrency", type=int,
+                    default=None)
+    tn.add_argument("--device-seconds", dest="device_seconds", type=float,
+                    default=None, help="device-seconds budget per second")
+    tn.add_argument("--description", default=None)
+    tn.set_defaults(func=cmd_tenants)
+    tn = tnsub.add_parser("set-quota", help="update fair share / quotas")
+    tn.add_argument("tenant_id")
+    tn.add_argument("--weight", type=float, default=None)
+    tn.add_argument("--qps", type=float, default=None)
+    tn.add_argument("--max-concurrency", dest="max_concurrency", type=int,
+                    default=None)
+    tn.add_argument("--device-seconds", dest="device_seconds", type=float,
+                    default=None)
+    tn.set_defaults(func=cmd_tenants)
+    tn = tnsub.add_parser("delete", help="delete a tenant record")
+    tn.add_argument("tenant_id")
+    tn.set_defaults(func=cmd_tenants)
 
     s = sub.add_parser(
         "rollout", help="canary rollout on a running query server"

@@ -93,6 +93,7 @@ class _Handler(JsonHandler):
 {rows}
 </table>
 {self._lifecycle_html()}
+{self._tenants_html()}
 </body></html>"""
 
     def _lifecycle_html(self) -> str:
@@ -126,6 +127,44 @@ class _Handler(JsonHandler):
         return f"""<h1>Model lifecycle</h1>
 <table border="1" cellpadding="4">
 <tr><th>Version</th><th>Engine</th><th>Status</th><th>Created</th><th>Params hash</th><th>Note</th></tr>
+{rows}
+</table>"""
+
+
+    def _tenants_html(self) -> str:
+        """Multi-tenant panel (ISSUE 6): who shares the serving fleet,
+        with weights and quotas. Descriptions are operator-authored, so
+        everything is escaped."""
+        from predictionio_tpu.tenancy.tenants import TenantStore
+
+        try:
+            store = getattr(self.server, "tenant_store", None)
+            if store is None:
+                store = TenantStore(self.server.storage)
+                self.server.tenant_store = store
+            tenants = store.list()
+        except Exception:
+            return "<h1>Tenants</h1><p>(tenant store unavailable)</p>"
+        if not tenants:
+            return "<h1>Tenants</h1><p>(no tenants registered)</p>"
+
+        def fmt(v):
+            return "∞" if v is None else html.escape(str(v))
+
+        rows = "".join(
+            f"<tr><td>{html.escape(t.id)}</td>"
+            f"<td>{html.escape(t.engine_id)}/{html.escape(t.engine_variant)}</td>"
+            f"<td>{t.weight:g}</td>"
+            f"<td>{fmt(t.qps)}</td><td>{fmt(t.max_concurrency)}</td>"
+            f"<td>{fmt(t.device_seconds_per_s)}</td>"
+            f"<td>{'yes' if t.enabled else 'no'}</td>"
+            f"<td>{html.escape(t.description)}</td></tr>"
+            for t in tenants
+        )
+        return f"""<h1>Tenants</h1>
+<table border="1" cellpadding="4">
+<tr><th>Tenant</th><th>Engine</th><th>Weight</th><th>QPS</th>
+<th>Concurrency</th><th>Device s/s</th><th>Enabled</th><th>Note</th></tr>
 {rows}
 </table>"""
 

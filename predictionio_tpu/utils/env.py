@@ -1,0 +1,19 @@
+"""Environment-variable parsing helpers shared across subsystems."""
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob: missing/empty → default; malformed → default
+    with a warning (a typo'd knob must not silently change behavior)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", name, raw)
+        return float(default)

@@ -115,8 +115,10 @@ class JsonHandler(BaseHTTPRequestHandler):
         stays bounded (/events/<id>.json → /events/{id}.json; admin's
         /cmd/app/<name>[/data] → /cmd/app/{name}[/data])."""
         parts = path.split("/")
-        if len(parts) >= 3 and parts[1] in ("jobs", "models"):
-            # lifecycle control plane: job/version ids are unbounded
+        if len(parts) >= 3 and parts[1] in ("jobs", "models", "tenants"):
+            # lifecycle + tenancy control planes: job/version/tenant ids
+            # are unbounded (and /tenants/{id}/queries.json is the
+            # serving hot path — one tenant, one label child)
             parts[2] = "{id}"
         elif len(parts) >= 3 and parts[1] in ("events", "engine_instances"):
             for suffix in (".json", ".html"):

@@ -180,6 +180,8 @@ class _Handler(JsonHandler):
                 self._respond(200, self.server.owner.status_html(), "text/html")
             elif path == "/rollout/status":
                 self._respond(200, self.server.owner.rollout_status())
+            elif path == "/tenants" or path.startswith("/tenants/"):
+                self._tenants_get(path)
             elif path == "/metrics":
                 self._serve_metrics()
             elif path == "/debug/traces":
@@ -210,7 +212,14 @@ class _Handler(JsonHandler):
         self._drain_body()
         path = self.path.split("?")[0].rstrip("/")
         if path == "/queries.json":
-            self._queries()
+            # tenant-tagged queries also ride the plain route via the
+            # X-PIO-Tenant header (the path form is canonical); an
+            # EMPTY header value means untenanted, not tenant ""
+            self._queries(
+                tenant_id=self.headers.get("X-PIO-Tenant") or None
+            )
+        elif path.startswith("/tenants/"):
+            self._tenants_post(path)
         elif path == "/reload":
             try:
                 self.server.owner.reload()
@@ -260,7 +269,73 @@ class _Handler(JsonHandler):
         else:
             self._respond(404, {"message": "Not Found"})
 
-    def _queries(self):
+    # -- multi-tenant control surface (ISSUE 6) ----------------------------
+    def _tenants_get(self, path: str) -> None:
+        from predictionio_tpu.tenancy import UnknownTenant
+
+        mux = self.server.owner.tenancy
+        if mux is None:
+            self._respond(
+                404, {"message": "multi-tenant serving is not enabled"}
+            )
+            return
+        parts = [p for p in path.split("/") if p]
+        try:
+            if len(parts) == 1:
+                self._respond(200, mux.status())
+            elif len(parts) == 2:
+                self._respond(200, mux.tenant_status(parts[1]))
+            elif len(parts) in (3, 4) and parts[2] == "rollout" and (
+                len(parts) == 3 or parts[3] == "status"
+            ):
+                self._respond(200, mux.rollout_status(parts[1]))
+            else:
+                self._respond(404, {"message": "Not Found"})
+        except UnknownTenant:
+            self._respond(404, {"message": f"no tenant {parts[1]!r}"})
+
+    def _tenants_post(self, path: str) -> None:
+        from predictionio_tpu.tenancy import UnknownTenant
+
+        owner = self.server.owner
+        mux = owner.tenancy
+        parts = [p for p in path.split("/") if p]
+        if mux is None:
+            self._respond(
+                404, {"message": "multi-tenant serving is not enabled"}
+            )
+            return
+        if len(parts) == 3 and parts[2] == "queries.json":
+            self._queries(tenant_id=parts[1])
+            return
+        if len(parts) == 4 and parts[2] == "rollout" and parts[3] in (
+            "start", "abort"
+        ):
+            try:
+                body = self._json_body()
+                if not isinstance(body, dict):
+                    body = {}
+                if parts[3] == "start":
+                    self._respond(200, mux.start_rollout(parts[1], body))
+                else:
+                    self._respond(200, mux.abort_rollout(
+                        parts[1], body.get("reason") or "operator abort"
+                    ))
+            except _HttpError as e:
+                self._respond(e.status, {"message": e.message})
+            except UnknownTenant:
+                self._respond(404, {"message": f"no tenant {parts[1]!r}"})
+            except RolloutConflict as e:
+                self._respond(409, {"message": str(e)})
+            except ValueError as e:
+                self._respond(400, {"message": str(e)})
+            except Exception as e:
+                log.exception("tenant rollout request failed")
+                self._respond(500, {"message": str(e)})
+            return
+        self._respond(404, {"message": "Not Found"})
+
+    def _queries(self, tenant_id: Optional[str] = None):
         """The serving hot path (reference CreateServer.scala:490-613)."""
         owner = self.server.owner
         t0 = time.perf_counter()
@@ -276,8 +351,53 @@ class _Handler(JsonHandler):
                 headers={"Retry-After": "1"},
             )
             return
-        variant: Optional[str] = None  # set once pick_runtime routes
+        # tenant admission (ISSUE 6): resolve the tenant and enforce its
+        # quotas BEFORE parse/batch/device time — an over-quota request
+        # is the tenant's doing and gets 429 + Retry-After, deliberately
+        # distinct from the deadline/overload 503 above
+        mux = owner.tenancy
+        tenant = None
+        lease = None
+        if tenant_id is not None:
+            from predictionio_tpu.tenancy import (
+                QuotaExceeded,
+                UnknownTenant,
+            )
+
+            if mux is None:
+                self._respond(
+                    404,
+                    {"message": "multi-tenant serving is not enabled"},
+                )
+                return
+            try:
+                tenant = mux.admit(tenant_id)
+            except UnknownTenant:
+                self._respond(
+                    404, {"message": f"no tenant {tenant_id!r}"}
+                )
+                return
+            except QuotaExceeded as e:
+                owner.count_shed("quota")
+                self._respond(
+                    429,
+                    {"message": str(e)},
+                    headers={
+                        "Retry-After": str(
+                            max(1, int(e.retry_after_s + 0.999))
+                        )
+                    },
+                )
+                return
+        variant: Optional[str] = None  # set once routing lands
         variant_booked = False
+
+        def _book(seconds: float, error: bool) -> None:
+            if tenant is not None:
+                mux.bookkeep(tenant_id, variant, seconds, error)
+            else:
+                owner.bookkeep_variant(variant, seconds, error)
+
         try:
             raw = self._raw_body.decode()
             try:
@@ -287,8 +407,19 @@ class _Handler(JsonHandler):
             # canary routing (ISSUE 5): sticky hash-of-request fraction
             # goes to the candidate runtime; snapshot semantics match
             # /reload — the query is extracted and served against ONE
-            # runtime even if a swap lands mid-flight
-            rt, variant = owner.pick_runtime(self._raw_body)
+            # runtime even if a swap lands mid-flight. Tenant queries
+            # (ISSUE 6) route through the model cache instead — a miss
+            # is a transparent model load, and the returned lease keeps
+            # the runtime un-evictable until bookkeeping finishes.
+            if tenant is not None:
+                from predictionio_tpu.tenancy import ModelLoadError
+
+                try:
+                    rt, variant, lease = mux.route(tenant, self._raw_body)
+                except ModelLoadError as e:
+                    raise _HttpError(503, str(e))
+            else:
+                rt, variant = owner.pick_runtime(self._raw_body)
             custom_from = getattr(
                 rt.query_serializer, "query_from_json", None
             )
@@ -310,7 +441,8 @@ class _Handler(JsonHandler):
             try:
                 if owner.dispatcher is not None:
                     prediction = owner.dispatcher.submit(
-                        supplemented, rt, deadline=_deadline.current()
+                        supplemented, rt, deadline=_deadline.current(),
+                        tenant=tenant_id if tenant is not None else None,
                     )
                 else:
                     tp = time.perf_counter()
@@ -318,7 +450,13 @@ class _Handler(JsonHandler):
                         algo.predict(model, supplemented)
                         for algo, model in zip(rt.algorithms, rt.models)
                     ]
-                    owner.bookkeep_predict(time.perf_counter() - tp, 1)
+                    dt_predict = time.perf_counter() - tp
+                    owner.bookkeep_predict(dt_predict, 1)
+                    if tenant is not None:
+                        # no dispatcher → no batch-level charge site:
+                        # debit the measured inline predict time here so
+                        # the device-seconds quota enforces either way
+                        owner.charge_device_seconds(tenant_id, dt_predict)
                     prediction = rt.serving.serve(supplemented, predictions)
             except ValueError as e:
                 # algorithms raise ValueError for query-level contract
@@ -338,12 +476,16 @@ class _Handler(JsonHandler):
                 result = plugin.process(query_json, result, {})
 
             owner.bookkeep(time.perf_counter() - t0)
-            owner.bookkeep_variant(
-                variant, time.perf_counter() - t0, error=False
-            )
+            _book(time.perf_counter() - t0, error=False)
             variant_booked = True
-            owner.maybe_shadow(self._raw_body, query_json, shadow_reference)
-            owner.feedback_async(query_json, result)
+            if tenant is None:
+                # server-level shadow mirroring and the feedback loop
+                # are single-tenant surfaces; tenant traffic must not
+                # leak into the server rollout's agreement windows
+                owner.maybe_shadow(
+                    self._raw_body, query_json, shadow_reference
+                )
+                owner.feedback_async(query_json, result)
             for plugin in owner.output_sniffers:
                 try:
                     plugin.process(query_json, result, {})
@@ -361,9 +503,7 @@ class _Handler(JsonHandler):
             # BOTH windows — they never reached either variant, and
             # booking them to one side would skew the delta.
             if variant is not None:
-                owner.bookkeep_variant(
-                    variant, time.perf_counter() - t0, error=True
-                )
+                _book(time.perf_counter() - t0, error=True)
             self._respond(e.status, {"message": e.message})
         except DeadlineExceeded as e:
             # expired in the queue or dispatch outran its budget: the
@@ -373,9 +513,7 @@ class _Handler(JsonHandler):
             # variants proportionally (delta ≈ 0), but a pathologically
             # slow candidate shedding only ITS fraction must be judged.
             if variant is not None:
-                owner.bookkeep_variant(
-                    variant, time.perf_counter() - t0, error=True
-                )
+                _book(time.perf_counter() - t0, error=True)
             self._respond(
                 503, {"message": str(e)}, headers={"Retry-After": "1"}
             )
@@ -386,10 +524,13 @@ class _Handler(JsonHandler):
                 # writing the 200) must not record the same request a
                 # second time as an error — the canary verdict would
                 # see inflated candidate error rates on client hangups
-                owner.bookkeep_variant(
-                    variant, time.perf_counter() - t0, error=True
-                )
+                _book(time.perf_counter() - t0, error=True)
             self._respond(500, {"message": str(e)})
+        finally:
+            if tenant is not None:
+                # release the cache lease (the runtime becomes evictable
+                # again) and the tenant's concurrency slot
+                mux.done(tenant_id, lease)
 
 
 class _Pending:
@@ -398,14 +539,18 @@ class _Pending:
     set by the submitting handler when its client stopped waiting, so
     the drain loop skips the entry instead of burning a device dispatch
     on an answer nobody will read (ISSUE 4 satellite: the old tuple
-    entries had no way to be withdrawn)."""
+    entries had no way to be withdrawn). `tenant` (ISSUE 6) tags the
+    entry for the fair scheduler's per-tenant sub-queue and for the
+    dispatcher's device-seconds accounting."""
 
     __slots__ = (
         "query", "runtime", "fut", "t_submit", "tctx", "deadline",
-        "cancelled",
+        "cancelled", "tenant",
     )
 
-    def __init__(self, query, runtime, fut, t_submit, tctx, deadline):
+    def __init__(
+        self, query, runtime, fut, t_submit, tctx, deadline, tenant=None
+    ):
         self.query = query
         self.runtime = runtime
         self.fut = fut
@@ -413,6 +558,7 @@ class _Pending:
         self.tctx = tctx
         self.deadline = deadline
         self.cancelled = False
+        self.tenant = tenant
 
 
 class _BatchDispatcher:
@@ -439,8 +585,9 @@ class _BatchDispatcher:
         max_window_ms: Optional[float] = None,
         pipeline_depth: int = 4,
     ):
-        import queue
         from concurrent.futures import ThreadPoolExecutor
+
+        from predictionio_tpu.tenancy.fair import FairQueue
 
         self.owner = owner
         self.min_window_s = window_ms / 1000.0
@@ -456,7 +603,13 @@ class _BatchDispatcher:
         self._inflight = threading.BoundedSemaphore(self.pipeline_depth)
         self._active_lock = threading.Lock()
         self._active = 0
-        self._queue: "queue.Queue" = queue.Queue()
+        # weighted-fair queueing (ISSUE 6): per-tenant sub-queues drained
+        # by deficit round robin replace the single FIFO, so one hog
+        # tenant's backlog cannot starve the batch assembler. With no
+        # tenants (every entry untenanted) this degenerates to FIFO.
+        self._queue = FairQueue(
+            weight_of=getattr(owner, "tenant_weight", None)
+        )
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="query-batcher", daemon=True
@@ -465,7 +618,7 @@ class _BatchDispatcher:
 
     def submit(
         self, query: Any, runtime: "EngineRuntime", timeout: float = 30.0,
-        deadline: Optional[float] = None,
+        deadline: Optional[float] = None, tenant: Optional[str] = None,
     ) -> Any:
         """Submit with the runtime snapshot the handler extracted the query
         against — a /reload mid-window must not serve an old-typed query
@@ -483,7 +636,9 @@ class _BatchDispatcher:
 
         fut: Future = Future()
         tctx = (_tracing.current_trace_id(), _spans.current_span_id())
-        p = _Pending(query, runtime, fut, time.perf_counter(), tctx, deadline)
+        p = _Pending(
+            query, runtime, fut, time.perf_counter(), tctx, deadline, tenant
+        )
         self._queue.put(p)
         wait = timeout
         if deadline is not None:
@@ -586,6 +741,10 @@ class _BatchDispatcher:
         # owner doubles
         variant_of = getattr(self.owner, "variant_of", None)
         variant = variant_of(rt) if variant_of is not None else "live"
+        # groups are keyed by runtime snapshot and each tenant serves its
+        # own runtime, so a group is (at most) one tenant's batch — its
+        # id scopes the fault point and the device-seconds charge below
+        group_tenant = group[0].tenant if group else None
         try:
             try:
                 # fault point (ISSUE 4): "error" fails the batch into the
@@ -595,6 +754,15 @@ class _BatchDispatcher:
                 # rollout variant: `dispatch.device@candidate:...` flips
                 # only canary batches bad while live batches sail through
                 _faults.fire("dispatch.device", scope=variant)
+                if group_tenant:
+                    # per-tenant fault scope (ISSUE 6): chaos tests flip
+                    # ONE tenant's batches bad
+                    # (`dispatch.device@tenant/acme:...`) while every
+                    # other tenant keeps serving
+                    _faults.fire(
+                        "dispatch.device",
+                        scope=f"tenant/{group_tenant}", scoped_only=True,
+                    )
                 per_algo = [
                     dict(algo.batch_predict(
                         algo.serving_context, model, queries
@@ -614,6 +782,21 @@ class _BatchDispatcher:
                         "device time per coalesced batch (dispatch to fetch)",
                     ).observe(self.last_batch_sec)
                 self.owner.bookkeep_predict(self.last_batch_sec, len(group))
+                # per-tenant device-seconds accounting (ISSUE 6): each
+                # tenant in the batch is charged its per-query share of
+                # the measured device time — the post-paid debit the
+                # device-seconds quota enforces at the next admission
+                charge = getattr(
+                    self.owner, "charge_device_seconds", None
+                )
+                if charge is not None and group_tenant is not None:
+                    per_query = self.last_batch_sec / len(group)
+                    counts: dict[str, int] = {}
+                    for p in group:
+                        if p.tenant:
+                            counts[p.tenant] = counts.get(p.tenant, 0) + 1
+                    for tid, n in counts.items():
+                        charge(tid, per_query * n)
                 for i, p in enumerate(group):
                     t_s = time.perf_counter()
                     try:
@@ -640,9 +823,13 @@ class _BatchDispatcher:
                     _child(i, "batch.device_dispatch", now_wall,
                            time.perf_counter() - t0, span_id=dev_ids[i],
                            error=True)
+                charge = getattr(
+                    self.owner, "charge_device_seconds", None
+                )
                 for p in group:
                     if p.cancelled:  # client gone mid-batch: skip retry
                         continue
+                    t_q = time.perf_counter()
                     try:
                         # scoped_only: a scope-less dispatch.device spec
                         # keeps the PR-4 semantic (batch fails, per-query
@@ -654,6 +841,12 @@ class _BatchDispatcher:
                             "dispatch.device", scope=variant,
                             scoped_only=True,
                         )
+                        if p.tenant:
+                            _faults.fire(
+                                "dispatch.device",
+                                scope=f"tenant/{p.tenant}",
+                                scoped_only=True,
+                            )
                         predictions = [
                             algo.predict(model, p.query)
                             for algo, model in zip(rt.algorithms, rt.models)
@@ -664,6 +857,16 @@ class _BatchDispatcher:
                     except Exception as e:
                         if not p.fut.done():
                             p.fut.set_exception(e)
+                    finally:
+                        # fallback predicts are real device work: debit
+                        # the post-paid device-seconds bucket here too,
+                        # or a tenant whose queries poison every batch
+                        # (forcing this path) would bypass the exact
+                        # quota meant to contain it
+                        if charge is not None and p.tenant:
+                            charge(
+                                p.tenant, time.perf_counter() - t_q
+                            )
         finally:
             if tok_s is not None:
                 _spans.reset_current_span(tok_s)
@@ -900,6 +1103,7 @@ class QueryServer(ServerProcess):
         self._swap_lock = threading.RLock()
         self.candidate: Optional[EngineRuntime] = None
         self.rollout = None  # Optional[RolloutController]
+        self.tenancy = None  # Optional[TenantMux] (ISSUE 6)
         self.last_serving_sec = 0.0
         self.last_predict_sec = 0.0
         self.dispatcher: Optional[_BatchDispatcher] = None
@@ -912,7 +1116,23 @@ class QueryServer(ServerProcess):
                 self.config.pipeline_depth,
             )
 
+    def start(self) -> int:
+        port = super().start()
+        # rollout re-adoption (ISSUE 6 satellite, PR-5 follow-up): a
+        # restart mid-canary re-adopts the persisted bake instead of
+        # silently dropping it (tenant rollouts re-adopt in the mux's
+        # sync pass; this covers the server's own engine variant)
+        try:
+            from predictionio_tpu.deploy.rollout import resume_rollout
+
+            resume_rollout(self)
+        except Exception:
+            log.exception("rollout re-adoption failed; serving continues")
+        return port
+
     def stop(self) -> None:
+        if self.tenancy is not None:
+            self.tenancy.stop()
         if self.rollout is not None:
             self.rollout.stop()
         if self.dispatcher is not None:
@@ -977,7 +1197,38 @@ class QueryServer(ServerProcess):
         return self.runtime, "live"
 
     def variant_of(self, rt: EngineRuntime) -> str:
-        return "candidate" if rt is self.candidate else "live"
+        if rt is self.candidate:
+            return "candidate"
+        mux = self.tenancy
+        if mux is not None and mux.is_candidate(rt):
+            return "candidate"
+        return "live"
+
+    # -- multi-tenant serving (ISSUE 6) ------------------------------------
+    def attach_tenancy(self, mux) -> None:
+        """Attach a TenantMux: /tenants/* routes go live, tenant-tagged
+        queries flow through the weighted-fair scheduler and the model
+        cache, and the mux's background sync (tenant refresh, rollout
+        re-adoption, registry-driven prefetch) starts."""
+        if self.dispatcher is None:
+            log.warning(
+                "tenancy attached with micro-batching disabled: "
+                "weighted-fair scheduling is unavailable (quotas and "
+                "the model cache still enforce)"
+            )
+        self.tenancy = mux
+        mux.start()
+
+    def tenant_weight(self, tenant_id: Optional[str]) -> float:
+        """Fair-queue weight lookup the dispatcher calls per drain."""
+        mux = self.tenancy
+        return 1.0 if mux is None else mux.tenant_weight(tenant_id)
+
+    def charge_device_seconds(self, tenant_id: str, seconds: float) -> None:
+        """Dispatcher hook: post-paid device-time debit per tenant."""
+        mux = self.tenancy
+        if mux is not None:
+            mux.charge_device_seconds(tenant_id, seconds)
 
     def bookkeep_variant(
         self, variant: str, seconds: float, error: bool

@@ -100,3 +100,70 @@ class TestDashboard:
             assert status == 404
         finally:
             srv.stop()
+
+
+class TestAdminTenants:
+    def test_tenant_crud_and_quota(self, admin):
+        storage, port = admin
+        status, raw = req(port, "/tenants")
+        assert status == 200 and json.loads(raw) == []
+
+        status, raw = req(port, "/tenants", "POST", {
+            "id": "acme", "engine_id": "rec", "weight": 2.0, "qps": 50,
+        })
+        assert status == 201
+        t = json.loads(raw)
+        assert t["engine_variant"] == "rec" and t["qps"] == 50.0
+
+        # upsert of an existing tenant is a 200, not a duplicate
+        status, raw = req(port, "/tenants", "POST", {
+            "id": "acme", "engine_id": "rec", "weight": 3.0,
+        })
+        assert status == 200 and json.loads(raw)["weight"] == 3.0
+
+        # malformed records 400 (bad id charset / missing engine)
+        status, _ = req(port, "/tenants", "POST", {"id": "a/b",
+                                                   "engine_id": "rec"})
+        assert status == 400
+        status, _ = req(port, "/tenants", "POST", {"id": "ok"})
+        assert status == 400
+
+        status, raw = req(port, "/tenants/acme/quota", "POST", {
+            "qps": 10, "max_concurrency": 4,
+        })
+        assert status == 200
+        t = json.loads(raw)
+        assert t["qps"] == 10.0 and t["max_concurrency"] == 4
+        status, _ = req(port, "/tenants/ghost/quota", "POST", {"qps": 1})
+        assert status == 404
+        status, _ = req(port, "/tenants/acme/quota", "POST", {"bogus": 1})
+        assert status == 400
+
+        status, raw = req(port, "/tenants/acme")
+        assert status == 200 and json.loads(raw)["qps"] == 10.0
+        status, raw = req(port, "/tenants")
+        assert [x["id"] for x in json.loads(raw)] == ["acme"]
+
+        status, _ = req(port, "/tenants/acme", "DELETE")
+        assert status == 200
+        status, _ = req(port, "/tenants/acme", "DELETE")
+        assert status == 404
+
+    def test_dashboard_tenants_panel(self, fresh_storage):
+        from predictionio_tpu.tenancy import Tenant, TenantStore
+        from predictionio_tpu.tools.dashboard import Dashboard
+
+        TenantStore(fresh_storage).upsert(Tenant(
+            id="acme", engine_id="rec", qps=25.0,
+            description="<b>needs escaping</b>",
+        ))
+        dash = Dashboard(fresh_storage, ip="127.0.0.1", port=0)
+        port = dash.start()
+        try:
+            status, raw = req(port, "/")
+            assert status == 200
+            assert "Tenants" in raw and "acme" in raw
+            assert "<b>needs escaping</b>" not in raw  # escaped
+            assert "&lt;b&gt;" in raw
+        finally:
+            dash.stop()

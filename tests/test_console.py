@@ -224,3 +224,30 @@ def test_faults_cli_local_registry(cli, capsys):
         assert not faults.active()
     finally:
         faults.clear()
+
+
+def test_tenants_cli(fresh_storage, capsys):
+    """`pio tenants new|list|show|set-quota|delete` round trip."""
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.tools import console
+
+    Storage.set_instance(fresh_storage)
+    try:
+        assert console.main([
+            "tenants", "new", "acme", "--engine", "rec",
+            "--weight", "2", "--qps", "100",
+        ]) == 0
+        assert console.main(["tenants", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "acme" in out and "weight=2.0" in out
+        assert console.main([
+            "tenants", "set-quota", "acme", "--qps", "10",
+            "--max-concurrency", "4",
+        ]) == 0
+        assert console.main(["tenants", "show", "acme"]) == 0
+        out = capsys.readouterr().out
+        assert '"qps": 10.0' in out
+        assert console.main(["tenants", "delete", "acme"]) == 0
+        assert console.main(["tenants", "show", "acme"]) == 1
+    finally:
+        Storage.set_instance(None)

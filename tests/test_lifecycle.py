@@ -649,3 +649,139 @@ class TestIngestShedding:
         status, _, body = self._post(port, deadline_ms=0)
         assert status == 202 and "walId" in body  # NOT shed
         faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6 satellites: registry-fold compaction + scheduler concurrency
+# ---------------------------------------------------------------------------
+
+
+class TestFoldCompaction:
+    def _n_events(self, storage, entity_id):
+        from predictionio_tpu.data.storage.base import EventQuery
+        from predictionio_tpu.deploy.registry import (
+            LIFECYCLE_APP_ID,
+            VERSION_ENTITY,
+        )
+
+        return len(list(storage.get_events().find(EventQuery(
+            app_id=LIFECYCLE_APP_ID, entity_type=VERSION_ENTITY,
+            entity_id=entity_id,
+        ))))
+
+    def test_compact_preserves_fold_and_bounds_events(self, fresh_storage):
+        from predictionio_tpu.deploy.registry import (
+            LifecycleRecordStore,
+            VERSION_ENTITY,
+        )
+
+        reg = ModelRegistry(fresh_storage)
+        v = reg.register(_instance("ci1"))
+        for i in range(6):
+            reg.set_status(v.id, "archived" if i % 2 else "trained",
+                           reason=f"r{i}")
+        before = reg.get(v.id).to_dict()
+        assert self._n_events(fresh_storage, v.id) >= 7
+        store = LifecycleRecordStore(fresh_storage)
+        # quiescence guard: a freshly-written record does NOT compact —
+        # a concurrent writer's update landing mid-compaction would be
+        # outranked by the snapshot and silently reverted
+        assert store.compact(VERSION_ENTITY, v.id) == 0
+        removed = store.compact(VERSION_ENTITY, v.id, min_age_s=0.0)
+        assert removed >= 7
+        # fold → ONE snapshot event, identical record
+        assert self._n_events(fresh_storage, v.id) == 1
+        assert reg.get(v.id).to_dict() == before
+        # further updates still fold on top of the snapshot
+        reg.set_status(v.id, "live")
+        assert reg.get(v.id).status == "live"
+
+    def test_gc_runs_compaction(self, fresh_storage):
+        reg = ModelRegistry(fresh_storage)
+        v = reg.register(_instance("ci2"))
+        for i in range(10):
+            reg.set_status(v.id, "trained", reason=f"r{i}")
+        assert self._n_events(fresh_storage, v.id) >= 11
+        # gc's sweep skips this still-hot record (quiescence guard)...
+        reg.gc(keep=5)
+        assert self._n_events(fresh_storage, v.id) >= 11
+        # ...and compacts it once it has gone quiet
+        reg.compact(min_age_s=0.0)
+        assert self._n_events(fresh_storage, v.id) == 1
+        assert reg.get(v.id).reason == "r9"
+
+    def test_compact_below_threshold_is_noop(self, fresh_storage):
+        from predictionio_tpu.deploy.registry import (
+            LifecycleRecordStore,
+            VERSION_ENTITY,
+        )
+
+        reg = ModelRegistry(fresh_storage)
+        v = reg.register(_instance("ci3"))
+        store = LifecycleRecordStore(fresh_storage)
+        assert store.compact_all(VERSION_ENTITY, min_events=8) == 0
+        assert self._n_events(fresh_storage, v.id) == 1
+
+
+class TestSchedulerConcurrency:
+    def test_two_engines_run_concurrently(self, fresh_storage, tmp_path):
+        """max_concurrent=2: two different engines' slow trains are
+        observed `running` at the same time (with one worker the second
+        would queue behind the first's full train)."""
+        q = JobQueue(fresh_storage)
+        slow_a = dict(
+            SLOW_VARIANT, id="lcslowa",
+            datasource={"params": {"id": 1, "sleep_s": 6.0}},
+        )
+        slow_b = dict(slow_a, id="lcslowb")
+        ja, jb = q.submit(slow_a), q.submit(slow_b)
+        sched = TrainScheduler(
+            fresh_storage, _scheduler_config(tmp_path, max_concurrent=2)
+        )
+        sched.start()
+        try:
+            _wait_for(
+                lambda: len(q.list(status="running")) == 2,
+                timeout=60, what="both engines training concurrently",
+            )
+            _wait_for(
+                lambda: all(
+                    q.get(j.id).status == "completed" for j in (ja, jb)
+                ),
+                timeout=120, what="both jobs completing",
+            )
+        finally:
+            sched.stop()
+
+    def test_same_engine_serializes(self, fresh_storage, tmp_path):
+        """Two jobs for ONE engine never run concurrently even with
+        max_concurrent=2 — concurrent trains of the same engine would
+        race the latest-COMPLETED pointer deploys read."""
+        q = JobQueue(fresh_storage)
+        slow = dict(
+            SLOW_VARIANT,
+            datasource={"params": {"id": 1, "sleep_s": 3.0}},
+        )
+        j1, j2 = q.submit(slow), q.submit(slow)
+        sched = TrainScheduler(
+            fresh_storage, _scheduler_config(tmp_path, max_concurrent=2)
+        )
+        max_running = 0
+        sched.start()
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                max_running = max(
+                    max_running, len(q.list(status="running"))
+                )
+                if all(
+                    q.get(j.id).status == "completed" for j in (j1, j2)
+                ):
+                    break
+                time.sleep(0.05)
+        finally:
+            sched.stop()
+        assert all(q.get(j.id).status == "completed" for j in (j1, j2))
+        assert max_running <= 1, (
+            f"same-engine jobs overlapped ({max_running} running at once)"
+        )

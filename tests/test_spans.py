@@ -327,6 +327,10 @@ def test_route_label_cardinality_bounded():
             f"/cmd/app/app-{i}/data",
             f"/cmd/channel/ch-{i}",
             f"/cmd/accesskey/key-{i}",
+            f"/tenants/tenant-{i}",
+            f"/tenants/tenant-{i}/queries.json",
+            f"/tenants/tenant-{i}/rollout/start",
+            f"/tenants/tenant-{i}/quota",
         ]
     labels = {label(p) for p in paths}
     assert labels == {
@@ -339,6 +343,10 @@ def test_route_label_cardinality_bounded():
         "/cmd/app/{name}/data",
         "/cmd/channel/{name}",
         "/cmd/accesskey/{name}",
+        "/tenants/{id}",
+        "/tenants/{id}/queries.json",
+        "/tenants/{id}/rollout/start",
+        "/tenants/{id}/quota",
     }
     # non-entity routes pass through untouched
     assert label("/queries.json") == "/queries.json"

@@ -1,0 +1,619 @@
+"""Multi-tenant serving e2e (ISSUE 6): tenant routing over a real
+trained engine, 429-vs-503 classification at the HTTP edge, transparent
+cache eviction/reload, weighted-fair dispatch under a hog, per-tenant
+fault scope, per-tenant canary rollouts, and mid-canary restart
+re-adoption."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.data.storage.registry import (
+    SourceConfig,
+    Storage,
+    StorageConfig,
+)
+from predictionio_tpu.deploy.registry import ModelRegistry
+from predictionio_tpu.resilience import faults
+from predictionio_tpu.tenancy import Tenant, TenantMux, TenantStore
+from predictionio_tpu.workflow.core import run_train
+from predictionio_tpu.workflow.server import (
+    QueryServer,
+    QueryServerConfig,
+    latest_completed_runtime,
+)
+
+VARIANT = {
+    "id": "mtsrv",
+    "engineFactory":
+        "predictionio_tpu.engines.recommendation.RecommendationEngine",
+    "datasource": {"params": {"app_name": "mtapp"}},
+    "algorithms": [
+        {"name": "als", "params": {"rank": 8, "num_iterations": 6}}
+    ],
+}
+
+
+def _seed(storage, n_users=8):
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="mtapp"))
+    events = storage.get_events()
+    events.init_app(app_id)
+    rng = np.random.RandomState(0)
+    batch = []
+    for u in range(n_users):
+        for _ in range(20):
+            i = rng.randint(0, 5) + (u % 2) * 5
+            batch.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties={"rating": 5.0},
+            ))
+    events.insert_batch(batch, app_id)
+
+
+@pytest.fixture(scope="module")
+def mt_storage(tmp_path_factory):
+    """One sqlite+localfs storage with a trained model, shared by the
+    module (training is the expensive part)."""
+    tmp = tmp_path_factory.mktemp("tenancy_e2e")
+    storage = Storage(StorageConfig(
+        sources={
+            "SQL": SourceConfig("SQL", "sqlite", {"PATH": str(tmp / "pio.db")}),
+            "FS": SourceConfig("FS", "localfs", {"PATH": str(tmp)}),
+        },
+        repositories={
+            "METADATA": "SQL", "EVENTDATA": "SQL", "MODELDATA": "FS",
+        },
+    ))
+    _seed(storage)
+    run_train(storage, VARIANT)
+    return storage
+
+
+def _make_server(storage, cache_capacity=2):
+    runtime = latest_completed_runtime(storage, "mtsrv", "0", "mtsrv")
+    srv = QueryServer(
+        storage, runtime, QueryServerConfig(ip="127.0.0.1", port=0)
+    )
+    mux = TenantMux(
+        storage, metrics=srv.metrics, cache_capacity=cache_capacity,
+        refresh_s=0.0, sync_s=3600.0,
+    )
+    srv.attach_tenancy(mux)
+    return srv, mux
+
+
+@pytest.fixture()
+def served(mt_storage):
+    store = TenantStore(mt_storage)
+    store.upsert(Tenant(id="t1", engine_id="mtsrv"))
+    store.upsert(Tenant(id="t2", engine_id="mtsrv"))
+    srv, mux = _make_server(mt_storage)
+    port = srv.start()
+    yield mt_storage, srv, mux, port
+    srv.stop()
+
+
+def post(port, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read().decode() or "null")
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# routing + control surface
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_routing_paths_and_header(served):
+    _, srv, mux, port = served
+    status, _, body = post(
+        port, "/tenants/t1/queries.json", {"user": "u0", "num": 3}
+    )
+    assert status == 200 and len(body["item_scores"]) == 3
+
+    # header form routes the same way
+    status, _, body = post(
+        port, "/queries.json", {"user": "u1", "num": 2},
+        headers={"X-PIO-Tenant": "t2"},
+    )
+    assert status == 200 and len(body["item_scores"]) == 2
+
+    # unknown tenant is a 404, not a silent fall-through to the default
+    status, _, body = post(
+        port, "/tenants/ghost/queries.json", {"user": "u0"}
+    )
+    assert status == 404
+
+    # the untenanted path still serves (single-tenant compatibility)
+    status, _, body = post(port, "/queries.json", {"user": "u0", "num": 2})
+    assert status == 200 and len(body["item_scores"]) == 2
+
+    status, body = get(port, "/tenants")
+    assert status == 200
+    assert {"t1", "t2"} <= set(body["tenants"])
+    assert body["cache"]["resident"] >= 1
+    status, body = get(port, "/tenants/t1")
+    assert status == 200 and body["resident"]
+    # per-tenant serve metrics landed under the tenant label
+    assert srv.metrics.histogram(
+        "tenant_serve_seconds", labelnames=("tenant",)
+    ).count_of(tenant="t1") >= 1
+
+
+def test_quota_429_distinct_from_deadline_503(served):
+    storage, srv, mux, port = served
+    TenantStore(storage).upsert(
+        Tenant(id="tq", engine_id="mtsrv", qps=1.0)
+    )
+    ok_status, _, _ = post(
+        port, "/tenants/tq/queries.json", {"user": "u0", "num": 1}
+    )
+    assert ok_status == 200
+    # burst is one second's allowance (1 token): the immediate second
+    # request is over quota → 429 + Retry-After (the tenant's problem)
+    status, headers, body = post(
+        port, "/tenants/tq/queries.json", {"user": "u0", "num": 1}
+    )
+    assert status == 429
+    assert int(headers.get("Retry-After", "0")) >= 1
+    assert "quota" in body["message"]
+    # an expired deadline on an IN-quota tenant is a 503 (the server
+    # sheds; retry later) — the classifications must not blur
+    status, headers, _ = post(
+        port, "/tenants/t1/queries.json", {"user": "u0"},
+        headers={"X-PIO-Deadline": "0"},
+    )
+    assert status == 503 and headers.get("Retry-After") == "1"
+    # quota rejection is visible on the metrics surface
+    assert srv.metrics.counter(
+        "tenant_quota_rejected_total", labelnames=("tenant", "resource")
+    ).value(tenant="tq", resource="qps") >= 1
+
+
+def test_evicted_model_transparently_reloads(mt_storage):
+    TenantStore(mt_storage).upsert(Tenant(id="t1", engine_id="mtsrv"))
+    TenantStore(mt_storage).upsert(Tenant(id="t2", engine_id="mtsrv"))
+    srv, mux = _make_server(mt_storage, cache_capacity=1)
+    port = srv.start()
+    try:
+        assert post(port, "/tenants/t1/queries.json",
+                    {"user": "u0", "num": 1})[0] == 200
+        assert post(port, "/tenants/t2/queries.json",
+                    {"user": "u1", "num": 1})[0] == 200  # evicts t1
+        assert post(port, "/tenants/t1/queries.json",
+                    {"user": "u0", "num": 1})[0] == 200  # reload, still 200
+        assert post(port, "/tenants/t1/queries.json",
+                    {"user": "u0", "num": 1})[0] == 200  # now a hit
+        s = mux.cache.stats()
+        assert s["capacity"] == 1
+        assert s["evictions"] >= 2
+        assert s["reloads"] >= 1
+        assert s["hits"] >= 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fairness under a hog (deterministic, dispatcher-level)
+# ---------------------------------------------------------------------------
+
+
+def test_hog_tenant_cannot_starve_good_tenant_dispatch():
+    """80 queued hog queries + 8 good-tenant queries on one dispatcher:
+    under DRR the good tenant's answers all land before the hog's
+    median answer (under FIFO they would land after the hog's LAST)."""
+    from concurrent.futures import Future
+
+    from predictionio_tpu.workflow.server import _BatchDispatcher, _Pending
+
+    class _SlowAlgo:
+        serving_context = None
+
+        def batch_predict(self, ctx, model, queries):
+            time.sleep(0.02)  # the device is busy 20 ms per batch
+            return [(i, q) for i, q in queries]
+
+    class _Serving:
+        def serve(self, q, preds):
+            return preds[0]
+
+    class _RT:  # one runtime object per tenant, like the model cache
+        def __init__(self):
+            self.algorithms = [_SlowAlgo()]
+            self.models = [None]
+            self.serving = _Serving()
+
+    class _Owner:
+        def bookkeep_predict(self, *_a):
+            pass
+
+        def tenant_weight(self, _t):
+            return 1.0
+
+    hog_rt, good_rt = _RT(), _RT()
+    disp = _BatchDispatcher(
+        _Owner(), window_ms=2.0, max_batch=8, max_window_ms=20.0,
+        pipeline_depth=1,
+    )
+    try:
+        done: dict = {}
+        t_start = time.perf_counter()
+
+        def enqueue(tenant, rt, i):
+            fut: Future = Future()
+            fut.add_done_callback(
+                lambda _f, k=(tenant, i): done.setdefault(
+                    k, time.perf_counter() - t_start
+                )
+            )
+            disp._queue.put(_Pending(
+                f"{tenant}-{i}", rt, fut, time.perf_counter(),
+                (None, None), None, tenant,
+            ))
+            return fut
+
+        hog = [enqueue("hog", hog_rt, i) for i in range(80)]
+        good = [enqueue("good", good_rt, i) for i in range(8)]
+        for f in hog + good:
+            f.result(timeout=60)
+        good_last = max(done[("good", i)] for i in range(8))
+        hog_sorted = sorted(done[("hog", i)] for i in range(80))
+        hog_median = hog_sorted[40]
+        assert good_last < hog_median, (
+            f"good tenant finished at {good_last:.3f}s, after the hog's "
+            f"median {hog_median:.3f}s — starved"
+        )
+    finally:
+        disp.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant fault scope
+# ---------------------------------------------------------------------------
+
+
+def test_per_tenant_fault_scope(served):
+    _, _, _, port = served
+    faults.install(faults.parse_spec(
+        "dispatch.device@tenant/t1:error:1.0"
+    ))
+    try:
+        status, _, _ = post(
+            port, "/tenants/t1/queries.json", {"user": "u0", "num": 1}
+        )
+        assert status == 500  # only the targeted tenant breaks
+        status, _, _ = post(
+            port, "/tenants/t2/queries.json", {"user": "u1", "num": 1}
+        )
+        assert status == 200  # the neighbor sails through
+    finally:
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant canary rollout + restart re-adoption
+# ---------------------------------------------------------------------------
+
+
+def test_per_tenant_rollout_and_abort(served):
+    storage, srv, mux, port = served
+    version = ModelRegistry(storage).register(srv.runtime.instance)
+    status, _, body = post(port, "/tenants/t1/rollout/start", {
+        "version": version.id, "fraction": 1.0,
+        "min_requests": 10**9, "bake_s": 3600.0, "interval_s": 60.0,
+    })
+    assert status == 200 and body["state"] == "canary"
+
+    # fraction 1.0: tenant t1's traffic serves from the candidate and
+    # feeds its verdict window; t2 is untouched
+    assert post(port, "/tenants/t1/queries.json",
+                {"user": "u0", "num": 1})[0] == 200
+    status, body = get(port, "/tenants/t1/rollout/status")
+    assert status == 200 and body["state"] == "canary"
+    assert body["candidate"]["count"] >= 1
+
+    # conflicting second start → 409
+    status, _, body = post(port, "/tenants/t1/rollout/start", {
+        "version": version.id,
+    })
+    assert status == 409
+
+    status, _, body = post(
+        port, "/tenants/t1/rollout/abort", {"reason": "test cleanup"}
+    )
+    assert status == 200 and body["state"] == "aborted"
+    assert ModelRegistry(storage).get(version.id).status == "rolled_back"
+    # nothing left to abort → 409
+    status, _, _ = post(port, "/tenants/t1/rollout/abort", {})
+    assert status == 409
+    # t1 serves live again
+    assert post(port, "/tenants/t1/queries.json",
+                {"user": "u0", "num": 1})[0] == 200
+
+
+def test_rollout_survives_server_restart(mt_storage):
+    """PR-5 follow-up satellite: a query-server restart mid-canary
+    re-adopts the persisted rollout — same version, bake progress
+    credited from the original wall-clock start."""
+    runtime = latest_completed_runtime(mt_storage, "mtsrv", "0", "mtsrv")
+    srv1 = QueryServer(
+        mt_storage, runtime, QueryServerConfig(ip="127.0.0.1", port=0)
+    )
+    srv1.start()
+    version = ModelRegistry(mt_storage).register(srv1.runtime.instance)
+    srv1.start_rollout({
+        "version": version.id, "fraction": 0.5,
+        "min_requests": 10**9, "bake_s": 3600.0, "interval_s": 60.0,
+    })
+    assert srv1.rollout is not None and srv1.rollout.st.state == "canary"
+    time.sleep(0.3)  # measurable bake progress to carry over
+    srv1.stop()  # restart: verdict thread dies, record + registry stay
+
+    runtime2 = latest_completed_runtime(mt_storage, "mtsrv", "0", "mtsrv")
+    srv2 = QueryServer(
+        mt_storage, runtime2, QueryServerConfig(ip="127.0.0.1", port=0)
+    )
+    port2 = srv2.start()
+    try:
+        rollout = srv2.rollout
+        assert rollout is not None, "restart did not re-adopt the canary"
+        assert rollout.st.state == "canary"
+        assert rollout.st.version.id == version.id
+        assert rollout.config.fraction == 0.5
+        # bake progress carried over from the original start
+        assert time.monotonic() - rollout.st.started_at >= 0.3
+        assert srv2.candidate is not None
+        # serving works with the re-adopted split
+        status, _, body = post(
+            port2, "/queries.json", {"user": "u0", "num": 1}
+        )
+        assert status == 200
+        # terminal state persists: an aborted rollout is NOT re-adopted
+        srv2.abort_rollout("test cleanup")
+    finally:
+        srv2.stop()
+    srv3 = QueryServer(
+        mt_storage,
+        latest_completed_runtime(mt_storage, "mtsrv", "0", "mtsrv"),
+        QueryServerConfig(ip="127.0.0.1", port=0),
+    )
+    srv3.start()
+    try:
+        assert srv3.rollout is None
+    finally:
+        srv3.stop()
+
+
+def test_default_scope_start_still_flips_live_version_to_canary(mt_storage):
+    """The tenant-scope live-skip in RolloutController.start() must NOT
+    leak into the default scope: a server-scope canary of an
+    already-live version flips it to "canary", because the default
+    scope's resume path is strict (status must be "canary") and a
+    skipped flip would make that bake unresumable after a restart."""
+    runtime = latest_completed_runtime(mt_storage, "mtsrv", "0", "mtsrv")
+    srv = QueryServer(
+        mt_storage, runtime, QueryServerConfig(ip="127.0.0.1", port=0)
+    )
+    srv.start()
+    try:
+        registry = ModelRegistry(mt_storage)
+        version = registry.register(srv.runtime.instance)
+        registry.promote(version.id)
+        srv.start_rollout({
+            "version": version.id, "fraction": 0.5,
+            "min_requests": 10**9, "bake_s": 3600.0, "interval_s": 60.0,
+        })
+        assert registry.get(version.id).status == "canary"
+        srv.abort_rollout("test cleanup")
+    finally:
+        srv.stop()
+
+
+def test_fallback_path_still_charges_device_seconds():
+    """A tenant whose queries poison every batch (batch_predict raises,
+    per-query fallback serves) must still be debited device-seconds —
+    otherwise exactly the hog the quota exists to contain bypasses it."""
+    from concurrent.futures import Future
+
+    from predictionio_tpu.workflow.server import _BatchDispatcher, _Pending
+
+    class _PoisonAlgo:
+        serving_context = None
+
+        def batch_predict(self, ctx, model, queries):
+            raise RuntimeError("poison batch")
+
+        def predict(self, model, q):
+            time.sleep(0.005)  # real per-query device work
+            return q
+
+    class _Serving:
+        def serve(self, q, preds):
+            return preds[0]
+
+    class _RT:
+        def __init__(self):
+            self.algorithms = [_PoisonAlgo()]
+            self.models = [None]
+            self.serving = _Serving()
+
+    charges: dict = {}
+
+    class _Owner:
+        def bookkeep_predict(self, *_a):
+            pass
+
+        def tenant_weight(self, _t):
+            return 1.0
+
+        def charge_device_seconds(self, tid, s):
+            charges[tid] = charges.get(tid, 0.0) + s
+
+    disp = _BatchDispatcher(
+        _Owner(), window_ms=2.0, max_batch=8, max_window_ms=20.0,
+        pipeline_depth=1,
+    )
+    try:
+        rt = _RT()
+        futs = []
+        for i in range(4):
+            fut: Future = Future()
+            disp._queue.put(_Pending(
+                f"q{i}", rt, fut, time.perf_counter(), (None, None),
+                None, "acme",
+            ))
+            futs.append(fut)
+        for f in futs:
+            assert f.result(timeout=30) is not None
+        assert charges.get("acme", 0.0) >= 4 * 0.005
+    finally:
+        disp.stop()
+
+
+def test_tenant_resume_survives_shared_version_promote(mt_storage):
+    """Tenants of one engine canary the same trained version by default,
+    so the version's GLOBAL status cannot prove THIS tenant's rollout
+    finished: another tenant promoting it to "live" mid-bake must not
+    cancel this tenant's restart re-adoption (and the resumed start must
+    not clobber the live pointer back to "canary")."""
+    store = TenantStore(mt_storage)
+    store.upsert(Tenant(id="ta", engine_id="mtsrv"))
+    srv, _mux = _make_server(mt_storage)
+    port = srv.start()
+    version = ModelRegistry(mt_storage).register(srv.runtime.instance)
+    status, _, _ = post(port, "/tenants/ta/rollout/start", {
+        "version": version.id, "fraction": 1.0,
+        "min_requests": 10**9, "bake_s": 3600.0, "interval_s": 60.0,
+    })
+    assert status == 200
+    srv.stop()  # restart mid-bake
+    # meanwhile another tenant of the same engine promotes the shared
+    # version: its global status flips to "live"
+    ModelRegistry(mt_storage).promote(version.id)
+
+    srv2, mux2 = _make_server(mt_storage)
+    srv2.start()
+    try:
+        mux2.sync()
+        # the first sync pass to claim re-adoption (ours or the mux's
+        # background thread) builds the candidate runtime — poll
+        host = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            host = mux2._hosts.get("ta")
+            if host is not None and host.rollout is not None:
+                break
+            time.sleep(0.1)
+        assert host is not None and host.rollout is not None, (
+            "shared-version promote cancelled the tenant's re-adoption"
+        )
+        assert host.rollout.st.state == "canary"
+        assert ModelRegistry(mt_storage).get(version.id).status == "live"
+        host.rollout.stop()
+        host.rollout.abort("test cleanup")
+    finally:
+        srv2.stop()
+        store.delete("ta")
+
+
+def test_tenant_resume_declines_rolled_back_and_retires_record(mt_storage):
+    """A version rolled back elsewhere IS globally disqualifying — and
+    the declined scope's stale "canary" record is retired so it is not
+    re-considered (baseline warmed + pinned) on every restart forever."""
+    from predictionio_tpu.deploy.registry import LifecycleRecordStore
+    from predictionio_tpu.deploy.rollout import ROLLOUT_ENTITY
+
+    store = TenantStore(mt_storage)
+    store.upsert(Tenant(id="tb", engine_id="mtsrv"))
+    srv, _mux = _make_server(mt_storage)
+    port = srv.start()
+    version = ModelRegistry(mt_storage).register(srv.runtime.instance)
+    status, _, _ = post(port, "/tenants/tb/rollout/start", {
+        "version": version.id, "fraction": 1.0,
+        "min_requests": 10**9, "bake_s": 3600.0, "interval_s": 60.0,
+    })
+    assert status == 200
+    srv.stop()
+    ModelRegistry(mt_storage).rollback(version.id, "judged bad elsewhere")
+
+    srv2, mux2 = _make_server(mt_storage)
+    srv2.start()
+    try:
+        mux2.sync()
+        # the declining sync pass may be the mux's background thread —
+        # poll for the retired record it writes
+        rec = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            rec = (
+                LifecycleRecordStore(mt_storage)
+                .fold(ROLLOUT_ENTITY, "tenant/tb")
+                .get("tenant/tb")
+            )
+            if rec and rec.get("state") == "aborted":
+                break
+            time.sleep(0.1)
+        host = mux2._hosts.get("tb")
+        assert host is None or host.rollout is None
+        assert rec and rec.get("state") == "aborted"
+        assert "not resumed" in rec.get("verdict_reason", "")
+    finally:
+        srv2.stop()
+        store.delete("tb")
+
+
+def test_recreate_mid_canary_keeps_pinned_baseline(served):
+    """Delete + recreate a tenant while its canary is still baking: the
+    deferred cleanup must NOT invalidate the cache entry the rollout's
+    pin lives on — a rebuilt baseline would be evictable mid-window."""
+    storage, srv, mux, port = served
+    version = ModelRegistry(storage).register(srv.runtime.instance)
+    status, _, _ = post(port, "/tenants/t1/rollout/start", {
+        "version": version.id, "fraction": 0.5,
+        "min_requests": 10**9, "bake_s": 3600.0, "interval_s": 60.0,
+    })
+    assert status == 200
+    baseline = mux.cache._entries.get("t1")
+    assert baseline is not None and baseline.pinned
+
+    store = TenantStore(storage)
+    store.delete("t1")
+    mux.refresh(force=True)  # delete observed; abort deferred (active)
+    store.upsert(Tenant(id="t1", engine_id="mtsrv"))
+    mux.refresh(force=True)  # recreate lands before the sync pass
+    mux.sync()
+    try:
+        entry = mux.cache._entries.get("t1")
+        assert entry is baseline, "recreate dropped the resident baseline"
+        assert entry.pinned, "recreate unpinned the baking rollout's baseline"
+        host = mux._hosts.get("t1")
+        assert host is not None and host.rollout is not None
+        assert host.rollout.st.state == "canary"
+    finally:
+        host = mux._hosts.get("t1")
+        if host is not None and host.rollout is not None:
+            host.rollout.stop()
+            host.rollout.abort("test cleanup")
