@@ -230,6 +230,8 @@ class _Handler(JsonHandler):
                 self._serve_metrics()
             elif path == "/debug/traces" and method == "GET":
                 self._serve_debug_traces()
+            elif path == "/debug/tsdb" and method == "GET":
+                self._serve_debug_tsdb()
             elif path == "/debug/profile" and method == "GET":
                 self._serve_debug_profile()
             elif path == "/debug/faults" and method == "GET":

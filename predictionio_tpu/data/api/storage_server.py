@@ -98,6 +98,8 @@ class _Handler(JsonHandler):
             self._serve_metrics()
         elif self.path.split("?")[0] == "/debug/traces":
             self._serve_debug_traces()
+        elif self.path.split("?")[0] == "/debug/tsdb":
+            self._serve_debug_tsdb()
         elif self.path.split("?")[0] == "/debug/profile":
             self._serve_debug_profile()
         elif self.path.split("?")[0] == "/debug/faults":
@@ -275,22 +277,41 @@ class StorageServer:
         self.httpd.dedupe_cache = OrderedDict()  # type: ignore[attr-defined]
         self.httpd.dedupe_inflight = {}  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        self._monitor_token: Optional[int] = None
 
     @property
     def port(self) -> int:
         return self.httpd.server_address[1]
+
+    def _attach_monitor(self) -> None:
+        # ISSUE 8: StorageServer owns its lifecycle (no ServerProcess),
+        # so it pairs with the process monitor here — the TSDB sampler
+        # must join on shutdown like every other monitor thread
+        if self._monitor_token is None:
+            from predictionio_tpu.obs.monitor import get_monitor
+
+            self._monitor_token = get_monitor().attach(
+                "storage", self.httpd.metrics  # type: ignore[attr-defined]
+            )
 
     def start(self) -> "StorageServer":
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="pio-storage", daemon=True
         )
         self._thread.start()
+        self._attach_monitor()
         return self
 
     def serve_forever(self) -> None:
+        self._attach_monitor()
         self.httpd.serve_forever()
 
     def shutdown(self) -> None:
+        if self._monitor_token is not None:
+            from predictionio_tpu.obs.monitor import get_monitor
+
+            get_monitor().detach(self._monitor_token)
+            self._monitor_token = None
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:

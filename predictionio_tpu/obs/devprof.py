@@ -174,6 +174,16 @@ class _SigAnalysis:
     code_bytes: float = 0.0
     cost_ok: bool = False
     memory_ok: bool = False
+    # loop-FLOPs calibration (ISSUE 8 satellite, the PR-3 follow-up):
+    # True when `flops`/`bytes_accessed` already include the loop trip
+    # count via the 1-vs-2-iteration lowering diff — the caller must
+    # NOT also multiply by the `scale_by` kwarg
+    calibrated: bool = False
+    # the raw one-pass numbers XLA reported for the actual kwargs, kept
+    # so the report can show the kwarg-scaled estimate for comparison
+    flops_body: float = 0.0
+    bytes_body: float = 0.0
+    iterations: float = 1.0
 
 
 @dataclass
@@ -367,6 +377,10 @@ class DeviceProfiler:
                     analysis = rec.signatures.get(sig)
                     if analysis is None or analysis is _ANALYSIS_PENDING:
                         analysis = _ANALYSIS_PENDING
+                if analysis.calibrated:
+                    # the 1-vs-2-iteration lowering already folded the
+                    # trip count in — kwarg scaling would double-count
+                    scale = 1.0
                 rec.invocations += 1
                 rec.device_seconds += dt
                 rec.flops_total += analysis.flops * scale
@@ -403,6 +417,8 @@ class DeviceProfiler:
             res.cost_ok = True
         except Exception:
             pass
+        if res.cost_ok and wrapper.scale_by is not None:
+            self._calibrate_loop(wrapper, lower, args, kwargs, res)
         if wrapper.memory_enabled():
             try:
                 compiled = lowered.compile()
@@ -427,6 +443,56 @@ class DeviceProfiler:
             except Exception:
                 pass
         return res
+
+    @staticmethod
+    def _calibrate_loop(wrapper: "_Instrumented", lower: Any, args: tuple,
+                        kwargs: dict, res: _SigAnalysis) -> None:
+        """Calibrate loop FLOPs with 1- and 2-iteration lowerings
+        (ISSUE 8 satellite, the PR-3 follow-up). XLA's HLO cost
+        analysis counts a `fori_loop`/`scan` body ONCE regardless of
+        trip count; PR 3 corrected by multiplying the whole program by
+        the static `scale_by` kwarg — which also scales the loop-
+        INVARIANT work (setup, output gather). Lowering the same
+        signature at 1 and 2 iterations separates the two:
+
+            per_iteration = cost(2) - cost(1)
+            total(n)      = cost(1) + (n - 1) * per_iteration
+
+        Lowering is trace-only (no backend compile) and runs once per
+        signature. Any failure — the kwarg not accepted, cost analysis
+        drift, a non-positive diff (XLA fully unrolled or folded the
+        loop, where the one-pass numbers are already honest) — falls
+        back to the PR-3 kwarg scaling, recorded as `flops_scaled_by`
+        with `flops_calibrated: false` in the report."""
+        res.flops_body, res.bytes_body = res.flops, res.bytes_accessed
+        try:
+            n = float(kwargs.get(wrapper.scale_by) or 1)
+        except (TypeError, ValueError):
+            return
+        res.iterations = n
+        try:
+            costs = []
+            for iters in (1, 2):
+                ca = lower(
+                    *args, **{**kwargs, wrapper.scale_by: iters}
+                ).cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                costs.append((
+                    float(ca.get("flops", 0.0) or 0.0),
+                    float(ca.get("bytes accessed", 0.0) or 0.0),
+                ))
+            (f1, b1), (f2, b2) = costs
+        except Exception:
+            return
+        if f1 <= 0 or f2 <= f1:
+            # the lowering's cost does NOT scale with the trip count
+            # (XLA counted the while body once): the 1-vs-2 diff can't
+            # see the loop, so the kwarg fallback is the honest scaling
+            return
+        res.flops = f1 + (n - 1) * (f2 - f1)
+        res.bytes_accessed = max(b1, b1 + (n - 1) * (b2 - b1))
+        res.calibrated = True
 
     def record_external(self, name: str, seconds: float,
                         invocations: int = 1) -> None:
@@ -492,7 +558,15 @@ class DeviceProfiler:
             "memory_analysis_ok": any(s.memory_ok for s in sigs),
         }
         if rec.scale_by is not None:
+            # kept for comparison with the calibrated numbers (ISSUE 8
+            # satellite): `flops_per_call_kwarg_scaled` is what the
+            # PR-3 trust-the-kwarg estimate would have claimed
             out["flops_scaled_by"] = rec.scale_by
+            out["flops_calibrated"] = any(s.calibrated for s in sigs)
+            if latest.calibrated:
+                out["flops_per_call_kwarg_scaled"] = (
+                    latest.flops_body * latest.iterations
+                )
         # derived roofline fields against the caller-resolved peaks (the
         # peak table + env + jax.devices lookup is process-constant, so
         # a report resolves it ONCE, not per executable per field)

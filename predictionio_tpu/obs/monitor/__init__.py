@@ -1,0 +1,262 @@
+"""Monitoring plane (ISSUE 8): in-process time-series history, fleet
+scrape aggregation, and SLO burn-rate alerting.
+
+The process-global :class:`Monitor` owns one TSDB and the background
+threads over it. Servers attach on start and detach on stop; the
+sampler (and the SLO engine, when specs are configured) runs while at
+least one server is attached and **joins on the last detach** — no
+leaked threads, same discipline as the dispatcher/WAL/mux threads.
+
+Knobs (read when the monitor is created; mutable attributes after):
+
+  PIO_TSDB=0             disable the monitoring plane wholesale
+  PIO_TSDB_INTERVAL_S    sampler period           (default 5)
+  PIO_TSDB_POINTS        ring capacity per series (default 720 → 1 h)
+  PIO_TSDB_MAX_SERIES    series cardinality cap   (default 4096)
+  PIO_SLO_INTERVAL_S     SLO evaluation period    (default 15)
+  PIO_SLOS               JSON SLO spec array, or @/path.json
+  PIO_MONITOR_TARGETS    fleet scrape targets (dashboard / pio monitor)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+from predictionio_tpu.obs.monitor.scrape import (
+    FleetScraper,
+    parse_prometheus_text,
+    parse_targets,
+)
+from predictionio_tpu.obs.monitor.slo import (
+    AlertStatus,
+    SLOEngine,
+    SLOSpec,
+    load_slos,
+)
+from predictionio_tpu.obs.monitor.tsdb import (
+    TSDB,
+    MetricsSampler,
+    sample_families,
+)
+from predictionio_tpu.utils.env import env_float
+
+__all__ = [
+    "TSDB",
+    "MetricsSampler",
+    "FleetScraper",
+    "SLOEngine",
+    "SLOSpec",
+    "AlertStatus",
+    "Monitor",
+    "enabled",
+    "get_monitor",
+    "load_slos",
+    "parse_prometheus_text",
+    "parse_targets",
+    "sample_families",
+]
+
+
+def enabled() -> bool:
+    return os.environ.get("PIO_TSDB", "").strip() != "0"
+
+
+class Monitor:
+    """One TSDB + sampler + optional SLO engine per process.
+
+    `attach(label, registry)` refcounts: the first attach starts the
+    sampler (and the SLO engine when specs exist), the last `detach`
+    stops and JOINS both. Families are sampled first-wins by name in
+    attach order, then the process-default registry — the exact merge
+    `GET /metrics` renders, so history and scrape can't disagree."""
+
+    def __init__(self):
+        self.sampler_interval_s = env_float("PIO_TSDB_INTERVAL_S", 5.0)
+        self.slo_interval_s = env_float("PIO_SLO_INTERVAL_S", 15.0)
+        self.tsdb = TSDB(
+            capacity=int(env_float("PIO_TSDB_POINTS", 720)),
+            max_series=int(env_float("PIO_TSDB_MAX_SERIES", 4096)),
+        )
+        self._lock = threading.Lock()
+        self._attached: list[tuple[int, str, Any]] = []  # (token, label, reg)
+        self._next_token = 1
+        self._sampler: Optional[MetricsSampler] = None
+        self._engine: Optional[SLOEngine] = None
+        self._slos: list[SLOSpec] = load_slos()
+
+    # -- what the sampler samples ------------------------------------------
+    def _families(self) -> list:
+        """Every attached registry's families plus the process-default
+        ones. Same-NAMED families from different servers are all kept —
+        a query server's and a storage daemon's `http_requests_total`
+        carry disjoint `server=` label children, and dropping the
+        later-attached server's family would blind its SLOs entirely.
+        Exact duplicate (name, labels) series — the per-registry
+        jax/devprof gauges reading one global source — are deduped
+        per-tick by the sampler, first writer wins."""
+        from predictionio_tpu.obs.registry import get_default_registry
+
+        seen_ids: set[int] = set()
+        out = []
+        with self._lock:
+            registries = [reg for _t, _l, reg in self._attached]
+        registries.append(get_default_registry())
+        for reg in registries:
+            for fam in reg.families():
+                if id(fam) not in seen_ids:
+                    seen_ids.add(id(fam))
+                    out.append(fam)
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    def attach(self, label: str, registry: Any) -> Optional[int]:
+        """Register a server's registry for sampling; returns a token
+        for `detach` (None when the plane is disabled or the server has
+        no registry)."""
+        if not enabled() or registry is None:
+            return None
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            self._attached.append((token, label, registry))
+        self._ensure_threads()
+        return token
+
+    def detach(self, token: Optional[int]) -> None:
+        if token is None:
+            return
+        stop_sampler = stop_engine = None
+        with self._lock:
+            self._attached = [
+                row for row in self._attached if row[0] != token
+            ]
+            if not self._attached:
+                stop_sampler, self._sampler = self._sampler, None
+                stop_engine, self._engine = self._engine, None
+        # join OUTSIDE the lock: the threads' loops call back into us
+        if stop_engine is not None:
+            stop_engine.stop()
+        if stop_sampler is not None:
+            stop_sampler.stop()
+
+    def _ensure_threads(self) -> None:
+        with self._lock:
+            if not self._attached:
+                return
+            if self._sampler is None:
+                self._sampler = MetricsSampler(
+                    self.tsdb, self._families, self.sampler_interval_s
+                )
+                self._sampler.start()
+            if self._engine is None and self._slos:
+                self._engine = SLOEngine(
+                    self.tsdb, self._slos, self.slo_interval_s
+                )
+                self._engine.start()
+
+    # -- SLOs --------------------------------------------------------------
+    def set_slos(self, specs: list[SLOSpec]) -> None:
+        """Install/replace the SLO set; starts the engine if servers are
+        already attached (tests and `pio monitor` configure this way,
+        deployments use PIO_SLOS)."""
+        with self._lock:
+            self._slos = list(specs)
+            if self._engine is not None:
+                self._engine.set_specs(self._slos)
+        self._ensure_threads()
+
+    @property
+    def engine(self) -> Optional[SLOEngine]:
+        return self._engine
+
+    @property
+    def attached_count(self) -> int:
+        with self._lock:
+            return len(self._attached)
+
+    def alerts_payload(self) -> dict:
+        """The `GET /alerts` body — stable shape whether or not the
+        engine is running."""
+        engine = self._engine
+        if engine is None:
+            return {
+                "enabled": enabled(),
+                "slos": [s.to_dict() for s in self._slos],
+                "alerts": [],
+                "firing": [],
+                "message": (
+                    "monitoring disabled (PIO_TSDB=0)" if not enabled()
+                    else "no SLO engine running (configure PIO_SLOS or "
+                         "Monitor.set_slos)"
+                ),
+            }
+        return {"enabled": True, **engine.payload()}
+
+    def tsdb_payload(self, qs: dict[str, str]) -> dict:
+        """The `GET /debug/tsdb` body: summary by default; `?name=`
+        (+`labels=k:v,...` `window_s=` `agg=rate|increase|quantile`
+        `q=`) for points/aggregates."""
+        if not enabled():
+            return {"enabled": False, "series": []}
+        name = qs.get("name")
+        if not name:
+            try:
+                limit = int(qs.get("limit", "0") or 0)
+            except ValueError:
+                limit = 0
+            return {"enabled": True, **self.tsdb.summary(limit=limit)}
+        match: Optional[dict] = None
+        labels_s = qs.get("labels", "")
+        if labels_s:
+            match = {}
+            for pair in labels_s.split(","):
+                if not pair:
+                    continue
+                k, _, v = (
+                    pair.partition(":") if ":" in pair
+                    else pair.partition("=")
+                )
+                match[k.strip()] = v.strip()
+        try:
+            window_s = float(qs["window_s"]) if "window_s" in qs else None
+        except ValueError:
+            window_s = None
+        agg = qs.get("agg")
+        out: dict[str, Any] = {"enabled": True, "name": name}
+        if agg in ("rate", "increase"):
+            w = window_s or 300.0
+            value = (
+                self.tsdb.rate(name, match, w) if agg == "rate"
+                else self.tsdb.increase(name, match, w)
+            )
+            out.update({"agg": agg, "window_s": w, "value": value})
+        elif agg == "quantile":
+            try:
+                q = float(qs.get("q", "0.99"))
+            except ValueError:
+                q = 0.99
+            out.update({
+                "agg": agg, "q": q, "window_s": window_s,
+                "value": self.tsdb.quantile_over_time(
+                    name, q, match, window_s
+                ),
+            })
+        else:
+            out["series"] = self.tsdb.range(name, match, window_s)
+        return out
+
+
+_monitor: Optional[Monitor] = None
+_monitor_lock = threading.Lock()
+
+
+def get_monitor() -> Monitor:
+    """The process-wide monitor (lazy, so env knobs set before first
+    server start are honored)."""
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = Monitor()
+        return _monitor

@@ -1,0 +1,456 @@
+"""SLO burn-rate alerting over the in-process TSDB.
+
+Declarative SLO specs — availability and latency objectives per route
+(and per tenant) — evaluated as **multi-window burn-rate rules** (the
+Google SRE workbook shape: a fast window catches cliffs, a slow window
+suppresses blips; an alert needs BOTH over threshold), driving a
+pending → firing → resolved state machine surfaced at ``GET /alerts``
+and as an ``alerts_firing{slo}`` gauge.
+
+Spec fields (JSON; `PIO_SLOS` holds a JSON array or ``@/path.json``):
+
+  name          unique id (becomes the metric label — keep it small)
+  kind          "availability" | "latency" | "up"
+  objective     e.g. 0.99  (error budget = 1 - objective)
+  server        metrics `server` label (default "query")
+  route         metric route label (default "/queries.json")
+  tenant        scope to one tenant's series instead of the route
+  instance      kind "up" only: the scrape target to watch
+  threshold_ms  latency only: the "good request" bound (default 250)
+  window_s      slow window (default 3600)
+  fast_window_s fast window (default 300)
+  burn_threshold  both windows must burn ≥ this (default 14.4 — the
+                  page-worthy rate; 1.0 = "exactly eating the budget")
+  for_s         seconds a breach must persist in `pending` before
+                `firing` (default 0 → fires on the second consecutive
+                breached evaluation)
+  resolve_s     hysteresis: seconds of clean evaluations a firing
+                alert needs before `resolved` (default 0 → next clean
+                evaluation resolves)
+  min_samples   requests the fast window must contain before the rule
+                is judged at all — the zero-traffic guard: an idle
+                route neither divides by zero nor flaps its alert
+
+Error-rate sources (all counter series the sampler already records):
+
+  availability  http_requests_total{server,path,status} — 5xx / all;
+                with `tenant`: tenant_requests_total{tenant,outcome}
+  latency       http_request_seconds_bucket{server,path,le} — the
+                fraction of requests over `threshold_ms`; with
+                `tenant`: tenant_serve_seconds_bucket{tenant,le}
+  up            1 - mean(up{instance}) — a dead scrape target burns
+                its availability budget directly
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from predictionio_tpu.obs.monitor.tsdb import TSDB
+from predictionio_tpu.obs.registry import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+KINDS = ("availability", "latency", "up")
+
+# alert states
+INACTIVE = "inactive"
+PENDING = "pending"
+FIRING = "firing"
+RESOLVED = "resolved"
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    name: str
+    kind: str = "availability"
+    objective: float = 0.99
+    server: str = "query"
+    route: str = "/queries.json"
+    tenant: Optional[str] = None
+    instance: Optional[str] = None
+    threshold_ms: float = 250.0
+    window_s: float = 3600.0
+    fast_window_s: float = 300.0
+    burn_threshold: float = 14.4
+    for_s: float = 0.0
+    resolve_s: float = 0.0
+    min_samples: int = 1
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("SLO spec needs a name")
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"SLO {self.name!r}: unknown kind {self.kind!r} "
+                f"(known: {', '.join(KINDS)})"
+            )
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"SLO {self.name!r}: objective must be in (0, 1), got "
+                f"{self.objective}"
+            )
+        if self.fast_window_s <= 0 or self.window_s <= 0:
+            raise ValueError(f"SLO {self.name!r}: windows must be > 0")
+        if self.fast_window_s > self.window_s:
+            raise ValueError(
+                f"SLO {self.name!r}: fast window must not exceed the "
+                "slow window"
+            )
+        if self.kind == "up" and not self.instance:
+            raise ValueError(
+                f"SLO {self.name!r}: kind 'up' needs an 'instance'"
+            )
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SLOSpec":
+        known = {
+            k: d[k] for k in (
+                "name", "kind", "objective", "server", "route", "tenant",
+                "instance", "threshold_ms", "window_s", "fast_window_s",
+                "burn_threshold", "for_s", "resolve_s", "min_samples",
+            ) if k in d
+        }
+        unknown = set(d) - set(known)
+        if unknown:
+            raise ValueError(
+                f"SLO spec has unknown field(s): {', '.join(sorted(unknown))}"
+            )
+        return cls(**known)
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {
+            "name": self.name, "kind": self.kind,
+            "objective": self.objective, "window_s": self.window_s,
+            "fast_window_s": self.fast_window_s,
+            "burn_threshold": self.burn_threshold,
+            "for_s": self.for_s, "resolve_s": self.resolve_s,
+            "min_samples": self.min_samples,
+        }
+        if self.kind == "up":
+            out["instance"] = self.instance
+        else:
+            out["server"] = self.server
+            if self.tenant:
+                out["tenant"] = self.tenant
+            else:
+                out["route"] = self.route
+        if self.kind == "latency":
+            out["threshold_ms"] = self.threshold_ms
+        return out
+
+
+def load_slos(text: Optional[str] = None) -> list[SLOSpec]:
+    """Parse `PIO_SLOS` (or an explicit string): a JSON array of spec
+    objects, or ``@/path/to/slos.json``. Malformed input logs and
+    yields [] — a typo'd spec must not take a server down."""
+    raw = text if text is not None else os.environ.get("PIO_SLOS", "")
+    raw = (raw or "").strip()
+    if not raw:
+        return []
+    try:
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        data = json.loads(raw)
+        if isinstance(data, dict):
+            data = [data]
+        return [SLOSpec.from_dict(d) for d in data]
+    except (OSError, ValueError, TypeError) as e:
+        log.warning("ignoring malformed PIO_SLOS (%s)", e)
+        return []
+
+
+@dataclass
+class AlertStatus:
+    """One spec's live alert state + the numbers behind it."""
+
+    spec: SLOSpec
+    state: str = INACTIVE
+    since: Optional[float] = None        # entered current state at
+    pending_since: Optional[float] = None
+    clear_since: Optional[float] = None  # firing + non-breach streak start
+    fast_burn: Optional[float] = None
+    slow_burn: Optional[float] = None
+    fast_samples: float = 0.0
+    last_eval: Optional[float] = None
+    transitions: int = 0
+    # (t, fast_burn) ring for the dashboard sparkline
+    history: deque = field(default_factory=lambda: deque(maxlen=120))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "slo": self.spec.name,
+            "state": self.state,
+            "since": self.since,
+            "fast_burn": (
+                None if self.fast_burn is None
+                else round(self.fast_burn, 4)
+            ),
+            "slow_burn": (
+                None if self.slow_burn is None
+                else round(self.slow_burn, 4)
+            ),
+            "fast_samples": self.fast_samples,
+            "burn_threshold": self.spec.burn_threshold,
+            "error_budget": round(self.spec.budget, 6),
+            "transitions": self.transitions,
+            "last_eval": self.last_eval,
+            "spec": self.spec.to_dict(),
+        }
+
+
+class SLOEngine:
+    """Evaluates every spec against the TSDB on a fixed interval and
+    drives the alert state machines. `stop()` joins the thread."""
+
+    thread_name = "slo-engine"
+
+    def __init__(self, tsdb: TSDB, specs: list[SLOSpec],
+                 interval_s: float = 15.0,
+                 registry: Optional[MetricsRegistry] = None):
+        self.tsdb = tsdb
+        self.interval_s = max(0.05, float(interval_s))
+        self._lock = threading.Lock()
+        self._status: dict[str, AlertStatus] = {
+            s.name: AlertStatus(spec=s) for s in specs
+        }
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if registry is None:
+            from predictionio_tpu.obs.registry import get_default_registry
+
+            registry = get_default_registry()
+        self._firing_gauge = registry.gauge(
+            "alerts_firing", "SLO alerts currently firing (1) or not (0)",
+            ("slo",),
+        )
+
+    # -- spec management ---------------------------------------------------
+    def set_specs(self, specs: list[SLOSpec]) -> None:
+        with self._lock:
+            old = self._status
+            self._status = {
+                s.name: old.get(s.name) or AlertStatus(spec=s)
+                for s in specs
+            }
+            for name, st in self._status.items():
+                st.spec = next(s for s in specs if s.name == name)
+
+    def specs(self) -> list[SLOSpec]:
+        with self._lock:
+            return [st.spec for st in self._status.values()]
+
+    # -- error-rate math ---------------------------------------------------
+    def _error_fraction(
+        self, spec: SLOSpec, window_s: float, now: float
+    ) -> tuple[Optional[float], float]:
+        """(bad/total over the window, total). total < min_samples →
+        (None, total): not enough traffic to judge — the caller holds
+        state instead of flapping (and never divides by zero)."""
+        if spec.kind == "up":
+            pts: list[float] = []
+            for s in self.tsdb.matching("up", {"instance": spec.instance}):
+                pts.extend(
+                    v for _t, v in self.tsdb.points(s, window_s, now)
+                )
+            if len(pts) < max(1, spec.min_samples):
+                return None, float(len(pts))
+            return 1.0 - sum(pts) / len(pts), float(len(pts))
+        if spec.kind == "availability":
+            if spec.tenant:
+                name, match = (
+                    "tenant_requests_total", {"tenant": spec.tenant}
+                )
+
+                def is_bad(lbls: dict) -> bool:
+                    return lbls.get("outcome") == "error"
+            else:
+                name, match = (
+                    "http_requests_total",
+                    {"server": spec.server, "path": spec.route},
+                )
+
+                def is_bad(lbls: dict) -> bool:
+                    try:
+                        return int(lbls.get("status", "0")) >= 500
+                    except ValueError:
+                        return False
+            total = bad = 0.0
+            for s in self.tsdb.matching(name, match):
+                inc = self.tsdb.series_increase(s, window_s, now)
+                total += inc
+                if is_bad(s.labels_dict()):
+                    bad += inc
+            if total < max(1, spec.min_samples):
+                return None, total
+            return bad / total, total
+        # latency: good = requests under the threshold, via the sampled
+        # cumulative bucket counters (the smallest le ≥ threshold is the
+        # conservative good-bucket — same rounding PromQL applies)
+        if spec.tenant:
+            name = "tenant_serve_seconds_bucket"
+            cname = "tenant_serve_seconds_count"
+            match: dict = {"tenant": spec.tenant}
+        else:
+            name = "http_request_seconds_bucket"
+            cname = "http_request_seconds_count"
+            match = {"server": spec.server, "path": spec.route}
+        total = self.tsdb.increase(cname, match, window_s, now)
+        if total < max(1, spec.min_samples):
+            return None, total
+        threshold_s = spec.threshold_ms / 1000.0
+        best_le: Optional[float] = None
+        series_by_le: dict[float, Any] = {}
+        for s in self.tsdb.matching(name, match):
+            le_s = s.labels_dict().get("le", "")
+            try:
+                le = float("inf") if le_s == "+Inf" else float(le_s)
+            except ValueError:
+                continue
+            series_by_le.setdefault(le, []).append(s)
+            if le >= threshold_s and (best_le is None or le < best_le):
+                best_le = le
+        if best_le is None:
+            return None, total
+        good = sum(
+            self.tsdb.series_increase(s, window_s, now)
+            for s in series_by_le[best_le]
+        )
+        return max(0.0, 1.0 - good / total), total
+
+    def burn_rate(
+        self, spec: SLOSpec, window_s: float, now: Optional[float] = None
+    ) -> tuple[Optional[float], float]:
+        """(error_fraction / budget, samples) over the window."""
+        now = time.time() if now is None else now
+        frac, samples = self._error_fraction(spec, window_s, now)
+        if frac is None:
+            return None, samples
+        return frac / spec.budget, samples
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate_once(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            statuses = list(self._status.values())
+        for st in statuses:
+            spec = st.spec
+            fast, fast_n = self.burn_rate(spec, spec.fast_window_s, now)
+            slow, _ = self.burn_rate(spec, spec.window_s, now)
+            with self._lock:
+                st.fast_burn, st.slow_burn = fast, slow
+                st.fast_samples = fast_n
+                st.last_eval = now
+                st.history.append(
+                    (round(now, 3), None if fast is None else fast)
+                )
+                if fast is None or slow is None:
+                    # zero-traffic window: hold state (no flap), freeze
+                    # the resolve streak — silence is not health
+                    st.clear_since = None if st.state == FIRING else (
+                        st.clear_since
+                    )
+                    self._export_locked(st)
+                    continue
+                breach = (
+                    fast >= spec.burn_threshold
+                    and slow >= spec.burn_threshold
+                )
+                self._step_locked(st, breach, now)
+                self._export_locked(st)
+
+    def _step_locked(self, st: AlertStatus, breach: bool,
+                     now: float) -> None:
+        spec = st.spec
+
+        def goto(state: str) -> None:
+            st.state = state
+            st.since = now
+            st.transitions += 1
+
+        if st.state in (INACTIVE, RESOLVED):
+            if breach:
+                st.pending_since = now
+                goto(PENDING)
+        elif st.state == PENDING:
+            if not breach:
+                goto(INACTIVE)
+                st.pending_since = None
+            elif now - (st.pending_since or now) >= spec.for_s:
+                goto(FIRING)
+                st.clear_since = None
+        elif st.state == FIRING:
+            if breach:
+                st.clear_since = None
+            else:
+                if st.clear_since is None:
+                    st.clear_since = now
+                if now - st.clear_since >= spec.resolve_s:
+                    goto(RESOLVED)
+                    st.clear_since = None
+
+    def _export_locked(self, st: AlertStatus) -> None:
+        try:
+            self._firing_gauge.set(
+                1.0 if st.state == FIRING else 0.0, slo=st.spec.name
+            )
+        except Exception:
+            pass
+
+    # -- reading -----------------------------------------------------------
+    def status(self, name: str) -> Optional[AlertStatus]:
+        with self._lock:
+            return self._status.get(name)
+
+    def payload(self) -> dict[str, Any]:
+        """The `GET /alerts` body."""
+        with self._lock:
+            rows = [st.to_dict() for st in self._status.values()]
+        return {
+            "interval_s": self.interval_s,
+            "slos": rows,
+            "alerts": [
+                r for r in rows if r["state"] != INACTIVE
+            ],
+            "firing": [r["slo"] for r in rows if r["state"] == FIRING],
+        }
+
+    def history(self, name: str) -> list[tuple[float, Optional[float]]]:
+        with self._lock:
+            st = self._status.get(name)
+            return list(st.history) if st else []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=self.thread_name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:
+                log.exception("SLO evaluation pass failed; will retry")

@@ -1,0 +1,407 @@
+"""In-process time-series history: a fixed-capacity ring-buffer TSDB.
+
+PR 1-3 made every server scrapeable (`/metrics`, `/debug/traces`,
+`/debug/profile`) but all of it is point-in-time: nobody can answer
+"when did p99 start degrading" without an external Prometheus, which
+the reference deployment story never assumes. This module keeps a
+bounded window of history IN the process:
+
+- :class:`TSDB` — thread-safe map of (name, sorted label pairs) →
+  ring buffer of ``(epoch_seconds, value)`` points (a deque; O(1)
+  append, oldest point falls off at capacity). Series cardinality is
+  bounded by ``max_series`` — the same guard discipline as the metric
+  route labels: past the cap, NEW series are dropped and counted
+  (`dropped_series`) instead of growing without bound.
+- :class:`MetricsSampler` — a background thread that snapshots metric
+  families every ``interval_s``: counters and gauges land as their
+  cumulative/current values; histograms land as `_count`/`_sum`,
+  per-bucket cumulative `_bucket{le=}` series (the SLO engine's
+  latency math needs the exact bucket counters), and point-in-time
+  p50/p95/p99 gauges under a ``quantile`` label (the sparkline/CLI
+  view). Counter RATES are derived at query time, not sample time —
+  `rate()`/`increase()` walk the ring counter-reset-aware, so a
+  restarted server's counters don't produce negative spikes.
+
+The query API is deliberately tiny (range / rate / increase /
+quantile_over_time / latest); `GET /debug/tsdb` is a direct window
+onto it. Everything here is stdlib-only — the monitor plane must be
+importable by data-plane processes that never pay the jax import.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Iterable, Optional
+
+from predictionio_tpu.obs.registry import (
+    CounterFamily,
+    GaugeFamily,
+    HistogramFamily,
+    MetricFamily,
+)
+
+LabelPairs = tuple[tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[dict]) -> LabelPairs:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Series:
+    """One named+labeled series' ring of (t, value) points."""
+
+    __slots__ = ("name", "labels", "kind", "points")
+
+    def __init__(self, name: str, labels: LabelPairs, kind: str,
+                 capacity: int):
+        self.name = name
+        self.labels = labels
+        self.kind = kind
+        self.points: deque[tuple[float, float]] = deque(maxlen=capacity)
+
+    def labels_dict(self) -> dict[str, str]:
+        return dict(self.labels)
+
+
+def increase_of(points: Iterable[tuple[float, float]]) -> float:
+    """Counter increase across `points`, reset-aware: a drop between
+    consecutive samples means the process restarted and the counter
+    began again from zero, so the post-reset value IS the delta (the
+    standard Prometheus semantic). Gauge series shouldn't come here."""
+    total = 0.0
+    prev: Optional[float] = None
+    for _t, v in points:
+        if prev is not None:
+            total += (v - prev) if v >= prev else v
+        prev = v
+    return total
+
+
+def quantile_of(values: list[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    vs = sorted(values)
+    if len(vs) == 1:
+        return vs[0]
+    pos = min(max(q, 0.0), 1.0) * (len(vs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(vs) - 1)
+    frac = pos - lo
+    return vs[lo] + (vs[hi] - vs[lo]) * frac
+
+
+class TSDB:
+    """Thread-safe fixed-capacity ring-buffer time-series store."""
+
+    def __init__(self, capacity: int = 720, max_series: int = 4096):
+        self.capacity = max(2, int(capacity))
+        self.max_series = max(1, int(max_series))
+        self._lock = threading.Lock()
+        self._series: "OrderedDict[tuple[str, LabelPairs], Series]" = (
+            OrderedDict()
+        )
+        self.dropped_series = 0  # adds refused at the cardinality cap
+
+    # -- writing -----------------------------------------------------------
+    def add(self, name: str, labels: Optional[dict], value: float,
+            kind: str = "gauge", t: Optional[float] = None) -> bool:
+        """Append one point; returns False when the series would exceed
+        the cardinality cap (dropped + counted, never raises)."""
+        key = (name, _label_key(labels))
+        now = time.time() if t is None else t
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped_series += 1
+                    return False
+                series = self._series[key] = Series(
+                    name, key[1], kind, self.capacity
+                )
+            series.points.append((now, float(value)))
+        return True
+
+    # -- reading -----------------------------------------------------------
+    def _match_locked(self, name: str,
+                      match: Optional[dict]) -> list[Series]:
+        want = None if match is None else _label_key(match)
+        out = []
+        for (n, lbls), series in self._series.items():
+            if n != name:
+                continue
+            if want is not None and not set(want) <= set(lbls):
+                continue
+            out.append(series)
+        return out
+
+    def matching(self, name: str,
+                 match: Optional[dict] = None) -> list[Series]:
+        """Series named `name` whose labels are a superset of `match`."""
+        with self._lock:
+            return list(self._match_locked(name, match))
+
+    def points(self, series: Series, window_s: Optional[float] = None,
+               now: Optional[float] = None) -> list[tuple[float, float]]:
+        now = time.time() if now is None else now
+        with self._lock:
+            pts = list(series.points)
+        if window_s is None:
+            return pts
+        cutoff = now - window_s
+        return [(t, v) for t, v in pts if t >= cutoff]
+
+    def range(self, name: str, match: Optional[dict] = None,
+              window_s: Optional[float] = None,
+              now: Optional[float] = None) -> list[dict[str, Any]]:
+        """The `GET /debug/tsdb?name=` payload: every matching series
+        with its in-window points."""
+        return [
+            {
+                "name": s.name,
+                "labels": s.labels_dict(),
+                "kind": s.kind,
+                "points": [
+                    [round(t, 3), v]
+                    for t, v in self.points(s, window_s, now)
+                ],
+            }
+            for s in self.matching(name, match)
+        ]
+
+    def series_increase(self, series: Series,
+                        window_s: Optional[float] = None,
+                        now: Optional[float] = None) -> float:
+        """Counter-reset-aware increase of ONE series over the window.
+        The last sample BEFORE the window is the baseline: the counter's
+        value at the window edge is unobservable between ticks, and
+        without the baseline a window holding a single sample would
+        always read as zero increase (sparse-sample window-edge bug)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            pts = list(series.points)
+        if window_s is None:
+            return increase_of(pts)
+        cutoff = now - window_s
+        idx = 0
+        for idx, (t, _v) in enumerate(pts):
+            if t >= cutoff:
+                break
+        else:
+            return 0.0  # nothing in-window: no observable activity
+        windowed = pts[idx:]
+        if idx > 0:
+            windowed = [pts[idx - 1]] + windowed
+        return increase_of(windowed)
+
+    def increase(self, name: str, match: Optional[dict] = None,
+                 window_s: Optional[float] = None,
+                 now: Optional[float] = None) -> float:
+        """Counter-reset-aware increase summed over matching series."""
+        return sum(
+            self.series_increase(s, window_s, now)
+            for s in self.matching(name, match)
+        )
+
+    def rate(self, name: str, match: Optional[dict] = None,
+             window_s: float = 300.0,
+             now: Optional[float] = None) -> float:
+        """Per-second rate over the window (increase / window)."""
+        if window_s <= 0:
+            return 0.0
+        return self.increase(name, match, window_s, now) / window_s
+
+    def quantile_over_time(self, name: str, q: float,
+                           match: Optional[dict] = None,
+                           window_s: Optional[float] = None,
+                           now: Optional[float] = None) -> Optional[float]:
+        """Quantile of the sampled VALUES across the window (gauge
+        series — e.g. 'what was the p99-of-p99 over the last hour')."""
+        values: list[float] = []
+        for s in self.matching(name, match):
+            values.extend(v for _t, v in self.points(s, window_s, now))
+        return quantile_of(values, q)
+
+    def latest(self, name: str, match: Optional[dict] = None
+               ) -> Optional[float]:
+        best_t, best_v = None, None
+        for s in self.matching(name, match):
+            with self._lock:
+                pt = s.points[-1] if s.points else None
+            if pt is not None and (best_t is None or pt[0] > best_t):
+                best_t, best_v = pt
+        return best_v
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def summary(self, limit: int = 0) -> dict[str, Any]:
+        """The parameterless `GET /debug/tsdb` payload: one line per
+        series, no points (those come per-name)."""
+        with self._lock:
+            rows = [
+                {
+                    "name": s.name,
+                    "labels": s.labels_dict(),
+                    "kind": s.kind,
+                    "points": len(s.points),
+                    "last": s.points[-1][1] if s.points else None,
+                    "last_t": (
+                        round(s.points[-1][0], 3) if s.points else None
+                    ),
+                }
+                for s in self._series.values()
+            ]
+        rows.sort(key=lambda r: (r["name"], sorted(r["labels"].items())))
+        if limit:
+            rows = rows[:limit]
+        return {
+            "series": rows,
+            "series_count": self.series_count(),
+            "capacity": self.capacity,
+            "max_series": self.max_series,
+            "dropped_series": self.dropped_series,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self.dropped_series = 0
+
+
+# -- the in-process sampler --------------------------------------------------
+
+#: quantiles materialized per histogram child at each sample tick
+SAMPLED_QUANTILES: tuple[tuple[float, str], ...] = (
+    (0.5, "p50"), (0.95, "p95"), (0.99, "p99"),
+)
+
+
+def sample_families(tsdb: TSDB, families: Iterable[MetricFamily],
+                    extra_labels: Optional[dict] = None,
+                    now: Optional[float] = None) -> int:
+    """Snapshot metric families into the TSDB; returns points written.
+    Shared by the in-process sampler (extra_labels None) and anything
+    that wants to stamp a whole registry at once (tests, bench).
+
+    Duplicate (name, labels) series within one pass write ONCE (first
+    family wins): several servers in a process each mount the same
+    unlabeled jax/devprof gauges over one global source, and letting
+    each write per tick would interleave near-duplicate points."""
+    now = time.time() if now is None else now
+    extra = dict(extra_labels or {})
+    written = 0
+    seen: set[tuple[str, LabelPairs]] = set()
+
+    def put(name: str, labels: dict, value: float, kind: str) -> None:
+        nonlocal written
+        merged = {**labels, **extra}
+        key = (name, _label_key(merged))
+        if key in seen:
+            return
+        seen.add(key)
+        if tsdb.add(name, merged, value, kind, now):
+            written += 1
+
+    for fam in families:
+        if isinstance(fam, HistogramFamily):
+            with fam._lock:
+                items = [
+                    (dict(zip(fam.labelnames, lv)),
+                     list(c.bucket_counts), c.sum, c.count)
+                    for lv, c in fam._children.items()
+                ]
+            for labels, bucket_counts, total_sum, count in items:
+                put(fam.name + "_count", labels, count, "counter")
+                put(fam.name + "_sum", labels, total_sum, "counter")
+                cum = 0
+                for edge, n in zip(fam.buckets, bucket_counts):
+                    cum += n
+                    put(
+                        fam.name + "_bucket",
+                        {**labels, "le": repr(float(edge))},
+                        cum, "counter",
+                    )
+                put(
+                    fam.name + "_bucket",
+                    {**labels, "le": "+Inf"}, count, "counter",
+                )
+                for q, qname in SAMPLED_QUANTILES:
+                    put(
+                        fam.name,
+                        {**labels, "quantile": qname},
+                        fam.quantile(q, **labels), "gauge",
+                    )
+        elif isinstance(fam, GaugeFamily):
+            if fam.callback is not None:
+                put(fam.name, {}, fam.value(), "gauge")
+                continue
+            with fam._lock:
+                items = [
+                    (dict(zip(fam.labelnames, lv)), c.value)
+                    for lv, c in fam._children.items()
+                ]
+            for labels, value in items:
+                put(fam.name, labels, value, "gauge")
+        elif isinstance(fam, CounterFamily):
+            with fam._lock:
+                items = [
+                    (dict(zip(fam.labelnames, lv)), c.value)
+                    for lv, c in fam._children.items()
+                ]
+            for labels, value in items:
+                put(fam.name, labels, value, "counter")
+    return written
+
+
+class MetricsSampler:
+    """Background thread snapshotting `provider()`'s metric families
+    into the TSDB every `interval_s`. `stop()` joins the thread — the
+    no-leaked-threads contract every monitor thread follows."""
+
+    thread_name = "tsdb-sampler"
+
+    def __init__(self, tsdb: TSDB,
+                 provider: Callable[[], list[MetricFamily]],
+                 interval_s: float = 5.0):
+        self.tsdb = tsdb
+        self.provider = provider
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_once(self, now: Optional[float] = None) -> int:
+        try:
+            families = self.provider()
+        except Exception:
+            return 0
+        return sample_families(self.tsdb, families, now=now)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=self.thread_name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        # sample immediately so short-lived processes still get history
+        while True:
+            try:
+                self.sample_once()
+            except Exception:
+                pass  # a sampling hiccup must never kill the thread
+            if self._stop.wait(self.interval_s):
+                return

@@ -144,6 +144,12 @@ class SpanRecorder:
         # trace_id -> {"spans": [...], "reason": keep-reason}
         self._traces: "OrderedDict[str, dict]" = OrderedDict()
         self._bridges: dict[str, Callable[[Span], None]] = {}
+        # query-triggered capture (ISSUE 8 satellite): capture_id ->
+        # {"requested", "remaining", "trace_ids", ...}; the dispatcher
+        # consumes one "batch credit" per device batch and force-keeps
+        # that batch's traces regardless of the sample rate
+        self._captures: "OrderedDict[str, dict]" = OrderedDict()
+        self._forced: dict[str, str] = {}  # trace_id -> capture_id
 
     # -- recording ---------------------------------------------------------
     @contextmanager
@@ -223,7 +229,11 @@ class SpanRecorder:
                     self._active.popitem(last=False)
                 return
             spans = self._active.pop(sp.trace_id)
-            reason = self._keep_reason(spans)
+            forced_cap = self._forced.pop(sp.trace_id, None)
+            reason = (
+                f"capture:{forced_cap}" if forced_cap
+                else self._keep_reason(spans)
+            )
             if reason is None:
                 if sp.parent_span_id is not None:
                     # the finalizing span has a REMOTE parent: it roots
@@ -242,6 +252,10 @@ class SpanRecorder:
                         self._active.popitem(last=False)
                 return
             self._traces[sp.trace_id] = {"spans": spans, "reason": reason}
+            if forced_cap is not None:
+                cap = self._captures.get(forced_cap)
+                if cap is not None and sp.trace_id not in cap["trace_ids"]:
+                    cap["trace_ids"].append(sp.trace_id)
             while len(self._traces) > self.max_traces:
                 self._traces.popitem(last=False)
 
@@ -271,6 +285,81 @@ class SpanRecorder:
         newer server's bridge."""
         if observe is None or self._bridges.get(span_name) is observe:
             self._bridges.pop(span_name, None)
+
+    # -- query-triggered capture (ISSUE 8 satellite) -----------------------
+    def arm_capture(self, n_batches: int) -> str:
+        """Arm force-sampling for the next `n_batches` device batches:
+        the dispatcher calls `consume_capture()` per batch and
+        `force_keep()`s that batch's trace ids, so they are retained
+        with reason ``capture:<id>`` no matter what PIO_TRACE_SAMPLE
+        says. Returns the capture id for `?capture=<id>`."""
+        capture_id = new_span_id()[:8]
+        with self._lock:
+            self._captures[capture_id] = {
+                "id": capture_id,
+                "requested": int(n_batches),
+                "remaining": int(n_batches),
+                "trace_ids": [],
+                "created": time.time(),
+            }
+            while len(self._captures) > 16:
+                dropped_id, dropped = self._captures.popitem(last=False)
+                # an evicted armed capture must not leave dangling arms
+                self._forced = {
+                    tid: cid for tid, cid in self._forced.items()
+                    if cid != dropped_id
+                }
+        return capture_id
+
+    def consume_capture(self) -> Optional[str]:
+        """One batch credit off the oldest still-armed capture (None
+        when nothing is armed — the inert fast path is one dict check)."""
+        if not self._captures:
+            return None
+        with self._lock:
+            for capture_id, cap in self._captures.items():
+                if cap["remaining"] > 0:
+                    cap["remaining"] -= 1
+                    return capture_id
+        return None
+
+    def force_keep(self, trace_id: str, capture_id: str) -> None:
+        """Mark a trace for unconditional retention under `capture_id`.
+        A trace already retained joins the capture immediately."""
+        with self._lock:
+            cap = self._captures.get(capture_id)
+            if cap is None:
+                return
+            kept = self._traces.get(trace_id)
+            if kept is not None:
+                if trace_id not in cap["trace_ids"]:
+                    cap["trace_ids"].append(trace_id)
+                return
+            self._forced[trace_id] = capture_id
+            # bound the pending map: a capture whose traces never
+            # finalize (handler crash) must not grow it forever
+            while len(self._forced) > 4 * self.max_spans_per_trace:
+                self._forced.pop(next(iter(self._forced)))
+
+    def capture_status(self, capture_id: str) -> Optional[dict]:
+        """The `GET /debug/traces?capture=<id>` body: the capture
+        record plus summaries of its retained traces."""
+        with self._lock:
+            cap = self._captures.get(capture_id)
+            if cap is None:
+                return None
+            cap = dict(cap, trace_ids=list(cap["trace_ids"]))
+        all_summaries = {
+            s["trace_id"]: s for s in self.summaries(limit=0)
+        }
+        return {
+            "capture": cap,
+            "done": cap["remaining"] == 0,
+            "traces": [
+                all_summaries[tid] for tid in cap["trace_ids"]
+                if tid in all_summaries
+            ],
+        }
 
     # -- reading -----------------------------------------------------------
     def get_trace(self, trace_id: str) -> list[Span]:
@@ -372,6 +461,8 @@ class SpanRecorder:
         with self._lock:
             self._active.clear()
             self._traces.clear()
+            self._captures.clear()
+            self._forced.clear()
 
 
 _default_recorder: Optional[SpanRecorder] = None

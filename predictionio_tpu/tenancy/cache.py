@@ -32,12 +32,71 @@ class ModelLoadError(RuntimeError):
     """The tenant's model could not be resolved or built."""
 
 
+def estimate_runtime_device_bytes(runtime: Any) -> float:
+    """Measured RESIDENT device bytes of one runtime: the model
+    arrays' own nbytes — what actually sits in HBM between queries.
+    Entry count is a poor proxy when one tenant serves a 10k-item
+    catalog and another 10M; bytes are what the HBM budget actually
+    constrains. (The serving dispatch's transient working set is
+    accounted ONCE against the budget by the cache — dispatches are
+    request-serialized, so folding it into every entry would charge
+    it N-fold.)"""
+    total = 0.0
+    seen: set[int] = set()
+
+    def walk(x: Any) -> None:
+        nonlocal total
+        if id(x) in seen:
+            return
+        seen.add(id(x))
+        n = getattr(x, "nbytes", None)
+        if isinstance(n, (int, float)):
+            total += float(n)
+            return
+        if isinstance(x, dict):
+            for v in x.values():
+                walk(v)
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+        else:
+            d = getattr(x, "__dict__", None)
+            if d is not None:
+                for v in d.values():
+                    walk(v)
+
+    for model in getattr(runtime, "models", ()) or ():
+        walk(model)
+    return total
+
+
+def serving_transient_bytes() -> float:
+    """The largest out+temp working set devprof's `memory_analysis`
+    measured for any profiled serving executable — the HBM a dispatch
+    needs ON TOP of the resident model state. Read at eviction time
+    (not load time) so it reflects the profiles gathered so far."""
+    try:
+        from predictionio_tpu.obs.devprof import get_profiler
+
+        transient = 0.0
+        for row in get_profiler().report().get("executables", ()):
+            if row.get("memory_analysis_ok"):
+                transient = max(
+                    transient,
+                    float(row.get("output_bytes") or 0.0)
+                    + float(row.get("temp_bytes") or 0.0),
+                )
+        return transient
+    except Exception:
+        return 0.0  # profiling absent/broken must never break eviction
+
+
 class CacheEntry:
     """One resident tenant runtime."""
 
     __slots__ = (
         "tenant_id", "version_key", "runtime", "refs", "pinned",
-        "last_used", "loaded_at",
+        "last_used", "loaded_at", "device_bytes",
     )
 
     def __init__(self, tenant_id: str, version_key: str, runtime: Any):
@@ -48,6 +107,7 @@ class CacheEntry:
         self.pinned = False
         self.last_used = time.monotonic()
         self.loaded_at = time.monotonic()
+        self.device_bytes = 0.0
 
 
 class ModelCache:
@@ -58,10 +118,21 @@ class ModelCache:
         storage,
         capacity: int = 4,
         build: Optional[Callable[[Any], Any]] = None,
+        hbm_bytes: Optional[float] = None,
+        measure: Optional[Callable[[Any], float]] = None,
+        transient: Optional[Callable[[], float]] = None,
     ):
         self.storage = storage
         self.capacity = max(1, int(capacity))
         self._build_fn = build
+        # HBM-aware capacity (ISSUE 8 satellite): with `hbm_bytes` set
+        # (PIO_TENANT_CACHE_HBM_BYTES via the mux) eviction is driven by
+        # cumulative measured device bytes instead of entry count — LRU
+        # victims go until resident + one dispatch's transient working
+        # set fit the budget
+        self.hbm_bytes = float(hbm_bytes) if hbm_bytes else None
+        self._measure = measure or estimate_runtime_device_bytes
+        self._transient = transient or serving_transient_bytes
         self._lock = threading.Lock()
         self._entries: dict[str, CacheEntry] = {}
         # per-tenant build locks: a slow model load must serialize the
@@ -146,9 +217,11 @@ class ModelCache:
                 raise ModelLoadError(
                     f"tenant {tenant.id!r} model load failed: {e}"
                 ) from e
+            nbytes = self._measure_safe(runtime)
             with self._lock:
                 entry = CacheEntry(tenant.id, version_key, runtime)
                 entry.refs = 1
+                entry.device_bytes = nbytes
                 self._entries[tenant.id] = entry
                 self._seen.add(tenant.id)
                 self._evict_locked()
@@ -187,9 +260,11 @@ class ModelCache:
         """Swap in an already-built runtime (rollout promote: the baked
         candidate becomes the tenant's resident entry; the old runtime
         drains as its in-flight leases release)."""
+        nbytes = self._measure_safe(runtime)
         with self._lock:
             old = self._entries.get(tenant_id)
             entry = CacheEntry(tenant_id, version_key, runtime)
+            entry.device_bytes = nbytes
             if old is not None:
                 entry.pinned = old.pinned
             self._entries[tenant_id] = entry
@@ -244,8 +319,39 @@ class ModelCache:
         return refreshed
 
     # -- eviction -----------------------------------------------------------
+    def _measure_safe(self, runtime: Any) -> float:
+        if self.hbm_bytes is None:
+            return 0.0
+        try:
+            return float(self._measure(runtime))
+        except Exception:
+            log.exception("runtime device-bytes measurement failed")
+            return 0.0
+
+    def resident_bytes(self) -> float:
+        with self._lock:
+            return sum(e.device_bytes for e in self._entries.values())
+
+    def _over_capacity_locked(self) -> bool:
+        if self.hbm_bytes is not None:
+            # bytes replace entry count: hold as many tenants as the
+            # HBM budget fits, reserving ONE dispatch's transient
+            # working set (dispatches are request-serialized, so it's
+            # shared, not per-entry) — but never evict down to an empty
+            # cache (one oversized model must still serve,
+            # soft-over-budget)
+            try:
+                transient = float(self._transient())
+            except Exception:
+                transient = 0.0
+            return len(self._entries) > 1 and (
+                sum(e.device_bytes for e in self._entries.values())
+                + transient > self.hbm_bytes
+            )
+        return len(self._entries) > self.capacity
+
     def _evict_locked(self) -> None:
-        while len(self._entries) > self.capacity:
+        while self._over_capacity_locked():
             victims = [
                 e for e in self._entries.values()
                 if e.refs == 0 and not e.pinned
@@ -265,6 +371,10 @@ class ModelCache:
             lookups = self.hits + self.misses
             return {
                 "capacity": self.capacity,
+                "hbm_bytes": self.hbm_bytes,
+                "resident_bytes": sum(
+                    e.device_bytes for e in self._entries.values()
+                ),
                 "resident": len(self._entries),
                 "hits": self.hits,
                 "misses": self.misses,
@@ -276,6 +386,7 @@ class ModelCache:
                         "version": e.version_key,
                         "refs": e.refs,
                         "pinned": e.pinned,
+                        "bytes": e.device_bytes,
                         "idle_s": round(
                             time.monotonic() - e.last_used, 1
                         ),

@@ -100,6 +100,7 @@ class TenantMux:
         storage,
         metrics=None,
         cache_capacity: Optional[int] = None,
+        cache_hbm_bytes: Optional[float] = None,
         refresh_s: Optional[float] = None,
         sync_s: Optional[float] = None,
         label_max: Optional[int] = None,
@@ -114,6 +115,14 @@ class TenantMux:
                 cache_capacity
                 if cache_capacity is not None
                 else env_float("PIO_TENANT_CACHE_SIZE", 4)
+            ),
+            # HBM-aware capacity (ISSUE 8 satellite): a byte budget
+            # replaces the entry count when set — 0/unset keeps the
+            # count-based bound
+            hbm_bytes=(
+                cache_hbm_bytes
+                if cache_hbm_bytes is not None
+                else (env_float("PIO_TENANT_CACHE_HBM_BYTES", 0) or None)
             ),
         )
         self.quota = QuotaEnforcer()

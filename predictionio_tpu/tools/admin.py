@@ -75,8 +75,12 @@ class _Handler(JsonHandler):
                 self._respond(200, {"status": "alive"})
             elif path == "/metrics":
                 self._serve_metrics()
+            elif path == "/alerts":
+                self._serve_alerts()
             elif path == "/debug/traces":
                 self._serve_debug_traces()
+            elif path == "/debug/tsdb":
+                self._serve_debug_tsdb()
             elif path == "/debug/profile":
                 self._serve_debug_profile()
             elif path == "/debug/faults":

@@ -352,7 +352,10 @@ def cmd_dashboard(args) -> int:
     from predictionio_tpu.tools.dashboard import Dashboard
 
     return _serve_until_interrupt(
-        Dashboard(_storage(), ip=args.ip, port=args.port),
+        Dashboard(
+            _storage(), ip=args.ip, port=args.port,
+            monitor_targets=getattr(args, "monitor_targets", None),
+        ),
         f"[INFO] Dashboard is listening at http://{args.ip}:{{port}}.",
     )
 
@@ -432,13 +435,20 @@ def cmd_metrics(args) -> int:
     return 0
 
 
-def _fetch_debug_traces(url: str, params: str = "") -> dict:
+def _fetch_json(url: str, path: str, timeout: float = 10.0) -> dict:
+    """GET a server JSON surface: the one fetch helper every remote
+    (`--url`) subcommand shares."""
     import json as _json
     import urllib.request
 
-    full = url.rstrip("/") + "/debug/traces" + (f"?{params}" if params else "")
-    with urllib.request.urlopen(full, timeout=10) as r:
+    with urllib.request.urlopen(url.rstrip("/") + path, timeout=timeout) as r:
         return _json.loads(r.read().decode())
+
+
+def _fetch_debug_traces(url: str, params: str = "") -> dict:
+    return _fetch_json(
+        url, "/debug/traces" + (f"?{params}" if params else "")
+    )
 
 
 def _print_span_tree(spans: list[dict]) -> None:
@@ -541,12 +551,7 @@ def cmd_trace(args) -> int:
 
 
 def _fetch_profile(url: str) -> dict:
-    import json as _json
-    import urllib.request
-
-    full = url.rstrip("/") + "/debug/profile"
-    with urllib.request.urlopen(full, timeout=10) as r:
-        return _json.loads(r.read().decode())
+    return _fetch_json(url, "/debug/profile")
 
 
 def cmd_profile(args) -> int:
@@ -718,6 +723,175 @@ def cmd_faults(args) -> int:
         return 0
     faults.clear(point)
     _print(faults.specs())
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# monitoring plane (ISSUE 8): monitor / alerts / tsdb
+# ---------------------------------------------------------------------------
+
+
+def cmd_monitor(args) -> int:
+    """`pio monitor` — a standalone fleet-aggregation process: scrape
+    the configured targets' /metrics into the in-process TSDB, run the
+    SLO engine over it, and print the fleet + alert state each pass."""
+    import os
+    import time as _time
+
+    from predictionio_tpu.obs.monitor import (
+        FleetScraper,
+        SLOEngine,
+        get_monitor,
+        load_slos,
+        parse_targets,
+    )
+
+    targets = parse_targets(
+        args.targets or os.environ.get("PIO_MONITOR_TARGETS", "")
+    )
+    if not targets:
+        return _fail(
+            "no scrape targets: pass --targets name=url[,name=url] or "
+            "set PIO_MONITOR_TARGETS"
+        )
+    monitor = get_monitor()
+    scraper = FleetScraper(
+        monitor.tsdb, targets, interval_s=args.interval
+    )
+    specs = load_slos(args.slos) if args.slos else load_slos()
+    engine = None
+    if specs:
+        engine = SLOEngine(
+            monitor.tsdb, specs, interval_s=max(args.interval, 1.0)
+        )
+    deadline = (
+        _time.monotonic() + args.duration if args.duration else None
+    )
+    try:
+        while True:
+            ups = scraper.scrape_once()
+            if engine is not None:
+                engine.evaluate_once()
+            stamp = _time.strftime("%H:%M:%S")
+            fleet = " ".join(
+                f"{inst}={'up' if ok else 'DOWN'}"
+                for inst, ok in sorted(ups.items())
+            )
+            print(f"[INFO] {stamp} fleet: {fleet}")
+            if engine is not None:
+                for row in engine.payload()["slos"]:
+                    fast = row["fast_burn"]
+                    print(
+                        f"[INFO]   slo {row['slo']}: {row['state']} "
+                        f"(fast burn "
+                        f"{'-' if fast is None else f'{fast:.2f}'} / "
+                        f"threshold {row['burn_threshold']})"
+                    )
+            if deadline is not None and _time.monotonic() >= deadline:
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_alerts(args) -> int:
+    """`pio alerts list|show` — SLO alert states of this process, or a
+    running server via --url (its GET /alerts)."""
+    from predictionio_tpu.obs.monitor import get_monitor
+
+    url = getattr(args, "url", None)
+    payload = (
+        _fetch_json(url, "/alerts") if url
+        else get_monitor().alerts_payload()
+    )
+    rows = payload.get("slos", [])
+    if args.alerts_action == "list":
+        if not rows:
+            print(
+                "[INFO] no SLOs configured "
+                f"({payload.get('message', 'set PIO_SLOS')})"
+            )
+            return 0
+        print(f"[INFO] {len(rows)} SLO(s), firing: "
+              f"{payload.get('firing') or 'none'}")
+        for r in rows:
+            fast, slow = r.get("fast_burn"), r.get("slow_burn")
+            print(
+                f"[INFO]   {r['slo']}: {r['state']}  fast="
+                f"{'-' if fast is None else f'{fast:.2f}'} slow="
+                f"{'-' if slow is None else f'{slow:.2f}'} "
+                f"threshold={r.get('burn_threshold')} "
+                f"samples={r.get('fast_samples')}"
+            )
+        return 0
+    row = next((r for r in rows if r["slo"] == args.name), None)
+    if row is None:
+        return _fail(f"no SLO {args.name!r}")
+    print(f"[INFO] {row['slo']}:")
+    for k, v in row.items():
+        if k != "slo":
+            print(f"[INFO]   {k}: {v}")
+    return 0
+
+
+def cmd_tsdb(args) -> int:
+    """`pio tsdb query` — the in-process time-series history of this
+    process, or a running server via --url (its GET /debug/tsdb)."""
+    from predictionio_tpu.obs.monitor import get_monitor
+
+    url = getattr(args, "url", None)
+    qs: dict = {}
+    if args.name:
+        qs["name"] = args.name
+    if args.labels:
+        qs["labels"] = args.labels
+    if args.window is not None:
+        qs["window_s"] = str(args.window)
+    if args.agg:
+        qs["agg"] = args.agg
+        if args.q is not None:
+            qs["q"] = str(args.q)
+    if url:
+        from urllib.parse import urlencode
+
+        payload = _fetch_json(
+            url, "/debug/tsdb" + (f"?{urlencode(qs)}" if qs else "")
+        )
+    else:
+        payload = get_monitor().tsdb_payload(qs)
+    if not payload.get("enabled", True):
+        print("[INFO] monitoring disabled (PIO_TSDB=0)")
+        return 0
+    if "value" in payload:
+        print(
+            f"[INFO] {payload['agg']}({payload['name']}"
+            + (f", window={payload.get('window_s')}s" if payload.get(
+                "window_s") else "")
+            + f") = {payload['value']}"
+        )
+        return 0
+    series = payload.get("series", [])
+    if not args.name:
+        print(
+            f"[INFO] {payload.get('series_count', len(series))} series "
+            f"(capacity {payload.get('capacity')} pts, "
+            f"{payload.get('dropped_series', 0)} dropped at the "
+            "cardinality cap)"
+        )
+        for s in series:
+            labels = ",".join(f"{k}={v}" for k, v in s["labels"].items())
+            where = f"{s['name']}{{{labels}}}" if labels else s["name"]
+            print(
+                f"[INFO]   {where} [{s['kind']}] {s['points']} pts "
+                f"last={s['last']}"
+            )
+        return 0
+    for s in series:
+        labels = ",".join(f"{k}={v}" for k, v in s["labels"].items())
+        where = f"{s['name']}{{{labels}}}" if labels else s["name"]
+        print(f"[INFO] {where} [{s['kind']}] {len(s['points'])} pts:")
+        for t, v in s["points"][-(args.last or len(s["points"])):]:
+            print(f"[INFO]   {t:.3f}  {v:g}")
     return 0
 
 
@@ -1282,7 +1456,16 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ip", default="0.0.0.0")
     s.add_argument("--port", type=int, default=7071)
     s.set_defaults(func=cmd_adminserver)
-    s = sub.add_parser("dashboard", help="run the evaluation dashboard")
+    s = sub.add_parser(
+        "dashboard",
+        help="run the evaluation dashboard (+ fleet monitor panels "
+             "when scrape targets are configured)",
+    )
+    s.add_argument(
+        "--monitor-targets", dest="monitor_targets", default=None,
+        help="fleet scrape targets instance=url[,...] "
+             "(default: PIO_MONITOR_TARGETS)",
+    )
     s.add_argument("--ip", default="0.0.0.0")
     s.add_argument("--port", type=int, default=9000)
     s.set_defaults(func=cmd_dashboard)
@@ -1384,6 +1567,63 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fault point to clear (default: all)")
     fc.add_argument("--url", help="server base URL")
     fc.set_defaults(func=cmd_faults)
+
+    # monitoring plane (ISSUE 8): monitor / alerts / tsdb
+    s = sub.add_parser(
+        "monitor",
+        help="standalone fleet monitor: scrape /metrics from a target "
+             "list into the TSDB and run SLO burn-rate alerting",
+    )
+    s.add_argument(
+        "--targets", default=None,
+        help="instance=url[,instance=url] (default: PIO_MONITOR_TARGETS)",
+    )
+    s.add_argument("--interval", type=float, default=10.0,
+                   help="scrape/evaluate period in seconds")
+    s.add_argument("--duration", type=float, default=None,
+                   help="stop after this many seconds (default: forever)")
+    s.add_argument(
+        "--slos", default=None,
+        help="SLO specs: JSON array or @/path.json (default: PIO_SLOS)",
+    )
+    s.set_defaults(func=cmd_monitor)
+
+    s = sub.add_parser(
+        "alerts",
+        help="SLO alert states (local engine, or a server via --url)",
+    )
+    asub = s.add_subparsers(dest="alerts_action", required=True)
+    al = asub.add_parser("list", help="list SLOs with their alert state")
+    al.add_argument("--url", help="server base URL, e.g. http://127.0.0.1:8000")
+    al.set_defaults(func=cmd_alerts)
+    ao = asub.add_parser("show", help="one SLO's full status")
+    ao.add_argument("name")
+    ao.add_argument("--url", help="server base URL")
+    ao.set_defaults(func=cmd_alerts)
+
+    s = sub.add_parser(
+        "tsdb",
+        help="query the in-process time-series history (local, or a "
+             "server via --url)",
+    )
+    dsub = s.add_subparsers(dest="tsdb_action", required=True)
+    dq = dsub.add_parser(
+        "query", help="list series, or one series' points/aggregates"
+    )
+    dq.add_argument("--name", default=None,
+                    help="series name (omit to list all)")
+    dq.add_argument("--labels", default=None,
+                    help="label filter, k:v[,k:v...]")
+    dq.add_argument("--window", type=float, default=None,
+                    help="window seconds (default: full ring)")
+    dq.add_argument("--agg", choices=("rate", "increase", "quantile"),
+                    default=None)
+    dq.add_argument("--q", type=float, default=None,
+                    help="quantile for --agg quantile (default 0.99)")
+    dq.add_argument("--last", type=int, default=20,
+                    help="points to print per series")
+    dq.add_argument("--url", help="server base URL")
+    dq.set_defaults(func=cmd_tsdb)
 
     # model lifecycle (ISSUE 5): jobs / models / rollout
     s = sub.add_parser(

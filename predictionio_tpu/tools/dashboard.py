@@ -30,8 +30,12 @@ class _Handler(JsonHandler):
                 self._respond(200, self._index(), "text/html")
             elif path == "/metrics":
                 self._serve_metrics()
+            elif path == "/alerts":
+                self._serve_alerts()
             elif path == "/debug/traces":
                 self._serve_debug_traces()
+            elif path == "/debug/tsdb":
+                self._serve_debug_tsdb()
             elif path == "/debug/profile":
                 self._serve_debug_profile()
             elif path == "/debug/faults":
@@ -92,9 +96,114 @@ class _Handler(JsonHandler):
 <tr><th>ID</th><th>Started</th><th>Evaluation</th><th>Result</th><th>Reports</th></tr>
 {rows}
 </table>
+{self._alerts_html()}
+{self._fleet_html()}
 {self._lifecycle_html()}
 {self._tenants_html()}
 </body></html>"""
+
+    # -- monitoring plane (ISSUE 8) ----------------------------------------
+    _SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+    @classmethod
+    def _sparkline(cls, values: list, width: int = 40) -> str:
+        """Unicode-block sparkline of the last `width` values (None →
+        gap). Scaled to the window's max so shape survives any unit."""
+        vals = [v for v in values[-width:]]
+        nums = [v for v in vals if v is not None]
+        if not nums:
+            return ""
+        top = max(nums) or 1.0
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(" ")
+            else:
+                idx = min(
+                    len(cls._SPARK_BLOCKS) - 1,
+                    int(max(0.0, v) / top * (len(cls._SPARK_BLOCKS) - 1)),
+                )
+                out.append(cls._SPARK_BLOCKS[idx])
+        return "".join(out)
+
+    def _alerts_html(self) -> str:
+        """Alerts panel: per-SLO state with a fast-burn-rate sparkline
+        (history from the engine) — "is the error budget burning" at a
+        glance. SLO names are operator-authored, so escaped."""
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        monitor = get_monitor()
+        engine = monitor.engine
+        payload = monitor.alerts_payload()
+        if not payload.get("slos"):
+            return (
+                "<h1>Alerts</h1><p>(no SLOs configured — set PIO_SLOS "
+                "or use Monitor.set_slos)</p>"
+            )
+        color = {
+            "firing": "#c00", "pending": "#c80",
+            "resolved": "#080", "inactive": "#888",
+        }
+        rows = []
+        for r in payload["slos"]:
+            name = r["slo"]
+            spark = ""
+            if engine is not None:
+                spark = self._sparkline(
+                    [v for _t, v in engine.history(name)]
+                )
+            fast = r.get("fast_burn")
+            slow = r.get("slow_burn")
+            state = r.get("state", "inactive")
+            rows.append(
+                f"<tr><td>{html.escape(name)}</td>"
+                f"<td style='color:{color.get(state, '#000')}'>"
+                f"<b>{html.escape(state)}</b></td>"
+                f"<td>{'-' if fast is None else f'{fast:.2f}'}</td>"
+                f"<td>{'-' if slow is None else f'{slow:.2f}'}</td>"
+                f"<td>{r.get('burn_threshold')}</td>"
+                f"<td><code>{html.escape(spark)}</code></td></tr>"
+            )
+        return f"""<h1>Alerts</h1>
+<table border="1" cellpadding="4">
+<tr><th>SLO</th><th>State</th><th>Fast burn</th><th>Slow burn</th>
+<th>Threshold</th><th>Burn history</th></tr>
+{''.join(rows)}
+</table>"""
+
+    def _fleet_html(self) -> str:
+        """Fleet panel: per-scrape-target up/latency with an `up`
+        sparkline — a dead server is visible without leaving the page."""
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        scraper = getattr(self.server, "fleet_scraper", None)
+        if scraper is None:
+            return ""
+        tsdb = get_monitor().tsdb
+        rows = []
+        for t in scraper.status():
+            ups = []
+            for s in tsdb.matching("up", {"instance": t["instance"]}):
+                ups = [v for _t, v in tsdb.points(s)]
+            up = t["up"]
+            state = (
+                "?" if up is None else ("up" if up else "DOWN")
+            )
+            lat = t["scrape_seconds"]
+            rows.append(
+                f"<tr><td>{html.escape(t['instance'])}</td>"
+                f"<td>{html.escape(t['url'])}</td>"
+                f"<td><b>{state}</b></td>"
+                f"<td>{'-' if lat is None else f'{lat * 1e3:.1f} ms'}</td>"
+                f"<td><code>{html.escape(self._sparkline(ups))}</code>"
+                f"</td></tr>"
+            )
+        return f"""<h1>Fleet</h1>
+<table border="1" cellpadding="4">
+<tr><th>Instance</th><th>URL</th><th>Up</th><th>Scrape</th>
+<th>Up history</th></tr>
+{''.join(rows)}
+</table>"""
 
     def _lifecycle_html(self) -> str:
         """Model-lifecycle panel (ISSUE 5): versions newest-first with
@@ -178,14 +287,59 @@ class _Server(ThreadedServer):
 
 
 class Dashboard(ServerProcess):
+    """The fleet aggregation point (ISSUE 8): when scrape targets are
+    configured (constructor arg or PIO_MONITOR_TARGETS), a FleetScraper
+    feeds every target's /metrics into the process TSDB under an
+    `instance` label, and the index page grows Alerts + Fleet panels."""
+
     _name = "dashboard"
 
     def __init__(self, storage: Optional[Storage] = None, ip: str = "0.0.0.0",
-                 port: int = 9000):
+                 port: int = 9000,
+                 monitor_targets: Optional[str] = None,
+                 scrape_interval_s: Optional[float] = None):
+        import os
+
         super().__init__()
         self.storage = storage or Storage.get_instance()
         self.ip = ip
         self.port_config = port
+        self.monitor_targets = (
+            monitor_targets if monitor_targets is not None
+            else os.environ.get("PIO_MONITOR_TARGETS", "")
+        )
+        self.scrape_interval_s = scrape_interval_s
+        self._scraper = None
 
     def _make_server(self) -> _Server:
         return _Server((self.ip, self.port_config), self.storage)
+
+    def start(self) -> int:
+        from predictionio_tpu.obs.monitor import (
+            FleetScraper,
+            enabled,
+            get_monitor,
+            parse_targets,
+        )
+        from predictionio_tpu.utils.env import env_float
+
+        port = super().start()
+        targets = parse_targets(self.monitor_targets)
+        if targets and enabled():
+            self._scraper = FleetScraper(
+                get_monitor().tsdb, targets,
+                interval_s=(
+                    self.scrape_interval_s
+                    if self.scrape_interval_s is not None
+                    else env_float("PIO_SCRAPE_INTERVAL_S", 10.0)
+                ),
+            )
+            self._scraper.start()
+            self._server.fleet_scraper = self._scraper  # type: ignore
+        return port
+
+    def stop(self) -> None:
+        if self._scraper is not None:
+            self._scraper.stop()  # joins the scrape thread
+            self._scraper = None
+        super().stop()

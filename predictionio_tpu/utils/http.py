@@ -223,6 +223,16 @@ class JsonHandler(BaseHTTPRequestHandler):
 
         qs = dict(parse_qsl(urlsplit(self.path).query))
         recorder = _obs_spans.get_default_recorder()
+        capture_id = qs.get("capture")
+        if capture_id:
+            cap = recorder.capture_status(capture_id)
+            if cap is None:
+                self._respond(
+                    404, {"message": f"no capture {capture_id}"}
+                )
+                return
+            self._respond(200, cap)
+            return
         trace_id = qs.get("trace_id")
         if qs.get("format") == "perfetto":
             # with trace_id: that one trace; without: every retained one
@@ -268,6 +278,46 @@ class JsonHandler(BaseHTTPRequestHandler):
             "traces": summaries,
             "sampling": recorder.config(),
         })
+
+    def _serve_debug_tsdb(self) -> None:
+        """GET /debug/tsdb — the in-process time-series history (ISSUE
+        8): no params lists series; `?name=` returns points, with
+        optional `labels=k:v,...`, `window_s=`, and
+        `agg=rate|increase|quantile&q=`. Every JsonHandler server
+        mounts this next to /metrics."""
+        from urllib.parse import parse_qsl, urlsplit
+
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        qs = dict(parse_qsl(urlsplit(self.path).query))
+        self._respond(200, get_monitor().tsdb_payload(qs))
+
+    def _serve_alerts(self) -> None:
+        """GET /alerts — the SLO engine's alert states (ISSUE 8):
+        pending/firing/resolved per declared SLO, with live burn
+        rates. Mounted on the query, admin, and dashboard servers."""
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        self._respond(200, get_monitor().alerts_payload())
+
+    def _serve_traces_capture(self) -> None:
+        """POST /debug/traces/capture {"n": N} — arm the span recorder
+        so the dispatcher force-samples the next N batches' traces
+        regardless of PIO_TRACE_SAMPLE (ISSUE 8 satellite, the PR-3
+        follow-up). Returns a capture id for
+        `GET /debug/traces?capture=<id>`. The query server routes this
+        — it owns the dispatcher that consumes the arm."""
+        body = self._json_body()
+        n = 1
+        if isinstance(body, dict) and "n" in body:
+            try:
+                n = int(body["n"])
+            except (TypeError, ValueError):
+                raise HttpError(400, "'n' must be an integer")
+        if not 1 <= n <= 64:
+            raise HttpError(400, "'n' must be in [1, 64]")
+        capture_id = _obs_spans.get_default_recorder().arm_capture(n)
+        self._respond(200, {"capture": capture_id, "batches": n})
 
     def _serve_debug_profile(self) -> None:
         """GET /debug/profile — the device-profiling report: per-
@@ -413,6 +463,7 @@ class ServerProcess:
     def __init__(self):
         self._server: Optional[ThreadedServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._monitor_token: Optional[int] = None
 
     def _make_server(self) -> ThreadedServer:
         raise NotImplementedError
@@ -428,6 +479,17 @@ class ServerProcess:
             target=self._server.serve_forever, name=self._name, daemon=True
         )
         self._thread.start()
+        # monitoring plane (ISSUE 8): register this server's registry
+        # with the process monitor — the TSDB sampler starts with the
+        # first attached server and joins when the last one stops
+        registry = getattr(self._server, "metrics", None)
+        if registry is not None and self._monitor_token is None:
+            from predictionio_tpu.obs.monitor import get_monitor
+
+            self._monitor_token = get_monitor().attach(
+                getattr(self._server, "metrics_label", self._name),
+                registry,
+            )
         # remote log shipping (reference CreateServer.scala:441-452
         # --log-url): any server whose config carries log_url ships the
         # framework's log records to the collector
@@ -443,6 +505,11 @@ class ServerProcess:
         return self.port
 
     def stop(self) -> None:
+        if self._monitor_token is not None:
+            from predictionio_tpu.obs.monitor import get_monitor
+
+            get_monitor().detach(self._monitor_token)
+            self._monitor_token = None
         shipper = getattr(self, "_log_shipper", None)
         if shipper is not None:
             import logging

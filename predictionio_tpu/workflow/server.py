@@ -184,8 +184,12 @@ class _Handler(JsonHandler):
                 self._tenants_get(path)
             elif path == "/metrics":
                 self._serve_metrics()
+            elif path == "/alerts":
+                self._serve_alerts()
             elif path == "/debug/traces":
                 self._serve_debug_traces()
+            elif path == "/debug/tsdb":
+                self._serve_debug_tsdb()
             elif path == "/debug/profile":
                 self._serve_debug_profile()
             elif path == "/debug/faults":
@@ -253,6 +257,19 @@ class _Handler(JsonHandler):
             except Exception as e:
                 log.exception("rollout request failed")
                 self._respond(500, {"message": str(e)})
+        elif path == "/debug/traces/capture":
+            # arm "trace the next N batches" (ISSUE 8 satellite): only
+            # meaningful where a dispatcher exists to consume the arm
+            try:
+                if self.server.owner.dispatcher is None:
+                    self._respond(409, {
+                        "message": "micro-batching is disabled: no "
+                                   "dispatcher to capture batches from"
+                    })
+                else:
+                    self._serve_traces_capture()
+            except _HttpError as e:
+                self._respond(e.status, {"message": e.message})
         elif path == "/debug/profile/capture":
             try:
                 self._serve_profile_capture()
@@ -679,6 +696,15 @@ class _BatchDispatcher:
         now_wall = time.time()
         registry = getattr(self.owner, "metrics", None)
         recorder = _spans.get_default_recorder()
+        # query-triggered capture (ISSUE 8 satellite): an armed
+        # POST /debug/traces/capture spends one batch credit here and
+        # force-retains every trace riding this batch — the operator's
+        # "trace the next N batches" regardless of PIO_TRACE_SAMPLE
+        capture_id = recorder.consume_capture()
+        if capture_id is not None:
+            for p in group:
+                if p.tctx[0]:
+                    recorder.force_keep(p.tctx[0], capture_id)
         first_submit = min(p.t_submit for p in group)
         # pre-mint the per-query device span ids: storage RPCs issued
         # DURING batch_predict (e.g. UR history fetches) must parent

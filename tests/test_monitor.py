@@ -1,0 +1,843 @@
+"""Monitoring plane unit coverage (ISSUE 8): TSDB ring semantics +
+counter-reset rates, sampler output shape, exposition parsing, fleet
+scrape, SLO burn-rate math (window edges, zero traffic, hysteresis),
+alert state machine, thread hygiene, trace capture, devprof loop
+calibration, and the HBM-byte-bounded tenant cache."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs.monitor import (
+    FleetScraper,
+    Monitor,
+    SLOEngine,
+    SLOSpec,
+    load_slos,
+    parse_prometheus_text,
+    parse_targets,
+    sample_families,
+)
+from predictionio_tpu.obs.monitor.tsdb import (
+    TSDB,
+    MetricsSampler,
+    increase_of,
+    quantile_of,
+)
+from predictionio_tpu.obs.registry import MetricsRegistry
+
+T0 = 1_700_000_000.0  # fixed epoch base: every test drives time explicitly
+
+
+# ---------------------------------------------------------------------------
+# TSDB core
+# ---------------------------------------------------------------------------
+
+
+class TestTSDB:
+    def test_ring_wraparound_keeps_newest(self):
+        db = TSDB(capacity=4)
+        for i in range(10):
+            db.add("m", None, float(i), "gauge", t=T0 + i)
+        (series,) = db.matching("m")
+        pts = db.points(series)
+        assert len(pts) == 4
+        assert [v for _t, v in pts] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_increase_survives_counter_reset(self):
+        # 10 → 2 is a restart: the post-reset value IS the delta
+        assert increase_of([(0, 10.0), (1, 2.0), (2, 5.0)]) == 5.0
+        assert increase_of([(0, 3.0)]) == 0.0
+        assert increase_of([]) == 0.0
+
+    def test_increase_and_rate_over_window(self):
+        db = TSDB()
+        for i in range(11):
+            db.add("c", {"k": "a"}, float(i * 5), "counter", t=T0 + i)
+        now = T0 + 10
+        # in-window points are t5..t10 (edge inclusive); the last
+        # pre-window sample (t4, value 20) is the baseline — the delta
+        # into the window is attributed to it: 50 - 20 = 30
+        assert db.increase("c", {"k": "a"}, window_s=5, now=now) == 30.0
+        assert db.rate("c", {"k": "a"}, window_s=5, now=now) == 6.0
+        # a window past all points sees the full increase (no baseline)
+        assert db.increase("c", {"k": "a"}, window_s=1e6, now=now) == 50.0
+        # a window before any point sees nothing
+        assert db.increase("c", {"k": "a"}, window_s=5, now=now + 100) == 0.0
+
+    def test_increase_single_sample_window_uses_baseline(self):
+        # sparse sampling: one in-window sample must still show the
+        # increase from the last pre-window sample (the window-edge bug
+        # the SLO engine's resolve path depends on)
+        db = TSDB()
+        db.add("c", None, 10.0, "counter", t=T0)
+        db.add("c", None, 60.0, "counter", t=T0 + 100)
+        assert db.increase("c", window_s=10, now=T0 + 105) == 50.0
+
+    def test_label_matching_is_subset(self):
+        db = TSDB()
+        db.add("m", {"a": "1", "b": "2"}, 1.0, t=T0)
+        db.add("m", {"a": "1", "b": "3"}, 2.0, t=T0)
+        db.add("other", {"a": "1"}, 9.0, t=T0)
+        assert len(db.matching("m", {"a": "1"})) == 2
+        assert len(db.matching("m", {"b": "3"})) == 1
+        assert len(db.matching("m", {"b": "9"})) == 0
+        assert len(db.matching("m")) == 2
+
+    def test_cardinality_cap_drops_new_series(self):
+        db = TSDB(max_series=2)
+        assert db.add("a", None, 1.0, t=T0)
+        assert db.add("b", None, 1.0, t=T0)
+        assert not db.add("c", None, 1.0, t=T0)
+        # existing series still accept points past the cap
+        assert db.add("a", None, 2.0, t=T0 + 1)
+        assert db.dropped_series == 1
+        assert db.series_count() == 2
+
+    def test_quantile_over_time(self):
+        db = TSDB()
+        for i in range(100):
+            db.add("g", None, float(i), "gauge", t=T0 + i)
+        now = T0 + 99
+        assert db.quantile_over_time("g", 1.0, now=now) == 99.0
+        p50 = db.quantile_over_time("g", 0.5, window_s=19, now=now)
+        assert 89.0 <= p50 <= 91.0
+        assert db.quantile_over_time("missing", 0.5) is None
+        assert quantile_of([5.0], 0.99) == 5.0
+
+    def test_summary_shape(self):
+        db = TSDB()
+        db.add("m", {"x": "1"}, 7.0, "counter", t=T0)
+        summary = db.summary()
+        assert summary["series_count"] == 1
+        row = summary["series"][0]
+        assert row["name"] == "m" and row["last"] == 7.0
+        assert row["kind"] == "counter" and row["labels"] == {"x": "1"}
+
+
+# ---------------------------------------------------------------------------
+# sampler
+# ---------------------------------------------------------------------------
+
+
+class TestSampler:
+    def test_sample_families_counters_gauges_histograms(self):
+        reg = MetricsRegistry()
+        reg.counter("hits_total", "", ("k",)).inc(3, k="a")
+        reg.gauge("depth").set(2.5)
+        reg.gauge_callback("cb", "", lambda: 42.0)
+        h = reg.histogram("lat_seconds", "", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        db = TSDB()
+        sample_families(db, reg.families(), now=T0)
+        assert db.latest("hits_total", {"k": "a"}) == 3.0
+        assert db.latest("depth") == 2.5
+        assert db.latest("cb") == 42.0
+        assert db.latest("lat_seconds_count") == 3.0
+        # cumulative buckets: le=0.1 → 1, le=1.0 → 2, +Inf → 3
+        assert db.latest("lat_seconds_bucket", {"le": "0.1"}) == 1.0
+        assert db.latest("lat_seconds_bucket", {"le": "1.0"}) == 2.0
+        assert db.latest("lat_seconds_bucket", {"le": "+Inf"}) == 3.0
+        assert db.latest("lat_seconds", {"quantile": "p50"}) is not None
+
+    def test_sampler_thread_joins_on_stop(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        db = TSDB()
+        sampler = MetricsSampler(db, reg.families, interval_s=0.05)
+        sampler.start()
+        deadline = time.monotonic() + 5
+        while db.latest("c") is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert db.latest("c") == 1.0
+        sampler.stop()
+        assert not any(
+            t.name == "tsdb-sampler" for t in threading.enumerate()
+        )
+
+
+# ---------------------------------------------------------------------------
+# exposition parsing + fleet scrape
+# ---------------------------------------------------------------------------
+
+
+class TestScrape:
+    def test_parse_targets(self):
+        assert parse_targets("a=http://h:1, b=http://h:2/") == [
+            ("a", "http://h:1"), ("b", "http://h:2"),
+        ]
+        assert parse_targets("http://h:3") == [("h:3", "http://h:3")]
+        assert parse_targets("") == []
+
+    def test_parse_prometheus_roundtrip(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", "help", ("path",)).inc(
+            2, path='/x"y\\z\nw'
+        )
+        reg.gauge("g").set(1.5)
+        reg.histogram("h_seconds", "", buckets=(1.0,)).observe(0.5)
+        samples = parse_prometheus_text(reg.render())
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+        assert by_name["c_total"] == [({"path": '/x"y\\z\nw'}, 2.0)]
+        assert by_name["g"] == [({}, 1.5)]
+        assert ({"le": "1"}, 1.0) in by_name["h_seconds_bucket"]
+        assert ({"le": "+Inf"}, 1.0) in by_name["h_seconds_bucket"]
+
+    def test_scraper_tags_instance_and_up(self, fresh_storage):
+        from predictionio_tpu.data.api.server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        srv = EventServer(
+            fresh_storage,
+            EventServerConfig(ip="127.0.0.1", port=0, wal_dir=None),
+        )
+        port = srv.start()
+        db = TSDB()
+        scraper = FleetScraper(
+            db,
+            [("ev", f"http://127.0.0.1:{port}"),
+             ("dead", "http://127.0.0.1:1")],
+            interval_s=60,
+        )
+        try:
+            ups = scraper.scrape_once()
+        finally:
+            srv.stop()
+        assert ups == {"ev": True, "dead": False}
+        assert db.latest("up", {"instance": "ev"}) == 1.0
+        assert db.latest("up", {"instance": "dead"}) == 0.0
+        assert db.latest(
+            "scrape_duration_seconds", {"instance": "dead"}
+        ) is not None
+        # scraped series carry the instance tag
+        assert db.matching("events_shed_total") == []  # nothing bogus
+        assert any(
+            s.labels_dict().get("instance") == "ev"
+            for s in db.matching("http_requests_total")
+        ) or db.latest("scrape_samples_stored", {"instance": "ev"}) >= 0
+        status = {t["instance"]: t for t in scraper.status()}
+        assert status["dead"]["up"] is False
+        scraper.stop()  # never started: stop is a no-op, not an error
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate math + alert state machine
+# ---------------------------------------------------------------------------
+
+
+def _avail_spec(**kw) -> SLOSpec:
+    base = dict(
+        name="avail", kind="availability", objective=0.99,
+        server="query", route="/queries.json",
+        fast_window_s=10.0, window_s=40.0, burn_threshold=1.0,
+        min_samples=1,
+    )
+    base.update(kw)
+    return SLOSpec(**base)
+
+
+def _feed_availability(db: TSDB, t: float, ok: float, err: float) -> None:
+    db.add(
+        "http_requests_total",
+        {"server": "query", "path": "/queries.json", "status": "200"},
+        ok, "counter", t=t,
+    )
+    db.add(
+        "http_requests_total",
+        {"server": "query", "path": "/queries.json", "status": "500"},
+        err, "counter", t=t,
+    )
+
+
+class TestBurnRate:
+    def test_availability_burn_math(self):
+        db = TSDB()
+        # 100 requests in-window, 2 errors → fraction 0.02, budget 0.01
+        _feed_availability(db, T0, 0, 0)
+        _feed_availability(db, T0 + 10, 98, 2)
+        engine = SLOEngine(db, [_avail_spec()], registry=MetricsRegistry())
+        burn, samples = engine.burn_rate(
+            _avail_spec(), window_s=10, now=T0 + 10
+        )
+        assert samples == 100
+        assert burn == pytest.approx(2.0)
+
+    def test_zero_traffic_window_returns_none_and_holds_state(self):
+        db = TSDB()
+        spec = _avail_spec(min_samples=1)
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        # empty TSDB: no divide-by-zero, burn is None, state stays put
+        burn, samples = engine.burn_rate(spec, 10, now=T0)
+        assert burn is None and samples == 0
+        engine.evaluate_once(now=T0)
+        st = engine.status("avail")
+        assert st.state == "inactive"
+        # drive to firing, then cut traffic entirely: still firing
+        _feed_availability(db, T0 + 1, 0, 0)
+        _feed_availability(db, T0 + 5, 0, 50)
+        engine.evaluate_once(now=T0 + 6)
+        engine.evaluate_once(now=T0 + 7)
+        assert engine.status("avail").state == "firing"
+        engine.evaluate_once(now=T0 + 1000)  # every window empty now
+        assert engine.status("avail").state == "firing"  # held, no flap
+
+    def test_min_samples_guards_thin_traffic(self):
+        db = TSDB()
+        spec = _avail_spec(min_samples=10)
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        _feed_availability(db, T0, 0, 0)
+        _feed_availability(db, T0 + 5, 1, 2)  # 3 requests, 2 errors
+        engine.evaluate_once(now=T0 + 5)
+        assert engine.status("avail").state == "inactive"
+
+    def test_pending_then_firing_then_resolved(self):
+        db = TSDB()
+        spec = _avail_spec()
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        _feed_availability(db, T0, 0, 0)
+        _feed_availability(db, T0 + 2, 50, 50)
+        engine.evaluate_once(now=T0 + 3)
+        assert engine.status("avail").state == "pending"
+        engine.evaluate_once(now=T0 + 4)
+        assert engine.status("avail").state == "firing"
+        # errors age out of both windows; healthy traffic resumes
+        _feed_availability(db, T0 + 100, 1000, 50)
+        engine.evaluate_once(now=T0 + 105)
+        assert engine.status("avail").state == "resolved"
+        # a fresh breach re-enters through pending, not straight to firing
+        _feed_availability(db, T0 + 110, 1000, 500)
+        engine.evaluate_once(now=T0 + 111)
+        assert engine.status("avail").state == "pending"
+
+    def test_pending_clears_when_breach_stops(self):
+        db = TSDB()
+        spec = _avail_spec(for_s=60.0)  # long promotion delay
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        _feed_availability(db, T0, 0, 0)
+        _feed_availability(db, T0 + 2, 0, 20)
+        engine.evaluate_once(now=T0 + 3)
+        assert engine.status("avail").state == "pending"
+        _feed_availability(db, T0 + 50, 5000, 20)
+        engine.evaluate_once(now=T0 + 55)
+        assert engine.status("avail").state == "inactive"
+
+    def test_resolve_hysteresis(self):
+        db = TSDB()
+        spec = _avail_spec(resolve_s=30.0, fast_window_s=5.0,
+                           window_s=10.0)
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        _feed_availability(db, T0, 0, 0)
+        _feed_availability(db, T0 + 2, 0, 50)
+        engine.evaluate_once(now=T0 + 3)
+        engine.evaluate_once(now=T0 + 4)
+        assert engine.status("avail").state == "firing"
+        # clean window, but the clear streak is shorter than resolve_s
+        _feed_availability(db, T0 + 20, 500, 50)
+        engine.evaluate_once(now=T0 + 25)
+        assert engine.status("avail").state == "firing"
+        # a breach mid-streak resets the hysteresis clock
+        _feed_availability(db, T0 + 30, 500, 550)
+        engine.evaluate_once(now=T0 + 32)
+        assert engine.status("avail").state == "firing"
+        _feed_availability(db, T0 + 60, 2000, 550)
+        engine.evaluate_once(now=T0 + 65)   # clear #1 (streak starts)
+        _feed_availability(db, T0 + 78, 3000, 550)
+        engine.evaluate_once(now=T0 + 80)   # 15 s clear < 30 s
+        assert engine.status("avail").state == "firing"
+        _feed_availability(db, T0 + 94, 4000, 550)
+        engine.evaluate_once(now=T0 + 96)   # 31 s clear ≥ 30 s
+        assert engine.status("avail").state == "resolved"
+
+    def test_latency_slo_reads_sampled_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "http_request_seconds", "", ("server", "path"),
+            buckets=(0.1, 0.25, 1.0),
+        )
+        h.observe(0.05, server="query", path="/queries.json")
+        db = TSDB()
+        sample_families(db, reg.families(), now=T0)  # baseline tick
+        for _ in range(89):
+            h.observe(0.05, server="query", path="/queries.json")
+        sample_families(db, reg.families(), now=T0 + 5)
+        for _ in range(10):
+            h.observe(0.9, server="query", path="/queries.json")
+        sample_families(db, reg.families(), now=T0 + 10)
+        spec = SLOSpec(
+            name="lat", kind="latency", objective=0.95,
+            threshold_ms=250.0, fast_window_s=20.0, window_s=40.0,
+            burn_threshold=1.0,
+        )
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        # the first sample (count=1) is the baseline: 99 observed
+        # requests in-window, 10 of them slower than 250 ms →
+        # bad fraction 10/99, budget 0.05 → burn ≈ 2.02
+        burn, samples = engine.burn_rate(spec, 20, now=T0 + 10)
+        assert samples == 99
+        assert burn == pytest.approx((10 / 99) / 0.05, rel=1e-6)
+
+    def test_up_slo_fires_on_dead_target(self):
+        db = TSDB()
+        spec = SLOSpec(
+            name="fleet-up", kind="up", instance="query",
+            objective=0.9, fast_window_s=10.0, window_s=20.0,
+            burn_threshold=1.0,
+        )
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        for i in range(5):
+            db.add("up", {"instance": "query"}, 1.0, t=T0 + i)
+        engine.evaluate_once(now=T0 + 5)
+        assert engine.status("fleet-up").state == "inactive"
+        for i in range(5, 10):
+            db.add("up", {"instance": "query"}, 0.0, t=T0 + i)
+        engine.evaluate_once(now=T0 + 10)
+        engine.evaluate_once(now=T0 + 11)
+        assert engine.status("fleet-up").state == "firing"
+
+    def test_firing_gauge_exported(self):
+        db = TSDB()
+        reg = MetricsRegistry()
+        spec = _avail_spec()
+        engine = SLOEngine(db, [spec], registry=reg)
+        _feed_availability(db, T0, 0, 0)
+        _feed_availability(db, T0 + 2, 0, 50)
+        engine.evaluate_once(now=T0 + 3)
+        engine.evaluate_once(now=T0 + 4)
+        assert reg.gauge(
+            "alerts_firing", labelnames=("slo",)
+        ).value(slo="avail") == 1.0
+
+    def test_spec_validation_and_env_loading(self, tmp_path):
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", objective=1.5)
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", kind="nope")
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", fast_window_s=100, window_s=10)
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", kind="up")  # needs instance
+        assert load_slos("") == []
+        assert load_slos("{not json") == []  # warn, never raise
+        assert load_slos('[{"name": "a", "bogus": 1}]') == []
+        specs = load_slos(
+            '[{"name": "a", "objective": 0.999, "kind": "availability"}]'
+        )
+        assert specs[0].budget == pytest.approx(0.001)
+        p = tmp_path / "slos.json"
+        p.write_text('[{"name": "f", "objective": 0.9}]')
+        assert load_slos(f"@{p}")[0].name == "f"
+
+    def test_engine_thread_joins(self):
+        engine = SLOEngine(
+            TSDB(), [_avail_spec()], interval_s=0.05,
+            registry=MetricsRegistry(),
+        )
+        engine.start()
+        engine.stop()
+        assert not any(
+            t.name == "slo-engine" for t in threading.enumerate()
+        )
+
+
+# ---------------------------------------------------------------------------
+# the process-global Monitor (attach/detach hygiene)
+# ---------------------------------------------------------------------------
+
+
+MONITOR_THREADS = ("tsdb-sampler", "slo-engine", "fleet-scraper")
+
+
+def _monitor_threads():
+    return [
+        t.name for t in threading.enumerate()
+        if t.name in MONITOR_THREADS and t.is_alive()
+    ]
+
+
+class TestMonitor:
+    def test_attach_refcount_joins_on_last_detach(self):
+        monitor = Monitor()
+        monitor.sampler_interval_s = 0.05
+        monitor.set_slos([_avail_spec()])
+        t1 = monitor.attach("a", MetricsRegistry())
+        t2 = monitor.attach("b", MetricsRegistry())
+        assert "tsdb-sampler" in _monitor_threads()
+        assert "slo-engine" in _monitor_threads()
+        monitor.detach(t1)
+        assert "tsdb-sampler" in _monitor_threads()
+        monitor.detach(t2)
+        assert _monitor_threads() == []
+        monitor.detach(t2)  # double detach is a no-op
+
+    def test_disabled_plane_attaches_nothing(self, monkeypatch):
+        monkeypatch.setenv("PIO_TSDB", "0")
+        monitor = Monitor()
+        assert monitor.attach("a", MetricsRegistry()) is None
+        assert _monitor_threads() == []
+        payload = monitor.alerts_payload()
+        assert payload["enabled"] is False
+        assert monitor.tsdb_payload({})["enabled"] is False
+
+    def test_server_stop_leaves_no_monitor_threads(self, fresh_storage):
+        from predictionio_tpu.data.api.server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        before = get_monitor().attached_count
+        srv = EventServer(
+            fresh_storage,
+            EventServerConfig(ip="127.0.0.1", port=0, wal_dir=None),
+        )
+        srv.start()
+        assert get_monitor().attached_count == before + 1
+        srv.stop()
+        assert get_monitor().attached_count == before
+        if before == 0:
+            assert _monitor_threads() == []
+
+    def test_same_named_families_across_servers_all_sampled(self):
+        # two servers in one process each own an `http_requests_total`
+        # family (disjoint server= children): BOTH must reach the TSDB
+        # — dropping the later-attached server's family would blind its
+        # SLOs — while exact-duplicate unlabeled gauges (the shared
+        # jax/devprof callbacks) write once per tick, first wins
+        monitor = Monitor()
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.counter("http_requests_total", "", ("server",)).inc(
+            1, server="query"
+        )
+        r2.counter("http_requests_total", "", ("server",)).inc(
+            2, server="storage"
+        )
+        r1.gauge_callback("devprof_mfu", "", lambda: 1.0)
+        r2.gauge_callback("devprof_mfu", "", lambda: 2.0)
+        monitor._attached = [(1, "query", r1), (2, "storage", r2)]
+        sample_families(monitor.tsdb, monitor._families(), now=T0)
+        db = monitor.tsdb
+        assert db.latest("http_requests_total", {"server": "query"}) == 1
+        assert db.latest("http_requests_total", {"server": "storage"}) == 2
+        (mfu,) = db.matching("devprof_mfu")
+        assert db.points(mfu) == [(T0, 1.0)]  # one point, first wins
+
+    def test_tsdb_payload_queries(self):
+        monitor = Monitor()
+        db = monitor.tsdb
+        now = time.time()  # the payload API anchors windows at wall now
+        for i in range(5):
+            db.add("c", {"k": "a"}, float(i), "counter", t=now - 5 + i)
+        listing = monitor.tsdb_payload({})
+        assert listing["series_count"] == 1
+        pts = monitor.tsdb_payload({"name": "c", "labels": "k:a"})
+        assert len(pts["series"][0]["points"]) == 5
+        agg = monitor.tsdb_payload(
+            {"name": "c", "agg": "increase", "window_s": "60"}
+        )
+        assert agg["value"] == 4.0
+
+
+class TestDashboardPanels:
+    def test_alerts_and_fleet_panels_render(self, fresh_storage):
+        import urllib.request
+
+        from predictionio_tpu.obs.monitor import get_monitor
+        from predictionio_tpu.tools.dashboard import Dashboard
+
+        monitor = get_monitor()
+        monitor.set_slos([_avail_spec(name="panel-slo")])
+        dash = Dashboard(
+            fresh_storage, ip="127.0.0.1", port=0,
+            monitor_targets="deadpeer=http://127.0.0.1:1",
+            scrape_interval_s=60,
+        )
+        port = dash.start()
+        try:
+            dash._scraper.scrape_once()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10
+            ) as r:
+                body = r.read().decode()
+            assert "Alerts" in body and "panel-slo" in body
+            assert "Fleet" in body and "deadpeer" in body
+            assert "DOWN" in body  # the dead target is visibly down
+        finally:
+            dash.stop()
+            monitor.set_slos([])
+        # sampler + SLO engine + fleet scraper all joined with the server
+        assert _monitor_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# trace capture (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceCapture:
+    def test_capture_forces_retention_past_sampling(self):
+        from predictionio_tpu.obs.spans import Span, SpanRecorder
+
+        rec = SpanRecorder(max_traces=32, slow_ms=1e9, sample_rate=0.0)
+        cap = rec.arm_capture(2)
+        # two "batches" with one trace each, one uncaptured trace after
+        assert rec.consume_capture() == cap
+        rec.force_keep("t1", cap)
+        assert rec.consume_capture() == cap
+        rec.force_keep("t2", cap)
+        assert rec.consume_capture() is None  # credits spent
+        for tid in ("t1", "t2", "t3"):
+            rec.record(
+                Span(trace_id=tid, span_id=tid + "s", name="server.request",
+                     start=time.time(), duration=0.001),
+                finalize=True,
+            )
+        # sample_rate 0 would drop everything; capture kept t1/t2 only
+        assert rec.get_trace("t1") and rec.get_trace("t2")
+        assert not rec.get_trace("t3")
+        status = rec.capture_status(cap)
+        assert status["done"] is True
+        assert sorted(status["capture"]["trace_ids"]) == ["t1", "t2"]
+        assert len(status["traces"]) == 2
+        assert rec.capture_status("nope") is None
+
+    def test_force_keep_on_already_retained_trace(self):
+        from predictionio_tpu.obs.spans import Span, SpanRecorder
+
+        rec = SpanRecorder(max_traces=32, slow_ms=1e9, sample_rate=1.0)
+        rec.record(
+            Span(trace_id="t", span_id="s", name="server.request",
+                 start=time.time(), duration=0.001),
+            finalize=True,
+        )
+        cap = rec.arm_capture(1)
+        rec.force_keep("t", cap)
+        assert rec.capture_status(cap)["capture"]["trace_ids"] == ["t"]
+
+
+# ---------------------------------------------------------------------------
+# devprof loop-FLOPs calibration (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FakeLowered:
+    def __init__(self, flops, nbytes):
+        self._flops = flops
+        self._bytes = nbytes
+
+    def cost_analysis(self):
+        return {"flops": self._flops, "bytes accessed": self._bytes}
+
+
+class _FakeLoopFn:
+    """Mimics a jit'd train loop: XLA counts the body once (cost is
+    base + per_iter regardless of `iterations`) — unless lowered with
+    an explicit iteration count, which this fake honors the way the
+    real 1-vs-2 lowering diff expects."""
+
+    def __init__(self, base=1000.0, per_iter=100.0):
+        self.base = base
+        self.per_iter = per_iter
+
+    def __call__(self, x, iterations=1):
+        return x
+
+    def lower(self, x, iterations=1):
+        return _FakeLowered(
+            self.base + self.per_iter * iterations,
+            10.0 + 1.0 * iterations,
+        )
+
+
+class TestDevprofCalibration:
+    def test_one_vs_two_iteration_lowering(self):
+        from predictionio_tpu.obs.devprof import (
+            DeviceProfiler,
+            _Instrumented,
+            _SigAnalysis,
+        )
+
+        prof = DeviceProfiler()
+        fn = _FakeLoopFn()
+        wrapper = _Instrumented("fake.loop", fn, scale_by="iterations")
+        res = _SigAnalysis()
+        res.flops, res.bytes_accessed = 1100.0, 11.0  # the n=10 lowering
+        res.cost_ok = True
+        prof._calibrate_loop(
+            wrapper, fn.lower, (0,), {"iterations": 10}, res
+        )
+        assert res.calibrated
+        # cost(1)=1100, cost(2)=1200 → per_iter 100 → total(10)=2000
+        assert res.flops == pytest.approx(2000.0)
+        assert res.flops_body == pytest.approx(1100.0)
+        # the kwarg-trusting estimate (1100 * 10 = 11000) would have
+        # over-counted the loop-invariant base 10×
+
+    def test_calibration_falls_back_on_lowering_failure(self):
+        from predictionio_tpu.obs.devprof import (
+            DeviceProfiler,
+            _Instrumented,
+            _SigAnalysis,
+        )
+
+        def bad_lower(*a, **k):
+            raise RuntimeError("no lowering for you")
+
+        wrapper = _Instrumented(
+            "fake.loop2", lambda x, iterations=1: x, scale_by="iterations"
+        )
+        res = _SigAnalysis()
+        res.flops, res.cost_ok = 500.0, True
+        DeviceProfiler._calibrate_loop(
+            wrapper, bad_lower, (0,), {"iterations": 4}, res
+        )
+        assert not res.calibrated  # caller keeps kwarg scaling
+        assert res.flops == 500.0
+
+    def test_flat_cost_falls_back_to_kwarg_scaling(self):
+        # the real-XLA while-loop case: cost analysis counts the body
+        # once, so the 1-vs-2 lowering diff is zero — calibration must
+        # decline and leave the PR-3 kwarg scaling in charge
+        from predictionio_tpu.obs.devprof import (
+            DeviceProfiler,
+            _Instrumented,
+            _SigAnalysis,
+        )
+
+        def flat_lower(x, iterations=1):
+            return _FakeLowered(1100.0, 10.0)  # trip-count blind
+
+        wrapper = _Instrumented(
+            "fake.flat", lambda x, iterations=1: x, scale_by="iterations"
+        )
+        res = _SigAnalysis()
+        res.flops, res.cost_ok = 1100.0, True
+        DeviceProfiler._calibrate_loop(
+            wrapper, flat_lower, (0,), {"iterations": 10}, res
+        )
+        assert not res.calibrated
+        assert res.flops == 1100.0  # caller multiplies by n, as before
+
+    def test_report_carries_calibration_fields(self):
+        from predictionio_tpu.obs import devprof
+
+        prof = devprof.DeviceProfiler()
+        fn = _FakeLoopFn()
+        wrapper = devprof._Instrumented(
+            "fake.loop3", fn, scale_by="iterations"
+        )
+        prof.call(wrapper, (1,), {"iterations": 10})
+        row = prof.executable("fake.loop3")
+        assert row["flops_scaled_by"] == "iterations"
+        assert row["flops_calibrated"] is True
+        assert row["flops_total"] == pytest.approx(2000.0)
+        # the PR-3 kwarg-trusting estimate would have claimed
+        # cost(n) * n = 2000 * 10 — kept in the report for comparison
+        assert row["flops_per_call_kwarg_scaled"] == pytest.approx(20000.0)
+
+
+# ---------------------------------------------------------------------------
+# HBM-byte-bounded tenant model cache (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+class _Tenant:
+    def __init__(self, tid):
+        self.id = tid
+        self.engine_id = "e"
+        self.engine_version = "0"
+        self.engine_variant = "e"
+
+
+class _Runtime:
+    def __init__(self, mb):
+        self.models = [np.zeros(int(mb * 1024 * 1024 // 8))]
+
+
+class TestHbmCache:
+    def _cache(self, hbm_mb, sizes_mb, transient_mb=0.0):
+        from predictionio_tpu.tenancy.cache import ModelCache
+
+        cache = ModelCache(
+            storage=None,
+            capacity=100,  # count bound out of the way: bytes decide
+            build=lambda inst: _Runtime(sizes_mb[inst]),
+            hbm_bytes=hbm_mb * 1024 * 1024,
+            transient=lambda: transient_mb * 1024 * 1024,
+        )
+        cache.resolve_version = lambda tenant: (f"v-{tenant.id}", tenant.id)
+        return cache
+
+    def test_evicts_by_cumulative_bytes_not_count(self):
+        sizes = {"a": 4, "b": 4, "c": 4}
+        cache = self._cache(10, sizes)
+        for tid in ("a", "b", "c"):
+            cache.release(cache.acquire(_Tenant(tid)))
+        # 12 MB resident > 10 MB budget → LRU ("a") evicted; two stay
+        assert cache.evictions == 1
+        assert sorted(cache.stats()["entries"]) == ["b", "c"]
+        assert cache.resident_bytes() <= 10 * 1024 * 1024
+
+    def test_one_oversized_model_still_serves(self):
+        cache = self._cache(1, {"big": 8})
+        entry = cache.acquire(_Tenant("big"))
+        cache.release(entry)
+        # soft-over-budget: the only entry is never evicted
+        assert cache.stats()["resident"] == 1
+
+    def test_inflight_and_pinned_survive_byte_pressure(self):
+        sizes = {"a": 6, "b": 6, "c": 6}
+        cache = self._cache(10, sizes)
+        held = cache.acquire(_Tenant("a"))  # refs > 0
+        cache.release(cache.acquire(_Tenant("b")))
+        cache.pin("b")
+        cache.release(cache.acquire(_Tenant("c")))
+        stats = cache.stats()
+        assert "a" in stats["entries"]  # in-flight: immune
+        assert "b" in stats["entries"]  # pinned: immune
+        cache.release(held)
+
+    def test_count_bound_still_rules_without_hbm_budget(self):
+        from predictionio_tpu.tenancy.cache import ModelCache
+
+        cache = ModelCache(
+            storage=None, capacity=2,
+            build=lambda inst: _Runtime(1),
+        )
+        cache.resolve_version = lambda tenant: (f"v-{tenant.id}", tenant.id)
+        for tid in ("a", "b", "c"):
+            cache.release(cache.acquire(_Tenant(tid)))
+        assert cache.stats()["resident"] == 2
+        assert cache.evictions == 1
+
+    def test_transient_reserved_once_not_per_entry(self):
+        # budget 16, three 4 MB models + a 3 MB dispatch working set:
+        # 12 + 3 fits; folding the transient into each entry (4+3 each
+        # = 21) would wrongly evict. A 5 MB transient tips it over.
+        sizes = {"a": 4, "b": 4, "c": 4}
+        cache = self._cache(16, sizes, transient_mb=3)
+        for tid in ("a", "b", "c"):
+            cache.release(cache.acquire(_Tenant(tid)))
+        assert cache.evictions == 0
+        cache2 = self._cache(16, sizes, transient_mb=5)
+        for tid in ("a", "b", "c"):
+            cache2.release(cache2.acquire(_Tenant(tid)))
+        assert cache2.evictions == 1
+
+    def test_estimate_counts_model_array_bytes(self):
+        from predictionio_tpu.tenancy.cache import (
+            estimate_runtime_device_bytes,
+        )
+
+        rt = _Runtime(2)
+        nbytes = estimate_runtime_device_bytes(rt)
+        # exactly the model arrays — the dispatch transient is the
+        # cache's budget-level reservation, not part of the entry
+        assert nbytes == rt.models[0].nbytes
