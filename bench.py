@@ -682,15 +682,172 @@ def bench_serving_framework():
         best = max(sweep, key=lambda r: r["qps"])
         monitor_cost = _bench_monitor_overhead(srv, port, n_users_serve)
         swap = _bench_hot_swap(srv, storage, port, n_users_serve)
+        online = _bench_online(srv, storage, port, app_id, n_users_serve)
         return dict(
             best, sweep=sweep, obs=_registry_snapshot(srv.metrics),
             slowest_trace=_slowest_trace_summary(recorder),
             devprof=_devprof_serving_crosscheck(),
             **monitor_cost,
             **swap,
+            **online,
         )
     finally:
         srv.stop()
+
+
+def _bench_online(srv, storage, port, app_id, n_users_serve):
+    """Online-learning cost + value (ISSUE 9 acceptance): with the
+    stream consumer attached, (a) event-ingest→serving-visibility
+    latency for COLD-START users — insert a brand-new user's events and
+    poll /queries.json until the answer is personalized (an unknown user
+    returns an empty result, so non-empty == folded); the bar is a
+    personalized answer within 2 consumer ticks — and (b) serving p99
+    with the consumer ATTACHED (ticking, stream idle) vs fully detached
+    (bar: `online_overhead_p99_ratio` < 1.05 — attachment must be free,
+    like the monitor plane). `online_folding_p99_ratio` additionally
+    reports p99 while the consumer actively folds a 20 ev/s trickle —
+    on the 2-core bench host the consumer's solve CPU contends directly
+    with the 32 client threads (same caveat as mt_hog_impact_ratio), so
+    that number is the honest contended cost, not the attachment bar."""
+    import threading as _threading
+    import urllib.request
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.online import OnlineConsumerConfig
+
+    events = storage.get_events()
+
+    def make_body(i):
+        return json.dumps(
+            {"user": f"u{i % n_users_serve}", "num": 10}
+        ).encode()
+
+    def hammer():
+        # best of two LONG passes: at 32×8 requests the p99 is the ~3rd
+        # slowest request — pure scheduler noise on the 2-core host (the
+        # idle-attached ratio measured 0.8×–1.7× run to run). 32×16 per
+        # pass + min-of-2 on BOTH sides of every ratio keeps the
+        # comparison about the consumer, not the scheduler's mood
+        a = _hammer_query_server(port, make_body, n_clients=32, n_per=16)
+        b = _hammer_query_server(port, make_body, n_clients=32, n_per=16)
+        return a if a["p99_ms"] <= b["p99_ms"] else b
+
+    def ask(uid):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/queries.json",
+            data=json.dumps({"user": uid, "num": 5}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read().decode())
+        except Exception:
+            return {}
+
+    off = hammer()
+
+    tick_s = 0.2
+    srv.attach_online(
+        app_id, OnlineConsumerConfig(tick_s=tick_s, from_latest=True)
+    )
+    try:
+        # (a) cold-start visibility latency — these folds also pre-warm
+        # the fold-in kernel's bucket shapes before any p99 measurement
+        lat = []
+        for c in range(5):
+            uid = f"coldstart{c}"
+            t0 = time.perf_counter()
+            events.insert_batch([
+                Event(
+                    event="rate", entity_type="user", entity_id=uid,
+                    target_entity_type="item", target_entity_id=f"i{j}",
+                    properties={"rating": 5.0},
+                )
+                for j in range(3)
+            ], app_id)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if (ask(uid) or {}).get("item_scores"):
+                    lat.append(time.perf_counter() - t0)
+                    break
+                time.sleep(0.02)
+        # warm the multi-user fold shape (the trickle below re-solves
+        # batches of existing users: r_pad=8/64 buckets) so no p99
+        # measurement eats one-time XLA compiles — and WAIT until the
+        # burst is fully consumed before measuring anything
+        consumed_target = srv.online.counters["events_consumed"] + 24
+        events.insert_batch([
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{u % 50}",
+                properties={"rating": 4.0},
+            )
+            for u in range(24)
+        ], app_id)
+        deadline = time.monotonic() + 30.0
+        while (
+            srv.online.counters["events_consumed"] < consumed_target
+            and time.monotonic() < deadline
+        ):
+            time.sleep(tick_s / 2)
+        time.sleep(tick_s * 2)  # let the publish settle
+
+        # (b) attachment cost: consumer ticking, stream idle — the bar
+        attached = hammer()
+
+        # (c) honest contended cost: consumer folding a live trickle
+        stop_feed = _threading.Event()
+
+        def feed():
+            n = 0
+            while not stop_feed.is_set():
+                n += 1
+                events.insert(Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{n % n_users_serve}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{n % 50}",
+                    properties={"rating": 4.0},
+                ), app_id)
+                stop_feed.wait(0.05)
+
+        feeder = _threading.Thread(target=feed, daemon=True)
+        feeder.start()
+        folding = hammer()
+        stop_feed.set()
+        feeder.join(timeout=5)
+        counters = dict(srv.online.counters)
+    finally:
+        srv.online.stop()
+        srv.online = None
+    lat_ms = sorted(x * 1000.0 for x in lat)
+    p50 = lat_ms[len(lat_ms) // 2] if lat_ms else None
+
+    def _ratio(on):
+        return (
+            round(on["p99_ms"] / off["p99_ms"], 4)
+            if off["p99_ms"] > 0 else None
+        )
+
+    return {
+        "online_tick_s": tick_s,
+        "online_fold_latency_p50_ms": (
+            None if p50 is None else round(p50, 1)
+        ),
+        "online_fold_latency_max_ms": (
+            round(lat_ms[-1], 1) if lat_ms else None
+        ),
+        "online_fold_latency_ticks": (
+            None if p50 is None else round(p50 / (tick_s * 1000.0), 2)
+        ),
+        "online_cold_users_visible": len(lat_ms),
+        "online_events_folded": counters.get("events_folded", 0),
+        "online_off_p99_ms": round(off["p99_ms"], 3),
+        "online_on_p99_ms": round(attached["p99_ms"], 3),
+        "online_overhead_p99_ratio": _ratio(attached),
+        "online_folding_p99_ms": round(folding["p99_ms"], 3),
+        "online_folding_p99_ratio": _ratio(folding),
+    }
 
 
 def _bench_monitor_overhead(srv, port, n_users_serve):
@@ -1683,6 +1840,20 @@ def main():
         "mt_cache_hit_rate": multitenant["cache"]["hit_rate"],
         "mt_cache_reloads": multitenant["cache"]["reloads"],
         "mt_cache_evictions": multitenant["cache"]["evictions"],
+        # ISSUE 9: online learning — ingest→serving-visibility latency
+        # for cold-start users (bar: < 2 consumer ticks) and fold-in
+        # overhead on serving p99 (bar: < 1.05× vs detached)
+        "online_tick_s": framework["online_tick_s"],
+        "online_fold_latency_p50_ms": framework["online_fold_latency_p50_ms"],
+        "online_fold_latency_max_ms": framework["online_fold_latency_max_ms"],
+        "online_fold_latency_ticks": framework["online_fold_latency_ticks"],
+        "online_cold_users_visible": framework["online_cold_users_visible"],
+        "online_events_folded": framework["online_events_folded"],
+        "online_off_p99_ms": framework["online_off_p99_ms"],
+        "online_on_p99_ms": framework["online_on_p99_ms"],
+        "online_overhead_p99_ratio": framework["online_overhead_p99_ratio"],
+        "online_folding_p99_ms": framework["online_folding_p99_ms"],
+        "online_folding_p99_ratio": framework["online_folding_p99_ratio"],
         "ur_framework_qps": round(ur["qps"], 1),
         "ur_framework_p50_ms": round(ur["p50_ms"], 1),
         "ur_framework_p99_ms": round(ur["p99_ms"], 1),

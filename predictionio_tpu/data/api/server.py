@@ -603,18 +603,34 @@ class EventServer(ServerProcess):
         if server is None or server.wal is None or not server.wal.pending():
             return 0
         store = self.storage.get_events()
+        batch_with_req_id = getattr(store, "insert_batch_with_req_id", None)
         insert_with_req_id = getattr(store, "insert_with_req_id", None)
 
-        def _insert(event, app_id, channel_id, req_id):
-            # remote backend: the stable req_id makes the replay insert
-            # idempotent end-to-end (daemon-side dedupe); embedded
-            # backends apply directly — the local ack file is the dedupe
-            if insert_with_req_id is not None:
-                insert_with_req_id(event, app_id, channel_id, req_id)
-            else:
-                store.insert(event, app_id, channel_id)
+        if batch_with_req_id is not None or not hasattr(
+            store, "insert_with_req_id"
+        ):
+            # batched replay (ISSUE 9 satellite): consecutive
+            # same-namespace spills land as ONE bulk write. Remote
+            # backends dedupe the whole batch on its stable req_id;
+            # embedded backends are idempotent via the spill-time
+            # event-id stamp (INSERT OR REPLACE semantics), so batching
+            # is safe there too. Only the sharded store — which has
+            # per-event req-id routing but no batch-level dedupe
+            # contract across shards — keeps the per-event path.
+            def _insert_batch(events, app_id, channel_id, batch_req_id):
+                if batch_with_req_id is not None:
+                    batch_with_req_id(events, app_id, channel_id,
+                                      batch_req_id)
+                else:
+                    store.insert_batch(events, app_id, channel_id)
 
-        replayed, err = server.wal.replay(_insert)
+            replayed, err = server.wal.replay_batched(_insert_batch)
+        else:
+
+            def _insert(event, app_id, channel_id, req_id):
+                insert_with_req_id(event, app_id, channel_id, req_id)
+
+            replayed, err = server.wal.replay(_insert)
         if replayed:
             server.metrics.counter(
                 "event_wal_replayed_total",

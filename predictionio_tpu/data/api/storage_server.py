@@ -40,7 +40,7 @@ _DAO_TABLE: dict[str, tuple[str, frozenset[str]]] = {
         frozenset({
             "init_app", "remove_app", "insert", "insert_batch", "delete",
             "delete_batch", "get", "find", "find_entities_batch",
-            "data_signature",
+            "data_signature", "find_since", "latest_revision",
         }),
     ),
     "apps": (

@@ -49,6 +49,11 @@ class Event:
     pr_id: Optional[str] = None
     creation_time: _dt.datetime = field(default_factory=utcnow)
     event_id: Optional[str] = None
+    # server-assigned, per-(app, channel) monotonically-increasing insert
+    # sequence (ISSUE 9): the skew-proof fold order a streaming consumer
+    # tails by. None until a revision-assigning backend stores the event;
+    # client-supplied values are IGNORED on insert (the store re-assigns).
+    revision: Optional[int] = None
 
     def __post_init__(self):
         if not isinstance(self.properties, DataMap):
@@ -63,6 +68,9 @@ class Event:
 
     def with_id(self, event_id: str) -> "Event":
         return replace(self, event_id=event_id)
+
+    def with_revision(self, revision: int) -> "Event":
+        return replace(self, revision=revision)
 
     # -- JSON codec (reference EventJson4sSupport.scala:30-236) -----------
     def to_json_dict(self, with_id: bool = True) -> dict[str, Any]:
@@ -87,6 +95,8 @@ class Event:
         if self.pr_id is not None:
             out["prId"] = self.pr_id
         out["creationTime"] = _iso(self.creation_time)
+        if self.revision is not None:
+            out["revision"] = self.revision
         return out
 
     def to_json(self) -> str:
@@ -122,6 +132,9 @@ class Event:
             pr_id=d.get("prId"),
             creation_time=_parse_time(d["creationTime"]) if d.get("creationTime") else now,
             event_id=d.get("eventId"),
+            revision=(
+                int(d["revision"]) if d.get("revision") is not None else None
+            ),
         )
 
     @staticmethod
@@ -151,12 +164,13 @@ class EventValidation:
     SPECIAL_EVENTS = frozenset({SET_EVENT, UNSET_EVENT, DELETE_EVENT})
     # framework-internal entities allowed under the reserved pio_ prefix:
     # feedback predictions (pio_pr), the model-lifecycle records (ISSUE
-    # 5), and the tenancy/rollout-state records (ISSUE 6) — all living
-    # in the reserved LIFECYCLE_APP_ID namespace
+    # 5), the tenancy/rollout-state records (ISSUE 6), and the online
+    # consumer's durable cursor records (ISSUE 9) — all living in the
+    # reserved LIFECYCLE_APP_ID namespace
     BUILTIN_ENTITY_TYPES = frozenset(
         {
             "pio_pr", "pio_model_version", "pio_train_job",
-            "pio_tenant", "pio_rollout",
+            "pio_tenant", "pio_rollout", "pio_online_cursor",
         }
     )
 

@@ -209,6 +209,57 @@ class EventStore(abc.ABC):
         """Stream events matching the filter, ordered by event_time
         (reversed=True → descending)."""
 
+    # -- insert-revision tailing (ISSUE 9 online learning) -----------------
+    def latest_revision(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> int:
+        """Highest server-assigned insert revision in the namespace (0
+        when empty or the backend never assigned any). Default: an O(n)
+        scan; revision-assigning backends override with O(1) reads."""
+        best = 0
+        for e in self.find(EventQuery(app_id=app_id, channel_id=channel_id)):
+            if e.revision is not None and e.revision > best:
+                best = e.revision
+        return best
+
+    def find_since(
+        self,
+        app_id: int,
+        after_revision: int,
+        channel_id: Optional[int] = None,
+        limit: Optional[int] = None,
+        shard: Optional[tuple[int, int]] = None,
+    ) -> list[Event]:
+        """Events with revision strictly greater than `after_revision`,
+        ordered BY REVISION ascending — the streaming-consumer tail read
+        (ISSUE 9). Revisions are assigned server-side at insert, so this
+        order is skew-proof: it cannot be reordered by client-supplied
+        event times the way an eventTime scan can. `shard=(i, n)` keeps
+        only primary-copy events of shard i (the consumer's dedupe
+        against successor-replica copies on sharded stores).
+
+        Default implementation scans and filters; revision-assigning
+        backends override with indexed range reads."""
+        out = [
+            e
+            for e in self.find(
+                EventQuery(app_id=app_id, channel_id=channel_id, shard=shard)
+            )
+            if e.revision is not None and e.revision > after_revision
+        ]
+        out.sort(key=lambda e: e.revision)  # type: ignore[arg-type, return-value]
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return out
+
+    def revision_streams(self) -> list[tuple[str, "EventStore", Optional[tuple[int, int]]]]:
+        """The independently-tailable revision streams of this store, as
+        (stream_key, store, shard_filter) rows. A plain store is ONE
+        stream; a sharded composite is one per shard, each filtered to
+        primary copies — revisions are only comparable WITHIN a stream,
+        so a durable cursor is a {stream_key: revision} map."""
+        return [("0", self, None)]
+
     def data_signature(
         self, app_id: int, channel_id: Optional[int] = None
     ) -> str:

@@ -34,6 +34,20 @@ class MemoryEventStore(base.EventStore):
         self._ns: dict[tuple[int, Optional[int]], dict[str, Event]] = {}
         # (app_id, channel_id) → write version (bumped on every mutation)
         self._versions: dict[tuple[int, Optional[int]], int] = {}
+        # (app_id, channel_id) → last server-assigned insert revision
+        # (ISSUE 9): monotonically increasing per namespace, assigned
+        # under the store lock so the tail order is skew-proof
+        self._revisions: dict[tuple[int, Optional[int]], int] = {}
+        # (app_id, channel_id) → append-ordered (revision, event_id) log:
+        # find_since bisects instead of scanning the namespace — a
+        # streaming consumer's idle tick must be O(page), not O(events).
+        # Deletes/overwrites leave stale rows; reads skip entries whose
+        # id is gone or re-inserted under a newer revision, and the log
+        # is rebuilt (amortized) once stale rows dominate — without the
+        # prune, delete-heavy namespaces (the lifecycle records' own
+        # append+compact cycle!) would grow the log forever.
+        self._rev_log: dict[tuple, list[tuple[int, str]]] = {}
+        self._rev_stale: dict[tuple, int] = {}
         # (app_id, channel_id) → {entity_id: {event_id}} — serving-time
         # history lookups (LEventStore find-by-entity) must not scan the
         # whole namespace; this is the role of the reference's HBase
@@ -43,6 +57,22 @@ class MemoryEventStore(base.EventStore):
     def _bump(self, app_id: int, channel_id: Optional[int]) -> None:
         key = self._key(app_id, channel_id)
         self._versions[key] = self._versions.get(key, 0) + 1
+
+    def _note_stale(self, key: tuple) -> None:
+        """One rev-log row went stale (delete/overwrite). Rebuild the
+        log once stale rows are the majority (amortized O(1) per
+        mutation). Caller holds the store lock."""
+        n = self._rev_stale.get(key, 0) + 1
+        self._rev_stale[key] = n
+        rev_log = self._rev_log.get(key)
+        if rev_log is not None and n > 64 and n * 2 > len(rev_log):
+            table = self._ns.get(key, {})
+            self._rev_log[key] = [
+                (rev, eid)
+                for rev, eid in rev_log
+                if eid in table and table[eid].revision == rev
+            ]
+            self._rev_stale[key] = 0
 
     def _key(self, app_id: int, channel_id: Optional[int]):
         return (app_id, channel_id)
@@ -56,6 +86,7 @@ class MemoryEventStore(base.EventStore):
         with self._lock:
             self._ns.pop(self._key(app_id, channel_id), None)
             self._by_entity.pop(self._key(app_id, channel_id), None)
+            self._rev_log.pop(self._key(app_id, channel_id), None)
         return True
 
     def _table(self, app_id: int, channel_id: Optional[int]) -> dict[str, Event]:
@@ -78,7 +109,14 @@ class MemoryEventStore(base.EventStore):
                 self._index(app_id, channel_id).get(
                     prev.entity_id, set()
                 ).discard(eid)
-            self._table(app_id, channel_id)[eid] = event.with_id(eid)
+                self._note_stale(self._key(app_id, channel_id))
+            key = self._key(app_id, channel_id)
+            rev = self._revisions.get(key, 0) + 1
+            self._revisions[key] = rev
+            self._table(app_id, channel_id)[eid] = event.with_id(
+                eid
+            ).with_revision(rev)
+            self._rev_log.setdefault(key, []).append((rev, eid))
             self._index(app_id, channel_id).setdefault(
                 event.entity_id, set()
             ).add(eid)
@@ -95,6 +133,8 @@ class MemoryEventStore(base.EventStore):
                     prev.entity_id, set()
                 ).discard(event_id)
                 self._bump(app_id, channel_id)
+                if prev.revision is not None:
+                    self._note_stale(self._key(app_id, channel_id))
             return prev is not None
 
     def get(
@@ -166,6 +206,45 @@ class MemoryEventStore(base.EventStore):
             n = len(self._table(app_id, channel_id))
             ver = self._versions.get((app_id, channel_id), 0)
             return f"{n}:{ver}"
+
+    def latest_revision(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> int:
+        with self._lock:
+            return self._revisions.get(self._key(app_id, channel_id), 0)
+
+    def find_since(
+        self,
+        app_id: int,
+        after_revision: int,
+        channel_id: Optional[int] = None,
+        limit: Optional[int] = None,
+        shard: Optional[tuple[int, int]] = None,
+    ) -> list[Event]:
+        import bisect
+
+        with self._lock:
+            log = self._rev_log.get(self._key(app_id, channel_id), [])
+            # cut by REVISION alone: a 1-tuple sorts below every
+            # (same-rev, eid) pair, so the cutoff is correct no matter
+            # what code points a client-supplied event id contains (a
+            # string sentinel like "￿" re-delivers ids above it)
+            start = bisect.bisect_left(log, (after_revision + 1,))
+            table = self._table(app_id, channel_id)
+            out: list[Event] = []
+            for rev, eid in log[start:]:
+                if limit is not None and 0 <= limit <= len(out):
+                    break  # checked BEFORE append: limit=0 means empty
+                e = table.get(eid)
+                # skip deleted rows and overwrite-superseded log entries
+                if e is None or e.revision != rev:
+                    continue
+                if shard is not None and base.shard_of(
+                    e.entity_id, shard[1]
+                ) != shard[0]:
+                    continue
+                out.append(e)
+        return out
 
 
 class MemoryApps(base.Apps):

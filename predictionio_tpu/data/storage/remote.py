@@ -321,6 +321,41 @@ class RemoteEventStore(_RemoteDao, base.EventStore):
             self.DAO, "insert", event, app_id, channel_id, _req_id=req_id
         )
 
+    def insert_batch_with_req_id(
+        self, events: Sequence[Event], app_id: int,
+        channel_id: Optional[int], req_id: str,
+    ) -> list[str]:
+        """Bulk insert under ONE caller-stable request id — the WAL
+        batch-replay contract (ISSUE 9 satellite): a re-sent batch whose
+        first send already applied replays the daemon's recorded outcome
+        instead of re-executing, so replay throughput gets the
+        50×-amortized RPC without giving up exactly-once."""
+        return self._client.call(
+            self.DAO, "insert_batch", list(events), app_id, channel_id,
+            _req_id=req_id,
+        )
+
+    def latest_revision(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> int:
+        return self._call("latest_revision", app_id, channel_id)
+
+    def find_since(
+        self,
+        app_id: int,
+        after_revision: int,
+        channel_id: Optional[int] = None,
+        limit: Optional[int] = None,
+        shard: Optional[tuple[int, int]] = None,
+    ) -> list[Event]:
+        """Revision-tail read, server-side filtered (ISSUE 9): the daemon
+        runs its DAO's indexed range scan; only the page crosses the
+        wire."""
+        return self._call(
+            "find_since", app_id, after_revision, channel_id=channel_id,
+            limit=limit, shard=list(shard) if shard is not None else None,
+        )
+
     def delete(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None
     ) -> bool:

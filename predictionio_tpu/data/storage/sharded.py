@@ -333,6 +333,32 @@ class ShardedEventStore(base.EventStore):
             for sx in range(self.n_shards)
         ]
 
+    # -- insert-revision tailing (ISSUE 9) ---------------------------------
+    def revision_streams(self):
+        """One tail stream per shard, each filtered server-side to the
+        shard's PRIMARY copies (`shard=(sx, N)` — successor-replica
+        copies have a foreign entity hash and are excluded), so a
+        consumer folding all streams sees every event exactly once even
+        with REPLICAS > 1. Revisions are per-shard monotonic; the
+        consumer's durable cursor keeps one entry per stream key."""
+        return [
+            (f"shard{sx}", s, (sx, self.n_shards))
+            for sx, s in enumerate(self._stores)
+        ]
+
+    def find_since(
+        self,
+        app_id: int,
+        after_revision: int,
+        channel_id: Optional[int] = None,
+        limit: Optional[int] = None,
+        shard: Optional[tuple[int, int]] = None,
+    ):
+        raise StorageError(
+            "sharded stores have no single revision sequence; tail the "
+            "per-shard streams from revision_streams() instead"
+        )
+
     # -- writes: routed by entity hash ------------------------------------
     def insert(
         self, event: Event, app_id: int, channel_id: Optional[int] = None

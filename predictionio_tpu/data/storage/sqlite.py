@@ -79,6 +79,27 @@ class SqliteEventStore(base.EventStore):
         "(tbl TEXT PRIMARY KEY, ver INTEGER NOT NULL)"
     )
 
+    # server-assigned insert revisions (ISSUE 9): one monotonic counter
+    # per events table, advanced under the client lock at insert so the
+    # tail order cannot be skewed by client-supplied event times
+    _REVISIONS_DDL = (
+        "CREATE TABLE IF NOT EXISTS pio_insert_revisions "
+        "(tbl TEXT PRIMARY KEY, rev INTEGER NOT NULL)"
+    )
+
+    def _next_revisions(self, name: str, n: int) -> int:
+        """Advance the table's revision counter by `n`; returns the FIRST
+        assigned revision. Caller holds the client lock."""
+        self._client.conn.execute(
+            "INSERT INTO pio_insert_revisions VALUES (?, ?) "
+            "ON CONFLICT(tbl) DO UPDATE SET rev = rev + ?",
+            (name, n, n),
+        )
+        (last,) = self._client.conn.execute(
+            "SELECT rev FROM pio_insert_revisions WHERE tbl = ?", (name,)
+        ).fetchone()
+        return last - n + 1
+
     def _bump(self, name: str) -> None:
         self._client.conn.execute(
             "INSERT INTO pio_data_versions VALUES (?, 1) "
@@ -92,6 +113,7 @@ class SqliteEventStore(base.EventStore):
             return name
         with self._client.lock:
             self._client.conn.execute(self._VERSIONS_DDL)
+            self._client.conn.execute(self._REVISIONS_DDL)
             self._client.conn.execute(
                 f"""CREATE TABLE IF NOT EXISTS {name} (
                     id TEXT PRIMARY KEY,
@@ -104,14 +126,35 @@ class SqliteEventStore(base.EventStore):
                     eventTime INTEGER NOT NULL,
                     tags TEXT,
                     prId TEXT,
-                    creationTime INTEGER NOT NULL
+                    creationTime INTEGER NOT NULL,
+                    revision INTEGER
                 )"""
             )
+            # migrate pre-revision tables in place (ISSUE 9); existing
+            # rows keep NULL revisions — only new inserts are tailable,
+            # which is the semantics a consumer attached mid-life wants
+            try:
+                self._client.conn.execute(
+                    f"ALTER TABLE {name} ADD COLUMN revision INTEGER"
+                )
+            except sqlite3.OperationalError:
+                pass  # column already exists
             self._client.conn.execute(
                 f"CREATE INDEX IF NOT EXISTS idx_{name}_time ON {name} (eventTime)"
             )
             self._client.conn.execute(
                 f"CREATE INDEX IF NOT EXISTS idx_{name}_entity ON {name} (entityType, entityId)"
+            )
+            self._client.conn.execute(
+                f"CREATE INDEX IF NOT EXISTS idx_{name}_rev ON {name} (revision)"
+            )
+            # seed the counter from any revisions already present (a
+            # restart must continue the sequence, never reuse it)
+            self._client.conn.execute(
+                "INSERT INTO pio_insert_revisions VALUES (?, "
+                f"COALESCE((SELECT MAX(revision) FROM {name}), 0)) "
+                "ON CONFLICT(tbl) DO NOTHING",
+                (name,),
             )
             self._client.conn.commit()
         self._known_tables.add(name)
@@ -133,7 +176,7 @@ class SqliteEventStore(base.EventStore):
         with self._client.lock:
             self._client.conn.commit()
 
-    def _row(self, event: Event, eid: str) -> tuple:
+    def _row(self, event: Event, eid: str, revision: int) -> tuple:
         return (
             eid,
             event.event,
@@ -146,6 +189,7 @@ class SqliteEventStore(base.EventStore):
             json.dumps(list(event.tags)) if event.tags else None,
             event.pr_id,
             _ms(event.creation_time),
+            revision,
         )
 
     def insert(
@@ -154,9 +198,10 @@ class SqliteEventStore(base.EventStore):
         name = self._ensure_table(app_id, channel_id)
         eid = event.event_id or new_event_id()
         with self._client.lock:
+            rev = self._next_revisions(name, 1)
             self._client.conn.execute(
-                f"INSERT OR REPLACE INTO {name} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
-                self._row(event, eid),
+                f"INSERT OR REPLACE INTO {name} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+                self._row(event, eid, rev),
             )
             self._bump(name)
             self._client.conn.commit()
@@ -166,9 +211,13 @@ class SqliteEventStore(base.EventStore):
         name = self._ensure_table(app_id, channel_id)
         ids = [e.event_id or new_event_id() for e in events]
         with self._client.lock:
+            rev0 = self._next_revisions(name, len(events)) if events else 0
             self._client.conn.executemany(
-                f"INSERT OR REPLACE INTO {name} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
-                [self._row(e, eid) for e, eid in zip(events, ids)],
+                f"INSERT OR REPLACE INTO {name} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+                [
+                    self._row(e, eid, rev0 + i)
+                    for i, (e, eid) in enumerate(zip(events, ids))
+                ],
             )
             self._bump(name)
             self._client.conn.commit()
@@ -201,6 +250,7 @@ class SqliteEventStore(base.EventStore):
             tags,
             pr_id,
             ctime,
+            *rest,  # revision column (absent on pre-migration SELECTs)
         ) = row
         return Event(
             event=event,
@@ -214,6 +264,7 @@ class SqliteEventStore(base.EventStore):
             pr_id=pr_id,
             creation_time=_from_ms(ctime),
             event_id=eid,
+            revision=rest[0] if rest and rest[0] is not None else None,
         )
 
     def get(
@@ -236,6 +287,44 @@ class SqliteEventStore(base.EventStore):
         with self._client.lock:
             rows = self._client.conn.execute(sql, params).fetchall()
         return (self._to_event(r) for r in rows)
+
+    def latest_revision(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> int:
+        name = self._ensure_table(app_id, channel_id)
+        with self._client.lock:
+            row = self._client.conn.execute(
+                "SELECT rev FROM pio_insert_revisions WHERE tbl = ?",
+                (name,),
+            ).fetchone()
+        return int(row[0]) if row else 0
+
+    def find_since(
+        self,
+        app_id: int,
+        after_revision: int,
+        channel_id: Optional[int] = None,
+        limit: Optional[int] = None,
+        shard: Optional[tuple[int, int]] = None,
+    ) -> list[Event]:
+        """Indexed tail read: revision > cursor, revision-ordered —
+        O(page) per call via idx_<table>_rev."""
+        name = self._ensure_table(app_id, channel_id)
+        clauses = ["revision > ?"]
+        params: list = [int(after_revision)]
+        if shard is not None:
+            clauses.append("pio_shard(entityId, ?) = ?")
+            params.extend([int(shard[1]), int(shard[0])])
+        lim = (
+            f"LIMIT {int(limit)}" if limit is not None and limit >= 0 else ""
+        )
+        sql = (
+            f"SELECT * FROM {name} WHERE {' AND '.join(clauses)} "
+            f"ORDER BY revision ASC {lim}"
+        )
+        with self._client.lock:
+            rows = self._client.conn.execute(sql, params).fetchall()
+        return [self._to_event(r) for r in rows]
 
     def data_signature(
         self, app_id: int, channel_id: Optional[int] = None

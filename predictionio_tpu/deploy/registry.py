@@ -228,6 +228,11 @@ class ModelVersion:
     train_metrics: dict[str, Any] = field(default_factory=dict)
     devprof: dict[str, Any] = field(default_factory=dict)
     reason: Optional[str] = None  # why rolled_back/archived
+    # submitting train-job id (ISSUE 9 satellite): a RETRIED job finds
+    # its already-registered version by this stamp and adopts it instead
+    # of retraining (the scheduler's infra-retry after a crash between
+    # register and the result receipt)
+    job_id: Optional[str] = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -244,16 +249,20 @@ class ModelVersion:
             "train_metrics": self.train_metrics,
             "devprof": self.devprof,
             "reason": self.reason,
+            "job_id": self.job_id,
         }
 
     @staticmethod
     def from_dict(d: dict) -> "ModelVersion":
         return ModelVersion(**{
-            k: d.get(k, None if k in ("parent_version", "reason") else "")
+            k: d.get(
+                k,
+                None if k in ("parent_version", "reason", "job_id") else "",
+            )
             for k in (
                 "id", "engine_id", "engine_version", "engine_variant",
                 "instance_id", "params_hash", "status", "created_at",
-                "updated_at", "parent_version", "reason",
+                "updated_at", "parent_version", "reason", "job_id",
             )
         } | {
             "train_metrics": d.get("train_metrics") or {},
@@ -290,6 +299,7 @@ class ModelRegistry:
         instance: EngineInstance,
         train_metrics: Optional[dict] = None,
         devprof: Optional[dict] = None,
+        job_id: Optional[str] = None,
     ) -> ModelVersion:
         """Record a COMPLETED train run as a new ``trained`` version.
         Lineage: `parent_version` points at the variant's live version at
@@ -324,9 +334,19 @@ class ModelRegistry:
             parent_version=live.id if live else None,
             train_metrics=metrics,
             devprof=dict(devprof or {}),
+            job_id=job_id,
         )
         self._store.append(VERSION_ENTITY, version.id, version.to_dict())
         return version
+
+    def find_by_job(self, job_id: str) -> Optional[ModelVersion]:
+        """The version a train job already registered, if any — the
+        retried-job adoption read (newest wins if a bug ever stamped
+        two)."""
+        if not job_id:
+            return None
+        hits = [v for v in self.list() if v.job_id == job_id]
+        return hits[0] if hits else None
 
     def set_status(
         self, version_id: str, status: str, reason: Optional[str] = None

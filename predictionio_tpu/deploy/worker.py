@@ -44,6 +44,32 @@ def main(argv: list[str]) -> int:
     with open(argv[1]) as f:
         spec = json.load(f)
     storage = Storage(storage_config_from_json(spec["storage"]))
+
+    # retried-job adoption (ISSUE 9 satellite): if a previous attempt of
+    # THIS job already trained and registered a version — and only the
+    # result receipt / bookkeeping was lost — adopt it instead of paying
+    # a full duplicate train. The job id is stamped on every version
+    # this worker registers (below), so the check is one registry fold.
+    job_id = spec.get("job_id")
+    if job_id:
+        try:
+            existing = ModelRegistry(storage).find_by_job(job_id)
+        except Exception:
+            existing = None  # storage hiccup: fall through to training
+        if existing is not None and existing.status not in (
+            "rolled_back", "archived"
+        ):
+            with open(spec["result_path"], "w") as f:
+                json.dump({
+                    "instance_id": existing.instance_id,
+                    "model_version": existing.id,
+                }, f)
+            print(
+                f"job {job_id}: adopting already-registered version "
+                f"{existing.id} (instance {existing.instance_id}); "
+                f"skipping retrain"
+            )
+            return 0
     try:
         instance = run_train(
             storage, spec["variant"], engine_id=spec.get("engine_id")
@@ -70,7 +96,7 @@ def main(argv: list[str]) -> int:
         pass  # profiling is best-effort; the version record stays valid
 
     version = ModelRegistry(storage).register(
-        instance, devprof=devprof_snapshot
+        instance, devprof=devprof_snapshot, job_id=job_id,
     )
     with open(spec["result_path"], "w") as f:
         json.dump(

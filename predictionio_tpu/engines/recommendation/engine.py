@@ -246,6 +246,11 @@ class ALSAlgorithmParams:
     # > 0: snapshot factor state into MODELDATA every N iterations so an
     # interrupted train resumes (workflow/checkpoint.py); 0 disables
     checkpoint_every: int = 0
+    # warm-start retrains from the variant's LIVE registry version
+    # (ISSUE 9): the parent's factors are mapped onto the new vocab so a
+    # periodic retrain reconverges WITH the online fold-in stream
+    # instead of re-deriving everything from random init
+    warm_start: bool = False
 
 
 class ALSModel:
@@ -333,8 +338,65 @@ class ALSAlgorithm(Algorithm):
             user_vocab=pd.user_vocab,
             item_vocab=pd.item_vocab,
             mesh=ctx.mesh,
+            init_factors=self._warm_start_init(ctx, pd, als_params),
         )
         return ALSModel(factors, item_categories=pd.item_categories)
+
+    def _warm_start_init(self, ctx: RuntimeContext, pd: TrainingData,
+                         als_params: als.ALSParams):
+        """Parent-version factors mapped onto the new vocab (ISSUE 9):
+        resolved through the registry lineage — the variant's live
+        version is exactly the `parent_version` this train's new record
+        will point at. Best-effort: any failure falls back to the cold
+        random init."""
+        if not self.params.warm_start or ctx.storage is None:
+            return None
+        try:
+            if not ctx.instance_id:
+                return None
+            inst = ctx.storage.get_meta_data_engine_instances().get(
+                ctx.instance_id
+            )
+            if inst is None:
+                return None
+            from predictionio_tpu.deploy.registry import ModelRegistry
+
+            live = ModelRegistry(ctx.storage).live_version(
+                inst.engine_id, inst.engine_variant
+            )
+            if live is None:
+                return None
+            blob = ctx.storage.get_model_data_models().get(live.instance_id)
+            if blob is None:
+                return None
+            from predictionio_tpu.controller.persistent import (
+                deserialize_models,
+            )
+
+            parent = next(
+                (
+                    m.factors for m in deserialize_models(blob.models)
+                    if hasattr(m, "factors")
+                ),
+                None,
+            )
+            if parent is None or parent.params.rank != als_params.rank:
+                return None
+            import logging as _logging
+
+            _logging.getLogger(__name__).info(
+                "warm-starting train from live version %s", live.id
+            )
+            return als.warm_start_factors(
+                parent, pd.user_vocab, pd.item_vocab, als_params
+            )
+        except Exception:
+            import logging as _logging
+
+            _logging.getLogger(__name__).warning(
+                "warm start unavailable; using cold init", exc_info=True
+            )
+            return None
 
     def train_grid(
         self, ctx: RuntimeContext, pd: TrainingData, params_list

@@ -1683,3 +1683,143 @@ def score_pairs(model: ALSFactors, user_idx: np.ndarray, item_idx: np.ndarray) -
     u = model.user_factors[np.asarray(user_idx)]
     i = model.item_factors[np.asarray(item_idx)]
     return np.sum(u * i, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Online fold-in (ISSUE 9): single-side incremental solve
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("implicit", "cg_iterations"))
+def _fold_in_jit(
+    fixed: jax.Array,  # (N, K) — the OPPOSITE side's factors, held fixed
+    edge_idx: jax.Array,  # (R, E) int32 — rows into `fixed` (0 on pads)
+    edge_val: jax.Array,  # (R, E) — ratings/weights (0 on pads)
+    edge_ok: jax.Array,  # (R, E) — 1.0 real edge / 0.0 padding
+    lam: jax.Array,  # () f32
+    alpha: jax.Array,  # () f32
+    *,
+    implicit: bool,
+    cg_iterations: int,
+) -> jax.Array:
+    """Solve R dirty rows' k×k regularized normal-equation systems against
+    the fixed opposite factor matrix — ONE ALS half-step restricted to the
+    dirty rows (the classic fold-in). Identical operator assembly to
+    `_half_step_implicit` / `_half_step_explicit`, but over a dense
+    (R, E) per-row edge block instead of the global COO list, so a tick's
+    worth of new/changed users solves as one tiny batched device program.
+    lam/alpha ride as traced scalars: parameter changes don't recompile."""
+    n, k = fixed.shape
+    y = fixed[edge_idx]  # (R, E, K)
+    eye = jnp.eye(k, dtype=jnp.float32)
+    if implicit:
+        conf = 1.0 + alpha * jnp.abs(edge_val)
+        pref = (edge_val > 0).astype(jnp.float32)
+        w_b = conf * pref * edge_ok
+        w_g = (conf - 1.0) * edge_ok
+        gram = f32_gram(fixed)
+        b = jnp.einsum("re,rek->rk", w_b, y)
+        a = (
+            jnp.einsum("re,rek,rel->rkl", w_g, y, y)
+            + gram[None, :, :]
+            + lam * eye
+        )
+    else:
+        w_b = edge_val * edge_ok
+        b = jnp.einsum("re,rek->rk", w_b, y)
+        deg = jnp.sum(edge_ok, axis=1)
+        reg = lam * jnp.maximum(deg, 1.0)
+        a = (
+            jnp.einsum("re,rek,rel->rkl", edge_ok, y, y)
+            + reg[:, None, None] * eye
+        )
+
+    def matvec(v):
+        return jnp.einsum("rkl,rl->rk", a, v)
+
+    return batched_cg(matvec, b, jnp.zeros_like(b), cg_iterations)
+
+
+_fold_in_jit = _devprof.instrument("als.fold_in", _fold_in_jit, memory=True)
+
+
+def _fold_edge_bucket(n: int) -> int:
+    """Pow2 ladder with a floor of 8 for the per-row edge axis — bounds
+    distinct compiled fold-in shapes the way serving buckets do."""
+    return max(8, 1 << (max(n, 1) - 1).bit_length())
+
+
+def fold_in_rows(
+    fixed: np.ndarray,  # (N, K) opposite-side factors (host or device)
+    edges: Sequence[Sequence[tuple[int, float]]],  # per dirty row: (fixed_row, value)
+    params: ALSParams,
+    fixed_device: Optional[jax.Array] = None,
+) -> np.ndarray:
+    """Public single-side fold-in solve (ISSUE 9): for each dirty row,
+    solve its regularized least-squares system against the FIXED opposite
+    factor matrix and return the (R, K) solved factors.
+
+    Row/edge axes are bucketed to a small pow2 ladder so a streaming
+    consumer's ticks reuse a handful of compiled programs; pads carry
+    edge_ok=0 and are inert in every term (same discipline as the train
+    paths). Rows with zero edges solve to exactly zero."""
+    from predictionio_tpu.utils.bucket import batch_bucket
+
+    if not edges:
+        return np.zeros((0, params.rank), np.float32)
+    r_real = len(edges)
+    r_pad = batch_bucket(r_real)
+    e_pad = _fold_edge_bucket(max(len(e) for e in edges))
+    idx = np.zeros((r_pad, e_pad), np.int32)
+    val = np.zeros((r_pad, e_pad), np.float32)
+    ok = np.zeros((r_pad, e_pad), np.float32)
+    for r, row in enumerate(edges):
+        for e, (j, v) in enumerate(row):
+            idx[r, e] = j
+            val[r, e] = v
+            ok[r, e] = 1.0
+    fx = fixed_device if fixed_device is not None else jnp.asarray(
+        np.asarray(fixed, np.float32)
+    )
+    solved = _fold_in_jit(
+        fx, jnp.asarray(idx), jnp.asarray(val), jnp.asarray(ok),
+        jnp.float32(params.lambda_), jnp.float32(params.alpha),
+        implicit=params.implicit_prefs,
+        cg_iterations=params.cg_iterations,
+    )
+    return np.asarray(solved)[:r_real]
+
+
+def warm_start_factors(
+    parent: ALSFactors,
+    user_vocab: BiMap,
+    item_vocab: BiMap,
+    params: ALSParams,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Map a parent version's factors onto a NEW training vocabulary —
+    the warm start that makes periodic retrains reconverge with the
+    stream instead of re-deriving it from noise (ISSUE 9). Rows whose id
+    survives copy the parent's factors; brand-new rows get the standard
+    scaled gaussian init (ALS is memoryless in factor state, so a warm
+    start changes the trajectory, not the fixed point)."""
+    rng = np.random.RandomState(params.seed)
+
+    def align(old_vocab: BiMap, new_vocab: BiMap, old: np.ndarray, n: int):
+        out = (
+            rng.standard_normal((n, params.rank)).astype(np.float32)
+            / np.sqrt(params.rank)
+        )
+        k = min(params.rank, old.shape[1]) if old.size else 0
+        for ident, new_row in new_vocab.items():
+            old_row = old_vocab.get(ident)
+            if old_row is not None and old_row < old.shape[0] and k:
+                out[new_row, :k] = old[old_row, :k]
+        return out
+
+    uf0 = align(
+        parent.user_vocab, user_vocab, parent.user_factors, len(user_vocab)
+    )
+    itf0 = align(
+        parent.item_vocab, item_vocab, parent.item_factors, len(item_vocab)
+    )
+    return uf0, itf0

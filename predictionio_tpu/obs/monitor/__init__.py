@@ -24,6 +24,7 @@ import os
 import threading
 from typing import Any, Optional
 
+from predictionio_tpu.obs.monitor.notify import AlertNotifier
 from predictionio_tpu.obs.monitor.scrape import (
     FleetScraper,
     parse_prometheus_text,
@@ -44,6 +45,7 @@ from predictionio_tpu.utils.env import env_float
 
 __all__ = [
     "TSDB",
+    "AlertNotifier",
     "MetricsSampler",
     "FleetScraper",
     "SLOEngine",
@@ -85,6 +87,13 @@ class Monitor:
         self._sampler: Optional[MetricsSampler] = None
         self._engine: Optional[SLOEngine] = None
         self._slos: list[SLOSpec] = load_slos()
+        # push sinks (ISSUE 9 satellite): webhook/exec fired on
+        # pending→firing (and resolve) transitions — SLO alerts AND the
+        # externally-raised ones below
+        self.notifier: AlertNotifier = AlertNotifier.from_env()
+        # externally-managed alerts (e.g. the online drift-pause): name →
+        # status dict, merged into alerts_payload and the firing gauge
+        self._external: dict[str, dict] = {}
 
     # -- what the sampler samples ------------------------------------------
     def _families(self) -> list:
@@ -152,7 +161,8 @@ class Monitor:
                 self._sampler.start()
             if self._engine is None and self._slos:
                 self._engine = SLOEngine(
-                    self.tsdb, self._slos, self.slo_interval_s
+                    self.tsdb, self._slos, self.slo_interval_s,
+                    on_transition=self._on_transition,
                 )
                 self._engine.start()
 
@@ -167,6 +177,77 @@ class Monitor:
                 self._engine.set_specs(self._slos)
         self._ensure_threads()
 
+    def _on_transition(
+        self, payload: dict, old_state: str, new_state: str
+    ) -> None:
+        if new_state in ("firing", "resolved"):
+            self.notifier.notify(dict(
+                payload, transition=f"{old_state}->{new_state}"
+            ))
+
+    # -- external alerts (ISSUE 9: drift-pause visibility) -----------------
+    def _firing_gauge(self):
+        from predictionio_tpu.obs.registry import get_default_registry
+
+        return get_default_registry().gauge(
+            "alerts_firing", "SLO alerts currently firing (1) or not (0)",
+            ("slo",),
+        )
+
+    def raise_alert(self, name: str, info: Optional[dict] = None) -> None:
+        """Raise (or refresh) an externally-managed alert: visible at
+        `GET /alerts` / `pio alerts`, exported on `alerts_firing{slo}`,
+        and pushed through the notification sinks on the inactive→firing
+        edge."""
+        import time as _time
+
+        with self._lock:
+            prev = self._external.get(name)
+            was_firing = prev is not None and prev.get("state") == "firing"
+            st = {
+                "slo": name,
+                "state": "firing",
+                "external": True,
+                "since": (
+                    prev.get("since") if was_firing else _time.time()
+                ),
+                **(info or {}),
+            }
+            self._external[name] = st
+        try:
+            self._firing_gauge().set(1.0, slo=name)
+        except Exception:
+            pass
+        if not was_firing:
+            self.notifier.notify(dict(st, transition="inactive->firing"))
+
+    def resolve_alert(self, name: str) -> None:
+        import time as _time
+
+        with self._lock:
+            st = self._external.get(name)
+            if st is None or st.get("state") != "firing":
+                return
+            # resolved entries are DROPPED (after notifying), not kept:
+            # unlike SLO alerts (fixed spec set, states cycle in place),
+            # external names are open-ended — keeping every resolved
+            # one would grow /alerts and the firing-gauge label set
+            # monotonically over pause/resume cycles
+            self._external.pop(name, None)
+            st = dict(st, state="resolved", since=_time.time())
+        try:
+            # remove, don't zero: open-ended external names (one per
+            # consumer cursor) would otherwise leave a dead 0-series
+            # per name on /metrics — and in the TSDB — forever
+            self._firing_gauge().remove(slo=name)
+        except Exception:
+            pass
+        self.notifier.notify(dict(st, transition="firing->resolved"))
+
+    def _external_rows(self) -> list[dict]:
+        with self._lock:
+            return [dict(v) for v in self._external.values()]
+
     @property
     def engine(self) -> Optional[SLOEngine]:
         return self._engine
@@ -178,10 +259,12 @@ class Monitor:
 
     def alerts_payload(self) -> dict:
         """The `GET /alerts` body — stable shape whether or not the
-        engine is running."""
+        engine is running. Externally-raised alerts (drift-pause) merge
+        into `alerts`/`firing` alongside the SLO ones."""
         engine = self._engine
+        ext = self._external_rows()
         if engine is None:
-            return {
+            out = {
                 "enabled": enabled(),
                 "slos": [s.to_dict() for s in self._slos],
                 "alerts": [],
@@ -192,7 +275,16 @@ class Monitor:
                          "Monitor.set_slos)"
                 ),
             }
-        return {"enabled": True, **engine.payload()}
+        else:
+            out = {"enabled": True, **engine.payload()}
+        if ext:
+            out["alerts"] = list(out.get("alerts", [])) + [
+                r for r in ext if r.get("state") != "inactive"
+            ]
+            out["firing"] = list(out.get("firing", [])) + [
+                r["slo"] for r in ext if r.get("state") == "firing"
+            ]
+        return out
 
     def tsdb_payload(self, qs: dict[str, str]) -> dict:
         """The `GET /debug/tsdb` body: summary by default; `?name=`

@@ -219,9 +219,14 @@ class SLOEngine:
 
     def __init__(self, tsdb: TSDB, specs: list[SLOSpec],
                  interval_s: float = 15.0,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 on_transition=None):
         self.tsdb = tsdb
         self.interval_s = max(0.05, float(interval_s))
+        # notification hook (ISSUE 9 satellite): called OUTSIDE the lock
+        # as (status_dict, old_state, new_state) on every state change —
+        # the Monitor wires the webhook/exec sinks through it
+        self.on_transition = on_transition
         self._lock = threading.Lock()
         self._status: dict[str, AlertStatus] = {
             s.name: AlertStatus(spec=s) for s in specs
@@ -345,6 +350,7 @@ class SLOEngine:
         now = time.time() if now is None else now
         with self._lock:
             statuses = list(self._status.values())
+        transitions: list[tuple[dict, str, str]] = []
         for st in statuses:
             spec = st.spec
             fast, fast_n = self.burn_rate(spec, spec.fast_window_s, now)
@@ -368,8 +374,19 @@ class SLOEngine:
                     fast >= spec.burn_threshold
                     and slow >= spec.burn_threshold
                 )
+                old_state = st.state
                 self._step_locked(st, breach, now)
                 self._export_locked(st)
+                if st.state != old_state:
+                    transitions.append((st.to_dict(), old_state, st.state))
+        # notification sinks fire OUTSIDE the lock: a slow webhook must
+        # not serialize alert evaluation
+        if self.on_transition is not None:
+            for payload, old_state, new_state in transitions:
+                try:
+                    self.on_transition(payload, old_state, new_state)
+                except Exception:
+                    log.exception("alert transition hook failed")
 
     def _step_locked(self, st: AlertStatus, breach: bool,
                      now: float) -> None:

@@ -108,6 +108,13 @@ class MetricFamily:
             )
         return tuple(str(labels[n]) for n in self.labelnames)
 
+    def remove(self, **labels: Any) -> bool:
+        """Drop one label-set child (ISSUE 9: open-ended label values —
+        per-consumer alert names — must not leave dead series on
+        /metrics forever). Returns whether it existed."""
+        with self._lock:
+            return self._children.pop(self._values(**labels), None) is not None
+
 
 class CounterFamily(MetricFamily):
     kind = "counter"

@@ -1,0 +1,50 @@
+"""Online learning (ISSUE 9): stream live events into the serving model
+between retrains.
+
+The Lambda-architecture staleness gap — events stream in continuously
+but models only change at the next batch train — closes here with three
+pieces:
+
+- a **stream consumer** (`consumer.py`) tailing the event store from a
+  durable cursor over server-assigned insert revisions (skew-proof fold
+  order; the cursor is a lifecycle record, so a restarted consumer
+  resumes exactly),
+- a **fold-in updater** (`foldin.py`) that re-solves each dirty user's
+  (symmetrically, item's) k×k regularized least-squares system against
+  the fixed opposite factor matrix — `models/als.py:fold_in_rows`, the
+  same batched-CG pieces the train loops use — growing factor matrices
+  and vocabularies in amortized chunks,
+- a **drift guard** (`drift.py`) comparing the folded model's score
+  distribution against the last-trained baseline: past the threshold,
+  fold-in pauses, an alert fires, and the last-good model keeps serving.
+
+Updates land in the live runtime via a copy-on-write sub-swap under the
+query server's runtime-swap lock (readers never see a torn model; the
+dispatcher's group-by-runtime drain makes the swap zero-drop), or into a
+tenant's cached runtime via `ModelCache.swap_runtime`.
+
+Import discipline: this package sits on server control paths — it must
+not import jax (models/als.py is imported lazily inside apply ticks).
+"""
+
+from predictionio_tpu.online.consumer import (
+    CURSOR_ENTITY,
+    OnlineConsumer,
+    OnlineConsumerConfig,
+    ServerApplyHost,
+    TenantApplyHost,
+)
+from predictionio_tpu.online.drift import DriftGuard, score_drift
+from predictionio_tpu.online.foldin import ALSFoldIn, FoldInConfig
+
+__all__ = [
+    "ALSFoldIn",
+    "CURSOR_ENTITY",
+    "DriftGuard",
+    "FoldInConfig",
+    "OnlineConsumer",
+    "OnlineConsumerConfig",
+    "ServerApplyHost",
+    "TenantApplyHost",
+    "score_drift",
+]

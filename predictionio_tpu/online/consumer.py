@@ -1,0 +1,569 @@
+"""Stream consumer: tail the event store, fold, apply, advance the cursor.
+
+One background thread per consumer. Each tick:
+
+1. **tail** — `find_since` on every revision stream (one for a plain
+   store, one per shard for a sharded one, primary-copy filtered) from
+   the durable cursor's per-stream positions. Revisions are assigned
+   server-side at insert, so the fold order is skew-proof — no
+   client-clock event time can reorder it.
+2. **fold** — `ALSFoldIn.apply` re-solves every dirty user's (and new
+   item's) factor row against the fixed opposite side; the result is a
+   copy-on-write model + runtime.
+3. **guard** — the folded factors are drift-checked against the
+   last-trained baseline BEFORE publishing; a breach pauses fold-in,
+   raises a monitor alert, and leaves the last-good model serving (the
+   cursor does not advance — nothing is lost).
+4. **apply** — the new runtime swaps in under the host's runtime-swap
+   discipline (the query server's swap lock, or the tenant cache's
+   conditional swap). A lost race (a retrain promoted mid-tick) aborts
+   the publish; the tick retries against the new runtime.
+5. **persist** — the cursor AND the cumulative fold counters land in ONE
+   lifecycle-record append. That atomicity is the exactly-once
+   accounting contract: a crash anywhere before the append replays the
+   tick (folding is a state-based re-solve, so replaying is idempotent
+   in model state) and the counters count each event once.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from predictionio_tpu.online.drift import DriftGuard
+from predictionio_tpu.online.foldin import ALSFoldIn, FoldInConfig
+from predictionio_tpu.utils.env import env_float
+
+log = logging.getLogger(__name__)
+
+CURSOR_ENTITY = "pio_online_cursor"
+
+DRIFT_ALERT = "online_drift_pause"
+
+
+@dataclass
+class OnlineConsumerConfig:
+    tick_s: float = field(
+        default_factory=lambda: env_float("PIO_ONLINE_TICK_S", 0.5)
+    )
+    batch_limit: int = 512  # events per stream per tick
+    foldin: FoldInConfig = field(default_factory=FoldInConfig)
+    drift_threshold: float = field(
+        default_factory=lambda: env_float("PIO_ONLINE_DRIFT_THRESHOLD", 1.0)
+    )
+    # compact the cursor record fold every N persisted ticks (single
+    # writer → the quiescence guard is unnecessary; min_age_s=0)
+    compact_every: int = 64
+    name: Optional[str] = None  # cursor record id override
+    # a consumer with NO persisted cursor starts from the stream head by
+    # default (everything before it is already in the trained model);
+    # True skips history and tails from the store's current revision —
+    # the right choice when attaching to a long-lived store whose
+    # history would make the first tick re-fold every user ever seen
+    from_latest: bool = False
+
+
+class ServerApplyHost:
+    """Apply seam for the single-tenant query server: the swap happens
+    under the server's runtime-swap lock, conditional on the runtime
+    being the one the tick folded from (a /reload or promote that landed
+    mid-tick wins; the tick retries)."""
+
+    scope = "server"
+
+    def __init__(self, server):
+        self.server = server
+
+    def current(self):
+        return self.server.runtime
+
+    def swap(self, expected, new_runtime) -> bool:
+        with self.server._swap_lock:  # noqa: SLF001 — the documented seam
+            if self.server.runtime is not expected:
+                return False
+            self.server.runtime = new_runtime
+            return True
+
+
+class TenantApplyHost:
+    """Apply seam for one tenant's cached runtime in the mux: the swap is
+    `ModelCache.swap_runtime` — conditional, lease-safe (in-flight
+    queries drain on the old entry), and invisible to other tenants."""
+
+    def __init__(self, mux, tenant_id: str):
+        self.mux = mux
+        self.tenant_id = tenant_id
+        self.scope = f"tenant/{tenant_id}"
+
+    def current(self):
+        return self.mux.cache.peek_runtime(self.tenant_id)
+
+    def swap(self, expected, new_runtime) -> bool:
+        return self.mux.cache.swap_runtime(
+            self.tenant_id, expected, new_runtime
+        )
+
+
+class OnlineConsumer:
+    """Background event-stream consumer feeding one serving runtime."""
+
+    thread_name = "online-consumer"
+
+    def __init__(
+        self,
+        storage,
+        host,
+        app_id: int,
+        config: Optional[OnlineConsumerConfig] = None,
+        channel_id: Optional[int] = None,
+        metrics=None,
+    ):
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+        from predictionio_tpu.obs import get_default_registry
+
+        self.storage = storage
+        self.host = host
+        self.app_id = app_id
+        self.channel_id = channel_id
+        self.config = config or OnlineConsumerConfig()
+        self.foldin = ALSFoldIn(self.config.foldin)
+        self.guard = DriftGuard(threshold=self.config.drift_threshold)
+        self._records = LifecycleRecordStore(storage)
+        # The cursor record has ONE writer by contract: a restarted
+        # consumer resumes the same record (the crash-resume guarantee),
+        # so the default id is stable per (app, scope). A REPLICATED
+        # serving tier folding one app on shared storage must give each
+        # replica its own `config.name` — two writers on one record
+        # would leapfrog each other's cursors and race the eager
+        # compaction (ROADMAP follow-up: derive a durable replica id).
+        self.cursor_id = self.config.name or (
+            f"online/{app_id}/{getattr(host, 'scope', 'server')}"
+        )
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._paused: Optional[str] = None
+        self._drift_paused = False  # drift pauses auto-clear on retrain
+        self._last_runtime: Any = None
+        self._ticks_persisted = 0
+        self._last_error: Optional[str] = None
+        # test seam: crash after apply, before the cursor persist — the
+        # exactly-once window the chaos test replays through
+        self._crash_after_apply = False
+
+        # durable state: per-stream cursor + cumulative fold counters,
+        # resumed from the persisted record (restart = exact resume)
+        rec = self._records.fold(CURSOR_ENTITY, self.cursor_id).get(
+            self.cursor_id
+        ) or {}
+        self.cursor: dict[str, int] = {
+            k: int(v) for k, v in (rec.get("cursor") or {}).items()
+        }
+        if not rec and self.config.from_latest:
+            try:
+                for key, stream_store, _shard in (
+                    storage.get_events().revision_streams()
+                ):
+                    self.cursor[key] = stream_store.latest_revision(
+                        app_id, channel_id
+                    )
+            except Exception:
+                log.warning(
+                    "from_latest cursor seed failed; starting from the "
+                    "stream head", exc_info=True,
+                )
+        self.counters: dict[str, int] = {
+            k: int(rec.get(k, 0))
+            for k in (
+                "events_consumed", "events_folded", "users_folded",
+                "items_folded", "ticks",
+            )
+        }
+        # the baseline watermark: which trained instance the folds sit
+        # on top of, and where the cursor stood when it was adopted. A
+        # runtime REBUILT from the same instance (cache eviction, an
+        # operator /reload of an unchanged version) discarded every fold
+        # since that point — the cursor rewinds there and the window
+        # re-folds (state-based re-solve: idempotent). A genuinely NEW
+        # instance (retrain) advances the watermark instead.
+        self._baseline_instance: Optional[str] = (
+            rec.get("baseline_instance") or None
+        )
+        self._baseline_cursor: Optional[dict[str, int]] = (
+            {
+                k: int(v)
+                for k, v in (rec.get("baseline_cursor") or {}).items()
+            }
+            or None
+        )
+
+        self.metrics = metrics or get_default_registry()
+        self._consumed_ctr = self.metrics.counter(
+            "online_events_consumed_total",
+            "events read off the revision tail by the online consumer",
+        )
+        self._folded_ctr = self.metrics.counter(
+            "online_events_folded_total",
+            "relevant events folded into the serving model",
+        )
+        self._rows_ctr = self.metrics.counter(
+            "online_rows_folded_total",
+            "factor rows re-solved by fold-in, by side",
+            ("side",),
+        )
+        self._tick_hist = self.metrics.histogram(
+            "online_fold_tick_seconds",
+            "one consumer tick: tail read + fold solve + publish",
+        )
+        # gauges carry a per-consumer `scope` label: they are
+        # last-write-wins, and two consumers (server + tenants) sharing
+        # an unlabeled gauge would silently mask each other's state —
+        # the same collision class the per-consumer alert name solves
+        self._drift_gauge = self.metrics.gauge(
+            "online_drift_score",
+            "score-distribution drift of the folded model vs the "
+            "last-trained baseline",
+            ("scope",),
+        )
+        self._paused_gauge = self.metrics.gauge(
+            "online_paused",
+            "1 while fold-in is paused (drift breach or operator)",
+            ("scope",),
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=self.thread_name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+            if t.is_alive():
+                # a wedged tick (hung storage RPC): KEEP the handle — a
+                # re-attach replacing this consumer would otherwise
+                # start a second writer on the same single-writer
+                # cursor record while the zombie keeps folding
+                log.error(
+                    "online consumer thread for %s did not stop within "
+                    "10s; handle kept so no replacement can double-"
+                    "write the cursor", self.cursor_id,
+                )
+            else:
+                self._thread = None
+
+    def stopped(self) -> bool:
+        """True when no consumer thread is running — the precondition a
+        re-attach must check before starting a replacement on the same
+        cursor record."""
+        t = self._thread
+        return t is None or not t.is_alive()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.tick_s):
+            try:
+                self.tick()
+            except Exception:
+                self._last_error = "tick failed (see log)"
+                log.exception("online fold tick failed; will retry")
+
+    # -- pause / resume -----------------------------------------------------
+    @property
+    def paused(self) -> Optional[str]:
+        return self._paused
+
+    def pause(self, reason: str, by_drift: bool = False) -> None:
+        with self._lock:
+            self._paused = reason
+            self._drift_paused = by_drift
+        self._paused_gauge.set(1.0, scope=self.cursor_id)
+        log.warning("online fold-in paused: %s", reason)
+
+    @property
+    def alert_name(self) -> str:
+        """Per-consumer drift-alert id: two consumers (tenant A and B,
+        or two scopes) must not share one alert — resuming one would
+        silently resolve the other's still-firing page."""
+        return f"{DRIFT_ALERT}/{self.cursor_id}"
+
+    def resume(self) -> None:
+        """Clear a pause (operator action, or a retrain landing). The
+        un-advanced cursor re-folds the paused window — state-based
+        re-solve makes that idempotent."""
+        with self._lock:
+            self._paused = None
+            self._drift_paused = False
+        self._paused_gauge.set(0.0, scope=self.cursor_id)
+        try:
+            from predictionio_tpu.obs.monitor import get_monitor
+
+            get_monitor().resolve_alert(self.alert_name)
+        except Exception:
+            log.debug("drift alert resolve failed", exc_info=True)
+        log.info("online fold-in resumed")
+
+    # -- one tick -----------------------------------------------------------
+    def tick(self) -> dict[str, Any]:
+        """One synchronous consume-fold-apply-persist pass. Public so
+        tests and `pio online` drive it without the thread."""
+        t0 = time.perf_counter()
+        # retrain detection BEFORE the pause gate: the host's runtime
+        # changing under us means a retrain/promote landed — the new
+        # model is the new drift baseline, and a DRIFT pause auto-clears
+        # (the documented recovery: "retrain or POST /online/resume";
+        # an operator pause stays until an explicit resume)
+        runtime = self.host.current()
+        if runtime is None:
+            return {"idle": "no runtime"}
+        if runtime is not self._last_runtime:
+            _ix, model = self.foldin.find_model(runtime)
+            if model is not None:
+                self.guard.rebase(model.factors)
+            inst_id = getattr(
+                getattr(runtime, "instance", None), "id", None
+            )
+            if self._last_runtime is None:
+                # consumer (re)start: the serving runtime usually still
+                # carries the overlay (the server kept running while we
+                # were down), so no rewind — re-folding here would
+                # double-count the durable fold counters. The persisted
+                # watermark stays valid for future rebuild detection;
+                # it only resets if the instance actually changed. (A
+                # rebuild that happened WHILE the consumer was down is
+                # indistinguishable and not rewound — those rows stay
+                # stale until a retrain or fresh events re-dirty them.)
+                if (
+                    inst_id != self._baseline_instance
+                    or self._baseline_cursor is None
+                ):
+                    self._baseline_instance = inst_id
+                    self._baseline_cursor = dict(self.cursor)
+            elif (
+                inst_id is not None
+                and inst_id == self._baseline_instance
+                and self._baseline_cursor is not None
+            ):
+                # OBSERVED transition to a runtime rebuilt from the
+                # same trained version: the fold overlay was discarded
+                # with the old runtime — rewind and re-fold (idempotent)
+                log.info(
+                    "runtime rebuilt from instance %s: rewinding cursor "
+                    "%s to its baseline to re-fold the overlay",
+                    inst_id, self.cursor_id,
+                )
+                self.cursor = dict(self._baseline_cursor)
+            else:
+                self._baseline_instance = inst_id
+                self._baseline_cursor = dict(self.cursor)
+            self._last_runtime = runtime
+            if self._paused is not None and self._drift_paused:
+                log.info(
+                    "retrain detected while drift-paused: rebasing and "
+                    "resuming fold-in (%s)", self.cursor_id,
+                )
+                self.resume()
+        if self._paused is not None:
+            return {"paused": self._paused}
+
+        store = self.storage.get_events()
+        new_cursor = dict(self.cursor)
+        batch: list = []
+        for key, stream_store, shard in store.revision_streams():
+            after = self.cursor.get(key, 0)
+            events = stream_store.find_since(
+                self.app_id, after, channel_id=self.channel_id,
+                limit=self.config.batch_limit, shard=shard,
+            )
+            for e in events:
+                if e.revision is not None and e.revision > new_cursor.get(
+                    key, 0
+                ):
+                    new_cursor[key] = e.revision
+            batch.extend(events)
+        if not batch:
+            if self.foldin.pending_items:
+                # idle stream must still drain carried-over item solves
+                # — a quiet tail would otherwise strand overflow items
+                # at zero factor rows until the next retrain
+                return self._pending_tick(runtime, t0)
+            return {"idle": "no new events"}
+        # replica copies / overwrites can surface one event id twice
+        seen: set[str] = set()
+        deduped = []
+        for e in batch:
+            if e.event_id and e.event_id in seen:
+                continue
+            if e.event_id:
+                seen.add(e.event_id)
+            deduped.append(e)
+        relevant = [e for e in deduped if self.foldin._relevant(e)]
+
+        result = (
+            self.foldin.apply(
+                self.storage, self.app_id, self.channel_id, runtime, relevant
+            )
+            if relevant
+            else None
+        )
+        if result is None and self.foldin.pending_items:
+            # a tick full of IRRELEVANT traffic ($set/profile events)
+            # must not starve the item-solve carry any more than an
+            # idle stream would
+            result = self.foldin.apply_pending(
+                self.storage, self.app_id, self.channel_id, runtime
+            )
+        stats = None
+        if result is not None:
+            new_runtime, new_model, stats = result
+            verdict = self._guard_and_publish(
+                runtime, new_runtime, new_model, stats
+            )
+            if verdict is not None:
+                return verdict
+
+        if self._crash_after_apply:  # chaos seam: die before the persist
+            raise RuntimeError("injected crash between apply and persist")
+
+        # ONE atomic record append carries the cursor and the counters:
+        # exactly-once accounting across crash-replay
+        self.cursor = new_cursor
+        self.counters["events_consumed"] += len(deduped)
+        self.counters["events_folded"] += len(relevant) if stats else 0
+        if stats is not None:
+            self.counters["users_folded"] += stats.users_folded
+            self.counters["items_folded"] += stats.items_folded
+        self.counters["ticks"] += 1
+        self._persist()
+
+        self._consumed_ctr.inc(len(deduped))
+        if stats is not None:
+            self._folded_ctr.inc(len(relevant))
+            self._rows_ctr.inc(stats.users_folded, side="user")
+            self._rows_ctr.inc(stats.items_folded, side="item")
+        dt = time.perf_counter() - t0
+        self._tick_hist.observe(dt)
+        self._last_error = None
+        return {
+            "consumed": len(deduped),
+            "folded": len(relevant) if stats else 0,
+            "stats": stats.to_dict() if stats else None,
+            "seconds": dt,
+        }
+
+    def _guard_and_publish(
+        self, runtime, new_runtime, new_model, stats
+    ) -> Optional[dict[str, Any]]:
+        """Drift-check then conditionally swap a fold result in; commits
+        the fold-in carry list only on success. Returns the tick's early
+        result dict on pause/lost-race, None when published."""
+        # drift guard BEFORE publish: a breach leaves the last-good
+        # model serving and the cursor un-advanced
+        drift = self.guard.check(new_model.factors)
+        self._drift_gauge.set(drift, scope=self.cursor_id)
+        if drift > self.guard.threshold:
+            reason = (
+                f"score drift {drift:.3f} > threshold "
+                f"{self.guard.threshold:.3f}"
+            )
+            self.pause(reason, by_drift=True)
+            self._raise_drift_alert(drift)
+            return {"paused": reason, "drift": drift}
+        if not self.host.swap(runtime, new_runtime):
+            # a retrain/promote swapped mid-tick: fold again next
+            # tick against the new runtime (cursor untouched)
+            return {"retry": "runtime changed during fold"}
+        self._last_runtime = new_runtime
+        self.foldin.commit_pending(stats.pending_after)
+        return None
+
+    def _pending_tick(self, runtime, t0: float) -> dict[str, Any]:
+        """Item-only pass draining the fold-in carry list on an
+        otherwise idle stream (cursor and consumed counters untouched;
+        the solved items' work is still accounted)."""
+        result = self.foldin.apply_pending(
+            self.storage, self.app_id, self.channel_id, runtime
+        )
+        if result is None:
+            return {"idle": "no new events"}
+        new_runtime, new_model, stats = result
+        verdict = self._guard_and_publish(
+            runtime, new_runtime, new_model, stats
+        )
+        if verdict is not None:
+            return verdict
+        self.counters["items_folded"] += stats.items_folded
+        self.counters["ticks"] += 1
+        self._persist()
+        self._rows_ctr.inc(stats.items_folded, side="item")
+        dt = time.perf_counter() - t0
+        self._tick_hist.observe(dt)
+        return {
+            "consumed": 0,
+            "folded": 0,
+            "stats": stats.to_dict(),
+            "seconds": dt,
+        }
+
+    def _persist(self) -> None:
+        self._records.append(CURSOR_ENTITY, self.cursor_id, {
+            "cursor": dict(self.cursor),
+            **self.counters,
+            "scope": getattr(self.host, "scope", "server"),
+            "app_id": self.app_id,
+            "baseline_instance": self._baseline_instance,
+            "baseline_cursor": dict(self._baseline_cursor or {}),
+            "updated_at": time.time(),
+        })
+        self._ticks_persisted += 1
+        if (
+            self.config.compact_every
+            and self._ticks_persisted % self.config.compact_every == 0
+        ):
+            try:
+                # single writer → no concurrent-update hazard: compact
+                # eagerly (min_age_s=0) so the fold stays O(1) events
+                self._records.compact(
+                    CURSOR_ENTITY, self.cursor_id, min_age_s=0.0
+                )
+            except Exception:
+                log.exception("cursor record compaction failed")
+
+    def _raise_drift_alert(self, drift: float) -> None:
+        try:
+            from predictionio_tpu.obs.monitor import get_monitor
+
+            get_monitor().raise_alert(self.alert_name, {
+                "scope": getattr(self.host, "scope", "server"),
+                "drift": round(drift, 4),
+                "threshold": self.guard.threshold,
+                "cursor_id": self.cursor_id,
+                "hint": "fold-in paused; retrain or POST /online/resume",
+            })
+        except Exception:
+            log.exception("drift alert raise failed")
+
+    # -- reporting ----------------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        return {
+            "cursor_id": self.cursor_id,
+            "app_id": self.app_id,
+            "scope": getattr(self.host, "scope", "server"),
+            "running": self._thread is not None,
+            "paused": self._paused,
+            "cursor": dict(self.cursor),
+            "counters": dict(self.counters),
+            "drift": round(self.guard.last_drift, 4),
+            "drift_threshold": self.guard.threshold,
+            "tick_s": self.config.tick_s,
+            "last_error": self._last_error,
+        }

@@ -1,0 +1,113 @@
+"""Score-distribution drift guard for the online fold-in path.
+
+A folded model that has drifted far from its last-trained baseline is a
+quality risk the verdict machinery would catch in a canary — but fold-in
+bypasses canarying (that's its point), so the guard recreates the check
+statistically: both models score the SAME fixed sample of (user, item)
+pairs (row-aligned — fold-in only appends rows, never reorders), and the
+drift statistic is the mean decile shift normalized by the baseline's
+inter-quartile scale. Zero when nothing changed, ~O(1) when folded
+scores no longer resemble trained ones.
+
+Past `threshold`, the consumer pauses fold-in (the last-good model keeps
+serving, the cursor stops advancing so no event is lost) and raises a
+`pio alerts`-visible alert via the monitor plane.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_DECILES = np.linspace(0.1, 0.9, 9)
+
+
+def score_drift(
+    baseline_factors: Any,
+    current_factors: Any,
+    sample_users: int = 128,
+    sample_items: int = 256,
+    seed: int = 0,
+) -> float:
+    """Drift statistic between two ALS factor sets' score distributions.
+
+    Samples are drawn from the ROW RANGE both models share, so a folded
+    model is judged on how it scores the baseline's known universe —
+    brand-new users/items (rows beyond the baseline) are exactly the rows
+    fold-in is supposed to change and are excluded by construction."""
+    n_u = min(
+        baseline_factors.user_factors.shape[0],
+        current_factors.user_factors.shape[0],
+    )
+    n_i = min(
+        baseline_factors.item_factors.shape[0],
+        current_factors.item_factors.shape[0],
+    )
+    if n_u == 0 or n_i == 0:
+        return 0.0
+    rng = np.random.RandomState(seed)
+    u_rows = rng.randint(0, n_u, size=min(sample_users, n_u))
+    i_rows = rng.randint(0, n_i, size=min(sample_items, n_i))
+
+    def deciles(f) -> tuple[np.ndarray, float]:
+        scores = (
+            f.user_factors[u_rows].astype(np.float64)
+            @ f.item_factors[i_rows].astype(np.float64).T
+        ).ravel()
+        q = np.quantile(scores, _DECILES)
+        iqr = float(np.quantile(scores, 0.75) - np.quantile(scores, 0.25))
+        return q, iqr
+
+    q_base, iqr_base = deciles(baseline_factors)
+    q_cur, _ = deciles(current_factors)
+    scale = max(iqr_base, 1e-6)
+    return float(np.mean(np.abs(q_cur - q_base)) / scale)
+
+
+class DriftGuard:
+    """Holds the last-trained baseline snapshot and judges folded models
+    against it. `rebase` on every retrain swap (the consumer detects the
+    runtime changed under it); `check` returns the drift statistic."""
+
+    def __init__(
+        self,
+        threshold: float = 1.0,
+        sample_users: int = 128,
+        sample_items: int = 256,
+        seed: int = 0,
+    ):
+        self.threshold = float(threshold)
+        self.sample_users = sample_users
+        self.sample_items = sample_items
+        self.seed = seed
+        self._baseline: Optional[Any] = None  # ALSFactors reference
+        self.last_drift: float = 0.0
+
+    @property
+    def has_baseline(self) -> bool:
+        return self._baseline is not None
+
+    def rebase(self, factors: Any) -> None:
+        """Adopt `factors` as the new baseline (a reference, not a copy:
+        fold-in is copy-on-write, so the baseline arrays never mutate)."""
+        self._baseline = factors
+        self.last_drift = 0.0
+
+    def check(self, factors: Any) -> float:
+        """Drift of `factors` vs the baseline (0.0 with no baseline)."""
+        if self._baseline is None:
+            return 0.0
+        self.last_drift = score_drift(
+            self._baseline, factors,
+            sample_users=self.sample_users,
+            sample_items=self.sample_items,
+            seed=self.seed,
+        )
+        return self.last_drift
+
+    def breached(self, factors: Any) -> bool:
+        return self.check(factors) > self.threshold

@@ -45,6 +45,10 @@ FAULT_POINTS = (
     "event.insert",
     "dispatch.device",
     "model.load",
+    # online fold-in tick (ISSUE 9): "error" fails the tick (consumer
+    # retries from its cursor), "corrupt" scrambles the solved factor
+    # rows — the injected-drift chaos input the drift guard must catch
+    "online.fold",
 )
 
 MODES = ("error", "delay", "corrupt")

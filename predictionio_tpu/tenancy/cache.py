@@ -271,6 +271,39 @@ class ModelCache:
             self._seen.add(tenant_id)
             self._evict_locked()
 
+    def peek_runtime(self, tenant_id: str):
+        """The tenant's resident runtime without taking a lease (the
+        online consumer's read point; None when not resident)."""
+        with self._lock:
+            entry = self._entries.get(tenant_id)
+            return entry.runtime if entry is not None else None
+
+    def swap_runtime(
+        self, tenant_id: str, expected: Any, runtime: Any
+    ) -> bool:
+        """Conditional copy-on-write swap (ISSUE 9 online fold-in): the
+        tenant's entry is replaced ONLY if it still serves `expected` —
+        a prefetch/promote that landed mid-fold wins and the caller
+        retries against it. The old entry object keeps its in-flight
+        leases (queries drain on their snapshot, zero-drop); pinned and
+        version_key carry over, since a fold does not change WHICH
+        version is serving. Device bytes are RE-measured — fold-in grows
+        factor matrices, and carrying the old entry's bytes would let
+        the HBM-budget eviction mode undercount that growth forever —
+        and the budget is re-checked after the swap."""
+        nbytes = self._measure_safe(runtime)
+        with self._lock:
+            old = self._entries.get(tenant_id)
+            if old is None or old.runtime is not expected:
+                return False
+            entry = CacheEntry(tenant_id, old.version_key, runtime)
+            entry.pinned = old.pinned
+            entry.last_used = old.last_used
+            entry.device_bytes = nbytes
+            self._entries[tenant_id] = entry
+            self._evict_locked()
+            return True
+
     def pin(self, tenant_id: str, on: bool = True) -> None:
         with self._lock:
             entry = self._entries.get(tenant_id)

@@ -154,6 +154,9 @@ class TenantMux:
         # pass, which aborts the orphaned rollout off the hot path)
         self._removed_pending: set[str] = set()
         self._last_compact = 0.0
+        # per-tenant online fold-in consumers (ISSUE 9): each feeds its
+        # tenant's CACHED runtime via the conditional cache swap
+        self._online: dict[str, Any] = {}
 
         self.metrics = metrics or get_default_registry()
         self._requests = self.metrics.counter(
@@ -204,6 +207,10 @@ class TenantMux:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        for consumer in list(self._online.values()):
+            # consumer threads join on mux stop (ISSUE 9 CI discipline)
+            consumer.stop()
+        self._online.clear()
         for host in list(self._hosts.values()):
             if host.rollout is not None:
                 host.rollout.stop()
@@ -519,6 +526,52 @@ class TenantMux:
     def charge_device_seconds(self, tenant_id: str, seconds: float) -> None:
         self.quota.charge_device(tenant_id, seconds)
         self._device_seconds.inc(seconds, tenant=self.label(tenant_id))
+
+    # -- per-tenant online fold-in (ISSUE 9) --------------------------------
+    def attach_online(
+        self, tenant_id: str, app_id: int, config=None,
+        channel_id: Optional[int] = None, consumer=None,
+    ):
+        """Attach a fold-in consumer for ONE tenant: events for `app_id`
+        stream into that tenant's cached runtime; every other tenant is
+        untouched. The tenant's model is warmed so the consumer has a
+        runtime to fold into before the first query."""
+        from predictionio_tpu.online import OnlineConsumer, TenantApplyHost
+
+        tenant = self.tenant(tenant_id)
+        if tenant is None:
+            raise UnknownTenant(tenant_id)
+        if self.cache.peek_runtime(tenant_id) is None:
+            self.cache.acquire_and_release(tenant)
+        old = self._online.get(tenant_id)
+        if old is not None:
+            old.stop()
+            if hasattr(old, "stopped") and not old.stopped():
+                # same double-writer guard as QueryServer.attach_online
+                raise RuntimeError(
+                    f"tenant {tenant_id}: previous online consumer did "
+                    "not stop; refusing a second writer on its cursor"
+                )
+        c = consumer or OnlineConsumer(
+            self.storage, TenantApplyHost(self, tenant_id), app_id,
+            config=config, channel_id=channel_id, metrics=self.metrics,
+        )
+        self._online[tenant_id] = c
+        c.start()
+        return c
+
+    def detach_online(self, tenant_id: str) -> bool:
+        c = self._online.pop(tenant_id, None)
+        if c is None:
+            return False
+        c.stop()
+        return True
+
+    def online_status(self, tenant_id: str) -> dict:
+        c = self._online.get(tenant_id)
+        if c is None:
+            return {"state": "detached", "tenant": tenant_id}
+        return dict(c.status(), state="attached", tenant=tenant_id)
 
     # -- per-tenant rollouts ------------------------------------------------
     def _host(self, tenant_id: str) -> _TenantRolloutHost:

@@ -93,6 +93,8 @@ class _Handler(JsonHandler):
                 self._get_tenants(parts)
             elif path == "/rollout":
                 self._get_rollout()
+            elif path == "/online":
+                self._get_online()
             elif path == "/cmd/app":
                 apps = self.storage.get_meta_data_apps().get_all()
                 keys = self.storage.get_meta_data_access_keys()
@@ -315,6 +317,22 @@ class _Handler(JsonHandler):
                 v.to_dict() for v in versions if v.status == "canary"
             ],
             "live": [v.to_dict() for v in versions if v.status == "live"],
+        })
+
+    def _get_online(self) -> None:
+        """Storage-side online-learning view (ISSUE 9): every consumer's
+        durable cursor record — where each stream tail stands and the
+        cumulative fold counters. The query server's /online/status has
+        the live (paused/drift) state."""
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+        from predictionio_tpu.online import CURSOR_ENTITY
+
+        records = LifecycleRecordStore(self.storage).fold(CURSOR_ENTITY)
+        self._respond(200, {
+            "consumers": [
+                dict(rec, cursor_id=cid)
+                for cid, rec in sorted(records.items())
+            ],
         })
 
     def _post_rollout(self) -> None:

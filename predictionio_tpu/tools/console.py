@@ -834,6 +834,81 @@ def cmd_alerts(args) -> int:
     return 0
 
 
+def _server_call(
+    base: str, path: str, body: Optional[dict] = None
+) -> dict:
+    """POST (with a JSON body) or GET `base+path` on a running query
+    server, turning HTTP/transport failures into CommandError — shared
+    by the rollout and online command families."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        base.rstrip("/") + path,
+        data=_json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+        method="POST" if body is not None else "GET",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return _json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")
+        try:
+            detail = _json.loads(detail).get("message", detail)
+        except ValueError:
+            pass
+        raise CommandError(f"query server refused ({e.code}): {detail}")
+    except OSError as e:
+        raise CommandError(f"query server unreachable at {base}: {e}")
+
+
+def cmd_online(args) -> int:
+    """`pio online status|pause|resume|cursors` — the streaming fold-in
+    consumer on a running query server (--url), or the durable cursor
+    records in storage (`cursors`)."""
+    action = args.online_action
+    if action == "cursors":
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+        from predictionio_tpu.online import CURSOR_ENTITY
+
+        records = LifecycleRecordStore(_storage()).fold(CURSOR_ENTITY)
+        if not records:
+            print("[INFO] no online consumer cursors recorded")
+            return 0
+        for cid, rec in sorted(records.items()):
+            print(f"[INFO] {cid}:")
+            print(f"[INFO]   cursor: {rec.get('cursor')}")
+            for k in (
+                "events_consumed", "events_folded", "users_folded",
+                "items_folded", "ticks",
+            ):
+                print(f"[INFO]   {k}: {rec.get(k, 0)}")
+        return 0
+
+    if action == "status":
+        st = _server_call(args.url, "/online/status")
+    elif action == "pause":
+        st = _server_call(
+            args.url, "/online/pause",
+            {"reason": args.reason or "operator pause"},
+        )
+    else:  # resume
+        st = _server_call(args.url, "/online/resume", {})
+    print(f"[INFO] online consumer: {st.get('state')}")
+    if st.get("state") != "attached":
+        return 0
+    paused = st.get("paused")
+    print(f"[INFO]   paused: {paused or 'no'}")
+    print(f"[INFO]   cursor {st.get('cursor_id')}: {st.get('cursor')}")
+    print(f"[INFO]   drift: {st.get('drift')} "
+          f"(threshold {st.get('drift_threshold')})")
+    for k, v in (st.get("counters") or {}).items():
+        print(f"[INFO]   {k}: {v}")
+    return 0
+
+
 def cmd_tsdb(args) -> int:
     """`pio tsdb query` — the in-process time-series history of this
     process, or a running server via --url (its GET /debug/tsdb)."""
@@ -1128,32 +1203,10 @@ def cmd_tenants(args) -> int:
 def cmd_rollout(args) -> int:
     """`pio rollout start|status|abort` — drive a canary on a running
     query server (--url)."""
-    import json as _json
-    import urllib.error
-    import urllib.request
-
-    base = args.url.rstrip("/")
     action = args.rollout_action
 
     def _call(path: str, body: Optional[dict] = None) -> dict:
-        req = urllib.request.Request(
-            base + path,
-            data=_json.dumps(body).encode() if body is not None else None,
-            headers={"Content-Type": "application/json"},
-            method="POST" if body is not None else "GET",
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=30) as r:
-                return _json.loads(r.read().decode())
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
-            try:
-                detail = _json.loads(detail).get("message", detail)
-            except ValueError:
-                pass
-            raise CommandError(f"query server refused ({e.code}): {detail}")
-        except OSError as e:
-            raise CommandError(f"query server unreachable at {base}: {e}")
+        return _server_call(args.url, path, body)
 
     def _print_status(st: dict) -> None:
         print(f"[INFO] rollout state: {st.get('state')}")
@@ -1727,6 +1780,28 @@ def build_parser() -> argparse.ArgumentParser:
     tn = tnsub.add_parser("delete", help="delete a tenant record")
     tn.add_argument("tenant_id")
     tn.set_defaults(func=cmd_tenants)
+
+    s = sub.add_parser(
+        "online", help="online learning: the streaming fold-in consumer"
+    )
+    osub = s.add_subparsers(dest="online_action", required=True)
+    ost = osub.add_parser("status", help="consumer status")
+    ost.add_argument("--url", default="http://localhost:8000",
+                     help="query server base URL")
+    ost.set_defaults(func=cmd_online)
+    op = osub.add_parser("pause", help="pause fold-in (last-good serves)")
+    op.add_argument("--url", default="http://localhost:8000")
+    op.add_argument("--reason", default=None)
+    op.set_defaults(func=cmd_online)
+    orr = osub.add_parser(
+        "resume", help="resume fold-in from the durable cursor"
+    )
+    orr.add_argument("--url", default="http://localhost:8000")
+    orr.set_defaults(func=cmd_online)
+    oc = osub.add_parser(
+        "cursors", help="durable consumer cursor records in storage"
+    )
+    oc.set_defaults(func=cmd_online)
 
     s = sub.add_parser(
         "rollout", help="canary rollout on a running query server"

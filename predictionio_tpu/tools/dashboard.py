@@ -100,7 +100,40 @@ class _Handler(JsonHandler):
 {self._fleet_html()}
 {self._lifecycle_html()}
 {self._tenants_html()}
+{self._online_html()}
 </body></html>"""
+
+    # -- online learning (ISSUE 9) -----------------------------------------
+    def _online_html(self) -> str:
+        """Online-learning panel: each consumer's durable cursor record —
+        stream positions and cumulative fold counters."""
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+        from predictionio_tpu.online import CURSOR_ENTITY
+
+        try:
+            records = LifecycleRecordStore(self.server.storage).fold(
+                CURSOR_ENTITY
+            )
+        except Exception:
+            return "<h1>Online learning</h1><p>(cursor store unavailable)</p>"
+        if not records:
+            return "<h1>Online learning</h1><p>(no consumers recorded)</p>"
+        rows = "".join(
+            f"<tr><td>{html.escape(cid)}</td>"
+            f"<td>{html.escape(str(rec.get('cursor')))}</td>"
+            f"<td>{rec.get('events_consumed', 0)}</td>"
+            f"<td>{rec.get('events_folded', 0)}</td>"
+            f"<td>{rec.get('users_folded', 0)}</td>"
+            f"<td>{rec.get('items_folded', 0)}</td>"
+            f"<td>{rec.get('ticks', 0)}</td></tr>"
+            for cid, rec in sorted(records.items())
+        )
+        return f"""<h1>Online learning</h1>
+<table border="1" cellpadding="4">
+<tr><th>Consumer</th><th>Cursor</th><th>Consumed</th><th>Folded</th>
+<th>User rows</th><th>Item rows</th><th>Ticks</th></tr>
+{rows}
+</table>"""
 
     # -- monitoring plane (ISSUE 8) ----------------------------------------
     _SPARK_BLOCKS = "▁▂▃▄▅▆▇█"

@@ -76,13 +76,18 @@ def train_als_checkpointed(
     Returns the final ALSFactors. The checkpoint is cleared on success."""
     from predictionio_tpu.models import als
 
+    # warm start (ISSUE 9): a caller-provided init (e.g. the parent
+    # version's factors mapped onto the new vocab) seeds the first
+    # segment; a resumed checkpoint still wins — it is strictly newer
+    init = train_kwargs.pop("init_factors", None)
+
     if manager is None or checkpoint_every <= 0:
         return als.train(
-            rows, cols, vals, n_users, n_items, params, **train_kwargs
+            rows, cols, vals, n_users, n_items, params,
+            init_factors=init, **train_kwargs
         )
 
     done = 0
-    init = None
     factors = None
     resumed = manager.load()
     if resumed is not None:

@@ -180,6 +180,8 @@ class _Handler(JsonHandler):
                 self._respond(200, self.server.owner.status_html(), "text/html")
             elif path == "/rollout/status":
                 self._respond(200, self.server.owner.rollout_status())
+            elif path == "/online/status":
+                self._respond(200, self.server.owner.online_status())
             elif path == "/tenants" or path.startswith("/tenants/"):
                 self._tenants_get(path)
             elif path == "/metrics":
@@ -233,6 +235,22 @@ class _Handler(JsonHandler):
             except Exception as e:
                 log.exception("reload failed")
                 self._respond(500, {"message": str(e)})
+        elif path in ("/online/pause", "/online/resume"):
+            owner = self.server.owner
+            if owner.online is None:
+                self._respond(
+                    404, {"message": "no online consumer attached"}
+                )
+            elif path == "/online/pause":
+                body = self._json_body()
+                reason = (
+                    body.get("reason") if isinstance(body, dict) else None
+                ) or "operator pause"
+                owner.online.pause(reason)
+                self._respond(200, owner.online_status())
+            else:
+                owner.online.resume()
+                self._respond(200, owner.online_status())
         elif path in ("/rollout/start", "/rollout/abort"):
             try:
                 body = self._json_body()
@@ -1130,6 +1148,7 @@ class QueryServer(ServerProcess):
         self.candidate: Optional[EngineRuntime] = None
         self.rollout = None  # Optional[RolloutController]
         self.tenancy = None  # Optional[TenantMux] (ISSUE 6)
+        self.online = None  # Optional[OnlineConsumer] (ISSUE 9)
         self.last_serving_sec = 0.0
         self.last_predict_sec = 0.0
         self.dispatcher: Optional[_BatchDispatcher] = None
@@ -1157,6 +1176,10 @@ class QueryServer(ServerProcess):
         return port
 
     def stop(self) -> None:
+        if self.online is not None:
+            # the consumer thread joins on server stop — same discipline
+            # as the monitor/mux/dispatcher threads (ISSUE 9 CI guard)
+            self.online.stop()
         if self.tenancy is not None:
             self.tenancy.stop()
         if self.rollout is not None:
@@ -1229,6 +1252,42 @@ class QueryServer(ServerProcess):
         if mux is not None and mux.is_candidate(rt):
             return "candidate"
         return "live"
+
+    # -- online learning (ISSUE 9) -----------------------------------------
+    def attach_online(
+        self, app_id: int, config=None, channel_id: Optional[int] = None,
+        consumer=None,
+    ):
+        """Attach a streaming fold-in consumer: events for `app_id` tail
+        into this server's live runtime between retrains. Pass a
+        pre-built `consumer` to override the default wiring (tests)."""
+        from predictionio_tpu.online import (
+            OnlineConsumer,
+            ServerApplyHost,
+        )
+
+        if self.online is not None:
+            self.online.stop()
+            if not self.online.stopped():
+                # a wedged tick survived the stop timeout: starting a
+                # replacement would put TWO writers on the same
+                # single-writer cursor record
+                raise RuntimeError(
+                    "previous online consumer did not stop (wedged "
+                    "tick?); refusing to start a second writer on its "
+                    "cursor"
+                )
+        self.online = consumer or OnlineConsumer(
+            self.storage, ServerApplyHost(self), app_id,
+            config=config, channel_id=channel_id, metrics=self.metrics,
+        )
+        self.online.start()
+        return self.online
+
+    def online_status(self) -> dict:
+        if self.online is None:
+            return {"state": "detached"}
+        return dict(self.online.status(), state="attached")
 
     # -- multi-tenant serving (ISSUE 6) ------------------------------------
     def attach_tenancy(self, mux) -> None:

@@ -1,0 +1,877 @@
+"""Online learning (ISSUE 9) units: insert revisions across backends,
+the fold-in solve, drift guard, durable cursor resume, WAL batch replay,
+job-id version adoption, alert notification sinks, and the tenant-cache
+conditional swap."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.memory import MemoryEventStore
+from predictionio_tpu.data.storage.sqlite import SqliteEventStore
+
+
+def _ev(u="u1", i="i1", rating=5.0, name="rate"):
+    return Event(
+        event=name, entity_type="user", entity_id=u,
+        target_entity_type="item", target_entity_id=i,
+        properties={"rating": rating},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Insert revisions
+# ---------------------------------------------------------------------------
+
+
+class TestInsertRevisions:
+    @pytest.mark.parametrize("make", [
+        lambda tmp: MemoryEventStore(),
+        lambda tmp: SqliteEventStore({"PATH": str(tmp / "r.db")}),
+    ], ids=["memory", "sqlite"])
+    def test_monotonic_and_tailable(self, tmp_path, make):
+        store = make(tmp_path)
+        store.init_app(1)
+        for k in range(4):
+            store.insert(_ev(u=f"u{k}"), 1)
+        store.insert_batch([_ev(u="u9"), _ev(u="u9")], 1)
+        evs = store.find_since(1, 0)
+        assert [e.revision for e in evs] == [1, 2, 3, 4, 5, 6]
+        assert store.latest_revision(1) == 6
+        # strict tail semantics: > cursor, revision-ordered, limited
+        assert [e.revision for e in store.find_since(1, 4, limit=1)] == [5]
+        assert store.find_since(1, 6) == []
+        assert store.find_since(1, 0, limit=0) == []  # 0 means empty
+        # shard filter partitions the stream disjointly and completely
+        s0 = store.find_since(1, 0, shard=(0, 2))
+        s1 = store.find_since(1, 0, shard=(1, 2))
+        assert len(s0) + len(s1) == 6
+        assert not ({e.event_id for e in s0} & {e.event_id for e in s1})
+
+    def test_sqlite_sequence_survives_restart(self, tmp_path):
+        path = str(tmp_path / "resume.db")
+        s1 = SqliteEventStore({"PATH": path})
+        s1.init_app(2)
+        s1.insert(_ev(), 2)
+        s1.insert(_ev(), 2)
+        s1.close()
+        s2 = SqliteEventStore({"PATH": path})
+        assert s2.latest_revision(2) == 2
+        s2.insert(_ev(), 2)
+        assert [e.revision for e in s2.find_since(2, 2)] == [3]
+
+    def test_namespaces_are_independent(self):
+        store = MemoryEventStore()
+        store.insert(_ev(), 1)
+        store.insert(_ev(), 7)
+        store.insert(_ev(), 7)
+        assert store.latest_revision(1) == 1
+        assert store.latest_revision(7) == 2
+
+    def test_memory_cursor_excludes_astral_event_ids(self):
+        """The bisect cutoff must compare by revision alone: a consumed
+        event whose client-supplied id contains a code point above
+        U+FFFF must not be re-delivered forever."""
+        store = MemoryEventStore()
+        store.init_app(1)
+        store.insert(_ev(u="a").with_id("evt-\U0001F600"), 1)
+        evs = store.find_since(1, 0)
+        assert len(evs) == 1
+        # the cursor at this event's revision sees nothing new
+        assert store.find_since(1, evs[0].revision) == []
+
+    def test_memory_rev_log_prunes_stale_rows(self):
+        """Delete-heavy namespaces (the lifecycle append+compact cycle)
+        must not grow the revision log forever."""
+        store = MemoryEventStore()
+        store.init_app(1)
+        keep = store.insert(_ev(u="keeper"), 1)
+        for k in range(200):
+            eid = store.insert(_ev(u=f"churn{k}"), 1)
+            store.delete(eid, 1)
+        key = (1, None)
+        assert len(store._rev_log[key]) < 150  # pruned, not ~201
+        # the survivor still tails correctly after rebuilds
+        evs = store.find_since(1, 0)
+        assert [e.event_id for e in evs] == [keep]
+
+    def test_revision_survives_wire_roundtrip(self):
+        from predictionio_tpu.data.storage import wire
+
+        e = _ev().with_revision(42)
+        assert wire.decode(wire.encode(e)).revision == 42
+        # and the public JSON form carries it only when present
+        assert "revision" not in _ev().to_json_dict()
+        assert _ev().with_revision(3).to_json_dict()["revision"] == 3
+
+    def test_remote_and_sharded_monotonicity(self):
+        """ISSUE 9 satellite: revisions stay per-stream monotonic across
+        remote daemons and a sharded composite; the per-shard streams
+        are disjoint and complete."""
+        from predictionio_tpu.data.api.storage_server import StorageServer
+        from predictionio_tpu.data.storage.registry import (
+            SourceConfig,
+            Storage,
+            StorageConfig,
+        )
+        from predictionio_tpu.data.storage.remote import RemoteEventStore
+        from predictionio_tpu.data.storage.sharded import ShardedEventStore
+
+        daemons, clients = [], []
+        try:
+            for _ in range(2):
+                st = Storage(StorageConfig(
+                    sources={"M": SourceConfig("M", "memory", {})},
+                    repositories={
+                        "METADATA": "M", "EVENTDATA": "M", "MODELDATA": "M",
+                    },
+                ))
+                d = StorageServer(st, port=0)
+                d.start()
+                daemons.append(d)
+                clients.append(RemoteEventStore(
+                    {"HOST": "127.0.0.1", "PORT": str(d.port)}
+                ))
+            sharded = ShardedEventStore(stores=clients)
+            ids = [
+                sharded.insert(_ev(u=f"user-{k}", i=f"i{k % 5}"), 3)
+                for k in range(20)
+            ]
+            assert len(set(ids)) == 20
+            streams = sharded.revision_streams()
+            assert len(streams) == 2
+            seen: set[str] = set()
+            for _key, stream_store, shard in streams:
+                evs = stream_store.find_since(3, 0, shard=shard)
+                revs = [e.revision for e in evs]
+                assert revs == sorted(revs)
+                assert len(revs) == len(set(revs)), "revisions not unique"
+                # paging from a mid-stream cursor continues exactly
+                if len(revs) >= 2:
+                    tail = stream_store.find_since(3, revs[0], shard=shard)
+                    assert [e.revision for e in tail] == revs[1:]
+                seen |= {e.event_id for e in evs}
+            assert len(seen) == 20, "shard streams lost or duplicated events"
+            # the composite refuses the ambiguous single-sequence read
+            from predictionio_tpu.data.storage.base import StorageError
+
+            with pytest.raises(StorageError):
+                sharded.find_since(3, 0)
+        finally:
+            for d in daemons:
+                d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fold-in solve + warm start
+# ---------------------------------------------------------------------------
+
+
+class TestFoldInSolve:
+    def test_implicit_matches_dense_solve(self):
+        from predictionio_tpu.models import als
+
+        rng = np.random.RandomState(0)
+        k = 6
+        itf = rng.standard_normal((30, k)).astype(np.float32)
+        params = als.ALSParams(
+            rank=k, implicit_prefs=True, cg_iterations=8, lambda_=0.05,
+            alpha=2.0,
+        )
+        edges = [[(1, 5.0), (3, 2.0), (9, 1.0)], [(7, 1.0)]]
+        out = als.fold_in_rows(itf, edges, params)
+        for r, row in enumerate(edges):
+            a = itf.T @ itf + params.lambda_ * np.eye(k)
+            b = np.zeros(k)
+            for j, v in row:
+                c = 1.0 + params.alpha * abs(v)
+                a += (c - 1.0) * np.outer(itf[j], itf[j])
+                b += c * itf[j]
+            ref = np.linalg.solve(a, b)
+            np.testing.assert_allclose(out[r], ref, atol=1e-4)
+
+    def test_explicit_matches_dense_solve(self):
+        from predictionio_tpu.models import als
+
+        rng = np.random.RandomState(1)
+        k = 4
+        itf = rng.standard_normal((12, k)).astype(np.float32)
+        params = als.ALSParams(
+            rank=k, implicit_prefs=False, cg_iterations=8, lambda_=0.1,
+        )
+        edges = [[(0, 4.0), (5, 2.0)]]
+        out = als.fold_in_rows(itf, edges, params)
+        a = (
+            np.outer(itf[0], itf[0]) + np.outer(itf[5], itf[5])
+            + params.lambda_ * 2 * np.eye(k)
+        )
+        b = 4.0 * itf[0] + 2.0 * itf[5]
+        np.testing.assert_allclose(
+            out[0], np.linalg.solve(a, b), atol=1e-4
+        )
+
+    def test_empty_edges_solve_to_zero(self):
+        from predictionio_tpu.models import als
+
+        itf = np.ones((4, 3), np.float32)
+        params = als.ALSParams(rank=3)
+        out = als.fold_in_rows(itf, [[]], params)
+        np.testing.assert_array_equal(out, np.zeros((1, 3), np.float32))
+        assert als.fold_in_rows(itf, [], params).shape == (0, 3)
+
+    def test_warm_start_maps_surviving_ids(self):
+        from predictionio_tpu.data.store.bimap import BiMap
+        from predictionio_tpu.models import als
+
+        params = als.ALSParams(rank=3, seed=5)
+        parent = als.ALSFactors(
+            user_factors=np.arange(6, dtype=np.float32).reshape(2, 3),
+            item_factors=np.arange(9, dtype=np.float32).reshape(3, 3),
+            user_vocab=BiMap({"a": 0, "b": 1}),
+            item_vocab=BiMap({"x": 0, "y": 1, "z": 2}),
+            params=params,
+        )
+        # new vocab: "b" moved rows, "a" dropped, "c" brand new
+        uf0, itf0 = als.warm_start_factors(
+            parent, BiMap({"b": 0, "c": 1}), BiMap({"z": 0, "x": 1}),
+            params,
+        )
+        np.testing.assert_array_equal(uf0[0], parent.user_factors[1])
+        assert not np.array_equal(uf0[1], parent.user_factors[0])
+        np.testing.assert_array_equal(itf0[0], parent.item_factors[2])
+        np.testing.assert_array_equal(itf0[1], parent.item_factors[0])
+
+
+class TestDriftGuard:
+    def _factors(self, seed=0, scale=1.0):
+        from predictionio_tpu.data.store.bimap import BiMap
+        from predictionio_tpu.models import als
+
+        rng = np.random.RandomState(seed)
+        return als.ALSFactors(
+            user_factors=(
+                rng.standard_normal((40, 4)).astype(np.float32) * scale
+            ),
+            item_factors=rng.standard_normal((60, 4)).astype(np.float32),
+            user_vocab=BiMap({}),
+            item_vocab=BiMap({}),
+        )
+
+    def test_identical_models_have_zero_drift(self):
+        from predictionio_tpu.online import score_drift
+
+        f = self._factors()
+        assert score_drift(f, f) == pytest.approx(0.0)
+
+    def test_scrambled_model_breaches(self):
+        from predictionio_tpu.online import DriftGuard
+
+        base = self._factors(0)
+        bad = self._factors(0, scale=40.0)
+        guard = DriftGuard(threshold=1.0)
+        guard.rebase(base)
+        assert guard.check(base) < 0.05
+        assert guard.breached(bad)
+        assert guard.last_drift > 1.0
+
+    def test_growth_only_change_is_small(self):
+        """Appending new rows must not read as drift: the statistic
+        samples the SHARED row range only."""
+        import dataclasses
+
+        from predictionio_tpu.online import score_drift
+
+        base = self._factors(3)
+        grown = dataclasses.replace(
+            base,
+            user_factors=np.concatenate([
+                base.user_factors,
+                np.ones((5, 4), np.float32) * 9.0,
+            ]),
+        )
+        assert score_drift(base, grown) == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# Durable cursor + consumer mechanics (storage-only; no engine/jax)
+# ---------------------------------------------------------------------------
+
+
+class _StubHost:
+    scope = "server"
+
+    def __init__(self):
+        # a runtime with no models: events consume (cursor advances)
+        # without folding — the storage-only unit-test posture
+        self.runtime = object()
+
+    def current(self):
+        return self.runtime
+
+    def swap(self, old, new):
+        if self.runtime is old:
+            self.runtime = new
+            return True
+        return False
+
+
+def _mem_storage():
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    return Storage(StorageConfig(
+        sources={"M": SourceConfig("M", "memory", {})},
+        repositories={
+            "METADATA": "M", "EVENTDATA": "M", "MODELDATA": "M",
+        },
+    ))
+
+
+class TestCursorResume:
+    def test_cursor_and_counters_resume_exactly(self):
+        from predictionio_tpu.online import (
+            OnlineConsumer,
+            OnlineConsumerConfig,
+        )
+
+        storage = _mem_storage()
+        store = storage.get_events()
+        store.insert_batch([_ev(u=f"u{k}") for k in range(5)], 1)
+        c1 = OnlineConsumer(
+            storage, _StubHost(), 1, OnlineConsumerConfig(tick_s=9),
+        )
+        out = c1.tick()
+        # no runtime → events consumed without folding, cursor advanced
+        assert out["consumed"] == 5 and out["folded"] == 0
+        assert c1.cursor == {"0": 5}
+        c2 = OnlineConsumer(
+            storage, _StubHost(), 1, OnlineConsumerConfig(tick_s=9),
+        )
+        assert c2.cursor == {"0": 5}
+        assert c2.counters["events_consumed"] == 5
+        assert c2.tick() == {"idle": "no new events"}
+
+    def test_from_latest_skips_history(self):
+        from predictionio_tpu.online import (
+            OnlineConsumer,
+            OnlineConsumerConfig,
+        )
+
+        storage = _mem_storage()
+        storage.get_events().insert_batch(
+            [_ev(u=f"u{k}") for k in range(4)], 1
+        )
+        c = OnlineConsumer(
+            storage, _StubHost(), 1,
+            OnlineConsumerConfig(tick_s=9, from_latest=True),
+        )
+        assert c.cursor == {"0": 4}
+        assert c.tick() == {"idle": "no new events"}
+
+    def test_crash_before_persist_replays_exactly_once(self):
+        """The exactly-once accounting window: a crash between apply and
+        the cursor persist replays the tick; counters count each event
+        once because they ride the SAME atomic record append."""
+        from predictionio_tpu.online import (
+            OnlineConsumer,
+            OnlineConsumerConfig,
+        )
+
+        storage = _mem_storage()
+        storage.get_events().insert_batch(
+            [_ev(u=f"u{k}") for k in range(3)], 1
+        )
+        c1 = OnlineConsumer(
+            storage, _StubHost(), 1, OnlineConsumerConfig(tick_s=9),
+        )
+        c1._crash_after_apply = True
+        with pytest.raises(RuntimeError):
+            c1.tick()
+        assert c1.counters["events_consumed"] == 0  # nothing persisted
+        # "restart": a fresh consumer resumes from the durable cursor
+        c2 = OnlineConsumer(
+            storage, _StubHost(), 1, OnlineConsumerConfig(tick_s=9),
+        )
+        assert c2.cursor == {}
+        out = c2.tick()
+        assert out["consumed"] == 3
+        assert c2.counters["events_consumed"] == 3
+        # replaying again finds nothing: no double-apply
+        assert c2.tick() == {"idle": "no new events"}
+        assert c2.counters["events_consumed"] == 3
+
+    def test_cursor_record_compacts(self):
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+        from predictionio_tpu.online import (
+            CURSOR_ENTITY,
+            OnlineConsumer,
+            OnlineConsumerConfig,
+        )
+
+        storage = _mem_storage()
+        store = storage.get_events()
+        c = OnlineConsumer(
+            storage, _StubHost(), 1,
+            OnlineConsumerConfig(tick_s=9, compact_every=4),
+        )
+        for k in range(8):
+            store.insert(_ev(u=f"u{k}"), 1)
+            c.tick()
+        records = LifecycleRecordStore(storage)
+        from predictionio_tpu.data.storage.base import EventQuery
+        from predictionio_tpu.deploy.registry import LIFECYCLE_APP_ID
+
+        n_events = len(list(storage.get_events().find(EventQuery(
+            app_id=LIFECYCLE_APP_ID, entity_type=CURSOR_ENTITY,
+        ))))
+        assert n_events <= 5  # 8 appends compacted twice
+        rec = records.fold(CURSOR_ENTITY, c.cursor_id)[c.cursor_id]
+        assert rec["events_consumed"] == 8
+
+    def test_pause_blocks_tick_and_resume_clears(self):
+        from predictionio_tpu.online import (
+            OnlineConsumer,
+            OnlineConsumerConfig,
+        )
+
+        storage = _mem_storage()
+        storage.get_events().insert(_ev(), 1)
+        c = OnlineConsumer(
+            storage, _StubHost(), 1, OnlineConsumerConfig(tick_s=9),
+        )
+        c.pause("test pause")
+        assert c.tick() == {"paused": "test pause"}
+        assert c.counters["events_consumed"] == 0
+        c.resume()
+        assert c.tick()["consumed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# WAL batch replay (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestWalBatchReplay:
+    def test_batched_replay_groups_by_namespace_in_order(self, tmp_path):
+        from predictionio_tpu.resilience.wal import EventWAL
+
+        wal = EventWAL(str(tmp_path / "wal"))
+        for k in range(5):
+            wal.append(_ev(u=f"a{k}"), 1, None)
+        wal.append(_ev(u="b0"), 2, None)
+        wal.append(_ev(u="b1"), 2, 7)
+        calls = []
+
+        def batch_fn(events, app_id, channel_id, req_id):
+            calls.append((
+                [e.entity_id for e in events], app_id, channel_id, req_id,
+            ))
+
+        n, err = wal.replay_batched(batch_fn, max_batch=3)
+        assert err is None and n == 7
+        assert [c[0] for c in calls] == [
+            ["a0", "a1", "a2"], ["a3", "a4"], ["b0"], ["b1"],
+        ]
+        assert [c[1:3] for c in calls] == [
+            (1, None), (1, None), (2, None), (2, 7),
+        ]
+        assert wal.pending() == 0
+        # fully replayed: a second pass is a no-op
+        assert wal.replay_batched(batch_fn)[0] == 0
+
+    def test_batched_replay_stops_at_failure_and_resumes(self, tmp_path):
+        from predictionio_tpu.resilience.wal import EventWAL
+
+        wal = EventWAL(str(tmp_path / "wal"))
+        for k in range(4):
+            wal.append(_ev(u=f"x{k}"), 1, None)
+        seen = []
+        fail = {"on": True}
+
+        def flaky(events, app_id, channel_id, req_id):
+            if fail["on"] and any(e.entity_id == "x2" for e in events):
+                raise OSError("storage down")
+            seen.extend(e.entity_id for e in events)
+
+        n, err = wal.replay_batched(flaky, max_batch=2)
+        assert n == 2 and err is not None
+        assert wal.pending() == 2
+        fail["on"] = False
+        n, err = wal.replay_batched(flaky, max_batch=2)
+        assert n == 2 and err is None
+        assert seen == ["x0", "x1", "x2", "x3"]
+
+    def test_batch_req_id_stable_across_resend(self, tmp_path):
+        """Same unacked prefix → same batch req_id: the daemon's dedupe
+        sees a re-sent batch as a replay, not new work."""
+        from predictionio_tpu.resilience.wal import EventWAL
+
+        wal = EventWAL(str(tmp_path / "wal"))
+        for k in range(2):
+            wal.append(_ev(u=f"r{k}"), 1, None)
+        req_ids = []
+
+        def record_then_fail(events, app_id, channel_id, req_id):
+            req_ids.append(req_id)
+            raise OSError("lost response")
+
+        wal.replay_batched(record_then_fail)
+        wal.replay_batched(record_then_fail)
+        assert len(req_ids) == 2 and req_ids[0] == req_ids[1]
+
+    def test_spill_stamps_event_id_for_store_level_idempotence(
+        self, tmp_path
+    ):
+        from predictionio_tpu.resilience.wal import EventWAL
+
+        wal = EventWAL(str(tmp_path / "wal"))
+        req_id = wal.append(_ev(u="s1"), 1, None)
+        store = MemoryEventStore()
+
+        def insert_twice(events, app_id, channel_id, batch_req):
+            store.insert_batch(events, app_id, channel_id)
+            store.insert_batch(events, app_id, channel_id)  # torn resend
+
+        wal.replay_batched(insert_twice)
+        evs = list(store.find_since(1, 0))
+        assert len(evs) == 1  # overwrite, not duplicate
+        assert evs[0].event_id == req_id
+
+    def test_event_server_uses_batched_replay(self, tmp_path):
+        """The ingest path end to end: spill under an injected outage,
+        then one replay pass lands everything through insert_batch."""
+        from predictionio_tpu.data.api.server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.resilience import faults
+
+        storage = _mem_storage()
+        from predictionio_tpu.data.storage.base import AccessKey, App
+
+        app_id = storage.get_meta_data_apps().insert(App(0, "walapp"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey("k1", app_id, ())
+        )
+        srv = EventServer(storage, EventServerConfig(
+            ip="127.0.0.1", port=0, wal_dir=str(tmp_path / "wal"),
+            wal_replay_interval_s=30.0,
+        ))
+        port = srv.start()
+        try:
+            import urllib.request
+
+            faults.install(faults.FaultSpec("event.insert", "error", 1.0))
+            for k in range(3):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/events.json?accessKey=k1",
+                    data=_ev(u=f"w{k}").to_json().encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    assert r.status == 202
+            assert srv._server.wal.pending() == 3
+            faults.clear()
+            assert srv.replay_wal_once() == 3
+            assert srv._server.wal.pending() == 0
+            from predictionio_tpu.data.storage.base import EventQuery
+
+            stored = list(storage.get_events().find(
+                EventQuery(app_id=app_id)
+            ))
+            assert sorted(e.entity_id for e in stored) == ["w0", "w1", "w2"]
+            # replaying again cannot duplicate
+            assert srv.replay_wal_once() == 0
+        finally:
+            faults.clear()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Job-id version adoption (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestJobAdoption:
+    def test_register_stamps_and_finds_by_job(self, fresh_storage):
+        import datetime as dt
+
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.deploy.registry import ModelRegistry
+
+        now = dt.datetime.now(dt.timezone.utc)
+        inst = EngineInstance(
+            id="inst-1", status="COMPLETED", start_time=now, end_time=now,
+            engine_id="e", engine_version="0", engine_variant="e",
+            engine_factory="f",
+        )
+        storage = fresh_storage
+        storage.get_meta_data_engine_instances().insert(inst)
+        reg = ModelRegistry(storage)
+        v = reg.register(inst, job_id="job-abc")
+        assert reg.find_by_job("job-abc").id == v.id
+        assert reg.find_by_job("job-nope") is None
+        assert reg.get(v.id).job_id == "job-abc"
+
+    def test_retried_worker_adopts_registered_version(
+        self, fresh_storage, tmp_path
+    ):
+        """A retried train whose previous attempt already registered a
+        version writes the receipt and exits 0 WITHOUT retraining — the
+        variant here is invalid, so reaching run_train would fail."""
+        import datetime as dt
+
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.deploy import worker
+        from predictionio_tpu.deploy.registry import ModelRegistry
+        from predictionio_tpu.deploy.scheduler import (
+            storage_config_to_json,
+        )
+
+        storage = fresh_storage
+        now = dt.datetime.now(dt.timezone.utc)
+        inst = EngineInstance(
+            id="inst-2", status="COMPLETED", start_time=now, end_time=now,
+            engine_id="e", engine_version="0", engine_variant="e",
+            engine_factory="f",
+        )
+        storage.get_meta_data_engine_instances().insert(inst)
+        v = ModelRegistry(storage).register(inst, job_id="job-retry")
+        spec_path = tmp_path / "spec.json"
+        result_path = tmp_path / "result.json"
+        spec_path.write_text(json.dumps({
+            "job_id": "job-retry",
+            "storage": storage_config_to_json(storage.config),
+            "variant": {"id": "broken", "engineFactory": "no.such.Factory"},
+            "engine_id": "e",
+            "result_path": str(result_path),
+        }))
+        rc = worker.main(["worker", str(spec_path)])
+        assert rc == 0
+        receipt = json.loads(result_path.read_text())
+        assert receipt == {
+            "instance_id": "inst-2", "model_version": v.id,
+        }
+
+    def test_rolled_back_version_is_not_adopted(
+        self, fresh_storage, tmp_path
+    ):
+        import datetime as dt
+
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.deploy import worker
+        from predictionio_tpu.deploy.registry import ModelRegistry
+        from predictionio_tpu.deploy.scheduler import (
+            EXIT_TRAIN_FAILED,
+            storage_config_to_json,
+        )
+
+        storage = fresh_storage
+        now = dt.datetime.now(dt.timezone.utc)
+        inst = EngineInstance(
+            id="inst-3", status="COMPLETED", start_time=now, end_time=now,
+            engine_id="e", engine_version="0", engine_variant="e",
+            engine_factory="f",
+        )
+        storage.get_meta_data_engine_instances().insert(inst)
+        reg = ModelRegistry(storage)
+        v = reg.register(inst, job_id="job-rb")
+        reg.rollback(v.id, "judged bad")
+        spec_path = tmp_path / "spec.json"
+        spec_path.write_text(json.dumps({
+            "job_id": "job-rb",
+            "storage": storage_config_to_json(storage.config),
+            "variant": {"id": "broken", "engineFactory": "no.such.Factory"},
+            "engine_id": "e",
+            "result_path": str(tmp_path / "r.json"),
+        }))
+        # falls through to training, which fails on the broken factory
+        assert worker.main(["worker", str(spec_path)]) == EXIT_TRAIN_FAILED
+
+
+# ---------------------------------------------------------------------------
+# Alert notification sinks (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestAlertNotify:
+    def test_exec_sink_receives_alert_json(self, tmp_path):
+        from predictionio_tpu.obs.monitor.notify import AlertNotifier
+
+        out = tmp_path / "alert.json"
+        script = tmp_path / "sink.py"
+        script.write_text(
+            "import os, sys\n"
+            f"open({str(out)!r}, 'w').write(os.environ['PIO_ALERT_JSON'])\n"
+        )
+        n = AlertNotifier(exec_cmd=f"{os.sys.executable} {script}")
+        n.notify({"slo": "t1", "state": "firing"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not out.exists():
+            time.sleep(0.02)
+        payload = json.loads(out.read_text())
+        assert payload["slo"] == "t1" and payload["state"] == "firing"
+
+    def test_slo_engine_fires_transition_hook(self):
+        from predictionio_tpu.obs.monitor.slo import SLOEngine, SLOSpec
+        from predictionio_tpu.obs.monitor.tsdb import TSDB
+        from predictionio_tpu.obs.registry import MetricsRegistry
+
+        transitions = []
+        engine = SLOEngine(
+            TSDB(), [SLOSpec(name="hooked", objective=0.99)],
+            interval_s=60.0, registry=MetricsRegistry(),
+            on_transition=lambda p, old, new: transitions.append(
+                (p["slo"], old, new)
+            ),
+        )
+        engine.burn_rate = lambda spec, w, now=None: (100.0, 50.0)
+        engine.evaluate_once(now=1000.0)  # inactive → pending
+        engine.evaluate_once(now=1001.0)  # pending → firing (for_s=0)
+        assert ("hooked", "inactive", "pending") in transitions
+        assert ("hooked", "pending", "firing") in transitions
+
+    def test_monitor_external_alerts_merge_and_notify(self):
+        from predictionio_tpu.obs.monitor import Monitor
+
+        m = Monitor()
+        sent = []
+        m.notifier.webhook_url = None
+        m.notifier.exec_cmd = None
+        m.notifier.notify = lambda alert: sent.append(alert)
+        m.raise_alert("online_drift_pause", {"drift": 2.5})
+        payload = m.alerts_payload()
+        assert "online_drift_pause" in payload["firing"]
+        assert any(
+            a.get("slo") == "online_drift_pause" and a.get("external")
+            for a in payload["alerts"]
+        )
+        # refresh while firing does NOT re-notify
+        m.raise_alert("online_drift_pause", {"drift": 3.0})
+        assert len(sent) == 1
+        m.resolve_alert("online_drift_pause")
+        assert "online_drift_pause" not in m.alerts_payload()["firing"]
+        assert len(sent) == 2
+        assert sent[1]["transition"] == "firing->resolved"
+
+
+# ---------------------------------------------------------------------------
+# Tenant-cache conditional swap + mux online lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestTenantOnline:
+    def test_cache_swap_runtime_is_conditional(self):
+        from predictionio_tpu.tenancy.cache import ModelCache
+
+        class T:
+            id = "acme"
+            engine_id = "e"
+            engine_version = "0"
+            engine_variant = "e"
+
+        rt1, rt2, rt3 = object(), object(), object()
+        cache = ModelCache(None, capacity=2, build=lambda inst: rt1)
+        cache.resolve_version = lambda tenant: ("v1", object())
+        entry = cache.acquire(T())
+        cache.release(entry)
+        cache.pin("acme", on=True)
+        assert cache.peek_runtime("acme") is rt1
+        assert cache.swap_runtime("acme", rt1, rt2)
+        assert cache.peek_runtime("acme") is rt2
+        # pinned + version_key carry over; stale expectation refused
+        assert cache._entries["acme"].pinned
+        assert cache._entries["acme"].version_key == "v1"
+        assert not cache.swap_runtime("acme", rt1, rt3)
+        assert not cache.swap_runtime("ghost", rt1, rt3)
+        assert cache.peek_runtime("acme") is rt2
+
+    def test_cache_swap_remeasures_device_bytes(self):
+        """HBM-budget mode must see fold-in growth: the swapped entry's
+        bytes are re-measured, not copied from the old entry."""
+        from predictionio_tpu.tenancy.cache import ModelCache
+
+        class T:
+            id = "acme"
+
+        sizes = {}
+        rt1, rt2 = object(), object()
+        sizes[id(rt1)], sizes[id(rt2)] = 100.0, 250.0
+        cache = ModelCache(
+            None, capacity=2, build=lambda inst: rt1,
+            hbm_bytes=10_000.0, measure=lambda rt: sizes[id(rt)],
+            transient=lambda: 0.0,
+        )
+        cache.resolve_version = lambda tenant: ("v1", object())
+        cache.release(cache.acquire(T()))
+        assert cache.resident_bytes() == 100.0
+        assert cache.swap_runtime("acme", rt1, rt2)
+        assert cache.resident_bytes() == 250.0
+
+    def test_mux_attach_online_stops_on_mux_stop(self):
+        from predictionio_tpu.tenancy.mux import TenantMux
+        from predictionio_tpu.tenancy.tenants import Tenant, TenantStore
+
+        storage = _mem_storage()
+        TenantStore(storage).upsert(Tenant(
+            id="acme", engine_id="e", engine_version="0",
+            engine_variant="e",
+        ))
+        mux = TenantMux(storage, cache_capacity=2)
+        mux.cache._build_fn = lambda inst: object()
+        mux.cache.resolve_version = lambda tenant: ("v1", object())
+
+        class StubConsumer:
+            def __init__(self):
+                self.started = False
+                self.stopped = False
+
+            def start(self):
+                self.started = True
+
+            def stop(self):
+                self.stopped = True
+
+            def status(self):
+                return {"cursor": {}}
+
+        c = StubConsumer()
+        mux.attach_online("acme", 1, consumer=c)
+        assert c.started
+        assert mux.online_status("acme")["state"] == "attached"
+        assert mux.online_status("ghost")["state"] == "detached"
+        mux.stop()
+        assert c.stopped
+
+    def test_tenant_apply_host_swaps_cached_runtime(self):
+        from predictionio_tpu.online import TenantApplyHost
+        from predictionio_tpu.tenancy.cache import ModelCache
+
+        class T:
+            id = "acme"
+
+        rt1, rt2 = object(), object()
+        cache = ModelCache(None, capacity=2, build=lambda inst: rt1)
+        cache.resolve_version = lambda tenant: ("v1", object())
+        cache.release(cache.acquire(T()))
+
+        class MuxStub:
+            pass
+
+        mux = MuxStub()
+        mux.cache = cache
+        host = TenantApplyHost(mux, "acme")
+        assert host.scope == "tenant/acme"
+        assert host.current() is rt1
+        assert host.swap(rt1, rt2)
+        assert host.current() is rt2
+        assert not host.swap(rt1, rt2)
