@@ -164,13 +164,15 @@ class EventValidation:
     SPECIAL_EVENTS = frozenset({SET_EVENT, UNSET_EVENT, DELETE_EVENT})
     # framework-internal entities allowed under the reserved pio_ prefix:
     # feedback predictions (pio_pr), the model-lifecycle records (ISSUE
-    # 5), the tenancy/rollout-state records (ISSUE 6), and the online
-    # consumer's durable cursor records (ISSUE 9) — all living in the
+    # 5), the tenancy/rollout-state records (ISSUE 6), the online
+    # consumer's durable cursor records (ISSUE 9), and the fleet's
+    # job-claim bids + worker heartbeats (ISSUE 10) — all living in the
     # reserved LIFECYCLE_APP_ID namespace
     BUILTIN_ENTITY_TYPES = frozenset(
         {
             "pio_pr", "pio_model_version", "pio_train_job",
             "pio_tenant", "pio_rollout", "pio_online_cursor",
+            "pio_job_claim", "pio_fleet_worker",
         }
     )
 

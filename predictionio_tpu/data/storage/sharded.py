@@ -55,6 +55,13 @@ retry tuning + Storage.scala:335 verifyAllDataObjects):
   commits; replica copy failures degrade redundancy and are logged
   loudly but do not fail the write (no hinted handoff — a down shard's
   replicas catch up only via re-import).
+- ``HEDGED_READS`` (default on when REPLICAS > 1) hedges idempotent
+  entity reads (`find_entities_batch`) to the copy holder after a
+  p95-derived delay — first answer wins
+  (``storage_hedged_reads_total{outcome}``). Because replica copies
+  are best-effort, a winning hedge can reflect a slightly-shorter
+  history than the slow home shard held; set ``HEDGED_READS=0`` where
+  that bounded staleness is not acceptable.
 """
 
 from __future__ import annotations
@@ -64,7 +71,12 @@ import heapq
 import itertools
 import logging
 import time
-from concurrent.futures import ThreadPoolExecutor, as_completed
+import threading
+from concurrent.futures import (
+    ThreadPoolExecutor,
+    TimeoutError as FuturesTimeout,
+    as_completed,
+)
 from typing import Any, Callable, Iterator, Optional, Sequence
 
 from predictionio_tpu.data.event import Event
@@ -126,6 +138,14 @@ class ShardedEventStore(base.EventStore):
     #: retry schedule base — attempt i sleeps BACKOFF_BASE * 2**i
     BACKOFF_BASE = 0.05
 
+    #: hedged-read tuning (ISSUE 10 satellite): the hedge fires when the
+    #: primary is still in flight past the recent read-latency p95
+    #: (bounded window, conservative cold-start default, floor so a
+    #: microsecond p95 on embedded stores doesn't duplicate every read)
+    HEDGE_WINDOW = 512
+    HEDGE_DEFAULT_DELAY_S = 0.05
+    HEDGE_MIN_DELAY_S = 0.002
+
     def __init__(
         self,
         config: Optional[dict] = None,
@@ -178,6 +198,21 @@ class ShardedEventStore(base.EventStore):
         self.replicas = max(
             1, min(int(config.get("REPLICAS", "1")), len(self._stores))
         )
+        # hedged reads (ISSUE 10 satellite): ON by default when replica
+        # copies exist — an idempotent read stuck past the p95 fires a
+        # duplicate against the next copy holder, first answer wins
+        self.hedged_reads = self.replicas > 1 and str(
+            config.get("HEDGED_READS", "1")
+        ).strip() not in ("0", "false", "no")
+        self._read_lat: list[float] = []
+        self._lat_lock = threading.Lock()
+        from predictionio_tpu.obs import get_default_registry
+
+        self._hedge_counter = get_default_registry().counter(
+            "storage_hedged_reads_total",
+            "hedged idempotent replica reads by outcome",
+            ("outcome",),
+        )
         #: shard indices skipped by the most recent degraded broadcast
         #: read (empty when that read was complete). Best-effort operator
         #: diagnostic: updated only by broadcast reads, unsynchronized
@@ -190,6 +225,14 @@ class ShardedEventStore(base.EventStore):
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, len(self._stores)),
             thread_name_prefix="shardcast",
+        )
+        # hedged primaries/hedges run on their OWN pool: _hedged_call
+        # executes inside _broadcast's pool tasks, and submitting the
+        # duplicate reads back into a saturated shardcast pool would
+        # deadlock (every worker waiting on a future no worker can run)
+        self._hedge_pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * len(self._stores)),
+            thread_name_prefix="shardhedge",
         )
 
     @property
@@ -206,6 +249,97 @@ class ShardedEventStore(base.EventStore):
 
     def _for_entity(self, entity_id: str) -> int:
         return shard_of(entity_id, self.n_shards)
+
+    # -- hedged reads (ISSUE 10 satellite; PR-4 resilience follow-up) ------
+    def _record_read_latency(self, seconds: float) -> None:
+        with self._lat_lock:
+            self._read_lat.append(seconds)
+            if len(self._read_lat) > self.HEDGE_WINDOW:
+                del self._read_lat[: -self.HEDGE_WINDOW]
+
+    def hedge_delay_s(self) -> float:
+        """The p95-derived hedge trigger: a read still in flight past
+        the recent p95 is probably stuck behind a slow/struggling shard
+        — that is the moment the duplicate fires. Cold start (no
+        history) uses a conservative default so the hedge never beats a
+        normal-latency answer."""
+        with self._lat_lock:
+            lat = list(self._read_lat)
+        if len(lat) < 20:
+            return self.HEDGE_DEFAULT_DELAY_S
+        lat.sort()
+        p95 = lat[min(len(lat) - 1, int(0.95 * len(lat)))]
+        return max(self.HEDGE_MIN_DELAY_S, p95)
+
+    def _hedged_call(self, chain: Sequence[int], make_call):
+        """Run an IDEMPOTENT read against `chain[0]`, hedging to the
+        next replica after the p95-derived delay — first answer wins,
+        the loser is abandoned (its future still drains in the pool).
+        Only replica-holding chains hedge; a single-copy read falls
+        back to the plain retry path. `make_call(sx)` must return a
+        zero-arg callable running the read against shard sx.
+
+        Counter: storage_hedged_reads_total{outcome} —
+          primary_fast  primary answered before the hedge delay
+          primary       hedge fired, primary still answered first
+          hedge         the hedge's answer won
+          failover      primary raised and the hedge rescued the read
+        """
+        def serial(shards: Sequence[int]):
+            last: Optional[ShardDownError] = None
+            for sx in shards:
+                try:
+                    t0 = time.monotonic()
+                    out = self._shard_call(sx, make_call(sx))
+                    self._record_read_latency(time.monotonic() - t0)
+                    return out
+                except ShardDownError as e:
+                    last = e
+                    log.warning(
+                        "shard %d down for read; trying replica", sx
+                    )
+            raise last  # type: ignore[misc]
+
+        if len(chain) < 2 or not self.hedged_reads:
+            return serial(chain)
+        t0 = time.monotonic()
+        primary = self._hedge_pool.submit(
+            self._shard_call, chain[0], make_call(chain[0])
+        )
+        try:
+            out = primary.result(timeout=self.hedge_delay_s())
+            self._record_read_latency(time.monotonic() - t0)
+            self._hedge_counter.inc(outcome="primary_fast")
+            return out
+        except FuturesTimeout:
+            pass
+        except ShardDownError:
+            # primary died before the hedge even fired: serial failover
+            # over the remaining chain (counted as failover either way)
+            self._hedge_counter.inc(outcome="failover")
+            return serial(chain[1:])
+        hedge = self._hedge_pool.submit(
+            self._shard_call, chain[1], make_call(chain[1])
+        )
+        errors: list[Exception] = []
+        for f in as_completed([primary, hedge]):
+            try:
+                out = f.result()
+            except Exception as e:
+                errors.append(e)
+                continue
+            self._record_read_latency(time.monotonic() - t0)
+            if f is primary:
+                outcome = "primary"
+            else:
+                outcome = "hedge" if not errors else "failover"
+            self._hedge_counter.inc(outcome=outcome)
+            return out
+        # both copies failed; deeper replicas (if any) serially
+        if len(chain) > 2:
+            self._hedge_counter.inc(outcome="failover")
+            return serial(chain[2:])
+        raise errors[0]
 
     def _replica_chain(self, home: int) -> list[int]:
         """Home shard first, then its R-1 successors (copy holders)."""
@@ -299,6 +433,7 @@ class ShardedEventStore(base.EventStore):
         for s in self._stores:
             s.close()
         self._pool.shutdown(wait=False)
+        self._hedge_pool.shutdown(wait=False)
 
     # -- health ------------------------------------------------------------
     def health(self) -> list[dict]:
@@ -741,15 +876,31 @@ class ShardedEventStore(base.EventStore):
         answers for ITS entities in one bulk call, all shards in one
         concurrent round (never partial — a missing user history would
         silently impersonate a cold-start user; with REPLICAS > 1 a
-        down home shard's whole group fails over to the copy holder)."""
+        down home shard's whole group fails over to the copy holder).
+
+        This is the serving tier's hottest idempotent read (user-history
+        exclusion masks), so with replicas it rides the HEDGED path
+        (ISSUE 10 satellite): a home-shard read stuck past the p95
+        fires the same read at the copy holder and the first answer
+        wins — one slow or GC-pausing daemon stops defining the serving
+        tail.
+
+        Consistency trade: replica copies are best-effort by the write
+        contract (a logged copy failure leaves the successor PARTIAL),
+        so a hedge that wins while the home shard is merely slow can
+        return a slightly-shorter history than the home would have —
+        bounded staleness instead of tail latency. The failover path
+        always had this exposure during outages; hedging extends it to
+        slow-shard moments. Readers that need the home shard's full
+        answer (training reads go through `find`, not here) or strict
+        read-your-writes should set HEDGED_READS=0."""
         groups: dict[int, list[str]] = {}
         for eid in dict.fromkeys(entity_ids):
             groups.setdefault(self._for_entity(eid), []).append(eid)
 
         def one(home: int, ids: list) -> dict:
-            last: Optional[ShardDownError] = None
-            for c in self._replica_chain(home):
-                def call(c=c):
+            def make_call(c):
+                def call():
                     return self._stores[c].find_entities_batch(
                         app_id,
                         entity_type,
@@ -760,15 +911,9 @@ class ShardedEventStore(base.EventStore):
                         reversed=reversed,
                     )
 
-                try:
-                    return self._shard_call(c, call)
-                except ShardDownError as e:
-                    last = e
-                    log.warning(
-                        "shard %d down for entity batch; trying replica",
-                        c,
-                    )
-            raise last  # type: ignore[misc]
+                return call
+
+            return self._hedged_call(self._replica_chain(home), make_call)
 
         res = self._broadcast(
             [(sx, one, (sx, ids)) for sx, ids in groups.items()]

@@ -117,6 +117,26 @@ class LifecycleRecordStore:
             d.pop("_seq", None)
         return out
 
+    def events(self, entity_type: str, entity_id: str) -> list:
+        """One record's raw update events in fold order — the CAS-claim
+        bid resolution read (deploy/scheduler.py): a claim's winner is
+        the FIRST bid in this total order, which every reader computes
+        identically once the bids are visible, unlike the LWW fold where
+        the LAST write wins. The (event_time, _seq, event_id) key makes
+        the order total even across processes whose clocks collide at
+        microsecond granularity."""
+        evs = list(self._events().find(EventQuery(
+            app_id=LIFECYCLE_APP_ID,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=[SET_EVENT],
+        )))
+        evs.sort(key=lambda e: (
+            e.event_time, e.properties.get_or_else("_seq", 0),
+            e.event_id or "",
+        ))
+        return evs
+
     def compact(
         self, entity_type: str, entity_id: str, min_events: int = 2,
         min_age_s: float = 60.0,

@@ -17,6 +17,28 @@ executed by a supervising worker:
   backoff until `max_attempts`; deterministic train failures fail fast,
 - `period_s` gives cron-style periodic retrain per engine: completion
   (or final failure) of a periodic job enqueues the next run.
+
+Fleet-safe claims (ISSUE 10): ownership transitions are **compare-and-
+set** on a fenced ``claim_token`` + monotonically increasing
+``generation``, so N workers (predictionio_tpu/fleet/coordinator.py)
+can poll ONE queue and two of them can never supervise the same job:
+
+- a claim is a **bid** appended to the job's claim record
+  (``pio_job_claim``, one entity per job); the winner of generation g
+  is the FIRST bid for g in the storage's total event order — every
+  reader computes the same winner once the bids are visible,
+- bidders with known live peers wait ``claim_settle_s`` (covering
+  write-visibility skew) before resolving; a lone worker skips the
+  wait, so single-worker deployments keep the old latency,
+- every queued↔running transition bumps ``generation`` — a claim's bid
+  generation is therefore never reused, and an owner's terminal
+  bookkeeping is **fenced**: it re-reads the record and abandons if its
+  (token, generation) was superseded,
+- the stale-heartbeat steal rides the SAME CAS: re-queuing an orphan is
+  a bid for the next generation, so two resuming schedulers cannot
+  both requeue (double-incrementing attempts) — and a wedged worker
+  that wakes up after being stolen sees the fence on its next
+  heartbeat, kills its child, and abandons.
 """
 
 from __future__ import annotations
@@ -46,6 +68,11 @@ from predictionio_tpu.resilience.retry import RetryPolicy
 log = logging.getLogger(__name__)
 
 JOB_ENTITY = "pio_train_job"
+
+# claim-bid records (ISSUE 10): one entity per job accumulates every
+# worker's claim bids; the winner of a generation is the first bid for
+# it in the record store's total event order (registry.py:events)
+CLAIM_ENTITY = "pio_job_claim"
 
 JOB_STATUSES = ("queued", "running", "completed", "failed")
 
@@ -108,6 +135,11 @@ class TrainJob:
     model_version: Optional[str] = None
     log_path: Optional[str] = None
     worker_id: Optional[str] = None
+    # fenced-claim state (ISSUE 10): `generation` increments on every
+    # queued↔running transition; `claim_token` identifies the current
+    # owner's claim and fences its heartbeats/terminal writes
+    generation: int = 0
+    claim_token: Optional[str] = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -121,6 +153,8 @@ class TrainJob:
             "instance_id": self.instance_id,
             "model_version": self.model_version,
             "log_path": self.log_path, "worker_id": self.worker_id,
+            "generation": self.generation,
+            "claim_token": self.claim_token,
         }
 
     @staticmethod
@@ -133,7 +167,8 @@ class TrainJob:
             "status", "created_at", "not_before", "started_at",
             "finished_at", "heartbeat_at", "attempt", "max_attempts",
             "timeout_s", "period_s", "last_error", "instance_id",
-            "model_version", "log_path", "worker_id",
+            "model_version", "log_path", "worker_id", "generation",
+            "claim_token",
         ):
             if d.get(k) is not None:
                 setattr(job, k, d[k])
@@ -204,6 +239,119 @@ class JobQueue:
             self._store.discard(prev_event_id)
         return eid
 
+    def heartbeat_fenced(
+        self, job_id: str, prev_event_id: Optional[str], claim_token: str,
+    ) -> tuple[Optional[str], bool]:
+        """Heartbeat ONLY while `claim_token` still owns the job.
+        Returns (event_id, owned). A stolen job (another worker CAS-won
+        the next generation off our stale heartbeat) must not be
+        refreshed — the beat would make the re-queued record look
+        supervised — and the caller must kill its child and abandon."""
+        job = self.get(job_id)
+        if job is None or job.claim_token != claim_token:
+            return None, False
+        return self.heartbeat(job_id, prev_event_id), True
+
+    # -- compare-and-set claims (ISSUE 10) --------------------------------
+    def claim_bid(
+        self, job_id: str, generation: int
+    ) -> Optional[dict]:
+        """The winning bid's properties for `generation`: the FIRST bid
+        for it in the claim record's total event order (None when
+        nobody bid). Deterministic for every reader once the bids are
+        visible."""
+        for e in self._store.events(CLAIM_ENTITY, f"{job_id}#claim"):
+            props = e.properties.to_dict()
+            if int(props.get("generation") or 0) == generation:
+                return props
+        return None
+
+    def claim_winner(self, job_id: str, generation: int) -> Optional[str]:
+        bid = self.claim_bid(job_id, generation)
+        return bid.get("claim_token") if bid else None
+
+    def highest_bid(self, job_id: str) -> tuple[int, Optional[dict]]:
+        """(generation, winning-bid props) of the HIGHEST generation any
+        bid names — the unwedge pass must bid past this, not past the
+        job record's generation (dead unwedge bids stack above it)."""
+        best_gen, best = 0, None
+        for e in self._store.events(CLAIM_ENTITY, f"{job_id}#claim"):
+            props = e.properties.to_dict()
+            gen = int(props.get("generation") or 0)
+            if gen > best_gen:
+                best_gen, best = gen, props
+        return best_gen, best
+
+    def claim(
+        self,
+        job: TrainJob,
+        worker_id: str,
+        settle_s: float = 0.0,
+        intent: str = "run",
+        generation: Optional[int] = None,
+        fields: Optional[dict] = None,
+    ) -> Optional[str]:
+        """CAS-acquire the job's next ownership transition.
+
+        Appends a bid and resolves the winner from the claim record's
+        total order; returns this worker's claim token when it won, None
+        when another worker's bid sorted first (or the job's generation
+        already moved past the observed one — the record was re-read
+        stale). `settle_s` > 0 waits out write-visibility skew before
+        resolving, which multi-worker fleets need (coordinator.py wires
+        it from the live-peer probe); a lone worker resolves
+        immediately.
+
+        `fields` is the winner's post-transition job-record write
+        (status/worker_id/...), performed HERE — immediately after the
+        final re-check — so the window in which a crashed winner leaves
+        a won-but-unwritten bid is a few storage calls, not a caller's
+        arbitrary code path. Such a wedge is still possible (a worker
+        can die on any instruction) and is recovered by
+        `resume_orphans`'s stale-bid unwedge pass, which bids PAST the
+        dead generation. `generation` overrides the default
+        job.generation+1 for exactly that unwedge."""
+        gen = generation if generation is not None else job.generation + 1
+        token = uuid.uuid4().hex
+        self._store.append(CLAIM_ENTITY, f"{job.id}#claim", {
+            "job_id": job.id,
+            "generation": gen,
+            "claim_token": token,
+            "worker_id": worker_id,
+            "intent": intent,
+            "bid_at": time.time(),
+        })
+        if settle_s > 0:
+            time.sleep(settle_s)
+        if self.claim_winner(job.id, gen) != token:
+            return None
+        cur = self.get(job.id)
+        if cur is None or cur.generation >= gen:
+            # the observed snapshot was stale: the transition we bid for
+            # already happened (or the job was purged) — a "win" here
+            # would supervise on top of the real generation's owner
+            return None
+        if fields is not None:
+            # fields may override claim_token (a steal/unwedge ends
+            # UNOWNED: status=queued, claim_token=None)
+            self.update(job.id, **{
+                "generation": gen, "claim_token": token, **fields,
+            })
+        return token
+
+    def is_owner(self, job: TrainJob) -> bool:
+        """Fencing read: does `job`'s recorded (claim_token, generation)
+        still match the caller's copy? Terminal bookkeeping checks this
+        right before writing; a steal that lands in the tiny window
+        after the check is bounded by the staleness the steal itself
+        required (an actively-writing owner is never stale)."""
+        cur = self.get(job.id)
+        return (
+            cur is not None
+            and cur.claim_token == job.claim_token
+            and cur.generation == job.generation
+        )
+
     def get(self, job_id: str) -> Optional[TrainJob]:
         d = self._store.fold(JOB_ENTITY, job_id).get(job_id)
         return TrainJob.from_dict(d) if d else None
@@ -219,7 +367,10 @@ class JobQueue:
         return jobs
 
     def purge(self, job_id: str) -> int:
-        return self._store.purge(JOB_ENTITY, job_id)
+        n = self._store.purge(JOB_ENTITY, job_id)
+        # claim-bid records live and die with their job
+        n += self._store.purge(CLAIM_ENTITY, f"{job_id}#claim")
+        return n
 
     def gc(self, keep: int = 200) -> list[str]:
         """Purge terminal (completed/failed) job records beyond the
@@ -234,7 +385,7 @@ class JobQueue:
         ]
         doomed = terminal[: len(terminal) - keep] if keep else terminal
         for j in doomed:
-            self._store.purge(JOB_ENTITY, j.id)
+            self.purge(j.id)  # job record + its claim-bid record
         # compact the survivors: status transitions accumulate ~5 events
         # per job, and every queue poll re-folds the whole history
         self._store.compact_all(JOB_ENTITY)
@@ -261,6 +412,13 @@ class SchedulerConfig:
     # a `running` job whose heartbeat is older than this is an orphan of
     # a crashed worker and gets re-queued on scheduler start
     stale_after_s: float = 15.0
+    # claim-bid settle window (ISSUE 10): with live fleet peers, a
+    # bidder waits this long before resolving its claim so concurrent
+    # bids become visible and every worker computes the same winner.
+    # Must exceed the storage's write-visibility skew (embedded stores:
+    # ~0; cross-host daemons: replication lag + clock skew). A worker
+    # with NO live peers skips the wait entirely.
+    claim_settle_s: float = 0.25
     default_timeout_s: float = 3600.0
     # terminal job records kept by the periodic retention sweep (the
     # queue poll re-folds the whole job history, so it must stay bounded)
@@ -280,9 +438,11 @@ class SchedulerConfig:
 class TrainScheduler:
     """The worker: claims queued jobs and supervises their subprocesses.
 
-    One scheduler per deployment is the normal shape; the claim protocol
-    is last-write-wins (heartbeats carry the worker id), so a second
-    worker is safe-but-wasteful rather than corrupting."""
+    Claims are compare-and-set on a fenced claim_token + generation
+    (ISSUE 10), so N schedulers over shared storage cooperate as a
+    worker fleet (fleet/coordinator.py) — two workers can never
+    supervise one job. A lone scheduler pays no settle wait and behaves
+    exactly like the PR-5 single-worker shape."""
 
     def __init__(
         self, storage: Storage, config: Optional[SchedulerConfig] = None
@@ -291,6 +451,10 @@ class TrainScheduler:
         self.config = config or SchedulerConfig()
         self.queue = JobQueue(storage)
         self.worker_id = f"worker-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        # live-peer probe (fleet/coordinator.py wires this to the worker
+        # records): > 0 live peers → claims wait out the settle window;
+        # None/0 → lone worker, resolve immediately
+        self.peer_probe: Optional[Any] = None
         self._stop = threading.Event()
         self._abandon = False  # crash simulation: die without bookkeeping
         self._thread: Optional[threading.Thread] = None
@@ -353,10 +517,30 @@ class TrainScheduler:
             self._pool.shutdown(wait=True)
             self._pool = None
 
+    def _claim_settle(self) -> float:
+        """Settle wait for claim bids: only paid when live fleet peers
+        could be bidding concurrently (coordinator.py wires the probe);
+        a lone worker resolves immediately — single-worker deployments
+        keep PR-5 claim latency."""
+        try:
+            peers = int(self.peer_probe()) if self.peer_probe else 0
+        except Exception:
+            peers = 1  # probe broken: assume contention, pay the wait
+        return self.config.claim_settle_s if peers > 0 else 0.0
+
     # -- crash resume -----------------------------------------------------
     def resume_orphans(self) -> list[str]:
         """Re-queue `running` jobs whose heartbeat went stale (their
-        worker died mid-train). Returns the re-queued job ids."""
+        worker died mid-train). Returns the re-queued job ids.
+
+        The steal is the SAME CAS as a run claim (ISSUE 10): the
+        requeue/fail transition is bid for generation+1, so two
+        schedulers resuming the same orphan can't both write it (a
+        double requeue would double-increment the attempt budget on the
+        next claim, and a requeue racing a fail would resurrect a dead
+        job). The bumped generation also fences the crashed owner if it
+        was merely wedged: its next heartbeat sees the token mismatch,
+        kills its child, and abandons."""
         cutoff = time.time() - self.config.stale_after_s
         requeued = []
         for job in self.queue.list(status="running"):
@@ -366,14 +550,21 @@ class TrainScheduler:
                 # a train that keeps killing its worker must not
                 # crash-loop forever: the attempt budget covers orphan
                 # resumes too, not just supervised infra failures
+                token = self.queue.claim(
+                    job, self.worker_id, settle_s=self._claim_settle(),
+                    intent="steal",
+                    fields=dict(
+                        status="failed", finished_at=_now_iso(),
+                        claim_token=None, worker_id=None,
+                        last_error="worker crashed mid-train; attempts "
+                                   "exhausted",
+                    ),
+                )
+                if token is None:
+                    continue  # another scheduler's steal won
                 log.warning(
                     "job %s orphaned on final attempt %d/%d; failing",
                     job.id, job.attempt, job.max_attempts,
-                )
-                self.queue.update(
-                    job.id, status="failed", finished_at=_now_iso(),
-                    last_error="worker crashed mid-train; attempts "
-                               "exhausted",
                 )
                 self._jobs_counter.inc(outcome="failed_infra")
                 # a periodic retrain chain must survive one exhausted
@@ -381,16 +572,54 @@ class TrainScheduler:
                 # period, and the orphan path owes the same
                 self._schedule_next_period(job)
                 continue
+            token = self.queue.claim(
+                job, self.worker_id, settle_s=self._claim_settle(),
+                intent="steal",
+                fields=dict(
+                    status="queued", worker_id=None, claim_token=None,
+                    last_error="worker crashed mid-train; re-queued",
+                ),
+            )
+            if token is None:
+                continue  # another scheduler's steal won — its write
             log.warning(
                 "job %s orphaned (heartbeat %.1fs stale); re-queuing",
                 job.id, time.time() - job.heartbeat_at,
             )
-            self.queue.update(
-                job.id, status="queued", worker_id=None,
-                last_error="worker crashed mid-train; re-queued",
-            )
             self._jobs_counter.inc(outcome="requeued_orphan")
             requeued.append(job.id)
+        # un-wedge QUEUED jobs whose next generation was won by a bid
+        # that never became a record write (the bidder died between
+        # winning and writing): every later claim of that generation
+        # loses to the dead bid forever. A stale winning bid on a job
+        # whose record never advanced is exactly that wedge — bid PAST
+        # the HIGHEST bid generation on record (not a fixed +1: a died
+        # unwedge stacks another dead bid above the first) so the next
+        # claim starts on a fresh generation.
+        for job in self.queue.list(status="queued"):
+            if self.queue.claim_bid(job.id, job.generation + 1) is None:
+                continue  # no bid above the record: not wedged
+            top_gen, top = self.queue.highest_bid(job.id)
+            if top is None or top_gen <= job.generation:
+                continue
+            if time.time() - float(top.get("bid_at") or 0) < \
+                    self.config.stale_after_s:
+                continue  # a live claimant is mid-protocol; leave it
+            token = self.queue.claim(
+                job, self.worker_id, settle_s=self._claim_settle(),
+                intent="unwedge", generation=top_gen + 1,
+                fields=dict(
+                    status="queued", worker_id=None, claim_token=None,
+                    last_error="claim wedged by a dead bid; generation "
+                               "bumped",
+                ),
+            )
+            if token is not None:
+                log.warning(
+                    "job %s: dead claim bid at generation %d; un-wedged",
+                    job.id, job.generation + 1,
+                )
+                self._jobs_counter.inc(outcome="unwedged")
         return requeued
 
     # -- main loop --------------------------------------------------------
@@ -477,14 +706,81 @@ class TrainScheduler:
 
     # -- job execution ----------------------------------------------------
     def _run_job(self, job: TrainJob) -> None:
+        # fleet-wide engine-serialization PRE-check (cheap, bid-free):
+        # while a same-engine job trains on any worker, don't even bid —
+        # a bid per poll cycle would grow the claim record by thousands
+        # of dead bids over a long rival train, and bids are
+        # uncompactable (first-bid-wins reads them all). The post-claim
+        # seniority check below still closes the claim/claim race this
+        # read can't see.
+        try:
+            if any(
+                j.engine_id == job.engine_id and j.id != job.id
+                for j in self.queue.list(status="running")
+            ):
+                return  # re-polled next cycle; nothing written
+        except Exception:
+            pass  # storage blip: the post-claim check still guards
+        # CAS-claim the queued→running transition (ISSUE 10): only the
+        # bid winner supervises; losers walk away without having touched
+        # the job record. The running-record write happens INSIDE
+        # claim(), right after the win — see claim()'s wedge note.
         os.makedirs(self._log_dir, mode=0o700, exist_ok=True)
         log_path = os.path.join(self._log_dir, f"{job.id}.log")
-        self.queue.update(
-            job.id, status="running", worker_id=self.worker_id,
-            started_at=_now_iso(), heartbeat_at=time.time(),
-            log_path=log_path, attempt=job.attempt + 1,
+        token = self.queue.claim(
+            job, self.worker_id, settle_s=self._claim_settle(),
+            fields=dict(
+                status="running", worker_id=self.worker_id,
+                started_at=_now_iso(), heartbeat_at=time.time(),
+                log_path=log_path, attempt=job.attempt + 1,
+            ),
         )
+        if token is None:
+            self._jobs_counter.inc(outcome="claim_lost")
+            log.debug("job %s: claim lost to another worker", job.id)
+            return
+        job.claim_token = token
+        job.generation += 1
         job.attempt += 1
+        # fleet-wide per-engine serialization: the in-process
+        # _running_engines set only guards ONE worker — two fleet
+        # members claiming two different jobs of the same engine would
+        # race the latest-COMPLETED pointer their deploys read. After
+        # the claim record lands (and a settle window when live peers
+        # exist, so concurrent claimants see each other), the SENIOR
+        # running job of the engine (earliest started_at, id
+        # tie-break — recorded strings, so every reader agrees)
+        # proceeds; juniors yield back to the queue without consuming
+        # their attempt.
+        settle = self._claim_settle()
+        if settle:
+            time.sleep(settle)
+        try:
+            rivals = [
+                j for j in self.queue.list(status="running")
+                if j.engine_id == job.engine_id and j.id != job.id
+            ]
+        except Exception:
+            rivals = []  # storage blip: the in-process guard still holds
+        if rivals:
+            mine = self.queue.get(job.id)
+            key = lambda j: (j.started_at or "", j.id)
+            if mine is not None and min(
+                rivals + [mine], key=key
+            ).id != job.id:
+                self.queue.update(
+                    job.id, status="queued", worker_id=None,
+                    claim_token=None, generation=job.generation + 1,
+                    attempt=job.attempt - 1,
+                    not_before=time.time() + self.config.poll_interval_s,
+                    last_error=None,
+                )
+                self._jobs_counter.inc(outcome="engine_yield")
+                log.info(
+                    "job %s: engine %s already training on another "
+                    "worker; yielded", job.id, job.engine_id,
+                )
+                return
         spec_path = os.path.join(self._log_dir, f"{job.id}.spec.json")
         result_path = os.path.join(self._log_dir, f"{job.id}.result.json")
         # the spec carries the storage wiring VERBATIM — including any
@@ -551,7 +847,25 @@ class TrainScheduler:
                         if self._abandon:
                             return  # crashed worker: no bookkeeping at all
                         try:
-                            hb_event = self.queue.heartbeat(job.id, hb_event)
+                            hb_event, owned = self.queue.heartbeat_fenced(
+                                job.id, hb_event, job.claim_token or ""
+                            )
+                            if not owned:
+                                # stolen: our heartbeat went stale long
+                                # enough for another scheduler to CAS the
+                                # next generation — kill the child NOW so
+                                # the job is never trained twice, and
+                                # drop all bookkeeping (the thief owns
+                                # the record)
+                                log.warning(
+                                    "job %s: claim fenced (stolen by "
+                                    "another worker); killing child and "
+                                    "abandoning", job.id,
+                                )
+                                self._jobs_counter.inc(outcome="fenced")
+                                child.kill()
+                                child.wait()
+                                return
                         except Exception:
                             # transient storage outage must not abort
                             # supervision of a healthy train — keep
@@ -583,6 +897,16 @@ class TrainScheduler:
                 self._children.pop(job.id, None)
         if self._abandon:
             return  # crashed worker: the record keeps its stale heartbeat
+        if not self.queue.is_owner(job):
+            # fenced between the last heartbeat and child exit: the
+            # thief's record wins, our outcome is dropped (the retrain
+            # the steal implies is by design — our heartbeats were stale)
+            log.warning(
+                "job %s: claim superseded before bookkeeping; dropping "
+                "outcome", job.id,
+            )
+            self._jobs_counter.inc(outcome="fenced")
+            return
         if timed_out:
             self._finish_infra(
                 job, f"train exceeded timeout ({timeout_s:.0f}s); killed"
@@ -599,7 +923,7 @@ class TrainScheduler:
                 job.id, status="completed", finished_at=_now_iso(),
                 instance_id=result.get("instance_id"),
                 model_version=result.get("model_version"),
-                last_error=None,
+                last_error=None, claim_token=None,
             )
             self._jobs_counter.inc(outcome="completed")
             self._schedule_next_period(job)
@@ -608,6 +932,7 @@ class TrainScheduler:
             self.queue.update(
                 job.id, status="failed", finished_at=_now_iso(),
                 last_error=f"train failed (see {log_path})",
+                claim_token=None,
             )
             self._jobs_counter.inc(outcome="failed_train")
             self._schedule_next_period(job)
@@ -618,19 +943,27 @@ class TrainScheduler:
 
     def _finish_infra(self, job: TrainJob, error: str) -> None:
         """Infra-class failure: re-queue with backoff, or give up after
-        max_attempts."""
+        max_attempts. Fenced like every terminal write (the spawn-failed
+        path reaches here without the supervise-side check)."""
+        if job.claim_token is not None and not self.queue.is_owner(job):
+            self._jobs_counter.inc(outcome="fenced")
+            return
         if job.attempt >= job.max_attempts:
             self.queue.update(
                 job.id, status="failed", finished_at=_now_iso(),
                 last_error=f"{error} (attempts exhausted)",
+                claim_token=None,
             )
             self._jobs_counter.inc(outcome="failed_infra")
             self._schedule_next_period(job)
             return
         backoff = self.config.retry.delay(job.attempt - 1)
+        # the running→queued transition bumps generation so the next
+        # claim's bid can never collide with this round's resolved bids
         self.queue.update(
             job.id, status="queued", last_error=error,
             not_before=time.time() + backoff, worker_id=None,
+            generation=job.generation + 1, claim_token=None,
         )
         self._jobs_counter.inc(outcome="retried")
         log.warning(

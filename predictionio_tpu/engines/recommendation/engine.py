@@ -251,6 +251,12 @@ class ALSAlgorithmParams:
     # periodic retrain reconverges WITH the online fold-in stream
     # instead of re-deriving everything from random init
     warm_start: bool = False
+    # sharded serving (ISSUE 10): with > 1 visible device, keep factor
+    # state row-sharded across a serving mesh (fleet.ShardedRuntime) so
+    # the catalog can exceed one chip's HBM; recommend lowers as local
+    # top-k per shard + global merge. Off by default — single-chip
+    # serving keeps the PR-2 resident-matrix path.
+    shard_serving: bool = False
 
 
 class ALSModel:
@@ -267,6 +273,7 @@ class ALSModel:
         self.item_categories = item_categories
         self._item_factors_device = None
         self._user_factors_device = None
+        self._sharded_runtime = None  # fleet.ShardedRuntime when active
         self._stage_lock = threading.Lock()
 
     # device caches + lock are serving state, not part of the pickled model
@@ -275,6 +282,55 @@ class ALSModel:
 
     def __setstate__(self, state):
         self.__init__(state["factors"], state.get("item_categories"))
+
+    def sharded_runtime(self):
+        """The fleet sharded serving state, staged lazily on first use
+        (ISSUE 10). Requires > 1 visible device; the optional
+        PIO_SERVE_HBM_BYTES env is the per-device budget the shards
+        must fit (the single-device path has no such gate — it simply
+        OOMs, which is exactly what sharding exists to prevent). The
+        single-device outcome is cached as False so the serving hot
+        path doesn't re-probe jax.devices() under the lock per batch."""
+        with self._stage_lock:
+            if self._sharded_runtime is False:
+                return None
+            if self._sharded_runtime is None:
+                import os
+
+                import jax
+
+                from predictionio_tpu.fleet import ShardedRuntime
+
+                if len(jax.devices()) < 2:
+                    self._sharded_runtime = False
+                    return None
+                budget = os.environ.get("PIO_SERVE_HBM_BYTES")
+                self._sharded_runtime = ShardedRuntime.from_factors(
+                    self.factors,
+                    device_budget_bytes=float(budget) if budget else None,
+                )
+            return self._sharded_runtime
+
+    def sharded_info(self) -> Optional[dict]:
+        """Shard layout for the server's fleet status (None when the
+        sharded tier is not staged)."""
+        srt = self._sharded_runtime
+        return srt.info() if srt else None  # None or the False sentinel
+
+    def resident_device_bytes(self) -> float:
+        """Per-device HBM footprint for the tenant cache's budget
+        (tenancy/cache.py walks to this hook): one SHARD when serving
+        sharded — the whole point of the fleet tier is that no chip
+        holds the catalog — else the factor matrices once (the staged
+        device copies mirror the host arrays 1:1, so counting the
+        host mirrors AND the copies would double-charge)."""
+        srt = self._sharded_runtime
+        if srt:
+            return float(srt.device_bytes()["per_shard"])
+        return float(
+            self.factors.user_factors.nbytes
+            + self.factors.item_factors.nbytes
+        )
 
     def item_factors_device(self):
         # locked: the pipelined dispatcher (server.py pipeline_depth) can
@@ -536,14 +592,26 @@ class ALSAlgorithm(Algorithm):
         # (vocab-known users, not the micro-batch's group size) and the
         # bucket the device program actually ran at
         prof0 = _devprof.snapshot()
-        scores, items = als.recommend(
-            model.factors,
-            user_rows,
-            k,
-            exclude_mask=sub_mask,
-            item_factors_device=model.item_factors_device(),
-            user_factors_device=model.user_factors_device(),
+        srt = (
+            model.sharded_runtime()
+            if getattr(self.params, "shard_serving", False)
+            else None
         )
+        if srt is not None:
+            # fleet sharded path (ISSUE 10): local top-k per shard +
+            # global merge; factor state stays row-sharded in HBM
+            scores, items = srt.recommend(
+                user_rows, k, exclude_mask=sub_mask
+            )
+        else:
+            scores, items = als.recommend(
+                model.factors,
+                user_rows,
+                k,
+                exclude_mask=sub_mask,
+                item_factors_device=model.item_factors_device(),
+                user_factors_device=model.user_factors_device(),
+            )
         _devprof.record_batch_padding(
             n_real, bucket, flops=_devprof.snapshot().flops - prof0.flops
         )

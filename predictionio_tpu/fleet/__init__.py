@@ -1,0 +1,59 @@
+"""Fleet subsystem (ISSUE 10): multi-chip sharded serving from
+TPU-resident factor state + a multi-worker distributed training tier.
+
+Three planes:
+
+- **coordinator.py** — N `TrainScheduler` workers cooperating on ONE
+  shared-storage job queue via compare-and-set claims (fenced
+  claim_token + generation, CAS stale-heartbeat steal), with
+  heartbeating worker records and `pio fleet status`,
+- **distributed.py** — jax.distributed-style multi-host init config
+  (coordinator address, process id/count) with a single-host fallback
+  so every test and laptop runs the same code,
+- **runtime.py** — `ShardedRuntime`: factor state row-sharded across a
+  serving mesh, recommend/similar/fold_in lowered as sharded
+  executables (local top-k per shard + global merge), so one model
+  serves a catalog larger than a single chip's HBM.
+
+Import discipline: this package sits on server/console control paths —
+it must not import jax. `runtime` (which does) loads lazily through
+module __getattr__.
+"""
+
+from predictionio_tpu.fleet.coordinator import (
+    WORKER_ENTITY,
+    FleetConfig,
+    FleetMember,
+    WorkerInfo,
+    WorkerRegistry,
+    fleet_status,
+)
+from predictionio_tpu.fleet.distributed import DistributedConfig
+
+_LAZY_RUNTIME = (
+    "ShardedRuntime",
+    "OversizedModelError",
+    "factor_state_bytes",
+    "check_single_device_budget",
+)
+
+__all__ = [
+    "DistributedConfig",
+    "FleetConfig",
+    "FleetMember",
+    "WORKER_ENTITY",
+    "WorkerInfo",
+    "WorkerRegistry",
+    "fleet_status",
+    *_LAZY_RUNTIME,
+]
+
+
+def __getattr__(name):
+    if name in _LAZY_RUNTIME:
+        from predictionio_tpu.fleet import runtime as _runtime
+
+        return getattr(_runtime, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -1,0 +1,321 @@
+"""Training worker fleet: N cooperating schedulers on shared storage
+(ISSUE 10 tentpole part 3).
+
+PR 5's `TrainScheduler` was one process supervising one queue. A fleet
+member wraps that scheduler with the two things N-worker operation
+needs:
+
+- **worker records**: each member registers a heartbeating
+  ``pio_fleet_worker`` record in the lifecycle record store, so every
+  member (and `pio fleet status`) sees who is alive. The scheduler's
+  ``peer_probe`` reads this — claims pay the CAS settle window only
+  when live peers could actually be bidding (deploy/scheduler.py),
+- **multi-host wiring**: an optional `DistributedConfig` is exported to
+  every train subprocess via the env contract (distributed.py), so an
+  N-host fleet's trains form one jax.distributed mesh; the single-host
+  fallback keeps laptops and tests config-free.
+
+There is deliberately NO elected coordinator process: the queue itself
+(compare-and-set job claims, fenced heartbeats, CAS stale-steal) is the
+coordination point, the same way the reference's HBase-backed metadata
+let any host run `pio train`. Any member can die at any time; its jobs
+go stale and the survivors steal them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.deploy.registry import LifecycleRecordStore
+from predictionio_tpu.deploy.scheduler import (
+    JobQueue,
+    SchedulerConfig,
+    TrainScheduler,
+)
+from predictionio_tpu.fleet.distributed import DistributedConfig
+
+log = logging.getLogger(__name__)
+
+WORKER_ENTITY = "pio_fleet_worker"
+
+
+def _utcnow_iso() -> str:
+    import datetime as _dt
+
+    return _dt.datetime.now(_dt.timezone.utc).isoformat()
+
+
+@dataclass
+class WorkerInfo:
+    """One fleet member's heartbeating presence record."""
+
+    id: str
+    host: str = ""
+    pid: int = 0
+    started_at: str = ""
+    heartbeat_at: float = 0.0
+    running_jobs: int = 0
+    capacity: int = 1
+    process_id: int = 0
+    num_processes: int = 1
+    devices: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id, "host": self.host, "pid": self.pid,
+            "started_at": self.started_at,
+            "heartbeat_at": self.heartbeat_at,
+            "running_jobs": self.running_jobs, "capacity": self.capacity,
+            "process_id": self.process_id,
+            "num_processes": self.num_processes, "devices": self.devices,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "WorkerInfo":
+        w = WorkerInfo(id=d.get("id", ""))
+        for k in (
+            "host", "pid", "started_at", "heartbeat_at", "running_jobs",
+            "capacity", "process_id", "num_processes", "devices",
+        ):
+            if d.get(k) is not None:
+                setattr(w, k, d[k])
+        return w
+
+
+@dataclass
+class FleetConfig:
+    """Fleet-member knobs on top of the scheduler's own config."""
+
+    # worker-record heartbeat cadence and liveness horizon
+    heartbeat_interval_s: float = 2.0
+    worker_stale_after_s: float = 10.0
+    # multi-host process topology exported to train children
+    distributed: DistributedConfig = field(
+        default_factory=DistributedConfig
+    )
+
+
+class WorkerRegistry:
+    """CRUD + liveness over worker records (shared record layer)."""
+
+    def __init__(self, storage: Storage):
+        self._store = LifecycleRecordStore(storage)
+
+    def upsert(self, info: WorkerInfo) -> None:
+        self._store.append(WORKER_ENTITY, info.id, info.to_dict())
+
+    def heartbeat(
+        self, worker_id: str, prev_event_id: Optional[str],
+        running_jobs: int,
+    ) -> str:
+        """Heartbeat with compaction (same discipline as job
+        heartbeats: one live beat event per worker, not one per tick).
+        The beat carries `id` too: a record a peer GC'd away during a
+        connectivity gap is otherwise resurrected identity-less, and an
+        id-"" phantom would count as a live peer of everyone forever."""
+        eid = self._store.append(WORKER_ENTITY, worker_id, {
+            "id": worker_id,
+            "heartbeat_at": time.time(), "running_jobs": running_jobs,
+        })
+        if prev_event_id:
+            self._store.discard(prev_event_id)
+        return eid
+
+    def remove(self, worker_id: str) -> None:
+        self._store.purge(WORKER_ENTITY, worker_id)
+
+    def list(self) -> list[WorkerInfo]:
+        return [
+            WorkerInfo.from_dict(d)
+            for d in self._store.fold(WORKER_ENTITY).values()
+        ]
+
+    def live(self, stale_after_s: float = 10.0) -> list[WorkerInfo]:
+        cutoff = time.time() - stale_after_s
+        return [w for w in self.list() if w.heartbeat_at >= cutoff]
+
+    def gc(self, stale_after_s: float = 60.0) -> list[str]:
+        """Purge records of workers dead for much longer than the
+        liveness horizon (a crashed member can't deregister itself)."""
+        cutoff = time.time() - stale_after_s
+        doomed = [w.id for w in self.list() if w.heartbeat_at < cutoff]
+        for wid in doomed:
+            self.remove(wid)
+        return doomed
+
+
+class FleetMember:
+    """One worker of the training fleet: a TrainScheduler + a
+    heartbeating worker record + the peer probe that arms the CAS
+    settle window only under real contention."""
+
+    def __init__(
+        self,
+        storage: Storage,
+        scheduler_config: Optional[SchedulerConfig] = None,
+        fleet_config: Optional[FleetConfig] = None,
+    ):
+        self.storage = storage
+        self.config = fleet_config or FleetConfig()
+        sched_cfg = scheduler_config or SchedulerConfig()
+        # export the process topology to every train child (single-host
+        # fallback exports nothing)
+        sched_cfg.child_env = dict(
+            sched_cfg.child_env, **self.config.distributed.child_env()
+        )
+        self.scheduler = TrainScheduler(storage, sched_cfg)
+        self.scheduler.peer_probe = self.live_peer_count
+        self.registry = WorkerRegistry(storage)
+        self.worker_id = self.scheduler.worker_id
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_event: Optional[str] = None
+        # liveness reads hit storage; cache them for a heartbeat period
+        # so every claim doesn't pay a worker-record fold
+        self._peer_cache: tuple[float, int] = (0.0, 0)
+        self._peer_lock = threading.Lock()
+
+    # -- liveness ----------------------------------------------------------
+    def live_peer_count(self) -> int:
+        """Live workers OTHER than this one (the scheduler's settle
+        gate). Cached for one heartbeat interval."""
+        now = time.monotonic()
+        with self._peer_lock:
+            ts, n = self._peer_cache
+            if now - ts < self.config.heartbeat_interval_s:
+                return n
+        try:
+            peers = [
+                w for w in self.registry.live(
+                    self.config.worker_stale_after_s
+                )
+                if w.id != self.worker_id
+            ]
+            n = len(peers)
+        except Exception:
+            n = 1  # storage hiccup: assume contention, pay the wait
+        with self._peer_lock:
+            self._peer_cache = (now, n)
+        return n
+
+    def peers(self) -> list[WorkerInfo]:
+        return [
+            w for w in self.registry.live(self.config.worker_stale_after_s)
+            if w.id != self.worker_id
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+    def _device_count(self) -> int:
+        # jax only if someone already paid for it — the fleet member
+        # itself must stay importable on jax-free control planes
+        import sys
+
+        if "jax" not in sys.modules:
+            return 0
+        try:
+            return len(sys.modules["jax"].devices())
+        except Exception:
+            return 0
+
+    def start(self) -> None:
+        dist = self.config.distributed
+        self.registry.upsert(WorkerInfo(
+            id=self.worker_id,
+            host=socket.gethostname(),
+            pid=os.getpid(),
+            started_at=_utcnow_iso(),
+            heartbeat_at=time.time(),
+            capacity=max(1, int(self.scheduler.config.max_concurrent)),
+            process_id=dist.process_id,
+            num_processes=dist.num_processes,
+            devices=self._device_count(),
+        ))
+        self._stop.clear()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, name="fleet-worker-heartbeat",
+            daemon=True,
+        )
+        self._hb_thread.start()
+        self.scheduler.resume_orphans()
+        self.scheduler.start()
+
+    def stop(self, kill_child: bool = False) -> None:
+        self.scheduler.stop(kill_child=kill_child)
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join()
+            self._hb_thread = None
+        if kill_child:
+            # crash simulation: leave the worker record to go stale so
+            # peers observe the death the way they would a real one
+            return
+        try:
+            self.registry.remove(self.worker_id)
+        except Exception:
+            log.debug("worker deregister failed (non-fatal)", exc_info=True)
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.config.heartbeat_interval_s):
+            try:
+                running = len(self.scheduler._running_ids)
+                self._hb_event = self.registry.heartbeat(
+                    self.worker_id, self._hb_event, running
+                )
+                self.registry.gc(
+                    stale_after_s=6 * self.config.worker_stale_after_s
+                )
+            except Exception:
+                log.warning(
+                    "worker heartbeat failed (storage down?); continuing",
+                    exc_info=True,
+                )
+
+
+def fleet_status(
+    storage: Storage, stale_after_s: float = 10.0
+) -> dict[str, Any]:
+    """Operator view of the fleet: live/stale workers + queue depth
+    (the `pio fleet status` payload)."""
+    registry = WorkerRegistry(storage)
+    queue = JobQueue(storage)
+    workers = registry.list()
+    cutoff = time.time() - stale_after_s
+    jobs = queue.list()
+    by_status: dict[str, int] = {}
+    for j in jobs:
+        by_status[j.status] = by_status.get(j.status, 0) + 1
+    return {
+        "workers": [
+            dict(
+                w.to_dict(),
+                live=w.heartbeat_at >= cutoff,
+                heartbeat_age_s=round(
+                    max(0.0, time.time() - w.heartbeat_at), 1
+                ),
+            )
+            for w in sorted(workers, key=lambda w: w.id)
+        ],
+        "live_workers": sum(
+            1 for w in workers if w.heartbeat_at >= cutoff
+        ),
+        "jobs": by_status,
+        "claimable": len(queue.claimable()),
+        "running": [
+            {
+                "id": j.id, "worker_id": j.worker_id,
+                "generation": j.generation,
+                "heartbeat_age_s": round(
+                    max(0.0, time.time() - j.heartbeat_at), 1
+                ),
+            }
+            for j in jobs if j.status == "running"
+        ],
+    }

@@ -1,0 +1,128 @@
+"""Multi-host process wiring for the training/serving fleet (ISSUE 10).
+
+The reference system scaled its training shuffle by handing partitions
+to Spark executors over a cluster manager; the jax_graft analogue is
+`jax.distributed`: N processes, each bound to its local chips, agree on
+a coordinator and form ONE device mesh spanning all of them (the
+tests/test_multihost.py topology, productized). ``DistributedConfig``
+carries the three coordinates every runtime needs — coordinator
+address, process id, process count — with a **single-host fallback**:
+`num_processes <= 1` makes `initialize()` a no-op, so every code path
+(tests, laptops, single-chip deployments) runs the same code with zero
+distributed setup.
+
+Import discipline: this module sits on control paths (scheduler worker
+spawn, console) — jax is imported lazily inside `initialize()` only.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+# env contract: the worker fleet exports these to train subprocesses so
+# an N-host train forms its mesh without per-job plumbing
+ENV_COORDINATOR = "PIO_FLEET_COORDINATOR"
+ENV_NUM_PROCESSES = "PIO_FLEET_NUM_PROCESSES"
+ENV_PROCESS_ID = "PIO_FLEET_PROCESS_ID"
+
+
+@dataclass(frozen=True)
+class DistributedConfig:
+    """jax.distributed-style multi-host init coordinates.
+
+    `coordinator_address` is host:port of process 0's coordinator
+    service; `process_id` ∈ [0, num_processes). With the default
+    `num_processes=1` everything degrades to single-host: no
+    coordinator, no collective init, tests run anywhere."""
+
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+    def __post_init__(self):
+        if self.num_processes < 1:
+            raise ValueError(
+                f"num_processes must be >= 1, got {self.num_processes}"
+            )
+        if not (0 <= self.process_id < self.num_processes):
+            raise ValueError(
+                f"process_id {self.process_id} outside "
+                f"[0, {self.num_processes})"
+            )
+        if self.num_processes > 1 and not self.coordinator_address:
+            raise ValueError(
+                "multi-process fleet needs a coordinator_address"
+            )
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_processes > 1
+
+    @staticmethod
+    def from_env(env: Optional[dict] = None) -> "DistributedConfig":
+        """The worker-side read of the env contract (missing → the
+        single-host fallback)."""
+        env = os.environ if env is None else env
+        return DistributedConfig(
+            coordinator_address=env.get(ENV_COORDINATOR) or None,
+            num_processes=int(env.get(ENV_NUM_PROCESSES, "1") or 1),
+            process_id=int(env.get(ENV_PROCESS_ID, "0") or 0),
+        )
+
+    @staticmethod
+    def from_json(obj: Optional[dict]) -> "DistributedConfig":
+        """Engine-variant / fleet-config JSON → config (the `fleet` key
+        next to `mesh` in engine.json)."""
+        obj = obj or {}
+        return DistributedConfig(
+            coordinator_address=obj.get("coordinator") or None,
+            num_processes=int(obj.get("num_processes", 1) or 1),
+            process_id=int(obj.get("process_id", 0) or 0),
+        )
+
+    def child_env(self) -> dict[str, str]:
+        """Env to export to a train subprocess so it re-forms the same
+        process topology (empty for single-host — the child must not
+        try to reach a coordinator that isn't there)."""
+        if not self.multi_host:
+            return {}
+        return {
+            ENV_COORDINATOR: str(self.coordinator_address),
+            ENV_NUM_PROCESSES: str(self.num_processes),
+            ENV_PROCESS_ID: str(self.process_id),
+        }
+
+    def initialize(self) -> bool:
+        """Join the multi-host collective (idempotent); returns whether
+        a distributed init actually ran. Single-host: no-op, False.
+
+        jax.distributed.initialize must run BEFORE any backend is
+        created — callers invoke this first thing in a worker process,
+        like tests/test_multihost.py's child does."""
+        if not self.multi_host:
+            return False
+        import jax
+
+        try:
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address,
+                num_processes=self.num_processes,
+                process_id=self.process_id,
+            )
+        except RuntimeError as e:
+            # already initialized (idempotent re-entry) is fine; a real
+            # topology error is not
+            if "already" in str(e).lower():
+                log.debug("jax.distributed already initialized")
+                return True
+            raise
+        log.info(
+            "joined fleet collective: process %d/%d via %s",
+            self.process_id, self.num_processes, self.coordinator_address,
+        )
+        return True

@@ -1,0 +1,507 @@
+"""Sharded serving runtime: TPU-resident, row-sharded factor state
+(ISSUE 10 tentpole part 2).
+
+A single-chip serving tier caps the catalog at one HBM's worth of
+factor rows. `ShardedRuntime` keeps BOTH factor matrices row-sharded
+over a 1-D device mesh (parallel/mesh.py:serving_mesh) and lowers the
+three serving verbs as sharded executables, so one model serves a
+catalog larger than any single chip can load:
+
+- **recommend**: each shard assembles the query block from the rows it
+  owns (masked gather + psum — the all-reduce half of the classic
+  gather), scores against ITS item slab, takes a LOCAL top-k, and an
+  all-gather + second top-k merges the per-shard candidates into the
+  global answer. Score traffic never leaves the shard; only (B, k)
+  candidates ride the ICI.
+- **similar**: same shape over L2-normalized item factors (cosine).
+- **fold_in**: the single-side normal-equation solve against the FIXED
+  opposite matrix — each shard contributes the partial Gram/b terms of
+  the edges it owns, one psum assembles the K×K systems, every shard
+  solves them redundantly (they are tiny), matching
+  models/als.py:_fold_in_jit numerics.
+
+Padding rows are exactly zero and masked out of every top-k by the
+global-index pad mask, the same inertness discipline the train paths
+use. This module imports jax at module level — reach it via
+``predictionio_tpu.fleet``'s lazy attribute, never from a data-plane
+import path.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.obs import devprof as _devprof
+from predictionio_tpu.ops.segment import batched_cg, f32_gram
+from predictionio_tpu.ops.topk import NEG_INF
+from predictionio_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    pad_rows_to_shards,
+    serving_mesh,
+    shard_map,
+    shard_rows,
+)
+
+log = logging.getLogger(__name__)
+
+
+class OversizedModelError(RuntimeError):
+    """The factor state does not fit the given per-device HBM budget."""
+
+
+def factor_state_bytes(
+    n_users: int, n_items: int, rank: int, dtype_bytes: int = 4
+) -> int:
+    """Resident bytes of the full (unsharded) factor state — what a
+    single-device runtime must fit in one HBM."""
+    return (n_users + n_items) * rank * dtype_bytes
+
+
+def check_single_device_budget(
+    n_users: int, n_items: int, rank: int, budget_bytes: float
+) -> None:
+    """Raise when a SINGLE-device runtime cannot hold this factor
+    state — the gate the sharded tier exists to pass (bench's
+    oversized-catalog proof calls this for the refusal side)."""
+    need = factor_state_bytes(n_users, n_items, rank)
+    if need > budget_bytes:
+        raise OversizedModelError(
+            f"factor state needs {need / 1e9:.2f} GB resident but the "
+            f"single-device budget is {budget_bytes / 1e9:.2f} GB — "
+            "serve it sharded (fleet.ShardedRuntime)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# sharded executables
+# ---------------------------------------------------------------------------
+
+
+def _owned_rows(rows: jax.Array, table: jax.Array, n_local: int):
+    """Shard-local gather of `table[rows]` contributions: rows this
+    shard owns yield their slab row, others yield zero — a psum over
+    the shard axis completes the distributed gather."""
+    idx = jax.lax.axis_index(MODEL_AXIS)
+    loc = rows - idx * n_local
+    own = (loc >= 0) & (loc < n_local)
+    safe = jnp.clip(loc, 0, n_local - 1)
+    return jnp.where(own[..., None], table[safe], 0.0)
+
+
+def _merge_topk(v: jax.Array, ix: jax.Array, k: int):
+    """Local (B, k_l) candidates → global (B, k) top-k: all-gather the
+    per-shard candidates along the score axis, then one more top_k."""
+    vs = jax.lax.all_gather(v, MODEL_AXIS, axis=1, tiled=True)
+    ixs = jax.lax.all_gather(ix, MODEL_AXIS, axis=1, tiled=True)
+    vv, sel = jax.lax.top_k(vs, k)
+    return vv, jnp.take_along_axis(ixs, sel, axis=1)
+
+
+@partial(jax.jit, static_argnames=("k", "n_items", "mesh", "masked"))
+def _sharded_recommend(
+    rows: jax.Array,  # (B,) int32, replicated
+    uf: jax.Array,  # (U_p, K) row-sharded over mp
+    itf: jax.Array,  # (I_p, K) row-sharded over mp
+    mask: Optional[jax.Array],  # (B, I_p) bool col-sharded / None
+    *,
+    k: int,
+    n_items: int,
+    mesh: jax.sharding.Mesh,
+    masked: bool,
+):
+    n_shards = int(mesh.shape[MODEL_AXIS])
+    u_local = uf.shape[0] // n_shards
+    i_local = itf.shape[0] // n_shards
+    k_l = min(k, i_local)
+
+    def local(rows_l, uf_l, itf_l, mask_l):
+        idx = jax.lax.axis_index(MODEL_AXIS)
+        q = jax.lax.psum(
+            _owned_rows(rows_l, uf_l, u_local), MODEL_AXIS
+        )  # (B, K) — every shard now holds the full query block
+        scores = q @ itf_l.T  # (B, i_local): the shard-local slab only
+        gcol = idx * i_local + jnp.arange(i_local)
+        dead = (gcol >= n_items)[None, :]
+        if masked:
+            dead = dead | mask_l
+        scores = jnp.where(dead, NEG_INF, scores)
+        v, ix = jax.lax.top_k(scores, k_l)
+        return _merge_topk(v, ix + idx * i_local, k)
+
+    sh = P(MODEL_AXIS, None)
+    if masked:
+        fn, args = local, (rows, uf, itf, mask)
+        in_specs = (P(), sh, sh, P(None, MODEL_AXIS))
+    else:
+        fn = lambda r, u, i: local(r, u, i, None)
+        args = (rows, uf, itf)
+        in_specs = (P(), sh, sh)
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=(P(), P()),
+        check=False,
+    )(*args)
+
+
+@partial(
+    jax.jit, static_argnames=("k", "n_items", "mesh", "exclude_self")
+)
+def _sharded_similar(
+    rows: jax.Array,  # (B,) int32 item rows, replicated
+    itf: jax.Array,  # (I_p, K) row-sharded
+    *,
+    k: int,
+    n_items: int,
+    mesh: jax.sharding.Mesh,
+    exclude_self: bool,
+):
+    n_shards = int(mesh.shape[MODEL_AXIS])
+    i_local = itf.shape[0] // n_shards
+    k_l = min(k, i_local)
+
+    def local(rows_l, itf_l):
+        idx = jax.lax.axis_index(MODEL_AXIS)
+        q = jax.lax.psum(_owned_rows(rows_l, itf_l, i_local), MODEL_AXIS)
+        qn = q / (jnp.linalg.norm(q, axis=-1, keepdims=True) + 1e-9)
+        fn_ = itf_l / (
+            jnp.linalg.norm(itf_l, axis=-1, keepdims=True) + 1e-9
+        )
+        scores = qn @ fn_.T  # (B, i_local)
+        gcol = idx * i_local + jnp.arange(i_local)
+        dead = (gcol >= n_items)[None, :]
+        if exclude_self:
+            dead = dead | (gcol[None, :] == rows_l[:, None])
+        scores = jnp.where(dead, NEG_INF, scores)
+        v, ix = jax.lax.top_k(scores, k_l)
+        return _merge_topk(v, ix + idx * i_local, k)
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(), P(MODEL_AXIS, None)),
+        out_specs=(P(), P()), check=False,
+    )(rows, itf)
+
+
+@partial(jax.jit, static_argnames=("implicit", "cg_iterations", "mesh"))
+def _sharded_fold_in(
+    fixed: jax.Array,  # (N_p, K) row-sharded — the FIXED opposite side
+    edge_idx: jax.Array,  # (R, E) int32 rows into `fixed` (replicated)
+    edge_val: jax.Array,  # (R, E)
+    edge_ok: jax.Array,  # (R, E) 1.0 real / 0.0 pad
+    lam: jax.Array,  # () f32
+    alpha: jax.Array,  # () f32
+    *,
+    implicit: bool,
+    cg_iterations: int,
+    mesh: jax.sharding.Mesh,
+):
+    """Sharded single-side fold-in solve: identical operator assembly to
+    models/als.py:_fold_in_jit, with the edge gather distributed — each
+    shard contributes the terms of the fixed rows it owns and ONE psum
+    assembles the (R, K, K) systems everywhere."""
+    n_shards = int(mesh.shape[MODEL_AXIS])
+    n_local = fixed.shape[0] // n_shards
+    k = fixed.shape[1]
+
+    def local(fixed_l, edge_idx, edge_val, edge_ok):
+        idx = jax.lax.axis_index(MODEL_AXIS)
+        loc = edge_idx - idx * n_local
+        own = (
+            ((loc >= 0) & (loc < n_local)).astype(jnp.float32) * edge_ok
+        )
+        safe = jnp.clip(loc, 0, n_local - 1)
+        y = fixed_l[safe] * own[..., None]  # (R, E, K) — owner-masked
+        eye = jnp.eye(k, dtype=jnp.float32)
+        if implicit:
+            conf = 1.0 + alpha * jnp.abs(edge_val)
+            pref = (edge_val > 0).astype(jnp.float32)
+            w_b = conf * pref * own
+            w_g = (conf - 1.0) * own
+            gram = jax.lax.psum(f32_gram(fixed_l), MODEL_AXIS)
+            b = jax.lax.psum(
+                jnp.einsum("re,rek->rk", w_b, y), MODEL_AXIS
+            )
+            a = (
+                jax.lax.psum(
+                    jnp.einsum("re,rek,rel->rkl", w_g, y, y), MODEL_AXIS
+                )
+                + gram[None, :, :]
+                + lam * eye
+            )
+        else:
+            b = jax.lax.psum(
+                jnp.einsum("re,rek->rk", edge_val * own, y), MODEL_AXIS
+            )
+            deg = jnp.sum(edge_ok, axis=1)  # edge_ok is replicated
+            reg = lam * jnp.maximum(deg, 1.0)
+            a = (
+                jax.lax.psum(
+                    jnp.einsum("re,rek,rel->rkl", own, y, y), MODEL_AXIS
+                )
+                + reg[:, None, None] * eye
+            )
+
+        def matvec(v):
+            return jnp.einsum("rkl,rl->rk", a, v)
+
+        return batched_cg(matvec, b, jnp.zeros_like(b), cg_iterations)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None), P(), P(), P()),
+        out_specs=P(), check=False,
+    )(fixed, edge_idx, edge_val, edge_ok)
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _scatter_rows(
+    table: jax.Array, rows: jax.Array, values: jax.Array, *, mesh
+):
+    """Functional row update that PRESERVES the row sharding (the
+    fold-in publish path: solved rows land in the resident state
+    without a host round-trip or a resharding copy). Deliberately NOT
+    donated: the pipelined dispatcher serves queries concurrently with
+    fold-in publishes, and a reader that captured the old table
+    reference must keep a live buffer (copy-on-write, like the dense
+    publish path) — the transient 2× is the price of zero-drop."""
+    out = table.at[rows].set(values)
+    return jax.lax.with_sharding_constraint(
+        out, NamedSharding(mesh, P(MODEL_AXIS, None))
+    )
+
+
+# serving executables opt into memory analysis like the dense serving
+# kernels: the per-signature AOT compile lands in warmup, and the
+# temp/output bytes feed the tenant cache's transient accounting
+_sharded_recommend = _devprof.instrument(
+    "fleet.recommend_sharded", _sharded_recommend, memory=True
+)
+_sharded_similar = _devprof.instrument(
+    "fleet.similar_sharded", _sharded_similar, memory=True
+)
+_sharded_fold_in = _devprof.instrument(
+    "fleet.fold_in_sharded", _sharded_fold_in, memory=True
+)
+
+
+# ---------------------------------------------------------------------------
+# the runtime
+# ---------------------------------------------------------------------------
+
+
+class ShardedRuntime:
+    """Row-sharded, device-resident ALS factor state + the sharded
+    serving verbs. Swapped atomically like any other runtime: the query
+    server's runtime-swap lock and the tenant model cache treat it as
+    opaque model state (tenancy/cache.py's device-bytes walk counts
+    only the per-device addressable shard)."""
+
+    def __init__(
+        self,
+        user_factors: np.ndarray,  # (U, K) f32
+        item_factors: np.ndarray,  # (I, K) f32
+        user_vocab: Optional[Any] = None,
+        item_vocab: Optional[Any] = None,
+        params: Optional[Any] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        device_budget_bytes: Optional[float] = None,
+    ):
+        if mesh is None:
+            mesh = serving_mesh()
+        if MODEL_AXIS not in mesh.shape or len(mesh.shape) != 1:
+            raise ValueError(
+                "ShardedRuntime needs a 1-D serving mesh "
+                f"(parallel.mesh.serving_mesh); got axes {dict(mesh.shape)}"
+            )
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape[MODEL_AXIS])
+        uf = np.asarray(user_factors, np.float32)
+        itf = np.asarray(item_factors, np.float32)
+        self.n_users, self.rank = uf.shape
+        self.n_items = itf.shape[0]
+        if device_budget_bytes is not None:
+            per_shard = self._padded_bytes(uf, itf) / self.n_shards
+            if per_shard > device_budget_bytes:
+                raise OversizedModelError(
+                    f"factor state needs {per_shard / 1e9:.2f} GB per "
+                    f"shard over {self.n_shards} shard(s) but the "
+                    f"per-device budget is "
+                    f"{device_budget_bytes / 1e9:.2f} GB"
+                )
+        self.user_vocab = user_vocab
+        self.item_vocab = item_vocab
+        self.params = params
+        self._lock = threading.Lock()
+        # ONE staging each: the sharded arrays stay HBM-resident across
+        # queries, folds, and swaps (CreateServer-style resident state)
+        self._uf = shard_rows(mesh, uf)
+        self._itf = shard_rows(mesh, itf)
+
+    def _padded_bytes(self, uf: np.ndarray, itf: np.ndarray) -> int:
+        u_p = pad_rows_to_shards(uf.shape[0], self.n_shards)
+        i_p = pad_rows_to_shards(itf.shape[0], self.n_shards)
+        return (u_p + i_p) * self.rank * 4
+
+    @classmethod
+    def from_factors(
+        cls,
+        factors: Any,  # models.als.ALSFactors
+        mesh: Optional[jax.sharding.Mesh] = None,
+        device_budget_bytes: Optional[float] = None,
+    ) -> "ShardedRuntime":
+        return cls(
+            factors.user_factors,
+            factors.item_factors,
+            user_vocab=factors.user_vocab,
+            item_vocab=factors.item_vocab,
+            params=factors.params,
+            mesh=mesh,
+            device_budget_bytes=device_budget_bytes,
+        )
+
+    # -- serving -----------------------------------------------------------
+    def recommend(
+        self,
+        user_indices: np.ndarray,
+        k: int,
+        exclude_mask: Optional[np.ndarray] = None,  # (B, n_items) bool
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Global top-k items per user from the sharded state; same
+        contract as models.als.recommend (scores, item_indices)."""
+        k = min(int(k), self.n_items)
+        rows = jnp.asarray(np.asarray(user_indices, np.int32))
+        if exclude_mask is None:
+            vals, idx = _sharded_recommend(
+                rows, self._uf, self._itf, None,
+                k=k, n_items=self.n_items, mesh=self.mesh, masked=False,
+            )
+        else:
+            mask = np.asarray(exclude_mask, bool)
+            i_p = int(self._itf.shape[0])
+            if mask.shape[1] != i_p:  # pad mask cols to the sharded width
+                mask = np.concatenate([
+                    mask,
+                    np.zeros(
+                        (mask.shape[0], i_p - mask.shape[1]), bool
+                    ),
+                ], axis=1)
+            vals, idx = _sharded_recommend(
+                rows, self._uf, self._itf, jnp.asarray(mask),
+                k=k, n_items=self.n_items, mesh=self.mesh, masked=True,
+            )
+        return np.asarray(vals), np.asarray(idx)
+
+    def similar_items(
+        self,
+        item_indices: np.ndarray,
+        k: int,
+        exclude_self: bool = True,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        k = min(int(k), self.n_items)
+        rows = jnp.asarray(np.asarray(item_indices, np.int32))
+        vals, idx = _sharded_similar(
+            rows, self._itf,
+            k=k, n_items=self.n_items, mesh=self.mesh,
+            exclude_self=exclude_self,
+        )
+        return np.asarray(vals), np.asarray(idx)
+
+    def fold_in_rows(
+        self,
+        edges: Sequence[Sequence[tuple[int, float]]],
+        params: Any,  # models.als.ALSParams
+        side: str = "user",
+    ) -> np.ndarray:
+        """Sharded single-side fold-in (the online consumer's solve):
+        per dirty row, solve its system against the FIXED opposite
+        sharded matrix; returns the (R, K) solved factors. Bucketing
+        mirrors models.als.fold_in_rows so streaming ticks reuse a
+        handful of compiled programs."""
+        from predictionio_tpu.models.als import _fold_edge_bucket
+        from predictionio_tpu.utils.bucket import batch_bucket
+
+        if not edges:
+            return np.zeros((0, self.rank), np.float32)
+        fixed = self._itf if side == "user" else self._uf
+        r_real = len(edges)
+        r_pad = batch_bucket(r_real)
+        e_pad = _fold_edge_bucket(max(len(e) for e in edges))
+        idx = np.zeros((r_pad, e_pad), np.int32)
+        val = np.zeros((r_pad, e_pad), np.float32)
+        ok = np.zeros((r_pad, e_pad), np.float32)
+        for r, row in enumerate(edges):
+            for e, (j, v) in enumerate(row):
+                idx[r, e] = j
+                val[r, e] = v
+                ok[r, e] = 1.0
+        solved = _sharded_fold_in(
+            fixed, jnp.asarray(idx), jnp.asarray(val), jnp.asarray(ok),
+            jnp.float32(params.lambda_), jnp.float32(params.alpha),
+            implicit=params.implicit_prefs,
+            cg_iterations=params.cg_iterations,
+            mesh=self.mesh,
+        )
+        return np.asarray(solved)[:r_real]
+
+    # -- state updates -----------------------------------------------------
+    def update_user_rows(
+        self, rows: np.ndarray, values: np.ndarray
+    ) -> None:
+        self._update("_uf", rows, values)
+
+    def update_item_rows(
+        self, rows: np.ndarray, values: np.ndarray
+    ) -> None:
+        self._update("_itf", rows, values)
+
+    def _update(self, attr: str, rows, values) -> None:
+        rows = np.asarray(rows, np.int32)
+        table = getattr(self, attr)
+        if rows.size and int(rows.max()) >= int(table.shape[0]):
+            raise ValueError(
+                "row update beyond the padded shard extent — vocab "
+                "growth needs a rebuild (amortized like the online "
+                "fold-in's factor growth), not an in-place set"
+            )
+        with self._lock:
+            setattr(self, attr, _scatter_rows(
+                getattr(self, attr), jnp.asarray(rows),
+                jnp.asarray(np.asarray(values, np.float32)),
+                mesh=self.mesh,
+            ))
+
+    # -- accounting --------------------------------------------------------
+    def device_bytes(self) -> dict[str, float]:
+        total = float(self._uf.nbytes + self._itf.nbytes)
+        return {
+            "total": total,
+            "per_shard": total / self.n_shards,
+            "shards": float(self.n_shards),
+        }
+
+    def info(self) -> dict[str, Any]:
+        b = self.device_bytes()
+        return {
+            "shards": self.n_shards,
+            "devices": [
+                str(d) for d in self.mesh.devices.reshape(-1)
+            ],
+            "n_users": self.n_users,
+            "n_items": self.n_items,
+            "rank": self.rank,
+            "resident_bytes_total": b["total"],
+            "resident_bytes_per_shard": b["per_shard"],
+        }
+
+    # the tenant cache's device-bytes walk finds these via __dict__:
+    # jax arrays report addressable-shard bytes there, so a cached
+    # sharded runtime is charged one SHARD, not the whole catalog
+    @property
+    def models(self):  # EngineRuntime-walk compatibility
+        return (self._uf, self._itf)

@@ -305,14 +305,20 @@ def _train_jit_dense(
         uf, itf = uf0, itf0
     else:
         ku, ki = jax.random.split(jax.random.PRNGKey(seed))
-        uf = (
-            jax.random.normal(ku, (n_users_p, rank), jnp.float32)
-            / jnp.sqrt(rank)
-        ) * (user_deg >= 0)[:, None]
-        itf = (
-            jax.random.normal(ki, (n_items_p, rank), jnp.float32)
-            / jnp.sqrt(rank)
-        ) * (item_deg >= 0)[:, None]
+        # partitionable threefry: element i's bits depend only on (key,
+        # i), not the array size — so the sharded trains (whose padded
+        # shapes differ with dp/mp) slice IDENTICAL inits from their
+        # larger draws and match this path exactly (newer jax defaults
+        # to this; the pin makes the parity hold on every version)
+        with jax.threefry_partitionable(True):
+            uf = (
+                jax.random.normal(ku, (n_users_p, rank), jnp.float32)
+                / jnp.sqrt(rank)
+            ) * (user_deg >= 0)[:, None]
+            itf = (
+                jax.random.normal(ki, (n_items_p, rank), jnp.float32)
+                / jnp.sqrt(rank)
+            ) * (item_deg >= 0)[:, None]
 
     def body(_, fs):
         uf, itf = fs
@@ -405,12 +411,11 @@ def _train_jit_dense_sharded(
     scale: float = 1.0,
     mesh=None,
 ):
-    """Dense-W alternating loop shard_map'd over the mesh's dp axis.
+    """Dense-W alternating loop shard_map'd over the mesh.
 
-    The rating matrix is ROW-sharded (each device owns a slab of users);
-    factors stay replicated (they are MBs at ALS sizes — mp sharding
-    would buy nothing and cost all-gathers every half-step, so the mp
-    axis is deliberately unused here). Per iteration:
+    With mp == 1 (the PR-7 shape): the rating matrix is ROW-sharded
+    over dp (each device owns a slab of users); factors stay
+    replicated. Per iteration:
 
       user half: each device solves ITS user rows from its local slab —
                  fully local, zero collectives;
@@ -418,6 +423,17 @@ def _train_jit_dense_sharded(
                  factors into partial (n_items, ·) sums; ONE psum over
                  dp combines them and every device solves the (small)
                  item systems redundantly.
+
+    With mp > 1 (ISSUE 10 model-axis sharding, activated by the
+    engine.json `mesh` key): R is 2-D block-sharded (users over dp,
+    items over mp), USER factors are row-sharded over dp and ITEM
+    factors row-sharded over mp — no device ever holds a full factor
+    matrix, so the factor state scales past one chip's HBM. Each
+    half-step's cross-side normal-equation assembly becomes partial
+    per-block sums + ONE all-reduce over the OPPOSITE axis (user half:
+    psum over mp assembles b/Gram from the item shards; item half: psum
+    over dp), then each shard solves only the systems of the rows it
+    owns — the gather/all-reduce shape of MLlib ALS's block shuffle.
 
     This is the TPU-native shape of MLlib ALS's block distribution: the
     ratings never move, only the (tiny) factor matrices ride ICI.
@@ -430,9 +446,16 @@ def _train_jit_dense_sharded(
     multi-chip deployment must re-run the bench's full-scale
     finiteness + windowed-agreement checks before trusting factors."""
     from predictionio_tpu.ops import dense as dense_ops
-    from predictionio_tpu.parallel.mesh import DATA_AXIS
+    from predictionio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
     n_users_p, n_items_p = r.shape
+    if int(mesh.shape.get(MODEL_AXIS, 1)) > 1:
+        return _dense_sharded_2d(
+            r, user_deg, item_deg, uf0, itf0,
+            rank=rank, iterations=iterations, implicit=implicit,
+            lam=lam, alpha=alpha, cg_iterations=cg_iterations,
+            seed=seed, dense_dtype=dense_dtype, scale=scale, mesh=mesh,
+        )
     spec_r = jax.sharding.PartitionSpec(DATA_AXIS, None)
     spec_v = jax.sharding.PartitionSpec(DATA_AXIS)
     rep2 = jax.sharding.PartitionSpec(None, None)
@@ -447,18 +470,21 @@ def _train_jit_dense_sharded(
             ku, ki = jax.random.split(jax.random.PRNGKey(seed))
             # generate the FULL init on every device (replicated
             # compute, deterministic) and slice the local slab so the
-            # sharded run matches the single-device run exactly
-            uf_full = (
-                jax.random.normal(ku, (n_users_p, rank), jnp.float32)
-                / jnp.sqrt(rank)
-            )
+            # sharded run matches the single-device run exactly;
+            # partitionable threefry makes the draw shape-stable, so
+            # the differently-padded single-device init is a prefix
+            with jax.threefry_partitionable(True):
+                uf_full = (
+                    jax.random.normal(ku, (n_users_p, rank), jnp.float32)
+                    / jnp.sqrt(rank)
+                )
+                itf = (
+                    jax.random.normal(ki, (n_items_p, rank), jnp.float32)
+                    / jnp.sqrt(rank)
+                ) * (ideg >= 0)[:, None]
             uf_l = jax.lax.dynamic_slice_in_dim(
                 uf_full, d * n_u_local, n_u_local
             ) * (udeg_l >= 0)[:, None]
-            itf = (
-                jax.random.normal(ki, (n_items_p, rank), jnp.float32)
-                / jnp.sqrt(rank)
-            ) * (ideg >= 0)[:, None]
 
         k = rank
         eye_flat = jnp.eye(k, dtype=jnp.float32).reshape(1, k * k)
@@ -506,12 +532,149 @@ def _train_jit_dense_sharded(
         fn = local_train
         args = (r, user_deg, item_deg, uf0, itf0)
         in_specs = (spec_r, spec_v, rep1, spec_r, rep2)
-    return jax.shard_map(
+    from predictionio_tpu.parallel.mesh import shard_map as _shard_map
+
+    return _shard_map(
         fn,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=(spec_r, rep2),
-        check_vma=False,
+        check=False,
+    )(*args)
+
+
+def _dense_sharded_2d(
+    r: jax.Array,  # (n_users_p, n_items_p) — block-sharded (dp, mp)
+    user_deg: jax.Array,  # (n_users_p,) — sharded over dp
+    item_deg: jax.Array,  # (n_items_p,) — sharded over mp
+    uf0,  # (n_users_p, rank) sharded over dp / None
+    itf0,  # (n_items_p, rank) sharded over mp / None
+    *,
+    rank: int,
+    iterations: int,
+    implicit: bool,
+    lam: float,
+    alpha: float,
+    cg_iterations: int,
+    seed: int,
+    dense_dtype: str,
+    scale: float,
+    mesh,
+):
+    """The mp > 1 body of `_train_jit_dense_sharded` (ISSUE 10): R is
+    2-D block-sharded, user factors live row-sharded over dp and item
+    factors row-sharded over mp. Each half-step runs the SAME
+    dense_row/col_pass kernels on the local block; the cross-side
+    normal-equation assembly is one psum over the opposite axis (plus
+    one for the implicit-mode global Gram), then each shard solves only
+    its own rows' K×K systems. Inits are generated replicated from the
+    same PRNG stream as the single-device path and sliced, so mp-
+    sharded factors match the unsharded solve to f32 reduction-order
+    tolerance."""
+    from predictionio_tpu.ops import dense as dense_ops
+    from predictionio_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+        shard_map as _shard_map,
+    )
+
+    n_users_p, n_items_p = r.shape
+    spec_r = jax.sharding.PartitionSpec(DATA_AXIS, MODEL_AXIS)
+    spec_u1 = jax.sharding.PartitionSpec(DATA_AXIS)
+    spec_i1 = jax.sharding.PartitionSpec(MODEL_AXIS)
+    spec_u2 = jax.sharding.PartitionSpec(DATA_AXIS, None)
+    spec_i2 = jax.sharding.PartitionSpec(MODEL_AXIS, None)
+
+    def local_train(r_l, udeg_l, ideg_l, uf0_l, itf0_l):
+        n_u_local, n_i_local = r_l.shape
+        d = jax.lax.axis_index(DATA_AXIS)
+        m = jax.lax.axis_index(MODEL_AXIS)
+        if uf0_l is not None and itf0_l is not None:
+            uf_l, itf_l = uf0_l, itf0_l
+        else:
+            ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+            # full init generated on every device (replicated compute,
+            # deterministic), sliced to the local slab — identical
+            # numbers to the single-device init (partitionable threefry
+            # makes the draw a shape-stable prefix, see _train_jit_dense)
+            with jax.threefry_partitionable(True):
+                uf_full = (
+                    jax.random.normal(ku, (n_users_p, rank), jnp.float32)
+                    / jnp.sqrt(rank)
+                )
+                itf_full = (
+                    jax.random.normal(ki, (n_items_p, rank), jnp.float32)
+                    / jnp.sqrt(rank)
+                )
+            uf_l = jax.lax.dynamic_slice_in_dim(
+                uf_full, d * n_u_local, n_u_local
+            ) * (udeg_l >= 0)[:, None]
+            itf_l = jax.lax.dynamic_slice_in_dim(
+                itf_full, m * n_i_local, n_i_local
+            ) * (ideg_l >= 0)[:, None]
+
+        k = rank
+        eye = jnp.eye(k, dtype=jnp.float32)
+        eye_flat = eye.reshape(1, k * k)
+
+        def body(_, fs):
+            uf_l, itf_l = fs
+            # user half: partial sums over MY item columns; psum over
+            # mp assembles each user row's full b and Gram correction
+            b, corr_flat = dense_ops.dense_row_pass(
+                r_l, itf_l, implicit=implicit, alpha=alpha,
+                dense_dtype=dense_dtype, scale=scale,
+            )
+            b = jax.lax.psum(b, MODEL_AXIS)
+            corr_flat = jax.lax.psum(corr_flat, MODEL_AXIS)
+            if implicit:
+                gram = jax.lax.psum(f32_gram(itf_l), MODEL_AXIS)
+                a_flat = corr_flat + (gram + lam * eye).reshape(1, k * k)
+            else:
+                reg = lam * jnp.maximum(udeg_l, 1.0)
+                a_flat = corr_flat + reg[:, None] * eye_flat
+            uf_l = batched_cg(
+                lambda v: flat_gram_matvec(a_flat, v), b, uf_l,
+                cg_iterations,
+            )
+            # item half: partial sums over MY user rows; psum over dp
+            b, corr_flat = dense_ops.dense_col_pass(
+                r_l, uf_l, implicit=implicit, alpha=alpha,
+                dense_dtype=dense_dtype, scale=scale,
+            )
+            b = jax.lax.psum(b, DATA_AXIS)
+            corr_flat = jax.lax.psum(corr_flat, DATA_AXIS)
+            if implicit:
+                gram = jax.lax.psum(f32_gram(uf_l), DATA_AXIS)
+                a_flat = corr_flat + (gram + lam * eye).reshape(1, k * k)
+            else:
+                reg = lam * jnp.maximum(ideg_l, 1.0)
+                a_flat = corr_flat + reg[:, None] * eye_flat
+            itf_l = batched_cg(
+                lambda v: flat_gram_matvec(a_flat, v), b, itf_l,
+                cg_iterations,
+            )
+            return uf_l, itf_l
+
+        return jax.lax.fori_loop(0, iterations, body, (uf_l, itf_l))
+
+    # shard_map cannot spec None leaves — close over absent inits
+    if uf0 is None or itf0 is None:
+        fn = lambda r_l, udeg_l, ideg_l: local_train(
+            r_l, udeg_l, ideg_l, None, None
+        )
+        args = (r, user_deg, item_deg)
+        in_specs = (spec_r, spec_u1, spec_i1)
+    else:
+        fn = local_train
+        args = (r, user_deg, item_deg, uf0, itf0)
+        in_specs = (spec_r, spec_u1, spec_i1, spec_u2, spec_i2)
+    return _shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(spec_u2, spec_i2),
+        check=False,
     )(*args)
 
 
@@ -557,10 +720,12 @@ class StagedDenseTrain:
 
 
 def dense_matrix_bytes(
-    n_users: int, n_items: int, dense_dtype: str = "bf16", dp: int = 1
+    n_users: int, n_items: int, dense_dtype: str = "bf16", dp: int = 1,
+    mp: int = 1,
 ) -> int:
     """Padded dense-R footprint — the auto-dispatch gate's input.
-    `dp` > 1 pads rows to whole per-device slabs (stage_dense does)."""
+    `dp` > 1 pads rows (and `mp` > 1 columns) to whole per-device slabs
+    (stage_dense does)."""
     from predictionio_tpu.ops.dense import (
         BYTES_PER_CELL,
         COL_PAD,
@@ -568,7 +733,7 @@ def dense_matrix_bytes(
     )
 
     n_u_p = -(-n_users // (ROW_BLOCK * dp)) * (ROW_BLOCK * dp)
-    n_i_p = -(-n_items // COL_PAD) * COL_PAD
+    n_i_p = -(-n_items // (COL_PAD * mp)) * (COL_PAD * mp)
     return n_u_p * n_i_p * BYTES_PER_CELL.get(dense_dtype, 2)
 
 
@@ -612,12 +777,15 @@ def dense_eligible(
 
         if int8_scale(vals) is not None:
             dense_dtype = "int8"
-    dp = 1
+    dp = mp = 1
     if mesh is not None and getattr(mesh, "devices", None) is not None:
-        from predictionio_tpu.parallel.mesh import DATA_AXIS
+        from predictionio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
         dp = int(mesh.shape.get(DATA_AXIS, 1))
-    if dense_matrix_bytes(n_users, n_items, dense_dtype, dp=dp) > budget:
+        mp = int(mesh.shape.get(MODEL_AXIS, 1))
+    if dense_matrix_bytes(
+        n_users, n_items, dense_dtype, dp=dp, mp=mp
+    ) > budget:
         return False
     if not params.implicit_prefs and np.any(vals == 0.0):
         return False
@@ -676,15 +844,17 @@ def stage_dense(
             )
         else:
             dense_dtype = "bf16"
-    dp = 1
+    dp = mp = 1
     if mesh is not None and mesh.devices.size > 1:
-        from predictionio_tpu.parallel.mesh import DATA_AXIS
+        from predictionio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
         dp = int(mesh.shape.get(DATA_AXIS, 1))
+        mp = int(mesh.shape.get(MODEL_AXIS, 1))
     # user rows pad to a slab multiple so every dp device scans whole
-    # row blocks of its own slab
+    # row blocks of its own slab; with mp > 1 (ISSUE 10) item columns
+    # pad likewise so every mp device owns whole COL_PAD column blocks
     n_u_p = -(-n_users // (ROW_BLOCK * dp)) * (ROW_BLOCK * dp)
-    n_i_p = -(-n_items // COL_PAD) * COL_PAD
+    n_i_p = -(-n_items // (COL_PAD * mp)) * (COL_PAD * mp)
     if user_deg is None:
         user_deg = np.zeros(n_users, np.float32)
         np.add.at(user_deg, rows, 1.0)
@@ -722,17 +892,26 @@ def stage_dense(
     if mesh is not None and mesh.devices.size > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from predictionio_tpu.parallel.mesh import DATA_AXIS
+        from predictionio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
         row_sh = NamedSharding(mesh, P(DATA_AXIS, None))
         vec_sh = NamedSharding(mesh, P(DATA_AXIS))
         rep = NamedSharding(mesh, P())
+        if mp > 1:
+            # model-axis sharding (ISSUE 10): R 2-D block-sharded, item
+            # degree/factors row-sharded over mp — no device holds a
+            # full factor matrix
+            r_sh = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+            ideg_sh = NamedSharding(mesh, P(MODEL_AXIS))
+            itf_sh = NamedSharding(mesh, P(MODEL_AXIS, None))
+        else:
+            r_sh, ideg_sh, itf_sh = row_sh, rep, rep
         device_args = (
-            jax.device_put(r, row_sh),
+            jax.device_put(r, r_sh),
             jax.device_put(pad_deg(user_deg, n_u_p), vec_sh),
-            jax.device_put(pad_deg(item_deg, n_i_p), rep),
+            jax.device_put(pad_deg(item_deg, n_i_p), ideg_sh),
             jax.device_put(uf0, row_sh) if uf0 is not None else None,
-            jax.device_put(itf0, rep) if itf0 is not None else None,
+            jax.device_put(itf0, itf_sh) if itf0 is not None else None,
         )
     else:
         device_args = (
@@ -956,14 +1135,18 @@ def _train_jit_windowed(
         uf, itf = shard_factors(uf0), shard_factors(itf0)
     else:
         ku, ki = jax.random.split(jax.random.PRNGKey(seed))
-        uf = (
-            jax.random.normal(ku, (n_users_p, rank), jnp.float32)
-            / jnp.sqrt(rank)
-        )
-        itf = (
-            jax.random.normal(ki, (n_items_p, rank), jnp.float32)
-            / jnp.sqrt(rank)
-        )
+        # partitionable threefry across ALL train paths: draws are
+        # shape-stable per element, so differently-padded paths (dense
+        # vs windowed vs sharded slabs) agree on the real rows
+        with jax.threefry_partitionable(True):
+            uf = (
+                jax.random.normal(ku, (n_users_p, rank), jnp.float32)
+                / jnp.sqrt(rank)
+            )
+            itf = (
+                jax.random.normal(ki, (n_items_p, rank), jnp.float32)
+                / jnp.sqrt(rank)
+            )
         # zero the window-padding rows so they stay exactly zero under CG
         uf = shard_factors(uf * (user_deg >= 0)[:, None])
         itf = shard_factors(itf * (item_deg >= 0)[:, None])
@@ -1205,14 +1388,15 @@ def _train_jit(
         ku, ki = jax.random.split(jax.random.PRNGKey(seed))
         # signed gaussian init scaled by 1/sqrt(rank); an all-positive init
         # (as some ALS impls use) starts near rank-1 and converges far slower
-        uf = shard_factors(
-            jax.random.normal(ku, (n_users, rank), jnp.float32)
-            / jnp.sqrt(rank)
-        )
-        itf = shard_factors(
-            jax.random.normal(ki, (n_items, rank), jnp.float32)
-            / jnp.sqrt(rank)
-        )
+        with jax.threefry_partitionable(True):
+            uf = shard_factors(
+                jax.random.normal(ku, (n_users, rank), jnp.float32)
+                / jnp.sqrt(rank)
+            )
+            itf = shard_factors(
+                jax.random.normal(ki, (n_items, rank), jnp.float32)
+                / jnp.sqrt(rank)
+            )
 
     if implicit:
         # MLlib trainImplicit semantics (Hu-Koren-Volinsky with signed

@@ -184,6 +184,13 @@ class _SigAnalysis:
     flops_body: float = 0.0
     bytes_body: float = 0.0
     iterations: float = 1.0
+    # per-shard attribution (ISSUE 10): the device count this
+    # signature's arguments span (sharded fleet programs > 1). For the
+    # shard_map programs this tree shards with, XLA lowers — and cost/
+    # memory-analyzes — the PER-DEVICE module, so flops/bytes here are
+    # already one shard's share; `devices` is the context a reader
+    # needs to reconstruct the global program (flops × devices).
+    devices: float = 1.0
 
 
 @dataclass
@@ -234,6 +241,33 @@ def _signature(args: tuple, kwargs: dict) -> tuple:
         return _leaf_sig(x)
 
     return (walk(args), walk(kwargs))
+
+
+def _arg_device_span(args: tuple, kwargs: dict) -> float:
+    """Max device count any argument's sharding spans (1 for host
+    arrays and single-device jax arrays) — the divisor for per-shard
+    FLOPs/HBM attribution of sharded executables (ISSUE 10)."""
+    n = 1
+
+    def walk(x: Any) -> None:
+        nonlocal n
+        sh = getattr(x, "sharding", None)
+        if sh is not None:
+            try:
+                n = max(n, len(sh.device_set))
+                return
+            except Exception:
+                pass
+        if isinstance(x, dict):
+            for v in x.values():
+                walk(v)
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+
+    walk(args)
+    walk(kwargs)
+    return float(n)
 
 
 def _arg_nbytes(args: tuple, kwargs: dict) -> float:
@@ -401,6 +435,10 @@ class DeviceProfiler:
             arg_bytes=_arg_nbytes(args, kwargs),
             output_bytes=_arg_nbytes((out,), {}),
         )
+        try:
+            res.devices = _arg_device_span(args, kwargs)
+        except Exception:
+            pass
         lower = getattr(fn, "lower", None)
         if lower is None:
             return res
@@ -557,6 +595,22 @@ class DeviceProfiler:
             "cost_analysis_ok": any(s.cost_ok for s in sigs),
             "memory_analysis_ok": any(s.memory_ok for s in sigs),
         }
+        if latest.devices > 1:
+            # per-shard attribution (ISSUE 10). Measured semantics (see
+            # tests/test_devprof_shards.py): shard_map programs — every
+            # sharded executable in this tree — LOWER THE PER-DEVICE
+            # module, so cost_analysis flops/bytes (and the mfu derived
+            # from them against one chip's peak) are ALREADY per-shard;
+            # dividing again would under-report by devices×. Likewise
+            # memory_analysis sizes are per-device with replicated
+            # operands counted in full — exactly the one-chip resident
+            # picture — so they pass through undivided too.
+            out["devices"] = latest.devices
+            if latest.memory_ok:
+                out["hbm_bytes_per_shard"] = (
+                    latest.arg_bytes + latest.output_bytes
+                    + latest.temp_bytes
+                )
         if rec.scale_by is not None:
             # kept for comparison with the calibrated numbers (ISSUE 8
             # satellite): `flops_per_call_kwarg_scaled` is what the

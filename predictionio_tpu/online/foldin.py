@@ -383,7 +383,17 @@ class ALSFoldIn:
         """New model object around the folded factors. Each UNCHANGED
         side's staged device cache carries over, so a user-only tick
         re-transfers only the user factor matrix and an item-only drain
-        pass (apply_pending) only the item matrix."""
+        pass (apply_pending) only the item matrix.
+
+        Fleet note (ISSUE 10): a staged `_sharded_runtime` deliberately
+        does NOT carry over — both factor sides live in one sharded
+        state object, and publishing into it incrementally needs the
+        tick's dirty-row indices plumbed through here
+        (`ShardedRuntime.update_user_rows/update_item_rows` exist for
+        exactly that; ROADMAP fleet follow-up). Until then a sharded
+        tenant re-stages lazily on the next query — a per-tick transfer
+        plus the same transient 2× the dense copy-on-write publish
+        pays, so size per-shard HBM budgets accordingly."""
         cls = type(model)
         cats = getattr(model, "item_categories", None)
         if cats is not None and len(cats) < new_factors.item_factors.shape[0]:

@@ -154,7 +154,11 @@ def fused_row_pass(
             jax.ShapeDtypeStruct((n_rows, k), jnp.float32),
             jax.ShapeDtypeStruct((n_rows, k * k), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        # jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5
+        compiler_params=getattr(
+            pltpu, "CompilerParams",
+            getattr(pltpu, "TPUCompilerParams", None),
+        )(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -201,7 +205,11 @@ def fused_col_pass(
             jax.ShapeDtypeStruct((n_cols, k), jnp.float32),
             jax.ShapeDtypeStruct((n_cols, k * k), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        # jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5
+        compiler_params=getattr(
+            pltpu, "CompilerParams",
+            getattr(pltpu, "TPUCompilerParams", None),
+        )(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

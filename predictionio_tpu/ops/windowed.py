@@ -278,7 +278,9 @@ def windowed_gram_b(
                 jax.lax.psum(g_l, DATA_AXIS),
             )
 
-        return jax.shard_map(
+        from predictionio_tpu.parallel.mesh import shard_map as _shard_map
+
+        return _shard_map(
             local_pass,
             mesh=mesh,
             in_specs=(
@@ -293,7 +295,7 @@ def windowed_gram_b(
             # pallas_call cannot annotate varying-mesh-axes on its
             # out_shapes; replication is established manually by the
             # psums above, so disable the checker rather than the kernel
-            check_vma=False,
+            check=False,
         )(factors, src, w_b, w_g, local, block_window)
     if p > 1:
         pallas = None  # no mesh handle → XLA path (GSPMD shards it)

@@ -94,6 +94,42 @@ def run_dryrun(n_devices: int) -> None:
         assert np.all(np.isfinite(factors.user_factors))
         assert np.all(np.isfinite(factors.item_factors))
 
+        # --- Fleet (ISSUE 10): model-axis sharded dense train — R 2-D
+        # block-sharded over (dp, mp), item factors row-sharded over mp;
+        # must agree with the single-device dense solve (the contract
+        # tests/test_fleet_sharded.py enforces at full tolerance)
+        if n_devices >= 2:
+            from predictionio_tpu.parallel.mesh import MeshConf
+
+            p2 = als.ALSParams(rank=8, iterations=1, cg_iterations=2)
+            ref = als.stage_dense(
+                d_rows, d_cols, d_vals, n_users, n_items, p2,
+                dense_dtype="f32",
+            )
+            uf_ref, itf_ref = ref.factors(*ref.run())
+            # odd counts (say 5) round down to the largest dp×2 grid
+            mesh2 = MeshConf(
+                dp=n_devices // 2, mp=2, devices=2 * (n_devices // 2)
+            ).build()
+            st = als.stage_dense(
+                d_rows, d_cols, d_vals, n_users, n_items, p2,
+                dense_dtype="f32", mesh=mesh2,
+            )
+            uf2, itf2 = st.factors(*st.run())
+            np.testing.assert_allclose(uf2, uf_ref, rtol=1e-3, atol=1e-4)
+            np.testing.assert_allclose(itf2, itf_ref, rtol=1e-3, atol=1e-4)
+
+            # --- Fleet: sharded serving — row-sharded factor state,
+            # local top-k per shard + global merge == dense top-k
+            from predictionio_tpu.fleet import ShardedRuntime
+
+            srt = ShardedRuntime.from_factors(factors)
+            q = np.arange(min(4, n_users))
+            v_d, i_d = als.recommend(factors, q, 5)
+            v_s, i_s = srt.recommend(q, 5)
+            np.testing.assert_allclose(v_s, v_d, rtol=1e-4, atol=1e-5)
+            assert (i_s == i_d).all()
+
         # --- CCO: user-sharded co-occurrence + LLR top-n ---
         n_u, n_i, n_j = 40, 16, 12
         primary = (rng.rand(n_u, n_i) < 0.2).astype(np.float32)

@@ -27,6 +27,25 @@ DATA_AXIS = "dp"
 MODEL_AXIS = "mp"
 
 
+def shard_map(fn, mesh, in_specs, out_specs, check: bool = False):
+    """Version-portable `shard_map`: newer jax exposes `jax.shard_map`
+    (replication check kwarg `check_vma`), 0.4.x only
+    `jax.experimental.shard_map` (`check_rep`). Every sharded program
+    in the tree builds through this shim so a jax upgrade is one-line."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as sm_exp
+
+    return sm_exp(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check,
+    )
+
+
 @dataclass(frozen=True)
 class MeshConf:
     """Mesh wiring parsed from an engine variant's `mesh` JSON object.
@@ -101,6 +120,51 @@ def factor_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def serving_mesh(
+    n_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 1-D model-axis mesh for the sharded serving tier (ISSUE 10):
+    every device is one factor shard, so a catalog's row-sharded factor
+    matrices spread over ALL visible HBM. Train meshes are 2-D (dp×mp)
+    because edges and factors shard differently; serving has only
+    factor state, so one axis is the whole story."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = n_shards or len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"serving mesh requests {n} shards but only {len(devs)} "
+            "devices visible"
+        )
+    return Mesh(np.array(devs[:n]), (MODEL_AXIS,))
+
+
+def pad_rows_to_shards(n_rows: int, n_shards: int) -> int:
+    """Row count padded so every shard owns an equal whole slab."""
+    return -(-max(n_rows, 1) // n_shards) * n_shards
+
+
+def shard_rows(mesh: Mesh, array: np.ndarray, axis_name: str = MODEL_AXIS):
+    """Zero-pad axis 0 to a whole-slab multiple of the axis size and
+    row-shard it over `axis_name` (remaining axes replicated). Callers
+    must keep pad rows inert (zero factors score 0 and are masked out
+    of top-k by the global-index pad mask).
+
+    The HOST array goes straight into the sharded device_put: routing
+    through jnp.asarray first would materialize the whole matrix on the
+    default device before resharding — an instant OOM for exactly the
+    over-one-HBM catalogs the sharded tier exists to hold."""
+    n = int(mesh.shape[axis_name])
+    n_p = pad_rows_to_shards(array.shape[0], n)
+    if n_p != array.shape[0]:
+        array = np.concatenate([
+            array,
+            np.zeros((n_p - array.shape[0],) + array.shape[1:], array.dtype),
+        ])
+    spec = P(axis_name, *([None] * (array.ndim - 1)))
+    return jax.device_put(np.ascontiguousarray(array), NamedSharding(mesh, spec))
 
 
 def pad_and_shard_rows(mesh: Mesh, *arrays: np.ndarray):

@@ -49,6 +49,13 @@ class Tenant:
     qps: Optional[float] = None
     max_concurrency: Optional[int] = None
     device_seconds_per_s: Optional[float] = None
+    # X-PIO-Deadline floor (ISSUE 10 satellite): the tenant-level cap on
+    # how long one of its requests may live in the serving pipeline —
+    # enforced at admit time, so a request with NO deadline (or a longer
+    # one) is clamped to this budget and this tenant's slow clients
+    # cannot hold dispatcher leases/queue slots indefinitely. None/0 =
+    # no floor (requests keep whatever deadline they carried).
+    deadline_floor_ms: Optional[float] = None
     enabled: bool = True
     description: str = ""
     created_at: str = ""
@@ -67,7 +74,7 @@ class Tenant:
         self.weight = float(self.weight)
         if self.weight <= 0:
             raise ValueError(f"tenant weight must be > 0, got {self.weight}")
-        for name in ("qps", "device_seconds_per_s"):
+        for name in ("qps", "device_seconds_per_s", "deadline_floor_ms"):
             v = getattr(self, name)
             if v is not None:
                 v = float(v)
@@ -90,6 +97,7 @@ class Tenant:
             "qps": self.qps,
             "max_concurrency": self.max_concurrency,
             "device_seconds_per_s": self.device_seconds_per_s,
+            "deadline_floor_ms": self.deadline_floor_ms,
             "enabled": self.enabled,
             "description": self.description,
             "created_at": self.created_at,
@@ -107,6 +115,7 @@ class Tenant:
             qps=d.get("qps"),
             max_concurrency=d.get("max_concurrency"),
             device_seconds_per_s=d.get("device_seconds_per_s"),
+            deadline_floor_ms=d.get("deadline_floor_ms"),
             enabled=bool(d.get("enabled", True)),
             description=d.get("description") or "",
             created_at=d.get("created_at") or "",
@@ -114,7 +123,10 @@ class Tenant:
         )
 
 
-QUOTA_FIELDS = ("weight", "qps", "max_concurrency", "device_seconds_per_s")
+QUOTA_FIELDS = (
+    "weight", "qps", "max_concurrency", "device_seconds_per_s",
+    "deadline_floor_ms",
+)
 
 
 class TenantStore:

@@ -1066,6 +1066,61 @@ def cmd_jobs(args) -> int:
         return 0
 
 
+def cmd_fleet(args) -> int:
+    """`pio fleet status|worker` — the multi-worker training fleet
+    (ISSUE 10). `status` lists live/stale workers and the shared queue;
+    `worker` runs a FleetMember: a CAS-claiming TrainScheduler with a
+    heartbeating worker record, optionally joined to a multi-host
+    jax.distributed collective via --coordinator/--num-processes."""
+    from predictionio_tpu.fleet import (
+        DistributedConfig,
+        FleetConfig,
+        FleetMember,
+        fleet_status,
+    )
+
+    storage = _storage()
+    if args.fleet_action == "status":
+        import json as _json
+
+        print(_json.dumps(fleet_status(storage), indent=2))
+        return 0
+    # worker
+    from predictionio_tpu.deploy.scheduler import SchedulerConfig
+
+    sched_cfg = SchedulerConfig()
+    if args.log_dir:
+        sched_cfg.log_dir = args.log_dir
+    if args.max_concurrent:
+        sched_cfg.max_concurrent = args.max_concurrent
+    try:
+        dist = DistributedConfig(
+            coordinator_address=args.coordinator or None,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    except ValueError as e:
+        return _fail(str(e))
+    member = FleetMember(
+        storage, scheduler_config=sched_cfg,
+        fleet_config=FleetConfig(distributed=dist),
+    )
+    member.start()
+    print(f"[INFO] fleet worker {member.worker_id} running"
+          + (f" (process {dist.process_id}/{dist.num_processes} via "
+             f"{dist.coordinator_address})" if dist.multi_host else "")
+          + " (Ctrl-C to stop)")
+    try:
+        while True:
+            import time as _time
+
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        print("[INFO] stopping fleet worker (in-flight train finishes)")
+        member.stop()
+        return 0
+
+
 def cmd_models(args) -> int:
     """`pio models list|show|promote|rollback|gc` — the version registry."""
     from predictionio_tpu.deploy.registry import ModelRegistry
@@ -1715,6 +1770,27 @@ def build_parser() -> argparse.ArgumentParser:
     jw.add_argument("--once", action="store_true",
                     help="drain currently-queued jobs, then exit")
     jw.set_defaults(func=cmd_jobs)
+
+    s = sub.add_parser(
+        "fleet", help="multi-worker training fleet"
+    )
+    fsub = s.add_subparsers(dest="fleet_action", required=True)
+    fs = fsub.add_parser("status", help="live workers + queue depth")
+    fs.set_defaults(func=cmd_fleet)
+    fw = fsub.add_parser(
+        "worker", help="run a fleet worker (CAS-claiming scheduler)"
+    )
+    fw.add_argument("--log-dir", default=None,
+                    help="per-job log directory")
+    fw.add_argument("--max-concurrent", type=int, default=None,
+                    help="train subprocesses in flight at once")
+    fw.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (multi-host trains)")
+    fw.add_argument("--num-processes", type=int, default=1,
+                    help="fleet process count (1 = single-host)")
+    fw.add_argument("--process-id", type=int, default=0,
+                    help="this worker's process id")
+    fw.set_defaults(func=cmd_fleet)
 
     s = sub.add_parser(
         "models", help="model version registry"

@@ -182,6 +182,8 @@ class _Handler(JsonHandler):
                 self._respond(200, self.server.owner.rollout_status())
             elif path == "/online/status":
                 self._respond(200, self.server.owner.online_status())
+            elif path == "/fleet/status":
+                self._respond(200, self.server.owner.fleet_serving_status())
             elif path == "/tenants" or path.startswith("/tenants/"):
                 self._tenants_get(path)
             elif path == "/metrics":
@@ -393,6 +395,7 @@ class _Handler(JsonHandler):
         mux = owner.tenancy
         tenant = None
         lease = None
+        dl_token = None  # tenant deadline-floor clamp (reset in finally)
         if tenant_id is not None:
             from predictionio_tpu.tenancy import (
                 QuotaExceeded,
@@ -424,6 +427,18 @@ class _Handler(JsonHandler):
                     },
                 )
                 return
+            # per-tenant X-PIO-Deadline floor (ISSUE 10 satellite):
+            # clamp the ambient deadline AT ADMIT — a request with no
+            # deadline (or a longer one) gets the tenant's budget, so
+            # this tenant's slow clients can't hold dispatcher leases
+            # past it (the dispatcher submit + shed paths below read
+            # the ambient deadline)
+            floor_ms = getattr(tenant, "deadline_floor_ms", None)
+            if floor_ms:
+                cap = time.monotonic() + floor_ms / 1000.0
+                cur = _deadline.current()
+                if cur is None or cur > cap:
+                    dl_token = _deadline.set_deadline(cap)
         variant: Optional[str] = None  # set once routing lands
         variant_booked = False
 
@@ -562,6 +577,8 @@ class _Handler(JsonHandler):
                 _book(time.perf_counter() - t0, error=True)
             self._respond(500, {"message": str(e)})
         finally:
+            if dl_token is not None:
+                _deadline.reset(dl_token)
             if tenant is not None:
                 # release the cache lease (the runtime becomes evictable
                 # again) and the tenant's concurrency slot
@@ -1288,6 +1305,33 @@ class QueryServer(ServerProcess):
         if self.online is None:
             return {"state": "detached"}
         return dict(self.online.status(), state="attached")
+
+    # -- sharded serving status (ISSUE 10) ---------------------------------
+    def fleet_serving_status(self) -> dict:
+        """GET /fleet/status: the sharded-serving layout of every served
+        runtime (live + candidate). Snapshotted under the runtime-swap
+        lock so a /reload or rollout promote mid-read can't tear the
+        variant→model mapping."""
+        with self._swap_lock:
+            variants = {"live": self.runtime}
+            if self.candidate is not None:
+                variants["candidate"] = self.candidate
+            out: dict = {"variants": {}}
+            for name, rt in variants.items():
+                models = []
+                for model in getattr(rt, "models", ()) or ():
+                    info_fn = getattr(model, "sharded_info", None)
+                    info = info_fn() if callable(info_fn) else None
+                    models.append(
+                        {"sharded": info is not None, **(info or {})}
+                    )
+                out["variants"][name] = {"models": models}
+            out["sharded"] = any(
+                m["sharded"]
+                for v in out["variants"].values()
+                for m in v["models"]
+            )
+            return out
 
     # -- multi-tenant serving (ISSUE 6) ------------------------------------
     def attach_tenancy(self, mux) -> None:

@@ -1,0 +1,403 @@
+"""Fleet coordination (ISSUE 10): CAS job claims, fenced steal, worker
+records. No jax — this is pure control-plane code over the record
+store."""
+
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.data.storage.registry import (
+    SourceConfig,
+    Storage,
+    StorageConfig,
+)
+from predictionio_tpu.deploy.scheduler import (
+    JobQueue,
+    SchedulerConfig,
+    TrainScheduler,
+)
+from predictionio_tpu.fleet import (
+    DistributedConfig,
+    FleetConfig,
+    FleetMember,
+    WorkerInfo,
+    WorkerRegistry,
+    fleet_status,
+)
+
+
+@pytest.fixture()
+def storage():
+    return Storage(StorageConfig(
+        sources={"M": SourceConfig("M", "memory", {})},
+        repositories={
+            "METADATA": "M", "EVENTDATA": "M", "MODELDATA": "M",
+        },
+    ))
+
+
+VARIANT = {"id": "eng", "engineFactory": "tests.sample_engine.factory"}
+
+
+class TestCasClaims:
+    def test_single_claim_wins_and_writes_generation(self, storage):
+        queue = JobQueue(storage)
+        job = queue.submit(VARIANT)
+        token = queue.claim(job, "w1")
+        assert token is not None
+        # the winner still owes the post-transition write
+        queue.update(
+            job.id, status="running", worker_id="w1",
+            generation=1, claim_token=token, heartbeat_at=time.time(),
+        )
+        cur = queue.get(job.id)
+        assert cur.generation == 1 and cur.claim_token == token
+        assert queue.is_owner(cur)
+
+    def test_two_concurrent_claims_one_winner(self, storage):
+        """The CAS regression shape: two workers bid the same
+        generation simultaneously; exactly one wins, and both agree
+        who (claim_winner is deterministic over the bid record)."""
+        queue_a, queue_b = JobQueue(storage), JobQueue(storage)
+        job = queue_a.submit(VARIANT)
+        barrier = threading.Barrier(2)
+        results = {}
+
+        def claim(name, q):
+            snapshot = q.get(job.id)
+            barrier.wait()
+            results[name] = q.claim(snapshot, name, settle_s=0.15)
+
+        threads = [
+            threading.Thread(target=claim, args=("a", queue_a)),
+            threading.Thread(target=claim, args=("b", queue_b)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wins = [n for n, tok in results.items() if tok is not None]
+        assert len(wins) == 1, results
+        assert queue_a.claim_winner(job.id, 1) == results[wins[0]]
+        assert queue_b.claim_winner(job.id, 1) == results[wins[0]]
+
+    def test_stale_bid_generation_never_rewins(self, storage):
+        """A requeued job bumps generation, so the NEXT claim can't
+        collide with the previous round's resolved bids."""
+        queue = JobQueue(storage)
+        job = queue.submit(VARIANT)
+        t1 = queue.claim(job, "w1")
+        assert t1 is not None
+        queue.update(
+            job.id, status="running", generation=1, claim_token=t1,
+        )
+        # owner requeues (infra backoff shape): generation bumps to 2
+        queue.update(
+            job.id, status="queued", generation=2, claim_token=None,
+        )
+        job2 = queue.get(job.id)
+        t2 = queue.claim(job2, "w2")
+        assert t2 is not None and t2 != t1
+        assert queue.claim_winner(job.id, 3) == t2
+
+    def test_claim_on_stale_snapshot_loses(self, storage):
+        queue = JobQueue(storage)
+        job = queue.submit(VARIANT)
+        stale = queue.get(job.id)  # generation 0 snapshot
+        t1 = queue.claim(stale, "w1")
+        assert t1 is not None
+        queue.update(
+            job.id, status="running", generation=1, claim_token=t1,
+        )
+        # a second worker claiming from the SAME stale snapshot bids
+        # generation 1 again — already resolved to w1, so it loses
+        assert queue.claim(stale, "w2") is None
+
+    def test_fenced_heartbeat_detects_steal(self, storage):
+        queue = JobQueue(storage)
+        job = queue.submit(VARIANT)
+        t1 = queue.claim(job, "w1")
+        queue.update(
+            job.id, status="running", generation=1, claim_token=t1,
+            heartbeat_at=time.time(),
+        )
+        eid, owned = queue.heartbeat_fenced(job.id, None, t1)
+        assert owned and eid
+        # steal: another scheduler re-queues the orphan (generation 2)
+        job_now = queue.get(job.id)
+        t2 = queue.claim(job_now, "w2", intent="steal")
+        assert t2 is not None
+        queue.update(
+            job.id, status="queued", generation=2, claim_token=None,
+        )
+        _, owned = queue.heartbeat_fenced(job.id, eid, t1)
+        assert not owned  # the wedged owner must kill its child
+
+    def test_purge_drops_claim_records(self, storage):
+        queue = JobQueue(storage)
+        job = queue.submit(VARIANT)
+        queue.claim(job, "w1")
+        assert queue.purge(job.id) >= 2  # job events + claim bid
+        assert queue.get(job.id) is None
+        assert queue.claim_winner(job.id, 1) is None
+
+
+class TestSchedulerRace:
+    def _scheduler(self, storage, ran, name):
+        cfg = SchedulerConfig(claim_settle_s=0.15, poll_interval_s=0.05)
+        s = TrainScheduler(storage, cfg)
+        s.worker_id = name
+        s.peer_probe = lambda: 1  # peers exist → pay the settle window
+
+        def fake_supervise(job, spec, result, log_path):
+            ran.append((name, job.id))
+            s.queue.update(
+                job.id, status="completed",
+                finished_at="now", claim_token=None,
+            )
+
+        s._supervise = fake_supervise
+        return s
+
+    def test_two_schedulers_one_queue_no_double_supervision(self, storage):
+        """The acceptance-criteria regression: two schedulers drain one
+        queue concurrently; every job is supervised by EXACTLY one."""
+        queue = JobQueue(storage)
+        jobs = [queue.submit(VARIANT) for _ in range(4)]
+        ran: list = []
+        s1 = self._scheduler(storage, ran, "w1")
+        s2 = self._scheduler(storage, ran, "w2")
+        barrier = threading.Barrier(2)
+
+        def drain(s):
+            barrier.wait()
+            # several passes so both schedulers contend on every job
+            # (a pause between passes lets engine-serialization yields'
+            # not_before gates reopen)
+            for _ in range(8):
+                s.run_pending_once()
+                time.sleep(0.1)
+
+        threads = [
+            threading.Thread(target=drain, args=(s,)) for s in (s1, s2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        supervised = [job_id for _, job_id in ran]
+        assert sorted(supervised) == sorted(j.id for j in jobs), ran
+        assert len(supervised) == len(set(supervised)), (
+            f"double supervision: {ran}"
+        )
+
+    def test_orphan_steal_is_single_winner(self, storage):
+        """Two resuming schedulers race to steal one stale orphan: one
+        requeue, one attempt bump."""
+        queue = JobQueue(storage)
+        job = queue.submit(VARIANT)
+        t1 = queue.claim(job, "dead-worker")
+        queue.update(
+            job.id, status="running", generation=1, claim_token=t1,
+            worker_id="dead-worker", heartbeat_at=time.time() - 1000,
+            attempt=1,
+        )
+        cfg = SchedulerConfig(claim_settle_s=0.15, stale_after_s=5.0)
+        s1 = TrainScheduler(storage, cfg)
+        s2 = TrainScheduler(storage, cfg)
+        for s in (s1, s2):
+            s.peer_probe = lambda: 1
+        results = {}
+        barrier = threading.Barrier(2)
+
+        def resume(name, s):
+            barrier.wait()
+            results[name] = s.resume_orphans()
+
+        threads = [
+            threading.Thread(target=resume, args=("a", s1)),
+            threading.Thread(target=resume, args=("b", s2)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        requeued = results["a"] + results["b"]
+        assert requeued == [job.id], results  # exactly one steal won
+        cur = queue.get(job.id)
+        assert cur.status == "queued"
+        assert cur.generation == 2  # the steal's CAS bump
+        assert cur.attempt == 1  # no double bump
+
+
+class TestWorkerFleet:
+    def test_worker_registry_liveness(self, storage):
+        reg = WorkerRegistry(storage)
+        reg.upsert(WorkerInfo(id="w1", heartbeat_at=time.time()))
+        reg.upsert(WorkerInfo(id="w2", heartbeat_at=time.time() - 1000))
+        live = reg.live(stale_after_s=10)
+        assert [w.id for w in live] == ["w1"]
+        assert reg.gc(stale_after_s=60) == ["w2"]
+        assert [w.id for w in reg.list()] == ["w1"]
+
+    def test_fleet_member_lifecycle_and_peers(self, storage):
+        m1 = FleetMember(
+            storage,
+            scheduler_config=SchedulerConfig(poll_interval_s=0.05),
+            fleet_config=FleetConfig(heartbeat_interval_s=0.05),
+        )
+        m2 = FleetMember(
+            storage,
+            scheduler_config=SchedulerConfig(poll_interval_s=0.05),
+            fleet_config=FleetConfig(heartbeat_interval_s=0.05),
+        )
+        m1.start()
+        try:
+            m2.start()
+            try:
+                deadline = time.time() + 5
+                while time.time() < deadline and not m1.peers():
+                    time.sleep(0.05)
+                assert [w.id for w in m1.peers()] == [m2.worker_id]
+                # the peer probe arms the settle window
+                m1._peer_cache = (0.0, 0)  # drop cache
+                assert m1.live_peer_count() >= 1
+                assert m1.scheduler._claim_settle() > 0
+                status = fleet_status(storage)
+                assert status["live_workers"] == 2
+            finally:
+                m2.stop()
+        finally:
+            m1.stop()
+        # clean stops deregister both records
+        assert fleet_status(storage)["workers"] == []
+        # a lone worker skips the settle wait entirely
+        m3 = FleetMember(storage)
+        m3.start()
+        try:
+            assert m3.scheduler._claim_settle() == 0.0
+        finally:
+            m3.stop()
+
+    def test_crashed_member_leaves_stale_record(self, storage):
+        m = FleetMember(
+            storage, fleet_config=FleetConfig(heartbeat_interval_s=0.05)
+        )
+        m.start()
+        m.stop(kill_child=True)  # crash simulation: record survives
+        workers = fleet_status(storage, stale_after_s=0.0)["workers"]
+        assert [w["id"] for w in workers] == [m.worker_id]
+
+
+class TestDistributedConfig:
+    def test_single_host_fallback(self):
+        cfg = DistributedConfig()
+        assert not cfg.multi_host
+        assert cfg.initialize() is False  # no-op, no jax needed
+        assert cfg.child_env() == {}
+
+    def test_env_round_trip(self):
+        cfg = DistributedConfig(
+            coordinator_address="10.0.0.1:1234",
+            num_processes=4,
+            process_id=2,
+        )
+        assert cfg.multi_host
+        env = cfg.child_env()
+        back = DistributedConfig.from_env(env)
+        assert back == cfg
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DistributedConfig(num_processes=2)  # no coordinator
+        with pytest.raises(ValueError):
+            DistributedConfig(
+                coordinator_address="x:1", num_processes=2, process_id=5
+            )
+
+    def test_from_json(self):
+        cfg = DistributedConfig.from_json({
+            "coordinator": "h:1", "num_processes": 2, "process_id": 1,
+        })
+        assert cfg.coordinator_address == "h:1"
+        assert DistributedConfig.from_json(None) == DistributedConfig()
+
+
+class TestClaimWedgeRecovery:
+    def test_dead_winning_bid_unwedges(self, storage):
+        """A claimant that dies between winning the bid and writing the
+        record would otherwise own that generation forever; the resume
+        pass bids PAST it and the job becomes claimable again."""
+        queue = JobQueue(storage)
+        job = queue.submit(VARIANT)
+        # claim WITHOUT fields = win the bid but never write the record
+        dead = queue.claim(job, "dead-worker")
+        assert dead is not None
+        assert queue.get(job.id).status == "queued"  # the wedge
+        # every later claim of generation 1 loses to the dead bid
+        assert queue.claim(queue.get(job.id), "w2") is None
+        cfg = SchedulerConfig(stale_after_s=0.05)
+        s = TrainScheduler(storage, cfg)
+        time.sleep(0.1)  # let the dead bid go stale
+        s.resume_orphans()
+        cur = queue.get(job.id)
+        assert cur.status == "queued" and cur.generation == 2
+        # fresh generation: claims work again
+        assert queue.claim(cur, "w3") is not None
+
+    def test_live_bid_not_unwedged(self, storage):
+        """A FRESH winning bid (a claimant mid-protocol) must not be
+        bumped — only stale ones."""
+        queue = JobQueue(storage)
+        job = queue.submit(VARIANT)
+        queue.claim(job, "live-worker")  # just bid, still writing
+        s = TrainScheduler(storage, SchedulerConfig(stale_after_s=30.0))
+        s.resume_orphans()
+        assert queue.get(job.id).generation == 0  # untouched
+
+
+class TestEngineSerializationAcrossWorkers:
+    def test_second_worker_yields_while_engine_trains_elsewhere(
+        self, storage
+    ):
+        """Two fleet members, two jobs of ONE engine: the junior
+        claimant must yield (queued again, attempt not consumed) while
+        the senior's train is running on the other worker."""
+        queue = JobQueue(storage)
+        job1 = queue.submit(VARIANT)
+        job2 = queue.submit(VARIANT)  # same engine_id
+        # worker A is mid-train on job1 (claimed + running record)
+        t1 = queue.claim(job1, "workerA", fields=dict(
+            status="running", worker_id="workerA",
+            started_at="2026-01-01T00:00:00", heartbeat_at=time.time(),
+            attempt=1,
+        ))
+        assert t1 is not None
+        s = TrainScheduler(
+            storage, SchedulerConfig(poll_interval_s=0.05)
+        )
+        supervised = []
+        s._supervise = lambda *a, **k: supervised.append(a)
+        s._run_job(queue.get(job2.id))
+        assert supervised == []  # yielded, never supervised
+        cur = queue.get(job2.id)
+        assert cur.status == "queued"
+        assert cur.attempt == 0  # the yield refunds the attempt
+        assert cur.claim_token is None
+        # once job1 finishes, job2 trains normally
+        queue.update(job1.id, status="completed", claim_token=None)
+        time.sleep(0.06)  # past the yield's not_before gate
+        s._supervise = lambda *a, **k: supervised.append("ran")
+        s._run_job(queue.get(job2.id))
+        assert supervised == ["ran"]
+
+    def test_heartbeat_resurrection_keeps_identity(self, storage):
+        """A beat landing after a peer GC'd the record must rebuild it
+        WITH its id — an id-less phantom would count as everyone's live
+        peer forever."""
+        reg = WorkerRegistry(storage)
+        reg.upsert(WorkerInfo(id="w1", heartbeat_at=time.time()))
+        reg.remove("w1")  # a peer's gc during our connectivity gap
+        reg.heartbeat("w1", None, 0)
+        assert [w.id for w in reg.list()] == ["w1"]

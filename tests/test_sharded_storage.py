@@ -716,3 +716,95 @@ class TestReplication:
             ids = store.insert_batch(evs, 1)
         assert all(ids)
         assert any("reduced redundancy" in r.message for r in caplog.records)
+
+
+class _SlowStore(_TogglableStore):
+    """A togglable store with a settable per-read stall (GC-pause twin)."""
+
+    delay = 0.0
+
+    def find_entities_batch(self, *a, **kw):
+        import time as _time
+
+        if self.delay:
+            _time.sleep(self.delay)
+        return super().find_entities_batch(*a, **kw)
+
+
+class TestHedgedReads:
+    """ISSUE 10 satellite: idempotent replica reads hedge after a
+    p95-derived delay; first answer wins."""
+
+    def _mk(self, n=3, replicas=2, **cfg):
+        children = [_SlowStore() for _ in range(n)]
+        store = ShardedEventStore(
+            stores=children, config={"REPLICAS": str(replicas), **cfg}
+        )
+        store.init_app(1)
+        return store, children
+
+    def test_enabled_only_with_replicas(self):
+        store, _ = self._mk(replicas=2)
+        assert store.hedged_reads
+        store, _ = self._mk(replicas=1)
+        assert not store.hedged_reads
+        store, _ = self._mk(replicas=2, HEDGED_READS="0")
+        assert not store.hedged_reads
+
+    def test_p95_delay_derivation(self):
+        store, _ = self._mk()
+        # cold start: conservative default
+        assert store.hedge_delay_s() == store.HEDGE_DEFAULT_DELAY_S
+        for _ in range(40):
+            store._record_read_latency(0.001)
+        store._record_read_latency(0.1)  # one outlier under p95
+        d = store.hedge_delay_s()
+        assert store.HEDGE_MIN_DELAY_S <= d < 0.1
+
+    def test_hedge_beats_slow_primary(self):
+        store, children = self._mk()
+        for e in _events():
+            store.insert(e, 1)
+        ids = [f"u{i}" for i in range(11)]
+        # warm the latency window with fast reads
+        for _ in range(25):
+            store.find_entities_batch(1, "user", ids)
+        import time as _time
+
+        # every shard is some entity's home: slow them ALL so each
+        # group's hedge (to the fast follower copy) is what answers…
+        # except followers are the same stores. Instead slow ONE shard:
+        # only its home groups hedge.
+        children[0].delay = 0.8
+        t0 = _time.monotonic()
+        out = store.find_entities_batch(1, "user", ids)
+        dt_read = _time.monotonic() - t0
+        assert dt_read < 0.7, dt_read  # hedge beat the stall
+        assert set(out) == set(ids)
+        from predictionio_tpu.obs import get_default_registry
+
+        text = get_default_registry().render()
+        assert "storage_hedged_reads_total" in text
+
+    def test_hedged_result_matches_serial(self):
+        store, children = self._mk()
+        for e in _events():
+            store.insert(e, 1)
+        ids = [f"u{i}" for i in range(11)]
+        baseline = store.find_entities_batch(1, "user", ids)
+        store.hedged_reads = False
+        serial = store.find_entities_batch(1, "user", ids)
+        assert set(baseline) == set(serial)
+        for k in baseline:
+            assert len(baseline[k]) == len(serial[k])
+
+    def test_down_primary_fails_over_through_hedge(self):
+        store, children = self._mk()
+        for e in _events():
+            store.insert(e, 1)
+        # find a user homed on shard 0, then kill shard 0 entirely
+        ids = [f"u{i}" for i in range(11) if shard_of(f"u{i}", 3) == 0]
+        assert ids
+        children[0].down = True
+        out = store.find_entities_batch(1, "user", ids)
+        assert set(out) == set(ids)  # replica copies answered

@@ -617,3 +617,38 @@ def test_recreate_mid_canary_keeps_pinned_baseline(served):
         if host is not None and host.rollout is not None:
             host.rollout.stop()
             host.rollout.abort("test cleanup")
+
+
+def test_tenant_deadline_floor_clamps_at_admit(served):
+    """ISSUE 10 satellite: a tenant-level X-PIO-Deadline floor bounds
+    how long its requests may live in the pipeline. A request with NO
+    deadline gets the tenant's budget at admit — with a 1 ms floor and
+    a 2 ms micro-batch window it must shed as a 503 instead of holding
+    a dispatcher lease; the floor never LOOSENS a client's own tighter
+    deadline, and floorless tenants are untouched."""
+    storage, srv, mux, port = served
+    TenantStore(storage).upsert(
+        Tenant(id="tfloor", engine_id="mtsrv", deadline_floor_ms=1.0)
+    )
+    # no client deadline → clamped to the 1 ms floor → shed (503)
+    status, headers, _ = post(
+        port, "/tenants/tfloor/queries.json", {"user": "u0", "num": 1}
+    )
+    assert status == 503 and headers.get("Retry-After") == "1"
+    # a generous floor admits normally
+    TenantStore(storage).set_quota("tfloor", deadline_floor_ms=30_000)
+    status, _, body = post(
+        port, "/tenants/tfloor/queries.json", {"user": "u0", "num": 1}
+    )
+    assert status == 200
+    # the client's own TIGHTER (already expired) deadline still wins
+    status, _, _ = post(
+        port, "/tenants/tfloor/queries.json", {"user": "u0"},
+        headers={"X-PIO-Deadline": "0"},
+    )
+    assert status == 503
+    # floorless tenants never see a clamp
+    status, _, _ = post(
+        port, "/tenants/t1/queries.json", {"user": "u0", "num": 1}
+    )
+    assert status == 200
