@@ -500,6 +500,166 @@ def bench_serving_device():
     return p50_single * 1e3, batch / per_batch
 
 
+def bench_serving_kernels():
+    """ISSUE 11: the staged-serving device floor across dtypes/kernels.
+
+    Measures warmed recommend latency (batch 1) and batched qps
+    (batch 64) through `als.recommend_serving` — the path the engine
+    actually serves — for f32 and int8 staged states, reports which
+    kernel mode resolved (the fused Pallas kernel on TPU, the XLA
+    two-step elsewhere), and the int8-vs-f32 score agreement on the
+    bench shapes."""
+    from predictionio_tpu.data.store.bimap import BiMap
+    from predictionio_tpu.models import als
+
+    rng = np.random.RandomState(7)
+    n_users_local = min(N_USERS, 65_536)
+    f = als.ALSFactors(
+        user_factors=rng.standard_normal(
+            (n_users_local, RANK)
+        ).astype(np.float32),
+        item_factors=rng.standard_normal(
+            (N_ITEMS, RANK)
+        ).astype(np.float32),
+        user_vocab=BiMap({}),
+        item_vocab=BiMap({}),
+    )
+
+    def measure(sv, batch):
+        rows = rng.randint(0, n_users_local, batch).astype(np.int32)
+        als.recommend_serving(sv, rows, 10)  # warm this shape
+        times = []
+        for _ in range(15):
+            t0 = time.perf_counter()
+            als.recommend_serving(sv, rows, 10)
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    out = {}
+    for dt in ("f32", "int8"):
+        sv = als.stage_serving(f, serve_dtype=dt)
+        p50 = measure(sv, 1)
+        per_batch = measure(sv, 64)
+        out[dt] = {
+            "p50_ms": p50 * 1e3,
+            "qps": 64 / per_batch,
+            "resident_mb": sv.device_nbytes() / 1e6,
+            "mode": sv.mode or "xla",
+        }
+    # int8-vs-f32 score agreement on a (64, I) slab
+    from predictionio_tpu.ops.recommend_pallas import quantize_rows_np
+
+    sample = rng.randint(0, n_users_local, 64)
+    uq, us = quantize_rows_np(f.user_factors[sample])
+    iq, isc = quantize_rows_np(f.item_factors)
+    s_f32 = f.user_factors[sample] @ f.item_factors.T
+    s_int8 = (
+        uq.astype(np.int32) @ iq.T.astype(np.int32)
+    ).astype(np.float32) * us[:, None] * isc[None, :]
+    out["int8_rel_err"] = float(
+        np.max(np.abs(s_int8 - s_f32)) / np.abs(s_f32).max()
+    )
+    return out
+
+
+def bench_batching_ab():
+    """ISSUE 11: continuous vs windowed micro-batching p99 under the
+    SAME closed-loop load on the same trained engine — the acceptance
+    check that admitting arrivals into in-flight buckets does not
+    regress tail latency vs fixed windows."""
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+    from predictionio_tpu.workflow.core import run_train
+    from predictionio_tpu.workflow.server import (
+        QueryServer,
+        QueryServerConfig,
+        latest_completed_runtime,
+    )
+
+    cfg = StorageConfig(
+        sources={"MEM": SourceConfig("MEM", "memory", {})},
+        repositories={
+            "METADATA": "MEM", "EVENTDATA": "MEM", "MODELDATA": "MEM",
+        },
+    )
+    storage = Storage(cfg)
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "abapp"))
+    storage.get_events().init_app(app_id)
+    rng = np.random.RandomState(23)
+    n_users_ab, n_items_ab = 400, 4000
+    batch = [
+        Event(
+            event="rate", entity_type="user",
+            entity_id=f"u{int(rng.randint(n_users_ab))}",
+            target_entity_type="item", target_entity_id=f"i{i}",
+            properties={"rating": float(rng.randint(1, 6))},
+        )
+        for i in range(n_items_ab)
+    ]
+    storage.get_events().insert_batch(batch, app_id)
+    variant = {
+        "id": "abrec",
+        "engineFactory":
+            "predictionio_tpu.engines.recommendation.RecommendationEngine",
+        "datasource": {"params": {"app_name": "abapp"}},
+        "algorithms": [
+            {"name": "als", "params": {"rank": RANK, "num_iterations": 3}}
+        ],
+    }
+    run_train(storage, variant)
+    runtime = latest_completed_runtime(storage, "abrec", "0", "abrec")
+    make_body = lambda i: json.dumps(  # noqa: E731
+        {"user": f"u{i % n_users_ab}", "num": 10}
+    ).encode()
+    servers = {}
+    out = {}
+    try:
+        for mode in ("continuous", "windowed"):
+            srv = QueryServer(
+                storage, runtime,
+                QueryServerConfig(ip="127.0.0.1", port=0, batching=mode),
+            )
+            servers[mode] = (srv, srv.start())
+        for mode, (_, port) in servers.items():
+            # warm: bucket-shape compiles + TCP stacks settle
+            _hammer_query_server(port, make_body, n_clients=16, n_per=2)
+        # 3 rounds per mode, INTERLEAVED (A/B/A/B...) so slow host
+        # drift hits both modes equally, then min-p99 / max-qps: on a
+        # 2-core bench host the 64 client threads contend with the
+        # server, so a single round's tail is scheduler noise (the
+        # mt_hog_impact_ratio honesty caveat) — min over rounds is the
+        # train bench's min-over-runs discipline applied to latency.
+        # Measured sequentially-per-server the SAME code read as a
+        # ±20% p99 swing in either direction; interleaved, the two
+        # modes agree within noise.
+        rounds = {mode: [] for mode in servers}
+        for _ in range(3):
+            for mode, (_, port) in servers.items():
+                rounds[mode].append(_hammer_query_server(
+                    port, make_body, n_clients=64, n_per=6,
+                ))
+        for mode, rs in rounds.items():
+            out[mode] = {
+                "qps": max(r["qps"] for r in rs),
+                "p50_ms": min(r["p50_ms"] for r in rs),
+                "p99_ms": min(r["p99_ms"] for r in rs),
+            }
+    finally:
+        for srv, _ in servers.values():
+            srv.stop()
+    out["p99_ratio"] = (
+        out["continuous"]["p99_ms"] / out["windowed"]["p99_ms"]
+        if out["windowed"]["p99_ms"] > 0 else None
+    )
+    return out
+
+
 def _hammer_query_server(port, make_body, n_clients, n_per, timeout=60.0):
     """Shared closed-loop load harness: n_clients keep-alive connections
     each issuing n_per sequential POST /queries.json requests.
@@ -1859,6 +2019,8 @@ def main():
     baseline = bench_numpy_baseline(rows, cols, vals)
     grid = bench_grid_tuning()
     dev_p50_ms, dev_qps = bench_serving_device()
+    kernels = bench_serving_kernels()
+    batching_ab = bench_batching_ab()
     framework = bench_serving_framework()
     multitenant = bench_multitenant()
     ur = bench_ur_framework()
@@ -1936,6 +2098,35 @@ def main():
         "als_rank_grid_seq_sec": round(grid["rank_seq_sec"], 2),
         "serving_device_p50_ms": round(dev_p50_ms, 2),
         "serving_device_qps": round(dev_qps, 1),
+        # ISSUE 11: staged serving kernels — fused mode + dtype ladder
+        "serving_fused_mode": kernels["f32"]["mode"],
+        "serving_fused_p50_ms": round(kernels["f32"]["p50_ms"], 3),
+        "serving_fused_qps": round(kernels["f32"]["qps"], 1),
+        "serving_int8_p50_ms": round(kernels["int8"]["p50_ms"], 3),
+        "serving_int8_qps": round(kernels["int8"]["qps"], 1),
+        "serving_int8_score_rel_err": round(kernels["int8_rel_err"], 5),
+        "serving_int8_resident_mb": round(
+            kernels["int8"]["resident_mb"], 2
+        ),
+        "serving_f32_resident_mb": round(
+            kernels["f32"]["resident_mb"], 2
+        ),
+        # ISSUE 11: continuous vs windowed batching under load
+        "serving_batching_continuous_qps": round(
+            batching_ab["continuous"]["qps"], 1
+        ),
+        "serving_batching_continuous_p99_ms": round(
+            batching_ab["continuous"]["p99_ms"], 1
+        ),
+        "serving_batching_windowed_qps": round(
+            batching_ab["windowed"]["qps"], 1
+        ),
+        "serving_batching_windowed_p99_ms": round(
+            batching_ab["windowed"]["p99_ms"], 1
+        ),
+        "serving_batching_p99_ratio": round(
+            batching_ab["p99_ratio"], 3
+        ) if batching_ab["p99_ratio"] else None,
         "serving_framework_qps": round(framework["qps"], 1),
         "serving_framework_p50_ms": round(framework["p50_ms"], 1),
         "serving_framework_p99_ms": round(framework["p99_ms"], 1),

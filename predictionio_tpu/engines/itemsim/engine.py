@@ -13,6 +13,7 @@ items (multi-item queries rank by total similarity to the basket).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -87,13 +88,71 @@ class ItemSimDataSource(DataSource):
 @dataclass
 class ItemSimAlgorithmParams:
     top_n: int = 50  # similar items kept per item
+    # sharded serving (ISSUE 11 satellite, carried fleet follow-up):
+    # instead of the train-time O(I²) top-N precompute, keep the item
+    # COLUMN vectors (the (I, U) transpose of the indicator matrix)
+    # row-sharded across the serving mesh and compute each query item's
+    # top-N cosine on the fly (fleet.ShardedRuntime.similar_items) —
+    # the catalog (and the U-dim vectors) can exceed one chip's HBM,
+    # and item-vocab growth needs no O(I²) recompute.
+    shard_serving: bool = False
 
 
 @dataclass
 class ItemSimModel:
-    sim_scores: np.ndarray  # (I, top_n)
+    sim_scores: np.ndarray  # (I, top_n) — empty when shard_serving
     sim_idx: np.ndarray  # (I, top_n), -1 padded
     item_vocab: BiMap
+    top_n: int = 50
+    # shard_serving: the raw (I, U) item column vectors; similarity is
+    # computed on the fly from the sharded copies
+    item_vectors: object = None  # Optional[np.ndarray]
+
+    def __post_init__(self):
+        self._stage_lock = threading.Lock()
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        # serving state + lock are not part of the pickled model
+        state.pop("_sharded_runtime", None)
+        state.pop("_stage_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        # models pickled BEFORE these fields existed must keep loading
+        state.setdefault("top_n", 50)
+        state.setdefault("item_vectors", None)
+        self.__dict__.update(state)
+        self._stage_lock = threading.Lock()
+
+    def sharded_runtime(self):
+        if self.item_vectors is None:
+            return None
+        # locked: concurrent pipelined batches must not double-stage
+        # the sharded vector matrix (same discipline as ALSModel)
+        with self._stage_lock:
+            srt = getattr(self, "_sharded_runtime", None)
+            if srt is False:
+                return None
+            if srt is None:
+                from predictionio_tpu.fleet import stage_serving_runtime
+
+                # no user side: the runtime only serves similar_items
+                self._sharded_runtime = stage_serving_runtime(
+                    np.zeros(
+                        (0, self.item_vectors.shape[1]), np.float32
+                    ),
+                    self.item_vectors,
+                    item_vocab=self.item_vocab,
+                )
+                if self._sharded_runtime is False:
+                    return None
+                srt = self._sharded_runtime
+            return srt
+
+    def sharded_info(self):
+        srt = getattr(self, "_sharded_runtime", None)
+        return srt.info() if srt else None
 
 
 class ItemSimAlgorithm(Algorithm):
@@ -101,27 +160,69 @@ class ItemSimAlgorithm(Algorithm):
         self.params = params
 
     def train(self, ctx: RuntimeContext, pd: TrainingData) -> ItemSimModel:
+        if self.params.shard_serving:
+            # keep the column vectors; similarity is served on the fly
+            # from the sharded copies — no O(I²) precompute
+            empty = np.zeros((0, 0), np.float32)
+            return ItemSimModel(
+                sim_scores=empty,
+                sim_idx=empty.astype(np.int64),
+                item_vocab=pd.item_vocab,
+                top_n=self.params.top_n,
+                item_vectors=np.ascontiguousarray(
+                    pd.matrix.T.astype(np.float32)
+                ),
+            )
         scores, idx = dimsum.column_cosine_topn(
             pd.matrix, top_n=self.params.top_n, mesh=ctx.mesh
         )
         return ItemSimModel(
-            sim_scores=scores, sim_idx=idx, item_vocab=pd.item_vocab
+            sim_scores=scores, sim_idx=idx, item_vocab=pd.item_vocab,
+            top_n=self.params.top_n,
         )
 
-    def predict(self, model: ItemSimModel, query: Query) -> PredictedResult:
-        n_items = len(model.item_vocab)
-        known = [
+    def _basket_rows(self, model: ItemSimModel, query: Query):
+        return [
             model.item_vocab.get(i)
             for i in query.items
             if model.item_vocab.get(i) is not None
         ]
+
+    def predict(self, model: ItemSimModel, query: Query) -> PredictedResult:
+        n_items = len(model.item_vocab)
+        known = self._basket_rows(model, query)
         if not known:
             return PredictedResult()
         total = np.zeros(n_items, dtype=np.float32)
-        for row in known:
-            idx = model.sim_idx[row]
-            ok = idx >= 0
-            np.add.at(total, idx[ok], model.sim_scores[row][ok])
+        if model.item_vectors is not None:
+            # on-the-fly similarity (shard_serving): sharded when > 1
+            # device is visible, host cosine otherwise — both truncate
+            # to top_n per query item exactly like the precomputed path
+            srt = model.sharded_runtime()
+            k = min(model.top_n, n_items)
+            if srt is not None:
+                vals, idx = srt.similar_items(
+                    np.asarray(known, np.int64), k, exclude_self=True
+                )
+            else:
+                from predictionio_tpu.models import ranking
+                from predictionio_tpu.ops.topk import NEG_INF
+
+                normed = ranking.l2_normalize(model.item_vectors)
+                scores = normed[known] @ normed.T
+                scores[np.arange(len(known)), known] = NEG_INF
+                idx = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+                vals = np.take_along_axis(scores, idx, axis=1)
+            from predictionio_tpu.ops.topk import NEG_INF
+
+            for r in range(len(known)):
+                ok = vals[r] > NEG_INF / 2
+                np.add.at(total, idx[r][ok], vals[r][ok])
+        else:
+            for row in known:
+                idx = model.sim_idx[row]
+                ok = idx >= 0
+                np.add.at(total, idx[ok], model.sim_scores[row][ok])
         total[known] = 0.0  # never recommend the queried items themselves
         top = np.argsort(-total)[: query.num]
         inv = model.item_vocab.inverse()

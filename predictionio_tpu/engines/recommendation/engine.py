@@ -257,6 +257,13 @@ class ALSAlgorithmParams:
     # top-k per shard + global merge. Off by default — single-chip
     # serving keeps the PR-2 resident-matrix path.
     shard_serving: bool = False
+    # serving dtype (ISSUE 11): "int8" quantizes BOTH factor matrices
+    # per-row at model publish/fold-in (half the resident bytes and the
+    # factor stream; int8xint8->int32 scoring, scale-product dequant in
+    # registers). Scores shift by the quantization error (~1% relative
+    # at serving rank — see tests/test_recommend_pallas.py bounds), so
+    # it is an explicit opt-in; "f32" keeps exact scoring.
+    serve_dtype: str = "f32"
 
 
 class ALSModel:
@@ -268,47 +275,92 @@ class ALSModel:
         self,
         factors: als.ALSFactors,
         item_categories: Optional[list[frozenset]] = None,
+        serve_dtype: str = "f32",
     ):
         self.factors = factors
         self.item_categories = item_categories
-        self._item_factors_device = None
-        self._user_factors_device = None
+        self.serve_dtype = serve_dtype
+        self._serving_state = None  # als.ServingFactors when staged
         self._sharded_runtime = None  # fleet.ShardedRuntime when active
         self._stage_lock = threading.Lock()
 
     # device caches + lock are serving state, not part of the pickled model
     def __getstate__(self):
-        return {"factors": self.factors, "item_categories": self.item_categories}
+        return {
+            "factors": self.factors,
+            "item_categories": self.item_categories,
+            "serve_dtype": self.serve_dtype,
+        }
 
     def __setstate__(self, state):
-        self.__init__(state["factors"], state.get("item_categories"))
+        self.__init__(
+            state["factors"],
+            state.get("item_categories"),
+            state.get("serve_dtype", "f32"),
+        )
+
+    def serving_state(self):
+        """The staged serving-side factor state (ISSUE 11): pad-aligned
+        for the fused recommend+top-k kernel, int8-quantized when
+        serve_dtype opts in, resident across calls. Staged lazily under
+        the stage lock (pipelined batches must not double-stage)."""
+        with self._stage_lock:
+            if self._serving_state is None:
+                self._serving_state = als.stage_serving(
+                    self.factors, serve_dtype=self.serve_dtype
+                )
+            return self._serving_state
+
+    def adopt_serving(self, old_state, dirty_users=None, dirty_items=None):
+        """Fold-in publish hook (online/foldin.py:_clone_model): carry
+        the predecessor's staged serving state by publishing ONLY the
+        tick's dirty rows device-side (quantize-at-fold-in for int8) —
+        copy-on-write off shared buffers, donated into grown private
+        ones — instead of re-staging a factor matrix per tick. Any
+        failure leaves the state unstaged; the next query restages."""
+        if old_state is None:
+            return
+        try:
+            n_users = self.factors.user_factors.shape[0]
+            n_items = self.factors.item_factors.shape[0]
+            ur, uv = dirty_users if dirty_users is not None else (None, None)
+            ir, iv = dirty_items if dirty_items is not None else (None, None)
+            # a side that changed without row attribution cannot be
+            # expressed as row writes — leave unstaged (lazy restage)
+            if dirty_users is None and n_users != old_state.n_users:
+                return
+            if dirty_items is None and n_items != old_state.n_items:
+                return
+            self._serving_state = als.serving_publish_rows(
+                old_state,
+                user_rows=ur, user_vals=uv,
+                item_rows=ir, item_vals=iv,
+                n_users=n_users, n_items=n_items,
+            )
+        except Exception:
+            self._serving_state = None
 
     def sharded_runtime(self):
         """The fleet sharded serving state, staged lazily on first use
-        (ISSUE 10). Requires > 1 visible device; the optional
-        PIO_SERVE_HBM_BYTES env is the per-device budget the shards
-        must fit (the single-device path has no such gate — it simply
-        OOMs, which is exactly what sharding exists to prevent). The
-        single-device outcome is cached as False so the serving hot
+        (ISSUE 10) via the shared `fleet.stage_serving_runtime` helper
+        (>= 2 visible devices; PIO_SERVE_HBM_BYTES per-device budget).
+        The single-device outcome is cached as False so the serving hot
         path doesn't re-probe jax.devices() under the lock per batch."""
         with self._stage_lock:
             if self._sharded_runtime is False:
                 return None
             if self._sharded_runtime is None:
-                import os
+                from predictionio_tpu.fleet import stage_serving_runtime
 
-                import jax
-
-                from predictionio_tpu.fleet import ShardedRuntime
-
-                if len(jax.devices()) < 2:
-                    self._sharded_runtime = False
-                    return None
-                budget = os.environ.get("PIO_SERVE_HBM_BYTES")
-                self._sharded_runtime = ShardedRuntime.from_factors(
-                    self.factors,
-                    device_budget_bytes=float(budget) if budget else None,
+                self._sharded_runtime = stage_serving_runtime(
+                    self.factors.user_factors,
+                    self.factors.item_factors,
+                    user_vocab=self.factors.user_vocab,
+                    item_vocab=self.factors.item_vocab,
+                    params=self.factors.params,
                 )
+                if self._sharded_runtime is False:
+                    return None
             return self._sharded_runtime
 
     def sharded_info(self) -> Optional[dict]:
@@ -327,33 +379,16 @@ class ALSModel:
         srt = self._sharded_runtime
         if srt:
             return float(srt.device_bytes()["per_shard"])
+        sv = self._serving_state
+        if sv is not None:
+            # the staged (possibly int8) state is the resident copy —
+            # int8 serving genuinely halves the cache charge
+            return sv.device_nbytes()
         return float(
             self.factors.user_factors.nbytes
             + self.factors.item_factors.nbytes
         )
 
-    def item_factors_device(self):
-        # locked: the pipelined dispatcher (server.py pipeline_depth) can
-        # run two batches for one model concurrently; double-staging would
-        # transiently double the factor matrices' HBM footprint
-        with self._stage_lock:
-            if self._item_factors_device is None:
-                import jax.numpy as jnp
-
-                self._item_factors_device = jnp.asarray(
-                    self.factors.item_factors
-                )
-            return self._item_factors_device
-
-    def user_factors_device(self):
-        with self._stage_lock:
-            if self._user_factors_device is None:
-                import jax.numpy as jnp
-
-                self._user_factors_device = jnp.asarray(
-                    self.factors.user_factors
-                )
-            return self._user_factors_device
 
 
 class ALSAlgorithm(Algorithm):
@@ -396,7 +431,11 @@ class ALSAlgorithm(Algorithm):
             mesh=ctx.mesh,
             init_factors=self._warm_start_init(ctx, pd, als_params),
         )
-        return ALSModel(factors, item_categories=pd.item_categories)
+        return ALSModel(
+            factors,
+            item_categories=pd.item_categories,
+            serve_dtype=getattr(self.params, "serve_dtype", "f32"),
+        )
 
     def _warm_start_init(self, ctx: RuntimeContext, pd: TrainingData,
                          als_params: als.ALSParams):
@@ -488,7 +527,12 @@ class ALSAlgorithm(Algorithm):
                 for p in als_list
             ]
         return [
-            ALSModel(f, item_categories=pd.item_categories) for f in grid
+            ALSModel(
+                f,
+                item_categories=pd.item_categories,
+                serve_dtype=getattr(self.params, "serve_dtype", "f32"),
+            )
+            for f in grid
         ]
 
     # -- serving -----------------------------------------------------------
@@ -604,13 +648,12 @@ class ALSAlgorithm(Algorithm):
                 user_rows, k, exclude_mask=sub_mask
             )
         else:
-            scores, items = als.recommend(
-                model.factors,
-                user_rows,
-                k,
+            # staged serving state (ISSUE 11): fused one-pass kernel
+            # where the lowering runs, int8 when the params opt in,
+            # resident factor state either way
+            scores, items = als.recommend_serving(
+                model.serving_state(), user_rows, k,
                 exclude_mask=sub_mask,
-                item_factors_device=model.item_factors_device(),
-                user_factors_device=model.user_factors_device(),
             )
         _devprof.record_batch_padding(
             n_real, bucket, flops=_devprof.snapshot().flops - prof0.flops

@@ -14,6 +14,7 @@ batched device path lives in models/als.similar_items)."""
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -132,6 +133,12 @@ class ALSSimilarParams:
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    # sharded serving (ISSUE 11 satellite, carried fleet follow-up):
+    # with > 1 visible device, serve the basket cosine from
+    # row-sharded item factors (fleet.ShardedRuntime.similar_vectors)
+    # so the catalog can exceed one chip's HBM — the same wiring the
+    # recommendation engine got in PR 10.
+    shard_serving: bool = False
 
 
 class SimilarModel:
@@ -140,36 +147,60 @@ class SimilarModel:
     def __init__(self, factors: als.ALSFactors):
         self.factors = factors
         self._normed = None
+        self._sharded_runtime = None  # fleet.ShardedRuntime when active
+        self._stage_lock = threading.Lock()
 
     # the cache is serving state, not part of the pickled model
     def __getstate__(self):
         return {"factors": self.factors}
 
     def __setstate__(self, state):
-        self.factors = state["factors"]
-        self._normed = None
+        self.__init__(state["factors"])
 
     def normed_item_factors(self) -> np.ndarray:
         if self._normed is None:
             self._normed = ranking.l2_normalize(self.factors.item_factors)
         return self._normed
 
+    def sharded_runtime(self):
+        """Sharded serving state, staged lazily via the shared
+        `fleet.stage_serving_runtime` helper (same contract as
+        recommendation's ALSModel.sharded_runtime: needs > 1 visible
+        device; PIO_SERVE_HBM_BYTES is the per-device budget; the
+        single-device outcome caches as False). Locked: the pipelined
+        dispatcher can run concurrent batches for one model, and
+        double-staging would transiently double the sharded factor
+        matrices' device footprint."""
+        with self._stage_lock:
+            if self._sharded_runtime is False:
+                return None
+            if self._sharded_runtime is None:
+                from predictionio_tpu.fleet import stage_serving_runtime
+
+                self._sharded_runtime = stage_serving_runtime(
+                    self.factors.user_factors,
+                    self.factors.item_factors,
+                    item_vocab=self.factors.item_vocab,
+                )
+                if self._sharded_runtime is False:
+                    return None
+            return self._sharded_runtime
+
+    def sharded_info(self):
+        srt = self._sharded_runtime
+        return srt.info() if srt else None
+
 
 class _SimilarBase(Algorithm):
     """Shared serving: average query item vectors → cosine top-N."""
 
-    def _predict(self, model: SimilarModel, query: Query) -> PredictedResult:
+    def _exclusion(self, model: SimilarModel, query: Query, known) -> np.ndarray:
         vocab = model.factors.item_vocab
-        known = [vocab.get(i) for i in query.items]
-        known = [k for k in known if k is not None]
-        if not known:
-            return PredictedResult()
-        normed = model.normed_item_factors()
-        scores = normed @ normed[known].mean(axis=0)
-        excluded = np.zeros(len(scores), dtype=bool)
+        n = model.factors.item_factors.shape[0]
+        excluded = np.zeros(n, dtype=bool)
         excluded[known] = True  # never recommend the query items
         if query.whitelist is not None:
-            keep = np.zeros(len(scores), dtype=bool)
+            keep = np.zeros(n, dtype=bool)
             for it in query.whitelist:
                 ix = vocab.get(it)
                 if ix is not None:
@@ -179,8 +210,48 @@ class _SimilarBase(Algorithm):
             ix = vocab.get(it)
             if ix is not None:
                 excluded[ix] = True
-        scores = ranking.exclusion_scores(scores, excluded)
+        return excluded
+
+    def _predict(self, model: SimilarModel, query: Query) -> PredictedResult:
+        vocab = model.factors.item_vocab
+        known = [vocab.get(i) for i in query.items]
+        known = [k for k in known if k is not None]
+        if not known:
+            return PredictedResult()
+        excluded = self._exclusion(model, query, known)
         inv = vocab.inverse()
+        srt = (
+            model.sharded_runtime()
+            if getattr(self.params, "shard_serving", False)
+            else None
+        )
+        if srt is not None:
+            # sharded basket cosine (ISSUE 11 satellite): the mean
+            # query vector scores each shard's slab locally; only the
+            # (1, k) candidates ride the ICI merge. Mean of NORMALIZED
+            # vectors, like the host path; the sharded verb divides by
+            # the query norm, so multiply it back — the same query must
+            # yield the same SCORES regardless of device count, not
+            # just the same ranking (clients threshold on values).
+            # Filter masked entries on the RAW value first: a scale
+            # < 0.5 would otherwise lift NEG_INF past the filter bound.
+            q = model.normed_item_factors()[known].mean(axis=0)
+            from predictionio_tpu.ops.topk import NEG_INF
+
+            vals, idx = srt.similar_vectors(
+                q[None, :], query.num, exclude_mask=excluded[None, :]
+            )
+            qnorm = float(np.linalg.norm(q)) + 1e-9
+            return PredictedResult(
+                item_scores=[
+                    ItemScore(item=inv(int(ix)), score=float(s * qnorm))
+                    for s, ix in zip(vals[0], idx[0])
+                    if s > NEG_INF / 2
+                ]
+            )
+        normed = model.normed_item_factors()
+        scores = normed @ normed[known].mean(axis=0)
+        scores = ranking.exclusion_scores(scores, excluded)
         return PredictedResult(
             item_scores=[
                 ItemScore(item=inv(int(ix)), score=float(scores[ix]))

@@ -49,6 +49,34 @@ __all__ = [
 ]
 
 
+def stage_serving_runtime(user_factors, item_factors, **kwargs):
+    """Shared lazy staging for the engines' `shard_serving` knobs
+    (recommendation / similarproduct / itemsim): returns a
+    `ShardedRuntime` over the visible devices honoring the
+    PIO_SERVE_HBM_BYTES per-device budget, or ``False`` when fewer
+    than two devices are visible — the sentinel the engine models
+    cache so the serving hot path never re-probes jax.devices().
+    jax imports HERE, never at module import (data-plane discipline)."""
+    import os
+
+    import jax
+
+    if len(jax.devices()) < 2:
+        return False
+    from predictionio_tpu.fleet import runtime as _runtime
+
+    budget = os.environ.get("PIO_SERVE_HBM_BYTES")
+    return _runtime.ShardedRuntime(
+        user_factors,
+        item_factors,
+        device_budget_bytes=float(budget) if budget else None,
+        **kwargs,
+    )
+
+
+__all__.append("stage_serving_runtime")
+
+
 def __getattr__(name):
     if name in _LAZY_RUNTIME:
         from predictionio_tpu.fleet import runtime as _runtime

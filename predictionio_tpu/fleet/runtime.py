@@ -105,7 +105,9 @@ def _merge_topk(v: jax.Array, ix: jax.Array, k: int):
     return vv, jnp.take_along_axis(ixs, sel, axis=1)
 
 
-@partial(jax.jit, static_argnames=("k", "n_items", "mesh", "masked"))
+@partial(
+    jax.jit, static_argnames=("k", "n_items", "mesh", "masked", "mode")
+)
 def _sharded_recommend(
     rows: jax.Array,  # (B,) int32, replicated
     uf: jax.Array,  # (U_p, K) row-sharded over mp
@@ -116,7 +118,16 @@ def _sharded_recommend(
     n_items: int,
     mesh: jax.sharding.Mesh,
     masked: bool,
+    mode: Optional[str] = None,
 ):
+    """Sharded recommend. With `mode` set (ISSUE 11), the shard-local
+    score+select runs the fused Pallas recommend+top-k kernel
+    (ops/recommend_pallas.py) — the same one-HBM-pass fusion as the
+    single-device path, amortized here by the existing local-top-k +
+    all-gather merge: each shard never materializes even its local
+    (B, i_local) score slab. Requires the item rows padded so every
+    shard's slab is tile-divisible (ShardedRuntime pre-pads when a mode
+    resolves); dead pad/foreign columns ride the kernel's mask input."""
     n_shards = int(mesh.shape[MODEL_AXIS])
     u_local = uf.shape[0] // n_shards
     i_local = itf.shape[0] // n_shards
@@ -127,13 +138,28 @@ def _sharded_recommend(
         q = jax.lax.psum(
             _owned_rows(rows_l, uf_l, u_local), MODEL_AXIS
         )  # (B, K) — every shard now holds the full query block
-        scores = q @ itf_l.T  # (B, i_local): the shard-local slab only
         gcol = idx * i_local + jnp.arange(i_local)
         dead = (gcol >= n_items)[None, :]
         if masked:
             dead = dead | mask_l
-        scores = jnp.where(dead, NEG_INF, scores)
-        v, ix = jax.lax.top_k(scores, k_l)
+        if mode is not None:
+            from predictionio_tpu.ops.recommend_pallas import (
+                fused_recommend_topk,
+            )
+
+            b = q.shape[0]
+            dead_f = jnp.broadcast_to(
+                dead.astype(jnp.float32), (b, i_local)
+            )
+            v, ix = fused_recommend_topk(
+                q, itf_l, None, None, dead_f,
+                k=k_l, n_items=i_local,
+                interpret=(mode == "interpret"),
+            )
+        else:
+            scores = q @ itf_l.T  # (B, i_local): the local slab only
+            scores = jnp.where(dead, NEG_INF, scores)
+            v, ix = jax.lax.top_k(scores, k_l)
         return _merge_topk(v, ix + idx * i_local, k)
 
     sh = P(MODEL_AXIS, None)
@@ -186,6 +212,59 @@ def _sharded_similar(
         local, mesh=mesh, in_specs=(P(), P(MODEL_AXIS, None)),
         out_specs=(P(), P()), check=False,
     )(rows, itf)
+
+
+@partial(
+    jax.jit, static_argnames=("k", "n_items", "mesh", "masked")
+)
+def _sharded_similar_vecs(
+    vecs: jax.Array,  # (B, K) f32 query vectors, replicated
+    itf: jax.Array,  # (I_p, K) row-sharded
+    mask: Optional[jax.Array],  # (B, I_p) bool col-sharded / None
+    *,
+    k: int,
+    n_items: int,
+    mesh: jax.sharding.Mesh,
+    masked: bool,
+):
+    """Cosine top-k against ARBITRARY query vectors (the
+    similarproduct/itemsim basket query: mean of the query items'
+    vectors; ISSUE 11 satellite). Same local-top-k + all-gather merge
+    as `_sharded_similar`, without the owned-rows gather — the caller
+    already holds the query vectors."""
+    n_shards = int(mesh.shape[MODEL_AXIS])
+    i_local = itf.shape[0] // n_shards
+    k_l = min(k, i_local)
+
+    def local(vecs_l, itf_l, mask_l):
+        idx = jax.lax.axis_index(MODEL_AXIS)
+        qn = vecs_l / (
+            jnp.linalg.norm(vecs_l, axis=-1, keepdims=True) + 1e-9
+        )
+        fn_ = itf_l / (
+            jnp.linalg.norm(itf_l, axis=-1, keepdims=True) + 1e-9
+        )
+        scores = qn @ fn_.T  # (B, i_local)
+        gcol = idx * i_local + jnp.arange(i_local)
+        dead = (gcol >= n_items)[None, :]
+        if masked:
+            dead = dead | mask_l
+        scores = jnp.where(dead, NEG_INF, scores)
+        v, ix = jax.lax.top_k(scores, k_l)
+        return _merge_topk(v, ix + idx * i_local, k)
+
+    sh = P(MODEL_AXIS, None)
+    if masked:
+        fn, args = local, (vecs, itf, mask)
+        in_specs = (P(), sh, P(None, MODEL_AXIS))
+    else:
+        fn = lambda v, i: local(v, i, None)
+        args = (vecs, itf)
+        in_specs = (P(), sh)
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=(P(), P()),
+        check=False,
+    )(*args)
 
 
 @partial(jax.jit, static_argnames=("implicit", "cg_iterations", "mesh"))
@@ -285,6 +364,9 @@ _sharded_recommend = _devprof.instrument(
 _sharded_similar = _devprof.instrument(
     "fleet.similar_sharded", _sharded_similar, memory=True
 )
+_sharded_similar_vecs = _devprof.instrument(
+    "fleet.similar_vecs_sharded", _sharded_similar_vecs, memory=True
+)
 _sharded_fold_in = _devprof.instrument(
     "fleet.fold_in_sharded", _sharded_fold_in, memory=True
 )
@@ -311,7 +393,10 @@ class ShardedRuntime:
         params: Optional[Any] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
         device_budget_bytes: Optional[float] = None,
+        serve_mode: str = "auto",
     ):
+        from predictionio_tpu.ops import recommend_pallas as _rp
+
         if mesh is None:
             mesh = serving_mesh()
         if MODEL_AXIS not in mesh.shape or len(mesh.shape) != 1:
@@ -321,10 +406,27 @@ class ShardedRuntime:
             )
         self.mesh = mesh
         self.n_shards = int(mesh.shape[MODEL_AXIS])
+        # fused local score+select (ISSUE 11): the sharded twin of the
+        # one-pass recommend+top-k kernel — resolved once here so every
+        # serving call traces against a fixed mode
+        self.serve_mode = _rp.resolve_mode(serve_mode)
         uf = np.asarray(user_factors, np.float32)
         itf = np.asarray(item_factors, np.float32)
+        if self.serve_mode is not None:
+            # the kernel needs each shard's item slab tile-divisible:
+            # pad item rows to shards × ITEM_PAD (pad rows are zero and
+            # ride the dead-column mask, same inertness discipline)
+            quantum = self.n_shards * _rp.ITEM_PAD
+            i_p = -(-max(itf.shape[0], 1) // quantum) * quantum
+            if i_p != itf.shape[0]:
+                itf = np.concatenate([
+                    itf,
+                    np.zeros(
+                        (i_p - itf.shape[0], itf.shape[1]), itf.dtype
+                    ),
+                ])
         self.n_users, self.rank = uf.shape
-        self.n_items = itf.shape[0]
+        self.n_items = int(np.asarray(item_factors).shape[0])
         if device_budget_bytes is not None:
             per_shard = self._padded_bytes(uf, itf) / self.n_shards
             if per_shard > device_budget_bytes:
@@ -380,19 +482,48 @@ class ShardedRuntime:
             vals, idx = _sharded_recommend(
                 rows, self._uf, self._itf, None,
                 k=k, n_items=self.n_items, mesh=self.mesh, masked=False,
+                mode=self.serve_mode,
             )
         else:
-            mask = np.asarray(exclude_mask, bool)
-            i_p = int(self._itf.shape[0])
-            if mask.shape[1] != i_p:  # pad mask cols to the sharded width
-                mask = np.concatenate([
-                    mask,
-                    np.zeros(
-                        (mask.shape[0], i_p - mask.shape[1]), bool
-                    ),
-                ], axis=1)
             vals, idx = _sharded_recommend(
-                rows, self._uf, self._itf, jnp.asarray(mask),
+                rows, self._uf, self._itf,
+                jnp.asarray(self._pad_mask(exclude_mask)),
+                k=k, n_items=self.n_items, mesh=self.mesh, masked=True,
+                mode=self.serve_mode,
+            )
+        return np.asarray(vals), np.asarray(idx)
+
+    def _pad_mask(self, exclude_mask) -> np.ndarray:
+        """Pad mask columns to the sharded item width."""
+        mask = np.asarray(exclude_mask, bool)
+        i_p = int(self._itf.shape[0])
+        if mask.shape[1] != i_p:
+            mask = np.concatenate([
+                mask,
+                np.zeros((mask.shape[0], i_p - mask.shape[1]), bool),
+            ], axis=1)
+        return mask
+
+    def similar_vectors(
+        self,
+        vectors: np.ndarray,  # (B, K) query vectors (e.g. basket means)
+        k: int,
+        exclude_mask: Optional[np.ndarray] = None,  # (B, n_items) bool
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Cosine top-k against arbitrary query vectors — the
+        similarproduct/itemsim basket query served from the sharded
+        state (ISSUE 11 satellite)."""
+        k = min(int(k), self.n_items)
+        vecs = jnp.asarray(np.asarray(vectors, np.float32))
+        if exclude_mask is None:
+            vals, idx = _sharded_similar_vecs(
+                vecs, self._itf, None,
+                k=k, n_items=self.n_items, mesh=self.mesh, masked=False,
+            )
+        else:
+            vals, idx = _sharded_similar_vecs(
+                vecs, self._itf,
+                jnp.asarray(self._pad_mask(exclude_mask)),
                 k=k, n_items=self.n_items, mesh=self.mesh, masked=True,
             )
         return np.asarray(vals), np.asarray(idx)

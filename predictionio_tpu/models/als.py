@@ -1763,6 +1763,28 @@ def _train_windowed(
 # ---------------------------------------------------------------------------
 # Serving-side scoring
 # ---------------------------------------------------------------------------
+#
+# Two generations coexist:
+#
+# - the PR-2 path (`recommend` + `_recommend_jit[_nomask]`): exact-width
+#   f32 factor matrices, XLA two-step (scores matmul -> lax.top_k). Kept
+#   for callers that serve straight off an ALSFactors.
+# - the ISSUE-11 path (`stage_serving` + `recommend_serving`): a staged
+#   `ServingFactors` whose item matrix is pad-aligned for the fused
+#   Pallas recommend+top-k kernel (ops/recommend_pallas.py — one HBM
+#   pass, no (B, I) score matrix), optionally int8-quantized per row
+#   (half the factor stream; int8xint8->int32 scoring), with device-side
+#   copy-on-write row publish for the online fold-in so a tick re-ships
+#   only its dirty rows instead of a factor matrix.
+#
+# Donation note (measured, not assumed): the per-query programs' outputs
+# ((B, k) values + indices) are strictly smaller than every input, so
+# `donate_argnums` on the query-row/mask buffers has nothing to alias —
+# XLA reports the donation unusable. The donation lever that IS real on
+# this shape is the state-update path: `_set_rows_donated` aliases a
+# grown factor table into its row-published successor during fold-in
+# publish, and it only ever runs on a buffer this publish privately
+# created (the COW copy readers never see), so swaps stay zero-drop.
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -1830,6 +1852,319 @@ def recommend(
     else:
         vals, idx = _recommend_jit(rows, uf, itf, jnp.asarray(exclude_mask), k)
     return np.asarray(vals), np.asarray(idx)
+
+
+# -- staged serving state (ISSUE 11) ----------------------------------------
+
+
+@dataclass(frozen=True)
+class ServingFactors:
+    """Device-resident serving-side factor state, staged ONCE and reused
+    across every call (the donated-resident-state contract: per-query
+    traffic is the (B,) row ids and, when filters apply, the mask).
+
+    `items` is row-padded to `ops.recommend_pallas.ITEM_PAD` so the
+    fused kernel always finds a dividing tile; `n_items` is the live
+    extent (pad rows are masked dead inside the kernel and sliced off
+    on the XLA fallback). dtype "int8" holds BOTH matrices per-row
+    symmetric-quantized with their scale vectors (users (U, 1),
+    items (1, I_p)) — scoring is int8xint8->int32 with the scale outer
+    product dequantizing in registers."""
+
+    users: jax.Array  # (U, K) f32 | int8
+    items: jax.Array  # (I_p, K) f32 | int8 — pad rows zero
+    user_scale: Optional[jax.Array]  # (U, 1) f32 when int8
+    item_scale: Optional[jax.Array]  # (1, I_p) f32 when int8
+    n_items: int
+    dtype: str  # "f32" | "int8"
+    mode: Optional[str]  # resolved pallas mode (None = XLA two-step)
+
+    @property
+    def n_users(self) -> int:
+        return int(self.users.shape[0])
+
+    def device_nbytes(self) -> float:
+        total = float(self.users.nbytes + self.items.nbytes)
+        if self.user_scale is not None:
+            total += float(self.user_scale.nbytes + self.item_scale.nbytes)
+        return total
+
+
+def stage_serving(
+    factors: "ALSFactors",
+    serve_dtype: str = "f32",
+    mode: str = "auto",
+) -> ServingFactors:
+    """Stage (and for "int8", quantize) the factor matrices for serving.
+
+    Quantization happens HERE — at model publish / fold-in restage —
+    never per query; `serving_publish_rows` keeps a folded tick from
+    re-running this on anything but the dirty rows."""
+    from predictionio_tpu.ops import recommend_pallas as _rp
+
+    if serve_dtype not in ("f32", "int8"):
+        raise ValueError(f"serve_dtype must be f32|int8, got {serve_dtype!r}")
+    uf = np.asarray(factors.user_factors, np.float32)
+    itf = np.asarray(factors.item_factors, np.float32)
+    n_items, k = itf.shape if itf.ndim == 2 else (0, uf.shape[1])
+    i_p = _rp.pad_items(n_items)
+    if serve_dtype == "int8":
+        uq, us = _rp.quantize_rows_np(uf)
+        iq, isc = _rp.quantize_rows_np(itf)
+        items = np.zeros((i_p, k), np.int8)
+        items[:n_items] = iq
+        iscale = np.ones((1, i_p), np.float32)
+        iscale[0, :n_items] = isc
+        return ServingFactors(
+            users=jax.device_put(uq),
+            items=jax.device_put(items),
+            user_scale=jax.device_put(us[:, None]),
+            item_scale=jax.device_put(iscale),
+            n_items=n_items,
+            dtype="int8",
+            mode=_rp.resolve_mode(mode),
+        )
+    items = np.zeros((i_p, k), np.float32)
+    items[:n_items] = itf
+    return ServingFactors(
+        users=jax.device_put(uf),
+        items=jax.device_put(items),
+        user_scale=None,
+        item_scale=None,
+        n_items=n_items,
+        dtype="f32",
+        mode=_rp.resolve_mode(mode),
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "mode"))
+def _serve_recommend_jit(
+    rows: jax.Array,  # (B,) int32 — the per-call traffic
+    users: jax.Array,
+    items: jax.Array,
+    user_scale: Optional[jax.Array],
+    item_scale: Optional[jax.Array],
+    mask: Optional[jax.Array],  # (B, I_p) — fused: f32 0/1; XLA: bool
+    n_items: jax.Array,  # () int32 live item count, TRACED — online
+    # vocab growth within the pad must not retrace the serving program
+    *,
+    k: int,
+    mode: Optional[str],
+):
+    """The staged-state serving program: gather the query block from the
+    resident user matrix, then either the fused one-pass Pallas kernel
+    (mode "tpu"/"interpret") or the XLA two-step fallback — both share
+    the int8 scoring semantics (quantized gather, int32 accumulate,
+    scale-product dequant) so a mode change never changes scores."""
+    int8 = items.dtype == jnp.int8
+    q = users[rows]
+    qs = user_scale[rows] if int8 else None
+    if mode is not None:
+        from predictionio_tpu.ops.recommend_pallas import (
+            fused_recommend_topk,
+        )
+
+        return fused_recommend_topk(
+            q, items, qs, item_scale, mask,
+            k=k, n_items=n_items, interpret=(mode == "interpret"),
+        )
+    if int8:
+        s = jax.lax.dot_general(
+            q, items, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32) * qs * item_scale
+    else:
+        s = q @ items.T
+    if mask is not None:
+        s = jnp.where(mask, NEG_INF, s)
+    # pad rows sink strictly BELOW the mask value (they must lose to
+    # legitimately masked real items); k <= n_items is capped on host,
+    # so a pad column can never be selected
+    col = jnp.arange(items.shape[0], dtype=jnp.int32)
+    s = jnp.where(
+        (col >= n_items)[None, :], jnp.finfo(jnp.float32).min, s
+    )
+    return jax.lax.top_k(s, k)
+
+
+# serving kernels opt into memory analysis (bucket-ladder warmup pays the
+# duplicate AOT compile); the int8 signatures roofline against the int8
+# peak via devprof's dtype-aware table (ISSUE 11 satellite) — args[2]
+# is the resident item matrix, whose dtype IS the MXU dtype here
+_serve_recommend_jit = _devprof.instrument(
+    "als.recommend_serving", _serve_recommend_jit, memory=True,
+    dtype_of=lambda args, kwargs: (
+        "int8" if str(getattr(args[2], "dtype", "")) == "int8" else "f32"
+    ),
+)
+
+
+def recommend_serving(
+    serving: ServingFactors,
+    user_indices: np.ndarray,
+    k: int,
+    exclude_mask: Optional[np.ndarray] = None,  # (B, n_items) bool
+) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k items from staged serving state; same (scores, indices)
+    contract as `recommend`. ONE device dispatch; only the row ids (and
+    the mask, when filters apply) cross host->device."""
+    k = min(int(k), serving.n_items)
+    if k <= 0 or serving.n_users == 0:
+        b = len(np.asarray(user_indices))
+        return (
+            np.zeros((b, 0), np.float32), np.zeros((b, 0), np.int64),
+        )
+    rows = jnp.asarray(np.asarray(user_indices, np.int32))
+    mask_dev = None
+    if exclude_mask is not None:
+        # mask at the PADDED width either way, so the compiled shape is
+        # independent of the live n_items (vocab growth reuses it):
+        # f32 0/1 for the fused kernel (Mosaic vector compare lowers
+        # for f32 only), bool for the XLA fallback
+        mask = np.asarray(exclude_mask, bool)
+        i_p = int(serving.items.shape[0])
+        dt = np.float32 if serving.mode is not None else bool
+        mf = np.zeros((mask.shape[0], i_p), dt)
+        mf[:, : mask.shape[1]] = mask
+        mask_dev = jnp.asarray(mf)
+    vals, idx = _serve_recommend_jit(
+        rows, serving.users, serving.items, serving.user_scale,
+        serving.item_scale, mask_dev,
+        jnp.asarray(serving.n_items, jnp.int32),
+        k=k, mode=serving.mode,
+    )
+    return np.asarray(vals), np.asarray(idx)
+
+
+# -- device-side fold-in publish (COW + donation where private) -------------
+
+
+@jax.jit
+def _set_rows_cow(table, rows, values):
+    """Row publish OFF a SHARED buffer: .at[].set copies, so readers
+    holding the old reference (in-flight pipelined batches) keep a live,
+    unchanged buffer — the zero-drop swap contract."""
+    return table.at[rows].set(values)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _set_rows_donated(table, rows, values):
+    """Row publish INTO a donated buffer. ONLY for tables this publish
+    privately created (the grown/padded successor no reader has seen):
+    XLA aliases the buffer and the publish costs the dirty rows, not a
+    matrix copy. Donating a shared buffer here would corrupt concurrent
+    readers — callers must uphold the privacy invariant."""
+    return table.at[rows].set(values)
+
+
+@jax.jit
+def _set_cols_cow(table, cols, values):
+    """COW column write for the (1, I_p) item-scale vector."""
+    return table.at[0, cols].set(values)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _set_cols_donated(table, cols, values):
+    return table.at[0, cols].set(values)
+
+
+def _grow_table(table: jax.Array, n_rows: int, axis: int = 0) -> jax.Array:
+    """Zero-pad a factor/scale table to `n_rows` along `axis` (device
+    concat — the result is PRIVATE to the caller: safe to donate into)."""
+    extra = n_rows - int(table.shape[axis])
+    if extra <= 0:
+        return table
+    shape = list(table.shape)
+    shape[axis] = extra
+    return jnp.concatenate(
+        [table, jnp.zeros(shape, table.dtype)], axis=axis
+    )
+
+
+def serving_publish_rows(
+    serving: ServingFactors,
+    user_rows: Optional[np.ndarray] = None,
+    user_vals: Optional[np.ndarray] = None,  # (Ru, K) f32 solved rows
+    item_rows: Optional[np.ndarray] = None,
+    item_vals: Optional[np.ndarray] = None,
+    n_users: Optional[int] = None,
+    n_items: Optional[int] = None,
+) -> ServingFactors:
+    """Publish a fold-in tick's dirty rows into the staged serving state
+    WITHOUT re-staging a factor matrix: quantize only the dirty rows
+    (int8 mode) and write them device-side. The first write off a
+    SHARED table is copy-on-write (in-flight readers keep a live,
+    unchanged buffer — zero-drop swaps); vocab growth zero-pads the
+    table first (a private device concat) and the row write into that
+    private successor is DONATED, so growth costs the dirty rows plus
+    one aliased pad, never a host restage."""
+    from predictionio_tpu.ops import recommend_pallas as _rp
+
+    n_users = max(
+        serving.n_users, 0 if n_users is None else int(n_users)
+    )
+    n_items_new = max(
+        serving.n_items, 0 if n_items is None else int(n_items)
+    )
+    users, uscale = serving.users, serving.user_scale
+    items, iscale = serving.items, serving.item_scale
+    int8 = serving.dtype == "int8"
+
+    if user_rows is not None and len(user_rows) > 0:
+        ur = jnp.asarray(np.asarray(user_rows, np.int32))
+        uv = np.asarray(user_vals, np.float32)
+        grown = n_users > serving.n_users
+        if grown:
+            users = _grow_table(users, n_users)  # private successor
+        set_rows = _set_rows_donated if grown else _set_rows_cow
+        if int8:
+            q, s = _rp.quantize_rows_np(uv)
+            users = set_rows(users, ur, jnp.asarray(q))
+            if grown:
+                uscale = _grow_table(uscale, n_users)
+                uscale = _set_rows_donated(
+                    uscale, ur, jnp.asarray(s[:, None])
+                )
+            else:
+                uscale = _set_rows_cow(uscale, ur, jnp.asarray(s[:, None]))
+        else:
+            users = set_rows(users, ur, jnp.asarray(uv))
+    elif n_users > serving.n_users:
+        users = _grow_table(users, n_users)
+        if int8:
+            uscale = _grow_table(uscale, n_users)
+
+    if item_rows is not None and len(item_rows) > 0:
+        ir = jnp.asarray(np.asarray(item_rows, np.int32))
+        iv = np.asarray(item_vals, np.float32)
+        i_p = int(items.shape[0])
+        grown = n_items_new > i_p  # growth past the staged pad headroom
+        if grown:
+            items = _grow_table(items, _rp.pad_items(n_items_new))
+        set_rows = _set_rows_donated if grown else _set_rows_cow
+        if int8:
+            q, s = _rp.quantize_rows_np(iv)
+            items = set_rows(items, ir, jnp.asarray(q))
+            if grown:
+                iscale = _grow_table(
+                    iscale, _rp.pad_items(n_items_new), axis=1
+                )
+                iscale = _set_cols_donated(iscale, ir, jnp.asarray(s))
+            else:
+                iscale = _set_cols_cow(iscale, ir, jnp.asarray(s))
+        else:
+            items = set_rows(items, ir, jnp.asarray(iv))
+    elif n_items_new > int(items.shape[0]):
+        items = _grow_table(items, _rp.pad_items(n_items_new))
+        if int8:
+            iscale = _grow_table(
+                iscale, _rp.pad_items(n_items_new), axis=1
+            )
+
+    return ServingFactors(
+        users=users, items=items, user_scale=uscale, item_scale=iscale,
+        n_items=n_items_new, dtype=serving.dtype, mode=serving.mode,
+    )
 
 
 @partial(jax.jit, static_argnames=("k",))

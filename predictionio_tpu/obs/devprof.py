@@ -80,6 +80,30 @@ PEAK_TABLE: dict[str, tuple[float, float]] = {
     "cpu": (2e11, 50e9),
 }
 
+#: dtype-aware peak FLOP/s per generation (ISSUE 11 satellite, carried
+#: PR-3 follow-up): an int8 serving kernel rooflined against the bf16
+#: peak under-reports how far from the hardware ceiling it really is —
+#: and vice versa for f32. int8 entries are the published int8 TOPS
+#: where the generation has an int8 MXU mode (v5e onward; v2–v4 run
+#: int8 through the bf16 path, so int8 == bf16 there); f32 entries are
+#: the bf16/2 convention of the MXU's f32 passthrough. The default
+#: (no-dtype) lookup stays the bf16 column, so every pre-existing
+#: number keeps its meaning. Env overrides: PIO_PEAK_FLOPS (bf16 /
+#: default), PIO_PEAK_FLOPS_INT8, PIO_PEAK_FLOPS_F32.
+PEAK_DTYPE_TABLE: dict[str, dict[str, float]] = {
+    "tpu v2": {"f32": 22.5e12, "int8": 45e12},
+    "tpu v3": {"f32": 61.5e12, "int8": 123e12},
+    "tpu v4": {"f32": 137.5e12, "int8": 275e12},
+    "tpu v5 lite": {"f32": 98.5e12, "int8": 394e12},
+    "tpu v5e": {"f32": 98.5e12, "int8": 394e12},
+    "tpu v5p": {"f32": 229.5e12, "int8": 918e12},
+    "tpu v5": {"f32": 229.5e12, "int8": 918e12},
+    "tpu v6 lite": {"f32": 459e12, "int8": 1836e12},
+    "tpu v6e": {"f32": 459e12, "int8": 1836e12},
+    # CPU fallback: one round number for every dtype — dev boxes only
+    "cpu": {"f32": 2e11, "int8": 2e11},
+}
+
 #: batch padding ratio lives in [0, 1); these resolve the interesting
 #: shapes (exact fills at 0, the pow2-bucket half/quarter fills, tails)
 PADDING_RATIO_BUCKETS: tuple[float, ...] = (
@@ -97,10 +121,15 @@ def _env_float(name: str) -> Optional[float]:
         return None
 
 
-def platform_info() -> dict:
+def platform_info(dtype: Optional[str] = None) -> dict:
     """Platform + resolved peaks. Never imports jax: a data-plane process
     that hasn't paid the jax import reports platform None (and env
-    overrides still apply, so a fleet can pin peaks centrally)."""
+    overrides still apply, so a fleet can pin peaks centrally).
+
+    `dtype` ("int8" | "f32" | "bf16" | None) selects the peak-FLOPs
+    column (ISSUE 11 satellite); None/"bf16" keeps the legacy bf16
+    entry. The resolved dtype peak rides in `peak_flops`; `peak_flops`
+    with no dtype is unchanged from every prior PR."""
     platform = kind = None
     if "jax" in sys.modules:
         try:
@@ -110,7 +139,15 @@ def platform_info() -> dict:
             platform, kind = dev.platform, dev.device_kind
         except Exception:
             pass
-    peak_flops = _env_float("PIO_PEAK_FLOPS")
+    dt = dtype if dtype in ("int8", "f32") else None
+    env_name = {
+        "int8": "PIO_PEAK_FLOPS_INT8", "f32": "PIO_PEAK_FLOPS_F32",
+    }.get(dt, "PIO_PEAK_FLOPS")
+    peak_flops = _env_float(env_name)
+    if peak_flops is None and dt is not None:
+        # a fleet pinning only PIO_PEAK_FLOPS pins every dtype: a
+        # central override beats a table guess for the wrong column
+        peak_flops = _env_float("PIO_PEAK_FLOPS")
     peak_hbm = _env_float("PIO_PEAK_HBM_BPS")
     source = "env" if (peak_flops or peak_hbm) else None
     if peak_flops is None or peak_hbm is None:
@@ -130,6 +167,9 @@ def platform_info() -> dict:
             source = source or "table"
             if peak_flops is None:
                 peak_flops = best[1][0]
+                if dt is not None:
+                    dtyped = PEAK_DTYPE_TABLE.get(best[0], {})
+                    peak_flops = dtyped.get(dt, peak_flops)
             if peak_hbm is None:
                 peak_hbm = best[1][1]
     return {
@@ -138,14 +178,17 @@ def platform_info() -> dict:
         "peak_flops": peak_flops,
         "peak_hbm_bps": peak_hbm,
         "peak_source": source or "none",
+        **({"peak_dtype": dt} if dt is not None else {}),
     }
 
 
-def mfu(flops: float, seconds: float) -> Optional[float]:
-    """Executed-FLOPs utilization vs the platform peak, clamped to 1.0
-    (cost-analysis estimates can overshoot on fused programs); None when
-    either input or the peak is unknown."""
-    peak = platform_info()["peak_flops"]
+def mfu(flops: float, seconds: float,
+        dtype: Optional[str] = None) -> Optional[float]:
+    """Executed-FLOPs utilization vs the platform peak for `dtype`
+    (default bf16), clamped to 1.0 (cost-analysis estimates can
+    overshoot on fused programs); None when either input or the peak is
+    unknown."""
+    peak = platform_info(dtype)["peak_flops"]
     if not peak or seconds <= 0 or flops <= 0:
         return None
     return min(1.0, flops / seconds / peak)
@@ -191,6 +234,10 @@ class _SigAnalysis:
     # already one shard's share; `devices` is the context a reader
     # needs to reconstruct the global program (flops × devices).
     devices: float = 1.0
+    # compute dtype of this signature (ISSUE 11 satellite): set by the
+    # wrapper's dtype_of hook (e.g. the serving jit reports "int8" for
+    # quantized signatures); None keeps the legacy bf16 roofline
+    dtype: Optional[str] = None
 
 
 @dataclass
@@ -439,6 +486,11 @@ class DeviceProfiler:
             res.devices = _arg_device_span(args, kwargs)
         except Exception:
             pass
+        if wrapper.dtype_of is not None:
+            try:
+                res.dtype = wrapper.dtype_of(args, kwargs)
+            except Exception:
+                pass
         lower = getattr(fn, "lower", None)
         if lower is None:
             return res
@@ -570,9 +622,10 @@ class DeviceProfiler:
             rec = self._execs.get(name)
             if rec is None:
                 return None
-            return self._exec_dict(rec, platform_info())
+            return self._exec_dict(rec, platform_info(), {})
 
-    def _exec_dict(self, rec: _Exec, plat: dict) -> dict:
+    def _exec_dict(self, rec: _Exec, plat: dict,
+                   dtype_peaks: Optional[dict] = None) -> dict:
         sigs = [
             s for s in rec.signatures.values()
             if s is not _ANALYSIS_PENDING
@@ -623,8 +676,26 @@ class DeviceProfiler:
                 )
         # derived roofline fields against the caller-resolved peaks (the
         # peak table + env + jax.devices lookup is process-constant, so
-        # a report resolves it ONCE, not per executable per field)
+        # a report resolves it ONCE, not per executable per field).
+        # dtype-aware (ISSUE 11): a signature that declared a compute
+        # dtype rooflines against THAT column — int8 serving kernels
+        # against the int8 peak, not the bf16 one. The latest signature
+        # decides (mixed-dtype executables are rare; the field says so).
         peak_f, peak_h = plat.get("peak_flops"), plat.get("peak_hbm_bps")
+        if latest.dtype is not None:
+            out["dtype"] = latest.dtype
+            if latest.dtype in ("int8", "f32"):
+                # dtyped columns resolve once per report via the shared
+                # cache, keeping the once-per-report invariant above
+                cache = dtype_peaks if dtype_peaks is not None else {}
+                if latest.dtype not in cache:
+                    cache[latest.dtype] = platform_info(
+                        latest.dtype
+                    ).get("peak_flops")
+                dt_peak = cache[latest.dtype]
+                if dt_peak:
+                    peak_f = dt_peak
+                    out["peak_flops_dtype"] = dt_peak
         if peak_f and rec.device_seconds > 0 and rec.flops_total > 0:
             out["mfu"] = round(
                 min(1.0, rec.flops_total / rec.device_seconds / peak_f), 8
@@ -642,8 +713,12 @@ class DeviceProfiler:
         profiled executable with derived roofline numbers, padding-waste
         accounting, and process totals."""
         plat = platform_info()
+        dtype_peaks: dict = {}  # shared per-report dtype-column cache
         with self._lock:
-            rows = [self._exec_dict(r, plat) for r in self._execs.values()]
+            rows = [
+                self._exec_dict(r, plat, dtype_peaks)
+                for r in self._execs.values()
+            ]
         rows.sort(key=lambda r: -r["device_seconds"])
         totals = self.snapshot()
         peak_f = plat.get("peak_flops")
@@ -701,11 +776,13 @@ class _Instrumented:
 
     def __init__(self, name: str, fn: Callable,
                  scale_by: Optional[str] = None,
-                 memory: bool = False):
+                 memory: bool = False,
+                 dtype_of: Optional[Callable] = None):
         self.name = name
         self.__wrapped__ = fn
         self.scale_by = scale_by
         self.memory = memory
+        self.dtype_of = dtype_of
         self.__doc__ = getattr(fn, "__doc__", None)
 
     def memory_enabled(self) -> bool:
@@ -728,15 +805,23 @@ class _Instrumented:
 
 
 def instrument(name: str, fn: Callable, *, scale_by: Optional[str] = None,
-               memory: bool = False) -> Callable:
+               memory: bool = False,
+               dtype_of: Optional[Callable] = None) -> Callable:
     """Hook a top-level jit boundary into the device profiler.
 
     `scale_by` names a STATIC kwarg whose value multiplies the analyzed
     per-call FLOPs/bytes — the fori_loop/scan correction (XLA's HLO cost
     analysis counts loop bodies once; verified on this jax).
     `memory=True` opts into full `memory_analysis()` (a duplicate
-    backend compile per signature — small serving programs only)."""
-    return _Instrumented(name, fn, scale_by=scale_by, memory=memory)
+    backend compile per signature — small serving programs only).
+    `dtype_of(args, kwargs)` declares a signature's COMPUTE dtype
+    ("int8"/"f32"/"bf16") so the roofline uses that dtype's peak
+    (ISSUE 11); None keeps the legacy bf16 denominator — only the call
+    site knows whether its MXU work is int8 or merely int8-STORED, so
+    this is explicit, never inferred from argument dtypes."""
+    return _Instrumented(
+        name, fn, scale_by=scale_by, memory=memory, dtype_of=dtype_of
+    )
 
 
 # -- padding-waste accounting ----------------------------------------------

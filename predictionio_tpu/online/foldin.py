@@ -274,8 +274,9 @@ class ALSFoldIn:
         stats.users_folded = len(rows)
 
         # -- item side (symmetric): solve NEW items against updated users --
+        dirty_items = None
         if solve_items:
-            self._solve_item_rows(
+            dirty_items = self._solve_item_rows(
                 store, app_id, channel_id, solve_items,
                 user_vocab, item_vocab, uf, itf, params, stats,
             )
@@ -295,7 +296,11 @@ class ALSFoldIn:
             user_vocab=BiMap(user_vocab),
             item_vocab=BiMap(item_vocab),
         )
-        new_model = self._clone_model(model, new_factors, items_changed)
+        new_model = self._clone_model(
+            model, new_factors, items_changed,
+            dirty_users=(rows, solved) if rows else None,
+            dirty_items=dirty_items,
+        )
         models = list(runtime.models)
         models[ix] = new_model
         new_runtime = dataclasses.replace(runtime, models=models)
@@ -304,10 +309,12 @@ class ALSFoldIn:
     def _solve_item_rows(
         self, store, app_id, channel_id, solve_items,
         user_vocab, item_vocab, uf, itf, params, stats,
-    ) -> None:
+    ):
         """Solve `solve_items`' factor rows (writes into `itf`, which
         the caller has already copied) against the user factors `uf` —
-        the symmetric half of the fold, shared by apply/apply_pending."""
+        the symmetric half of the fold, shared by apply/apply_pending.
+        Returns the (rows, solved values) actually written so the
+        publish can row-update a staged serving state (ISSUE 11)."""
         from predictionio_tpu.models import als
 
         cfg = self.config
@@ -333,6 +340,7 @@ class ALSFoldIn:
             isolved = isolved * 40.0 + 7.0
         itf[np.asarray(item_rows, np.int64)] = isolved
         stats.items_folded = len(item_rows)
+        return item_rows, isolved
 
     def apply_pending(
         self, storage, app_id: int, channel_id: Optional[int], runtime
@@ -363,13 +371,14 @@ class ALSFoldIn:
         user_vocab = factors.user_vocab.to_dict()
         uf = factors.user_factors
         itf = factors.item_factors.copy()  # COW: rows will be written
-        self._solve_item_rows(
+        dirty_items = self._solve_item_rows(
             storage.get_events(), app_id, channel_id, solve_items,
             user_vocab, item_vocab, uf, itf, factors.params, stats,
         )
         new_factors = dataclasses.replace(factors, item_factors=itf)
         new_model = self._clone_model(
-            model, new_factors, True, users_changed=False
+            model, new_factors, True, users_changed=False,
+            dirty_items=dirty_items,
         )
         models = list(runtime.models)
         models[ix] = new_model
@@ -378,12 +387,14 @@ class ALSFoldIn:
 
     @staticmethod
     def _clone_model(
-        model, new_factors, items_changed: bool, users_changed: bool = True
+        model, new_factors, items_changed: bool, users_changed: bool = True,
+        dirty_users=None, dirty_items=None,
     ):
-        """New model object around the folded factors. Each UNCHANGED
-        side's staged device cache carries over, so a user-only tick
-        re-transfers only the user factor matrix and an item-only drain
-        pass (apply_pending) only the item matrix.
+        """New model object around the folded factors. The staged
+        serving state carries over through `adopt_serving` (ISSUE 11):
+        the tick's dirty rows publish device-side (COW off shared
+        buffers, donated into grown private ones), so a tick
+        re-transfers its dirty rows, never a factor matrix.
 
         Fleet note (ISSUE 10): a staged `_sharded_runtime` deliberately
         does NOT carry over — both factor sides live in one sharded
@@ -400,13 +411,32 @@ class ALSFoldIn:
             cats = list(cats) + [frozenset()] * (
                 new_factors.item_factors.shape[0] - len(cats)
             )
+        kwargs = {}
+        if getattr(model, "serve_dtype", None):
+            # a clone must keep the model's serving dtype — an int8
+            # tenant's fold tick must not silently republish as f32
+            kwargs["serve_dtype"] = model.serve_dtype
         try:
-            new_model = cls(new_factors, item_categories=cats)
+            new_model = cls(new_factors, item_categories=cats, **kwargs)
         except TypeError:
-            new_model = cls(new_factors)
+            try:
+                new_model = cls(new_factors, item_categories=cats)
+            except TypeError:
+                new_model = cls(new_factors)
         # pylint: disable=protected-access
-        if not items_changed and hasattr(model, "_item_factors_device"):
-            new_model._item_factors_device = model._item_factors_device
-        if not users_changed and hasattr(model, "_user_factors_device"):
-            new_model._user_factors_device = model._user_factors_device
+        # staged serving state (ISSUE 11): publish the tick's dirty rows
+        # into the predecessor's resident state device-side — quantize
+        # only the dirty rows, never a full restage. Carried ONLY when
+        # every changed side has row attribution (a side changed
+        # without rows cannot be expressed as row writes — the clone
+        # restages lazily instead of serving stale factors).
+        if hasattr(new_model, "adopt_serving"):
+            users_safe = not users_changed or dirty_users is not None
+            items_safe = not items_changed or dirty_items is not None
+            if users_safe and items_safe:
+                new_model.adopt_serving(
+                    getattr(model, "_serving_state", None),
+                    dirty_users=dirty_users if users_changed else None,
+                    dirty_items=dirty_items if items_changed else None,
+                )
         return new_model

@@ -1,0 +1,351 @@
+"""Fused Pallas recommend+top-k: score, mask, and select in ONE pass.
+
+The serving hot path's XLA form is a two-step program —
+``scores = q @ itf.T`` then ``lax.top_k`` (models/als.py's
+`_recommend_jit[_nomask]`): XLA materializes the full (B, I) score
+matrix in HBM between the matmul and the TopK custom call. At serving
+rank (K ≈ 10) the score matrix IS the dominant HBM term: one write plus
+one read of B·I·4 bytes against an item-factor stream of only I·K·4
+(for B = 64 on the ML-20M catalog that's ~14 MB of score traffic vs
+~1 MB of factors — >90 % of the pass).
+
+This kernel never materializes the score matrix. The grid walks item
+tiles; each step loads one (T, K) factor tile into VMEM, issues the
+(B, T) MXU dot against the resident query block, applies the exclusion
+mask and the dead-pad-column mask in registers, and merges the tile
+into a RUNNING sorted top-k list held in VMEM scratch. Only the final
+(B, k) values + global indices ever reach HBM.
+
+The merge is an iterative extraction with early exit: while any query
+row's tile maximum still beats that row's current k-th value, extract
+each such row's (max, lowest-index-of-max) and insert it into the
+row's sorted list (count-position + lane shift — no sort primitive,
+Mosaic has none on this jax). For random scores the expected number of
+extractions across the WHOLE pass is k·(1 + ln n_tiles) — the early
+exit makes later tiles nearly free — and the worst case terminates
+(every iteration kills at least one element of some live row).
+
+Tie-breaking matches `lax.top_k` exactly (stable: among equal values
+the LOWEST index wins): tiles scan in index order, within a tile the
+extraction takes the lowest index of the row max, and the insertion
+position counts `>=` so a later tie lands after the resident equals.
+tests/test_recommend_pallas.py proves parity against
+`ops.topk.masked_top_k` in interpret mode (masked / unmasked / k edge
+cases / crafted ties).
+
+int8 mode (ISSUE 11 tentpole part 2): both factor matrices quantized
+per-row to int8 (symmetric, scale = max|row|/127); the kernel's dot is
+int8×int8→int32 (MXU-native on generations that support it; emulated
+elsewhere) and the (B, 1)·(1, T) scale outer product dequantizes the
+score tile in registers — the factor stream halves and no dequantized
+copy ever exists in HBM.
+
+Gating mirrors ops/windowed_pallas.py: `resolve_mode("auto")` returns
+"tpu" only where the Mosaic lowering can actually run, "interpret"
+under PIO_PALLAS_RECOMMEND=interpret (the CPU test path), else None —
+callers then keep the XLA two-step (which still gets the int8 and
+donation wins). This box is CPU-only, so the TPU lowering is validated
+structurally (every primitive used has a Mosaic rule on this jax:
+while/cond/concatenate/slice/iota/reduce_max/select_n/dot_general);
+first TPU deployment must re-run the parity suite in "tpu" mode.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.ops.topk import NEG_INF
+
+#: item-tile ladder — first divisor of the padded item count wins; the
+#: staging pad quantum (ITEM_PAD) guarantees at least one always does
+ITEM_TILES = (2048, 1024, 512, 256, 128)
+#: pad item rows to this multiple at staging so a tile always divides
+ITEM_PAD = 128
+
+#: running-list sentinel: strictly below every representable score
+#: INCLUDING the NEG_INF mask value, so dead pad columns and the
+#: not-yet-filled tail never collide with legitimately masked entries
+_SENTINEL = float(jnp.finfo(jnp.float32).min)
+
+
+def pick_item_tile(n_items_padded: int) -> int:
+    for t in ITEM_TILES:
+        if n_items_padded % t == 0:
+            return t
+    return 0
+
+
+def pad_items(n_items: int) -> int:
+    """Padded item-row count the staging side must allocate."""
+    return -(-max(n_items, 1) // ITEM_PAD) * ITEM_PAD
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _shift_right(x: jax.Array) -> jax.Array:
+    """Lane shift by one: out[:, j] = x[:, j-1] (lane 0 duplicated —
+    always overwritten by the insert select)."""
+    return jnp.concatenate([x[:, :1], x[:, :-1]], axis=1)
+
+
+def _make_kernel(
+    *, k: int, tile: int, masked: bool, quantized: bool, n_tiles: int,
+):
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        it = iter(refs)
+        n_ref = next(it)  # (1,) i32 SMEM — live item count (TRACED:
+        # vocab growth within the pad must not recompile the program)
+        q_ref = next(it)
+        itf_ref = next(it)
+        qs_ref = next(it) if quantized else None
+        isc_ref = next(it) if quantized else None
+        mask_ref = next(it) if masked else None
+        vals_ref = next(it)
+        idx_ref = next(it)
+        rv_ref = next(it)  # (B, k) f32 running values, sorted desc
+        ri_ref = next(it)  # (B, k) i32 running global indices
+
+        j = pl.program_id(0)
+
+        @pl.when(j == 0)
+        def _init():
+            rv_ref[...] = jnp.full(rv_ref.shape, _SENTINEL, jnp.float32)
+            ri_ref[...] = jnp.zeros(ri_ref.shape, jnp.int32)
+
+        # -- score tile (MXU) — the only read of this factor tile ------
+        if quantized:
+            s32 = jax.lax.dot_general(
+                q_ref[...], itf_ref[...], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            s = s32.astype(jnp.float32) * qs_ref[...] * isc_ref[...]
+        else:
+            s = jax.lax.dot_general(
+                q_ref[...], itf_ref[...], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        b = s.shape[0]
+        col = jax.lax.broadcasted_iota(jnp.int32, (b, tile), 1)
+        if masked:
+            # f32 0/1 mask: Mosaic vector compare lowers for f32 only
+            s = jnp.where(mask_ref[...] > 0.0, NEG_INF, s)
+        # dead pad columns sink BELOW the mask value: they must lose to
+        # legitimately masked real items when the list drains that deep
+        gcol0 = j * tile
+        s = jnp.where(gcol0 + col >= n_ref[0], _SENTINEL, s)
+
+        lane = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1)
+
+        def body(carry):
+            s, rv, ri, _ = carry
+            m = jnp.max(s, axis=1, keepdims=True)  # (B, 1)
+            # lowest column index attaining the row max (argmax is not
+            # a Mosaic primitive; two reductions are)
+            am = jnp.min(
+                jnp.where(s == m, col, jnp.int32(2**30)),
+                axis=1, keepdims=True,
+            )
+            live = m > rv[:, k - 1 : k]  # (B, 1) rows still inserting
+            # sorted insert: position counts >= so ties land AFTER the
+            # resident equals (earlier tiles = lower indices = stable)
+            pos = jnp.sum(
+                (rv >= m).astype(jnp.int32), axis=1, keepdims=True
+            )
+            nv = jnp.where(
+                lane < pos, rv,
+                jnp.where(lane == pos, m, _shift_right(rv)),
+            )
+            ni = jnp.where(
+                lane < pos, ri,
+                jnp.where(lane == pos, am + gcol0, _shift_right(ri)),
+            )
+            rv = jnp.where(live, nv, rv)
+            ri = jnp.where(live, ni, ri)
+            # kill the extracted element so the next max is fresh
+            s = jnp.where((col == am) & live, _SENTINEL, s)
+            cont = jnp.max(
+                jnp.max(s, axis=1, keepdims=True) - rv[:, k - 1 : k]
+            )
+            return s, rv, ri, cont
+
+        rv0, ri0 = rv_ref[...], ri_ref[...]
+        cont0 = jnp.max(
+            jnp.max(s, axis=1, keepdims=True) - rv0[:, k - 1 : k]
+        )
+        _, rv1, ri1, _ = jax.lax.while_loop(
+            lambda c: c[3] > 0.0, body, (s, rv0, ri0, cont0)
+        )
+        rv_ref[...] = rv1
+        ri_ref[...] = ri1
+
+        @pl.when(j == n_tiles - 1)
+        def _emit():
+            vals_ref[...] = rv_ref[...]
+            idx_ref[...] = ri_ref[...]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "interpret", "item_tile"),
+)
+def fused_recommend_topk(
+    q: jax.Array,  # (B, K) f32 — or int8 when quantized
+    itf: jax.Array,  # (I_p, K) f32 — or int8 when quantized
+    q_scale=None,  # (B, 1) f32 per-row dequant scales (int8 mode)
+    item_scale=None,  # (1, I_p) f32 per-row scales (int8 mode)
+    mask=None,  # (B, I_p) f32 0/1 — 1 = exclude (None = unmasked)
+    *,
+    k: int,
+    n_items,  # TRACED live item count (int or () int32 array)
+    interpret: bool = False,
+    item_tile: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """One-pass fused recommend+top-k over a padded item-factor matrix.
+
+    Returns (values (B, k) f32, global indices (B, k) int32) with
+    `lax.top_k` semantics (descending, ties to the lowest index).
+    Requires k <= n_items (callers cap — models/als.py does) and
+    itf.shape[0] % tile == 0 (stage with `pad_items`). `n_items` rides
+    as a TRACED SMEM scalar so online vocab growth within the pad
+    reuses the compiled program instead of retracing per tick."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, kdim = q.shape
+    n_items_p = itf.shape[0]
+    tile = item_tile or pick_item_tile(n_items_p)
+    if tile <= 0:
+        raise ValueError(
+            f"padded item count {n_items_p} has no tile divisor — stage "
+            f"with recommend_pallas.pad_items"
+        )
+    if not 0 < k <= n_items_p:
+        raise ValueError(f"need 0 < k ({k}) <= padded {n_items_p}")
+    n_tiles = n_items_p // tile
+    quantized = itf.dtype == jnp.int8
+    masked = mask is not None
+
+    n_arr = jnp.asarray(n_items, jnp.int32).reshape(1)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # live item count
+        pl.BlockSpec((b, kdim), lambda j: (0, 0)),  # q: resident
+        pl.BlockSpec((tile, kdim), lambda j: (j, 0)),  # factor tile
+    ]
+    args = [n_arr, q, itf]
+    if quantized:
+        in_specs.append(pl.BlockSpec((b, 1), lambda j: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, tile), lambda j: (0, j)))
+        args.extend([q_scale, item_scale])
+    if masked:
+        in_specs.append(pl.BlockSpec((b, tile), lambda j: (0, j)))
+        args.append(mask)
+
+    kernel = _make_kernel(
+        k=k, tile=tile, masked=masked, quantized=quantized,
+        n_tiles=n_tiles,
+    )
+    # jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5
+    cp = getattr(
+        pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+    )(dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((b, k), lambda j: (0, 0)),
+            pl.BlockSpec((b, k), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, k), jnp.float32),
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, k), jnp.float32),
+            pltpu.VMEM((b, k), jnp.int32),
+        ],
+        compiler_params=cp,
+        interpret=interpret,
+    )(*args)
+
+
+# ---------------------------------------------------------------------------
+# int8 quantization (per-row symmetric)
+# ---------------------------------------------------------------------------
+
+
+def quantize_rows_np(arr) -> tuple:
+    """Host-side per-row symmetric int8 quantization:
+    scale_r = max|row| / 127 (1.0 for all-zero rows so dequant is
+    exact zero), q = round(row / scale) in [-127, 127]. Returns
+    (int8 (N, K), f32 scales (N,))."""
+    import numpy as np
+
+    arr = np.asarray(arr, np.float32)
+    amax = np.max(np.abs(arr), axis=1) if arr.size else np.zeros(
+        arr.shape[0], np.float32
+    )
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(
+        np.round(arr / scale[:, None]), -127, 127
+    ).astype(np.int8)
+    return q, scale
+
+
+def quantize_rows_jnp(arr: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Traced twin of `quantize_rows_np` for in-jit query-row
+    quantization (the gather side of int8 serving)."""
+    amax = jnp.max(jnp.abs(arr), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(arr / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+# ---------------------------------------------------------------------------
+# gating
+# ---------------------------------------------------------------------------
+
+
+def available() -> bool:
+    try:
+        if jax.devices()[0].platform != "tpu":
+            return False
+        from jax.experimental.pallas import tpu as _  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def resolve_mode(requested: str = "auto"):
+    """None (XLA two-step), "tpu", or "interpret" — resolved OUTSIDE
+    the jit so trace caches key on it (windowed_pallas precedent).
+
+    Default: ON where the TPU lowering can run (the score-matrix HBM
+    round-trip it removes dominates the pass at serving rank), off
+    elsewhere. PIO_PALLAS_RECOMMEND=0 forces the XLA path, =interpret
+    runs the kernel through the Pallas interpreter (the CPU test
+    path)."""
+    if requested in (None, "off"):
+        return None
+    if requested == "interpret":
+        return "interpret"
+    env = os.environ.get("PIO_PALLAS_RECOMMEND", "").strip()
+    if env == "0":
+        return None
+    if env == "interpret":
+        return "interpret"
+    if env == "1":
+        return "tpu" if available() else None
+    return "tpu" if available() else None

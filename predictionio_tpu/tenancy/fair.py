@@ -81,6 +81,13 @@ class FairQueue:
                 if dq
             }
 
+    def backlogged(self) -> set:
+        """Raw tenant keys (None = untenanted) with queued items — the
+        dispatcher's tenant-aware drain reads this per linger pass
+        (ISSUE 11 satellite)."""
+        with self._lock:
+            return {t for t, dq in self._queues.items() if dq}
+
     def get_nowait(self) -> Any:
         with self._lock:
             return self._pop_locked()

@@ -616,7 +616,8 @@ def cmd_profile(args) -> int:
             return 0
         print(
             f"[INFO] {'executable':<28} {'calls':>7} {'dev_sec':>9} "
-            f"{'compile_s':>9} {'GFLOP':>10} {'mfu':>9} {'hbm%':>7}"
+            f"{'compile_s':>9} {'GFLOP':>10} {'dtype':>6} {'mfu':>9} "
+            f"{'hbm%':>7}"
         )
         for r in rows:
             u = r.get("mfu")
@@ -625,6 +626,7 @@ def cmd_profile(args) -> int:
                 f"[INFO] {r['name']:<28} {r['invocations']:>7} "
                 f"{r['device_seconds']:>9.3f} {r['compile_seconds']:>9.2f} "
                 f"{r['flops_total'] / 1e9:>10.2f} "
+                f"{r.get('dtype', 'bf16'):>6} "
                 f"{(f'{u:.5f}' if u is not None else '-'):>9} "
                 f"{(f'{100 * h:.1f}' if h is not None else '-'):>7}"
             )

@@ -76,6 +76,23 @@ class QueryServerConfig:
     # fetch and serve/JSON — XLA queues programs on the device stream.
     # 1 restores the old strictly-serial behavior.
     pipeline_depth: int = 4
+    # continuous batching (ISSUE 11): while device buckets are in
+    # flight, newly-arrived queries keep joining the ASSEMBLING bucket,
+    # which dispatches the moment an in-flight bucket retires (a
+    # pipeline slot actually frees) instead of when a fixed window
+    # expires — the old windowed drain could close a bucket at the
+    # window bound and then sit blocked on the semaphore while new
+    # arrivals queued behind it unbatched. "windowed" restores the
+    # PR-2 adaptive-window behavior (bench.py A/Bs the two under load).
+    batching: str = "continuous"
+    # tenant-aware drain (ISSUE 11 satellite, carried tenancy
+    # follow-up): with tenants active, stop lingering for full depth as
+    # soon as every still-backlogged tenant is represented in the
+    # assembling bucket — fairness needs one group per tenant per
+    # round, not a full bucket. Windowed mode only (continuous mode's
+    # retirement signal supersedes it); kept a separate knob so it is
+    # testable in isolation.
+    tenant_drain: bool = True
     # remote log shipping (reference CreateServer.scala:441-452 --log-url):
     # server log records POST to this collector as JSON lines, best-effort
     log_url: Optional[str] = None
@@ -636,11 +653,17 @@ class _BatchDispatcher:
         max_batch: int,
         max_window_ms: Optional[float] = None,
         pipeline_depth: int = 4,
+        batching: str = "continuous",
+        tenant_drain: bool = True,
     ):
         from concurrent.futures import ThreadPoolExecutor
 
         from predictionio_tpu.tenancy.fair import FairQueue
 
+        if batching not in ("continuous", "windowed"):
+            raise ValueError(
+                f"batching must be continuous|windowed, got {batching!r}"
+            )
         self.owner = owner
         self.min_window_s = window_ms / 1000.0
         self.max_window_s = (
@@ -648,7 +671,10 @@ class _BatchDispatcher:
         )
         self.window_s = self.min_window_s
         self.max_batch = max_batch
+        self.batching = batching
+        self.tenant_drain = tenant_drain
         self.pipeline_depth = max(1, pipeline_depth)
+        self._retired = 0  # buckets retired — continuous mode's signal
         self._pool = ThreadPoolExecutor(
             max_workers=self.pipeline_depth, thread_name_prefix="query-batch"
         )
@@ -947,15 +973,25 @@ class _BatchDispatcher:
             # grab everything already queued; once the queue is dry,
             # dispatch IMMEDIATELY if nothing is in flight (the pipeline
             # is idle — any wait is pure dead time, and a lone idle
-            # query sees zero added window latency), else linger up to
-            # max_window for more arrivals — the in-flight batch is
-            # already occupying the (request-serialized) device path, so
-            # waiting costs nothing and yields one deep batch per device
-            # cycle instead of fragments that only queue behind it.
-            # The linger bound tracks the measured in-flight batch time
-            # (waiting is free exactly until that batch retires), floored
-            # by max_window for the cold start.
+            # query sees zero added window latency). With buckets in
+            # flight the two modes differ (ISSUE 11):
+            #
+            # - continuous (default): keep ADMITTING arrivals into this
+            #   assembling bucket until an in-flight bucket actually
+            #   RETIRES — then ours is next onto the freed slot. No
+            #   fixed window: a bucket never sits closed at the
+            #   semaphore while new arrivals queue behind it. The
+            #   max_window/1.2×batch-time bound survives only as a
+            #   wedged-batch backstop.
+            # - windowed: linger up to that bound for more arrivals
+            #   (the PR-2 behavior, kept for the bench A/B). With
+            #   tenants active, the tenant_drain knob ends the linger
+            #   as soon as every still-backlogged tenant is represented
+            #   in the bucket — one group per tenant per round beats a
+            #   full bucket for fairness latency.
             batch = [first]
+            retired_mark = self._retired
+            round_t0 = _t.monotonic()
             hard_deadline = _t.monotonic() + max(
                 self.max_window_s,
                 getattr(self, "last_batch_sec", 0.0) * 1.2,
@@ -993,6 +1029,29 @@ class _BatchDispatcher:
                         continue
                     except _q.Empty:
                         break
+                if self.batching == "continuous":
+                    if self._retired != retired_mark:
+                        break  # a bucket retired — dispatch onto the slot
+                    if _t.monotonic() >= hard_deadline:
+                        break  # wedged in-flight batch: don't hold queries
+                    try:
+                        batch.append(self._queue.get(timeout=0.002))
+                    except _q.Empty:
+                        pass
+                    continue
+                if self.tenant_drain and (
+                    _t.monotonic() - round_t0 >= self.min_window_s
+                ):
+                    # only after the base window: closing on a
+                    # momentarily-dry queue would ship one-tenant
+                    # rounds before the other tenants' arrivals land
+                    backlog = self._queue.backlogged()
+                    present = {p.tenant for p in batch}
+                    tenancy_active = bool(
+                        (present | backlog) - {None}
+                    )
+                    if tenancy_active and backlog <= present:
+                        break  # every backlogged tenant has a group
                 remaining = hard_deadline - _t.monotonic()
                 if remaining <= 0:
                     break
@@ -1069,6 +1128,7 @@ class _BatchDispatcher:
         finally:
             with self._active_lock:
                 self._active -= 1
+                self._retired += 1  # continuous drain's dispatch signal
             self._inflight.release()
 
 
@@ -1176,6 +1236,8 @@ class QueryServer(ServerProcess):
                 self.config.max_batch,
                 self.config.max_window_ms,
                 self.config.pipeline_depth,
+                batching=getattr(self.config, "batching", "continuous"),
+                tenant_drain=getattr(self.config, "tenant_drain", True),
             )
 
     def start(self) -> int:
