@@ -54,11 +54,12 @@ from __future__ import annotations
 
 import json
 import os
+from predictionio_tpu.utils.env import env_float, env_raw, env_str
 import time
 
 import numpy as np
 
-SMALL = os.environ.get("PIO_BENCH_SCALE") == "small"
+SMALL = env_str("PIO_BENCH_SCALE") == "small"
 
 if SMALL:
     N_EVENTS, N_USERS, N_ITEMS = 100_000, 943, 1682
@@ -71,8 +72,8 @@ LAMBDA = 0.01
 ALPHA = 1.0
 N_RUNS = 6  # timed device runs; the first is discarded
 BASELINE_SAMPLE_EVENTS = 1_000_000  # CPU baseline subsample (extrapolated)
-HBM_PEAK = float(os.environ.get("PIO_BENCH_HBM_PEAK", 819e9))
-FLOP_PEAK = float(os.environ.get("PIO_BENCH_PEAK_FLOPS", 197e12))
+HBM_PEAK = env_float("PIO_BENCH_HBM_PEAK")
+FLOP_PEAK = env_float("PIO_BENCH_PEAK_FLOPS")
 
 
 def make_data(seed: int = 0):
@@ -287,7 +288,7 @@ def bench_tpu(rows, cols, vals):
         # before the windowed arrays stage (axon defers deallocation)
         sync(*jax.jit(lambda: (jnp.zeros(8), jnp.zeros(8)))())
 
-    _prior_mode = os.environ.get("PIO_PALLAS_WINDOWED")
+    _prior_mode = env_raw("PIO_PALLAS_WINDOWED")
     staged, main = measure(None)  # default: pallas on TPU, XLA elsewhere
     _, xla = measure("0")
     xla.pop("_factors_device", None)

@@ -1,0 +1,36 @@
+"""In-tree invariant analyzer + thread sanitizer (ISSUE 12).
+
+`pio lint` runs the AST checkers in this package over the framework's
+own source; `PIO_TSAN=1` arms the dynamic lock-order sanitizer
+(`analysis/tsan.py`) and the pytest thread-leak tripwire
+(`analysis/pytest_plugin.py`). Eleven PRs of review rounds fixed the
+same defect classes by hand — state mutated outside the runtime-swap
+lock, background threads never joined, raw PIO_* env reads with
+divergent parsing, jit boundaries missing devprof, unbounded metric
+labels; these checkers make each of them a CI failure instead of a
+review comment.
+
+Import weight: this package's __init__ is imported by the devprof and
+storage RPC hot paths (for the `tsan.note_blocking` hooks), so it must
+stay empty-cheap: no jax, no numpy, and no eager import of the AST
+checker machinery — `lint` attributes resolve lazily.
+"""
+
+from typing import Any
+
+_LINT_EXPORTS = (
+    "Finding", "LintError", "all_rules", "lint_paths", "lint_repo",
+)
+
+__all__ = list(_LINT_EXPORTS) + ["tsan"]
+
+
+def __getattr__(name: str) -> Any:
+    import importlib
+
+    if name in _LINT_EXPORTS:
+        lint = importlib.import_module("predictionio_tpu.analysis.lint")
+        return getattr(lint, name)
+    if name == "tsan":
+        return importlib.import_module("predictionio_tpu.analysis.tsan")
+    raise AttributeError(name)

@@ -1,0 +1,126 @@
+"""env-knob checker (ISSUE 12).
+
+Every ``PIO_*`` environment read must go through the typed parsers in
+``utils/env.py`` and be declared in the central knob registry. Before
+this, 62 knobs were parsed at ~40 sites with at least four divergent
+grammars (PR-5 found bool knobs that could not parse "false"; PR-6
+round 6 moved one copy to utils/env.py — this rule retires the rest).
+
+Violations:
+  * ``os.environ[...]`` / ``os.environ.get(...)`` / ``os.getenv(...)``
+    with a ``PIO_*`` literal key anywhere outside utils/env.py;
+  * ``<mapping>.get("PIO_*")`` on ANY receiver (captured child envs
+    included — they must parse through the same grammar via the
+    parsers' ``env=`` parameter);
+  * dynamic ``os.environ.get(<expr>)`` reads (unauditable — route
+    through ``env_raw`` so the registry check still applies);
+  * parser calls (``env_str``/``env_int``/… ) naming a knob that is
+    not declared in the registry.
+
+Writes (``os.environ[k] = v``, ``.pop``, child-env dict construction)
+are allowed: the rule is about divergent READ grammars.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from predictionio_tpu.analysis.lint import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    str_const,
+)
+
+RULE_NAME = "env-knobs"
+
+PARSERS = {
+    "env_str", "env_path", "env_int", "env_float", "env_opt_float",
+    "env_bool", "env_flag", "env_raw",
+}
+
+
+def _registered(name: str) -> bool:
+    from predictionio_tpu.utils.env import KNOBS
+
+    if name in KNOBS:
+        return True
+    return any(k.prefix and name.startswith(k.name) for k in KNOBS.values())
+
+
+def _environ_recv(node: ast.AST) -> bool:
+    return dotted_name(node) in ("os.environ", "_os.environ", "environ")
+
+
+def check(mod: ModuleInfo) -> Iterator[Finding]:
+    if mod.path.replace("\\", "/").endswith("utils/env.py"):
+        return
+    for node in ast.walk(mod.tree):
+        # os.environ["PIO_X"] loads
+        if isinstance(node, ast.Subscript) and _environ_recv(node.value):
+            if isinstance(node.ctx, ast.Load):
+                key = str_const(node.slice)
+                if key is None or key.startswith("PIO_"):
+                    yield Finding(
+                        RULE_NAME, mod.path, node.lineno,
+                        f"raw os.environ[{key or '<dynamic>'}] read — "
+                        "use the typed parsers in utils/env.py",
+                    )
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        callee = dotted_name(fn)
+        # os.getenv("PIO_X")
+        if callee in ("os.getenv", "_os.getenv", "getenv"):
+            key = str_const(node.args[0]) if node.args else None
+            if key is None or key.startswith("PIO_"):
+                yield Finding(
+                    RULE_NAME, mod.path, node.lineno,
+                    f"os.getenv({key or '<dynamic>'}) read — use the "
+                    "typed parsers in utils/env.py",
+                )
+            continue
+        # <recv>.get("PIO_X") — os.environ or any captured env mapping
+        if isinstance(fn, ast.Attribute) and fn.attr == "get" and node.args:
+            key = str_const(node.args[0])
+            if _environ_recv(fn.value):
+                if key is None or key.startswith("PIO_"):
+                    yield Finding(
+                        RULE_NAME, mod.path, node.lineno,
+                        f"raw os.environ.get({key or '<dynamic>'}) read "
+                        "— use the typed parsers in utils/env.py",
+                    )
+            elif key is not None and key.startswith("PIO_"):
+                yield Finding(
+                    RULE_NAME, mod.path, node.lineno,
+                    f".get({key!r}) on a captured env mapping — pass "
+                    "the mapping to a utils/env.py parser (env=...) so "
+                    "one grammar parses every knob",
+                )
+            continue
+        # parser calls must name registered knobs
+        base = callee.rsplit(".", 1)[-1] if callee else ""
+        if base in PARSERS:
+            key = str_const(node.args[0]) if node.args else None
+            if key is None:
+                kw = next(
+                    (k.value for k in node.keywords if k.arg == "name"),
+                    None,
+                )
+                key = str_const(kw) if kw is not None else None
+            if key is not None and not _registered(key):
+                yield Finding(
+                    RULE_NAME, mod.path, node.lineno,
+                    f"env knob {key!r} is not declared in the "
+                    "utils/env.py registry (name, type, default, doc)",
+                )
+
+
+RULE = Rule(
+    RULE_NAME,
+    "PIO_* reads go through utils/env.py parsers + the knob registry",
+    check,
+)

@@ -1,0 +1,175 @@
+"""jit-boundary checker (ISSUE 12).
+
+Two invariants over the device-dispatch surface:
+
+1. Every top-level jit boundary — a module-level def decorated with
+   ``jax.jit`` / ``partial(jax.jit, ...)`` (or a module-level
+   ``name = jax.jit(...)`` / ``name = pl.pallas_call(...)`` binding) —
+   must pass through ``devprof.instrument``: the profiler hooks ONLY
+   wrapped call sites, so an uninstrumented boundary silently vanishes
+   from FLOPs/MFU/HBM accounting (the PR-3 contract). A def containing
+   a ``pallas_call`` must itself be jitted or called from a jitted def
+   in the same module — a bare pallas launch bypasses both XLA's
+   dispatch cache and the profiler.
+
+2. No wall-clock or host-RNG calls inside jitted bodies: ``time.*``,
+   ``datetime.*``, ``random.*``, ``np.random.*`` execute ONCE at trace
+   time and bake a constant into the compiled program — the classic
+   silent-staleness bug. Use traced arguments or ``jax.random``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from predictionio_tpu.analysis.lint import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+    dotted_name,
+)
+
+RULE_NAME = "jit-boundary"
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"}
+HOST_CALL_PREFIXES = (
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "datetime.", "random.", "np.random.", "numpy.random.",
+)
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    name = dotted_name(dec)
+    if name in JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fn_name = call_name(dec)
+        if fn_name in JIT_NAMES:
+            return True
+        if fn_name.endswith("partial") and dec.args:
+            return dotted_name(dec.args[0]) in JIT_NAMES
+    return False
+
+
+def _jit_value_call(value: ast.expr) -> Optional[str]:
+    """'jit' / 'pallas_call' when value is jax.jit(...) / pallas_call(...)."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = call_name(value)
+    if name in JIT_NAMES:
+        return "jit"
+    if name.split(".")[-1] == "pallas_call":
+        return "pallas_call"
+    return None
+
+
+def _instrumented_names(tree: ast.Module) -> set[str]:
+    """Names passed (as args or kwargs) to any *.instrument(...) call."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if not name.rsplit(".", 1)[-1] == "instrument":
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, ast.Name):
+                out.add(arg.id)
+    return out
+
+
+def _contains_pallas_call(fn: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call)
+        and call_name(n).split(".")[-1] == "pallas_call"
+        for n in ast.walk(fn)
+    )
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    return {
+        call_name(n).split(".", 1)[0]
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Call)
+    }
+
+
+def check(mod: ModuleInfo) -> Iterator[Finding]:
+    instrumented = _instrumented_names(mod.tree)
+    module_fns: dict[str, ast.FunctionDef] = {}
+    jitted: dict[str, ast.FunctionDef] = {}
+    bound_jits: dict[str, ast.stmt] = {}  # name = jax.jit(...) / pallas_call
+    for node in mod.tree.body:
+        if isinstance(node, ast.FunctionDef):
+            module_fns[node.name] = node
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                jitted[node.name] = node
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name) and _jit_value_call(node.value):
+                bound_jits[target.id] = node
+
+    # (1a) jitted defs + jit/pallas bindings must be instrumented
+    for name, fn in jitted.items():
+        if name not in instrumented:
+            yield Finding(
+                RULE_NAME, mod.path, fn.lineno,
+                f"jitted function {name!r} never passes through "
+                "devprof.instrument — this boundary is invisible to "
+                "FLOPs/MFU/HBM accounting",
+            )
+    for name, stmt in bound_jits.items():
+        if name not in instrumented:
+            yield Finding(
+                RULE_NAME, mod.path, stmt.lineno,
+                f"module-level jit/pallas binding {name!r} never passes "
+                "through devprof.instrument",
+            )
+
+    # (1b) pallas_call sites must sit under a jitted entry point:
+    # compute reachability from jitted defs through same-module calls
+    reachable = set(jitted)
+    frontier = list(jitted.values())
+    while frontier:
+        fn = frontier.pop()
+        for callee in _called_names(fn):
+            if callee in module_fns and callee not in reachable:
+                reachable.add(callee)
+                frontier.append(module_fns[callee])
+    for name, fn in module_fns.items():
+        if not _contains_pallas_call(fn):
+            continue
+        if name in reachable or name in instrumented:
+            continue
+        yield Finding(
+            RULE_NAME, mod.path, fn.lineno,
+            f"{name!r} launches a pallas_call but is neither jitted nor "
+            "called from a jitted def in this module — the launch "
+            "bypasses the dispatch cache and the profiler",
+        )
+
+    # (2) host wall-clock / RNG inside jitted bodies
+    for name, fn in jitted.items():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname.startswith("jax.") or cname.startswith("jnp."):
+                continue
+            if any(cname.startswith(p) for p in HOST_CALL_PREFIXES):
+                yield Finding(
+                    RULE_NAME, mod.path, node.lineno,
+                    f"host call {cname}() inside jitted {name!r} runs "
+                    "once at trace time and bakes a constant into the "
+                    "compiled program",
+                )
+
+
+RULE = Rule(
+    RULE_NAME,
+    "jit boundaries route through devprof.instrument; no host "
+    "clock/RNG inside jitted bodies",
+    check,
+)

@@ -1,0 +1,167 @@
+"""lock-discipline checker (ISSUE 12).
+
+Attributes declared ``# guarded-by: <lock>`` (on their assignment line,
+conventionally in ``__init__``) may only be MUTATED inside a
+``with self.<lock>:`` block in the same class — the static version of
+the runtime-swap-lock / cache-entries discipline that PR-5/6/9 review
+rounds re-litigated by hand. Reads stay unchecked (snapshot-read
+patterns are legitimate); the annotation may name alternatives
+(``# guarded-by: _lock|_not_empty``) for Condition wrappers that hold
+the same underlying lock.
+
+Methods whose callers hold the lock declare it on the def line with
+``# lint: holds=<lock>``. ``__init__`` is exempt: the object is not
+shared yet. The check is lexical and per-class — mutations reached
+through another object's reference are the dynamic sanitizer's job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from predictionio_tpu.analysis.lint import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    self_attr,
+)
+
+RULE_NAME = "lock-discipline"
+
+#: method calls that mutate their receiver in place
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "rotate", "sort", "reverse",
+}
+
+
+def _guard_decls(mod: ModuleInfo, cls: ast.ClassDef) -> dict[str, tuple[str, ...]]:
+    """attr name → lock names, from `# guarded-by:` comments on
+    self.<attr> assignment lines anywhere in the class body."""
+    guards: dict[str, tuple[str, ...]] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        locks = mod.guarded.get(node.lineno)
+        if not locks:
+            continue
+        for t in targets:
+            attr = self_attr(t)
+            if attr is not None:
+                guards[attr] = locks
+    return guards
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo, cls_name: str,
+                 guards: dict[str, tuple[str, ...]],
+                 held: tuple[str, ...]):
+        self.mod = mod
+        self.cls_name = cls_name
+        self.guards = guards
+        self.held: list[str] = list(held)
+        self.findings: list[Finding] = []
+
+    # -- lock tracking ---------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            attr = self_attr(item.context_expr)
+            if attr is not None:
+                self.held.append(attr)
+                pushed += 1
+        self.generic_visit(node)
+        for _ in range(pushed):
+            self.held.pop()
+
+    # -- mutations -------------------------------------------------------
+    def _flag(self, attr: str, line: int, what: str) -> None:
+        locks = self.guards[attr]
+        want = " or ".join(f"self.{lk}" for lk in locks)
+        self.findings.append(Finding(
+            RULE_NAME, self.mod.path, line,
+            f"{self.cls_name}.{attr} is guarded-by {'|'.join(locks)} "
+            f"but {what} outside `with {want}`",
+        ))
+
+    def _check_target(self, target: ast.AST, line: int) -> None:
+        attr = self_attr(target)
+        if attr in self.guards and not set(self.guards[attr]) & set(self.held):
+            self._flag(attr, line, "assigned")
+        if isinstance(target, (ast.Subscript, ast.Attribute)) and not (
+            attr is not None
+        ):
+            inner = self_attr(target.value) if isinstance(
+                target, (ast.Subscript, ast.Attribute)
+            ) else None
+            if inner in self.guards and not (
+                set(self.guards[inner]) & set(self.held)
+            ):
+                self._flag(inner, line, "item-assigned")
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_target(elt, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            attr = self_attr(fn.value)
+            if attr in self.guards and not (
+                set(self.guards[attr]) & set(self.held)
+            ):
+                self._flag(attr, node.lineno, f".{fn.attr}() called")
+        self.generic_visit(node)
+
+    # nested defs: visited with the current lexical held-stack — a
+    # closure built under the lock but run later is a known blind spot
+    # the dynamic sanitizer covers
+
+
+def check(mod: ModuleInfo) -> Iterator[Finding]:
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guards = _guard_decls(mod, cls)
+        if not guards:
+            continue
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue  # not shared yet
+            held = mod.holds.get(item.lineno, ())
+            visitor = _MethodVisitor(mod, cls.name, guards, held)
+            for stmt in item.body:
+                visitor.visit(stmt)
+            yield from visitor.findings
+
+
+RULE = Rule(
+    RULE_NAME,
+    "# guarded-by: attrs may only be mutated under their declared lock",
+    check,
+)

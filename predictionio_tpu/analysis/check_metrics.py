@@ -1,0 +1,113 @@
+"""metric-cardinality checker (ISSUE 12).
+
+Prometheus-style label sets are per-value time series: an unbounded
+label value (raw path, client-chosen id, exception text) grows the
+registry — and everything scraping it — without limit. The PR-2
+route-label table and the PR-6 tenant cap exist precisely to bound
+this; the rule makes the bound a declared, checkable property:
+
+  * every labeled metric-family creation
+    (``registry.counter/gauge/histogram(..., ("route", ...))``) must
+    carry a ``# label-bound: <mechanism>`` annotation within the call's
+    line span naming what bounds the values (route-label table, tenant
+    cap + (other) overflow, literal set, ...);
+  * label VALUES at ``.inc/.set/.dec/.observe`` call sites must not be
+    built by string construction (f-strings, ``+``/``%``/``.format``) —
+    a constructed value is unbounded by construction; route it through
+    the bounding table first.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from predictionio_tpu.analysis.lint import (
+    Finding,
+    ModuleInfo,
+    Rule,
+)
+
+RULE_NAME = "metric-cardinality"
+
+FAMILY_CTORS = {"counter", "gauge", "histogram"}
+FEEDERS = {"inc", "set", "dec", "observe"}
+
+
+def _labelnames_arg(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def _is_nonempty_literal(node: ast.expr) -> bool:
+    return isinstance(node, (ast.Tuple, ast.List)) and bool(node.elts)
+
+
+def _constructed(node: ast.expr) -> Optional[str]:
+    """Describe the string-construction shape, or None when clean."""
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Add, ast.Mod)
+    ):
+        return "string concatenation/format"
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+    ):
+        return ".format()"
+    return None
+
+
+def check(mod: ModuleInfo) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        if fn.attr in FAMILY_CTORS:
+            labelnames = _labelnames_arg(node)
+            if labelnames is None or (
+                isinstance(labelnames, (ast.Tuple, ast.List))
+                and not labelnames.elts
+            ):
+                continue
+            if not _is_nonempty_literal(labelnames) and not isinstance(
+                labelnames, ast.Name
+            ):
+                continue  # not a metric-family shape (e.g. dict.update)
+            end = getattr(node, "end_lineno", node.lineno)
+            span = range(node.lineno - 1, end + 2)
+            if not any(ln in mod.label_bound for ln in span):
+                yield Finding(
+                    RULE_NAME, mod.path, node.lineno,
+                    "labeled metric family without a `# label-bound:` "
+                    "annotation — declare what bounds the label values "
+                    "(route table, tenant cap, literal set, ...)",
+                )
+        elif fn.attr in FEEDERS and node.keywords:
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                shape = _constructed(kw.value)
+                if shape is not None:
+                    yield Finding(
+                        RULE_NAME, mod.path, node.lineno,
+                        f"label {kw.arg!r} fed a {shape}-constructed "
+                        "value — unbounded by construction; route it "
+                        "through the bounding table first",
+                    )
+
+
+RULE = Rule(
+    RULE_NAME,
+    "labeled metric families declare their bound; no constructed "
+    "label values at feed sites",
+    check,
+)

@@ -1,0 +1,157 @@
+"""thread-lifecycle checker (ISSUE 12).
+
+Every ``threading.Thread(...)`` spawn site must be (1) daemon=True —
+a crash elsewhere must never hang process exit on a worker loop —
+(2) named — the CI no-leaked-threads guards and the tsan tripwire
+identify threads by name — and (3) owned: reachable from something
+that joins it. "Owned" is checked lexically:
+
+  * assigned to ``self.<attr>``: the enclosing class must define a
+    stop-like method (stop/close/shutdown/detach/stop_all/drain) AND
+    contain a ``.join(...)`` call somewhere — the PR-8/9 discipline
+    where every background thread joins on its owner's stop().
+  * assigned to a local: the same function must ``.join()`` it, or
+    append it to a ``self.<attr>`` collection of an owning class (the
+    tracked-stray pattern).
+  * anything else is a fire-and-forget thread — the exact leak class
+    tier1.yml's no-leaked-threads step catches dynamically — and needs
+    an explicit ``# lint: disable=thread-lifecycle`` with justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from predictionio_tpu.analysis.lint import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    enclosing,
+    self_attr,
+)
+
+RULE_NAME = "thread-lifecycle"
+STOP_NAMES = {"stop", "close", "shutdown", "detach", "stop_all", "drain"}
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "Thread":
+        return isinstance(fn.value, ast.Name) and fn.value.id == "threading"
+    return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _class_joins(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+        ):
+            return True
+    return False
+
+
+def _class_has_stop(cls: ast.ClassDef) -> bool:
+    return any(
+        isinstance(n, ast.FunctionDef) and n.name in STOP_NAMES
+        for n in cls.body
+    )
+
+
+def _local_join_or_tracked(
+    fn: ast.AST, var: str
+) -> bool:
+    """var.join(...) in the same function, or var appended/added to a
+    self.<attr> container (owner tracks it for a later join)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if (
+            f.attr == "join"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == var
+        ):
+            return True
+        if f.attr in ("append", "add") and any(
+            isinstance(a, ast.Name) and a.id == var for a in node.args
+        ):
+            if self_attr(f.value) is not None:
+                return True
+    return False
+
+
+def check(mod: ModuleInfo) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        line = node.lineno
+        name_kw = _kw(node, "name")
+        daemon_kw = _kw(node, "daemon")
+        if name_kw is None:
+            yield Finding(
+                RULE_NAME, mod.path, line,
+                "thread spawned without name= — leak guards and the "
+                "sanitizer tripwire identify threads by name",
+            )
+        if not (
+            isinstance(daemon_kw, ast.Constant) and daemon_kw.value is True
+        ):
+            yield Finding(
+                RULE_NAME, mod.path, line,
+                "thread spawned without daemon=True — a non-daemon "
+                "worker loop hangs process exit on any crash",
+            )
+        parent = mod.parent(node)
+        owner_attr = None
+        local_var = None
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            target = parent.targets[0]
+            owner_attr = self_attr(target)
+            if isinstance(target, ast.Name):
+                local_var = target.id
+        if owner_attr is not None:
+            cls = enclosing(mod, node, (ast.ClassDef,))
+            if cls is not None and _class_has_stop(cls) and _class_joins(cls):
+                continue
+            yield Finding(
+                RULE_NAME, mod.path, line,
+                f"thread stored on self.{owner_attr} but the enclosing "
+                "class has no stop()/join() path — background threads "
+                "must be joined by their owner's stop",
+            )
+        elif local_var is not None:
+            fn = enclosing(
+                mod, node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if fn is not None and _local_join_or_tracked(fn, local_var):
+                continue
+            yield Finding(
+                RULE_NAME, mod.path, line,
+                f"thread bound to local {local_var!r} is never joined "
+                "or tracked on an owner — it leaks past its spawner",
+            )
+        else:
+            yield Finding(
+                RULE_NAME, mod.path, line,
+                "fire-and-forget thread: not assigned to an owner and "
+                "never joined",
+            )
+
+
+RULE = Rule(
+    RULE_NAME,
+    "threading.Thread sites must be daemon+named and joined by an owner",
+    check,
+)

@@ -1,0 +1,279 @@
+"""`pio lint` core: file discovery, comment annotations, suppression,
+rule registry, and the findings pipeline (ISSUE 12).
+
+Each checker module registers one Rule over a parsed ModuleInfo —
+source, AST, parent map, and the comment-derived annotations:
+
+  ``# lint: disable=<rule>[,<rule>]``   suppress on that line; a
+                                        whole-line comment suppresses
+                                        the rule file-wide
+  ``# lint: holds=<lock>``              on a def line: callers hold
+                                        <lock>, so guarded mutations
+                                        inside count as locked
+  ``# guarded-by: <lock>[|<lock>]``     on a self.<attr> assignment:
+                                        the attr may only be mutated
+                                        under one of the named locks
+  ``# label-bound: <why>``              on a labeled metric-family
+                                        creation: names the mechanism
+                                        bounding the label values
+
+Suppressions are expected to carry a justification after the rule list
+(``# lint: disable=thread-lifecycle — self-stop from handler``); the
+checker does not parse the prose, reviewers do.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator, Optional
+
+DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([a-z0-9_\-]+(?:\s*,\s*[a-z0-9_\-]+)*)")
+HOLDS_RE = re.compile(r"#\s*lint:\s*holds=([A-Za-z0-9_]+(?:\s*[|,]\s*[A-Za-z0-9_]+)*)")
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z0-9_]+(?:\s*[|,]\s*[A-Za-z0-9_]+)*)")
+LABEL_BOUND_RE = re.compile(r"#\s*label-bound:\s*(\S.*)")
+
+
+class LintError(RuntimeError):
+    """A module could not be analyzed (syntax error, unreadable file)."""
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file + everything the checkers share."""
+
+    path: str  # as passed (repo-relative in CI/console runs)
+    source: str
+    tree: ast.Module
+    comments: dict[int, str] = field(default_factory=dict)
+    #: rules disabled for the whole file (whole-line disable comments)
+    file_disabled: set[str] = field(default_factory=set)
+    #: line → rules disabled on that line (trailing disable comments)
+    line_disabled: dict[int, set[str]] = field(default_factory=dict)
+    #: line → lock names an attr on that line is guarded by
+    guarded: dict[int, tuple[str, ...]] = field(default_factory=dict)
+    #: line → lock names a def on that line declares its callers hold
+    holds: dict[int, tuple[str, ...]] = field(default_factory=dict)
+    #: lines carrying a `# label-bound:` annotation
+    label_bound: set[int] = field(default_factory=set)
+    parents: dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_disabled:
+            return True
+        return rule in self.line_disabled.get(line, set())
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    description: str
+    check: Callable[[ModuleInfo], Iterator[Finding]]
+
+
+def _split_names(raw: str) -> tuple[str, ...]:
+    return tuple(
+        n.strip() for n in re.split(r"[|,]", raw) if n.strip()
+    )
+
+
+def parse_module(path: str, source: Optional[str] = None) -> ModuleInfo:
+    if source is None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            raise LintError(f"{path}: unreadable ({e})") from e
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        raise LintError(f"{path}:{e.lineno}: syntax error: {e.msg}") from e
+    mod = ModuleInfo(path=path, source=source, tree=tree)
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            mod.parents[child] = node
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except tokenize.TokenizeError:  # pragma: no cover - ast parsed already
+        tokens = []
+    src_lines = source.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        line_no, col = tok.start
+        text = tok.string
+        mod.comments[line_no] = text
+        whole_line = src_lines[line_no - 1][:col].strip() == ""
+        m = DISABLE_RE.search(text)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if whole_line:
+                mod.file_disabled |= rules
+            else:
+                mod.line_disabled.setdefault(line_no, set()).update(rules)
+        m = GUARDED_RE.search(text)
+        if m:
+            mod.guarded[line_no] = _split_names(m.group(1))
+        m = HOLDS_RE.search(text)
+        if m:
+            mod.holds[line_no] = _split_names(m.group(1))
+        if LABEL_BOUND_RE.search(text):
+            mod.label_bound.add(line_no)
+    return mod
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is `self.x`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('' when not a plain name chain)."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing(mod: ModuleInfo, node: ast.AST,
+              kinds: tuple[type, ...]) -> Optional[ast.AST]:
+    cur = mod.parent(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = mod.parent(cur)
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# -- rule registry -----------------------------------------------------------
+
+_RULES: Optional[list[Rule]] = None
+
+
+def all_rules() -> list[Rule]:
+    global _RULES
+    if _RULES is None:
+        from predictionio_tpu.analysis import (
+            check_env,
+            check_jit,
+            check_locks,
+            check_metrics,
+            check_threads,
+        )
+
+        _RULES = [
+            check_threads.RULE,
+            check_locks.RULE,
+            check_env.RULE,
+            check_jit.RULE,
+            check_metrics.RULE,
+        ]
+    return _RULES
+
+
+def discover_files(root: str) -> list[str]:
+    """All .py files under `root` (or `root` itself when it is a file)."""
+    if os.path.isfile(root):
+        return [root]
+    found: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in ("__pycache__", ".git")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                found.append(os.path.join(dirpath, fn))
+    return found
+
+
+def lint_paths(
+    paths: Iterable[str],
+    rules: Optional[Iterable[Rule]] = None,
+) -> tuple[list[Finding], list[str]]:
+    """Run `rules` (default: all) over every .py under `paths`.
+
+    Returns (findings, errors): suppressed findings are filtered here,
+    unparseable files surface as error strings, not exceptions — one
+    bad file must not hide the rest of the report."""
+    rules = list(rules) if rules is not None else all_rules()
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for root in paths:
+        for path in discover_files(root):
+            try:
+                mod = parse_module(path)
+            except LintError as e:
+                errors.append(str(e))
+                continue
+            for rule in rules:
+                try:
+                    found = list(rule.check(mod))
+                except Exception as e:  # checker bug: loud, not fatal
+                    errors.append(
+                        f"{path}: checker {rule.name} crashed: {e!r}"
+                    )
+                    continue
+                findings.extend(
+                    f for f in found if not mod.suppressed(f.rule, f.line)
+                )
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, errors
+
+
+def package_root() -> str:
+    import predictionio_tpu
+
+    return os.path.dirname(os.path.abspath(predictionio_tpu.__file__))
+
+
+def lint_repo(
+    rules: Optional[Iterable[Rule]] = None,
+) -> tuple[list[Finding], list[str]]:
+    """Lint the installed predictionio_tpu package (the CI gate)."""
+    return lint_paths([package_root()], rules)

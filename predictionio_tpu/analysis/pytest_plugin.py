@@ -1,0 +1,57 @@
+"""pytest integration for the thread sanitizer (ISSUE 12).
+
+Wired through tests/conftest.py (so plain ``python -m pytest tests/``
+picks it up with no -p flag): with ``PIO_TSAN=1`` in the environment,
+``pytest_configure`` arms the lock-order sanitizer before any test
+runs, and ``pytest_sessionfinish`` runs the thread-leak tripwire,
+writes the JSON findings report (``PIO_TSAN_REPORT`` path, default
+``tsan-report.json``), and FAILS the session (exit 3) on any finding —
+the CI "zero sanitizer findings on the concurrency suites" gate.
+
+Without PIO_TSAN both hooks are no-ops; tier-1 runs are unaffected.
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.analysis import tsan
+from predictionio_tpu.utils.env import env_flag
+
+#: exit code a sanitizer finding turns the session into
+TSAN_EXIT_CODE = 3
+
+
+def pytest_configure(config) -> None:
+    if env_flag("PIO_TSAN"):
+        tsan.enable()
+
+
+def pytest_sessionfinish(session, exitstatus) -> None:
+    if not tsan.enabled():
+        return
+    rep = tsan.report()
+    path = tsan.write_report(report_dict=rep)
+    tw = getattr(session.config, "get_terminal_writer", lambda: None)()
+    lines = [
+        "",
+        f"tsan: {rep['edges_total']} lock-order edges, "
+        f"{len(rep['lock_order_cycles'])} cycles, "
+        f"{len(rep['blocking_with_lock_held'])} blocked-while-holding, "
+        f"{len(rep['leaked_threads'])} leaked threads "
+        f"(report: {path})",
+    ]
+    for cyc in rep["lock_order_cycles"]:
+        lines.append(f"tsan: CYCLE between {', '.join(cyc['sites'])}")
+    for b in rep["blocking_with_lock_held"]:
+        lines.append(
+            f"tsan: BLOCKED on {b['kind']} holding "
+            f"{', '.join(b['held_sites'])} (x{b['count']})"
+        )
+    for t in rep["leaked_threads"]:
+        lines.append(f"tsan: LEAKED thread {t['name']!r}")
+    text = "\n".join(lines)
+    if tw is not None:
+        tw.line(text)
+    else:  # pragma: no cover - ancient pytest
+        print(text)
+    if rep["findings_count"]:
+        session.exitstatus = TSAN_EXIT_CODE

@@ -1,0 +1,364 @@
+"""Dynamic thread sanitizer (ISSUE 12): lock-order graph + tripwires.
+
+``PIO_TSAN=1`` (via the pytest plugin or an explicit ``enable()``)
+patches ``threading.Lock``/``threading.RLock`` so every lock created
+AFTERWARD is a recording proxy. Each acquisition appends the lock's
+creation site to a per-thread held-stack; first-time (held → acquired)
+pairs become edges in a global lock-order graph with a captured stack.
+At report time:
+
+  * cycles in the graph (an AB/BA inversion somewhere in the run) are
+    potential deadlocks — the exact class the FairQueue/mux/cache lock
+    nest could produce;
+  * ``note_blocking(kind)`` hooks — called from the devprof dispatch
+    wrapper and the storage RPC client — record any locks held across
+    device dispatch or blocking I/O (a held lock there serializes the
+    whole server behind one slow call);
+  * the thread-leak tripwire diffs ``threading.enumerate()`` against
+    the enable-time baseline: threads still alive at session end were
+    never joined by their owner.
+
+Locks are keyed by CREATION SITE (file:line), not instance, so an
+inversion between two instances of the same two classes is still
+caught; edges between two instances from the SAME site are ignored
+(per-entry locks from one constructor line would self-cycle falsely).
+
+Overhead when disabled: ``note_blocking`` is one attribute load + a
+falsy check; no locks are wrapped. The proxies survive ``disable()``
+(recording just stops), so tests can enable/disable freely.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import traceback
+from typing import Any, Optional
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+#: modules whose frames are skipped when attributing a creation site
+_SKIP_FILES = (os.sep + "threading.py", __file__)
+
+
+class _State:
+    def __init__(self) -> None:
+        self.enabled = False
+        # held-site -> acquired-site -> {"stack": [...], "count": n}
+        self.graph: dict[str, dict[str, dict]] = {}
+        # (kind, held-sites) -> {"stack": [...], "count": n}
+        self.blocking: dict[tuple[str, tuple[str, ...]], dict] = {}
+        self.allowed_blocking: set[str] = set()
+        self.baseline_threads: set[int] = set()
+        self.mu = _REAL_LOCK()
+        self.tl = threading.local()
+
+    def held(self) -> list:
+        stack = getattr(self.tl, "stack", None)
+        if stack is None:
+            stack = self.tl.stack = []
+        return stack
+
+
+_state = _State()
+
+
+def _caller_site() -> str:
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not any(fn.endswith(s) or fn == s for s in _SKIP_FILES):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _stack_lines(limit: int = 14) -> list[str]:
+    raw = traceback.format_stack(limit=limit)
+    return [ln.rstrip() for ln in raw[:-2]]
+
+
+class _SanLock:
+    """Recording proxy over one real Lock/RLock instance."""
+
+    def __init__(self, inner: Any, site: str):
+        self._inner = inner
+        self._site = site
+
+    # -- core protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and _state.enabled:
+            _record_acquire(self._site)
+        return ok
+
+    def release(self) -> None:
+        if _state.enabled:
+            _record_release(self._site)
+        self._inner.release()
+
+    def __enter__(self) -> "_SanLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        return bool(locked()) if locked is not None else False
+
+    # -- Condition compatibility ----------------------------------------
+    # Condition(lock) PROBES for _release_save/_acquire_restore/_is_owned
+    # at construction and falls back to proxy.acquire/release when they
+    # are absent — so delegation must preserve absence: a plain Lock has
+    # none of them, and defining them here would hand Condition methods
+    # that raise at wait() time. RLocks get direct delegation (the
+    # held-stack intentionally keeps the site across a wait; the thread
+    # records nothing while blocked and is consistent after reacquire).
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<tsan {self._inner!r} @ {self._site}>"
+
+
+def _record_acquire(site: str) -> None:
+    held = _state.held()
+    if site not in held:
+        new_edges = [h for h in held if h != site]
+        if new_edges:
+            with _state.mu:
+                for h in new_edges:
+                    edges = _state.graph.setdefault(h, {})
+                    info = edges.get(site)
+                    if info is None:
+                        edges[site] = {
+                            "stack": _stack_lines(), "count": 1,
+                        }
+                    else:
+                        info["count"] += 1
+    held.append(site)
+
+
+def _record_release(site: str) -> None:
+    held = _state.held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == site:
+            del held[i]
+            return
+
+
+def _lock_factory(*args: Any, **kwargs: Any) -> Any:
+    inner = _REAL_LOCK(*args, **kwargs)
+    if not _state.enabled:
+        return inner
+    return _SanLock(inner, _caller_site())
+
+
+def _rlock_factory(*args: Any, **kwargs: Any) -> Any:
+    inner = _REAL_RLOCK(*args, **kwargs)
+    if not _state.enabled:
+        return inner
+    return _SanLock(inner, _caller_site())
+
+
+# -- public API --------------------------------------------------------------
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def enable() -> None:
+    """Patch the lock constructors and baseline the live thread set."""
+    if _state.enabled:
+        return
+    _state.enabled = True
+    threading.Lock = _lock_factory  # type: ignore[misc]
+    threading.RLock = _rlock_factory  # type: ignore[misc]
+    _state.baseline_threads = {t.ident for t in threading.enumerate()}
+
+
+def disable() -> None:
+    """Stop recording and restore the real constructors. Existing
+    proxies keep working (recording is gated per-call)."""
+    _state.enabled = False
+    threading.Lock = _REAL_LOCK  # type: ignore[misc]
+    threading.RLock = _REAL_RLOCK  # type: ignore[misc]
+
+
+def reset() -> None:
+    """Drop all recorded state (test isolation)."""
+    with _state.mu:
+        _state.graph.clear()
+        _state.blocking.clear()
+        _state.allowed_blocking.clear()
+
+
+def allow_blocking(site_substring: str) -> None:
+    """Declare a lock (by creation-site substring) EXPECTED to be held
+    across device dispatch — e.g. a stage lock whose whole job is
+    'one staging, many waiters'. The owner of the lock declares this,
+    never the code that happens to trip it."""
+    with _state.mu:
+        _state.allowed_blocking.add(site_substring)
+
+
+def allow_blocking_lock(lock: Any) -> None:
+    """Instance form of `allow_blocking`: the owner passes the lock it
+    just created. No-op when the sanitizer is off (the lock is then a
+    plain threading lock with no site)."""
+    site = getattr(lock, "_site", None)
+    if site is not None:
+        allow_blocking(site)
+
+
+def note_blocking(kind: str) -> None:
+    """Hot-path hook: called where the thread is about to block on
+    device dispatch or remote I/O. Near-zero cost when disabled."""
+    if not _state.enabled:
+        return
+    held = getattr(_state.tl, "stack", None)
+    if not held:
+        return
+    sites = tuple(held)
+    with _state.mu:
+        live = [
+            s for s in sites
+            if not any(sub in s for sub in _state.allowed_blocking)
+        ]
+        if not live:
+            return
+        key = (kind, tuple(live))
+        info = _state.blocking.get(key)
+        if info is None:
+            _state.blocking[key] = {"stack": _stack_lines(), "count": 1}
+        else:
+            info["count"] += 1
+
+
+def _find_cycles(graph: dict[str, dict[str, dict]]) -> list[list[str]]:
+    """Strongly-connected components of size > 1 (plus self-loops):
+    every lock-order inversion lives inside one."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = [0]
+    sccs: list[list[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph.get(v, {}))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, {})))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1 or node in graph.get(node, {}):
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def leaked_threads() -> list[dict]:
+    """Threads alive now that were not alive at enable() time —
+    anything here outlived whatever spawned it without being joined."""
+    current = threading.current_thread()
+    out = []
+    for t in threading.enumerate():
+        if t.ident in _state.baseline_threads or t is current:
+            continue
+        if not t.is_alive():
+            continue
+        out.append({"name": t.name, "daemon": t.daemon})
+    return sorted(out, key=lambda d: d["name"])
+
+
+def report(check_leaks: bool = True) -> dict:
+    """JSON-able findings report (the `pio lint --tsan-report` payload)."""
+    with _state.mu:
+        graph = {
+            h: {a: dict(info) for a, info in edges.items()}
+            for h, edges in _state.graph.items()
+        }
+        blocking = [
+            {
+                "kind": kind,
+                "held_sites": list(sites),
+                "stack": info["stack"],
+                "count": info["count"],
+            }
+            for (kind, sites), info in sorted(_state.blocking.items())
+        ]
+    cycles = []
+    for comp in _find_cycles(graph):
+        edges = []
+        for a in comp:
+            for b, info in graph.get(a, {}).items():
+                if b in comp:
+                    edges.append({
+                        "from": a, "to": b, "count": info["count"],
+                        "stack": info["stack"],
+                    })
+        cycles.append({"sites": comp, "edges": edges})
+    leaks = leaked_threads() if check_leaks else []
+    return {
+        "enabled": _state.enabled,
+        "edges_total": sum(len(e) for e in graph.values()),
+        "lock_order_cycles": cycles,
+        "blocking_with_lock_held": blocking,
+        "leaked_threads": leaks,
+        "findings_count": len(cycles) + len(blocking) + len(leaks),
+    }
+
+
+def write_report(path: Optional[str] = None,
+                 check_leaks: bool = True,
+                 report_dict: Optional[dict] = None) -> str:
+    """Dump the findings as JSON; returns the path written. Pass
+    `report_dict` to write an already-computed snapshot (the pytest
+    plugin decides exit status and writes from ONE report, so the
+    JSON can never disagree with the console summary)."""
+    if not path:
+        from predictionio_tpu.utils.env import env_path
+
+        path = env_path("PIO_TSAN_REPORT") or "tsan-report.json"
+    rep = report_dict if report_dict is not None else report(
+        check_leaks=check_leaks
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(rep, f, indent=2, sort_keys=True)
+    return path

@@ -79,7 +79,7 @@ def train_stage_histogram():
     return get_default_registry().histogram(
         "train_stage_seconds",
         "train workflow stage durations (read/prepare/train/persist)",
-        ("stage",),
+        ("stage",),  # label-bound: literal DASE stage names
     )
 
 

@@ -22,6 +22,7 @@ from typing import Any, Optional
 
 from predictionio_tpu.core.base import PersistentModelManifest
 from predictionio_tpu.controller.params import load_symbol
+from predictionio_tpu.utils.env import env_path
 
 
 @dataclass(frozen=True)
@@ -54,9 +55,7 @@ class LocalFileSystemPersistentModel(PersistentModel):
 
     @staticmethod
     def _path(model_id: str) -> str:
-        base = os.environ.get(
-            "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
-        )
+        base = env_path("PIO_FS_BASEDIR")
         os.makedirs(base, exist_ok=True)
         return os.path.join(base, f"pm-{model_id}.pkl")
 

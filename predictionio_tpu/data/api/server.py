@@ -45,6 +45,7 @@ from predictionio_tpu.data.storage.base import (
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.obs import server_registry
 from predictionio_tpu.resilience.wal import EventWAL
+from predictionio_tpu.utils.env import env_path
 from predictionio_tpu.utils.http import (
     HttpError as _HttpError,
     JsonHandler,
@@ -58,9 +59,7 @@ MAX_EVENTS_PER_BATCH = 50  # reference EventServer.scala:68
 
 
 def _default_wal_dir() -> str:
-    return os.environ.get("PIO_WAL_DIR") or os.path.join(
-        os.path.expanduser("~"), ".predictionio_tpu", "event-wal"
-    )
+    return env_path("PIO_WAL_DIR")
 
 
 @dataclass
@@ -206,7 +205,7 @@ class _Handler(JsonHandler):
         self.server.metrics.counter(
             "events_shed_total",
             "ingest POSTs refused before storage work, by reason",
-            ("reason",),
+            ("reason",),  # label-bound: literal shed-reason set
         ).inc(reason="deadline")
         self._respond(
             503,

@@ -153,6 +153,8 @@ class _Handler(JsonHandler):
             return
         self.server.metrics.counter(  # type: ignore[attr-defined]
             "storage_rpc_total", "storage RPCs by DAO and method",
+            # label-bound: dao/method validated against the DAO table
+            # before this inc — unknown RPCs 404 above it
             ("dao", "method"),
         ).inc(dao=dao_name, method=method)
         # Writes carry a req_id: a retry of a request we already applied

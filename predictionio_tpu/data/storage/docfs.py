@@ -26,6 +26,7 @@ import threading
 from typing import Any, Optional
 
 from predictionio_tpu.data.storage import base
+from predictionio_tpu.utils.env import env_path
 from predictionio_tpu.data.storage.base import (
     AccessKey,
     App,
@@ -56,13 +57,7 @@ class _DocFSClient:
         config = config or {}
         self.root = config.get(
             "PATH",
-            os.path.join(
-                os.environ.get(
-                    "PIO_FS_BASEDIR",
-                    os.path.join(os.path.expanduser("~"), ".pio_store"),
-                ),
-                "docfs",
-            ),
+            os.path.join(env_path("PIO_FS_BASEDIR"), "docfs"),
         )
         os.makedirs(self.root, exist_ok=True)
         self.lock = threading.RLock()

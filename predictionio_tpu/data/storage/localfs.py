@@ -9,12 +9,11 @@ from typing import Optional
 
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import Model
+from predictionio_tpu.utils.env import env_path
 
 
 def default_basedir() -> str:
-    return os.environ.get(
-        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
-    )
+    return env_path("PIO_FS_BASEDIR")
 
 
 class LocalFSModels(base.Models):

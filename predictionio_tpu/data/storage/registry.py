@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import StorageError
+from predictionio_tpu.utils.env import env_path
 
 # repository name → env default source type (reference Storage.scala:140-142)
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
@@ -109,9 +110,7 @@ class StorageConfig:
     def default_dev(basedir: Optional[str] = None) -> "StorageConfig":
         """Zero-config dev wiring: sqlite metadata+events, localfs models —
         the analogue of the reference's pio-env.sh.template defaults."""
-        base_dir = basedir or os.environ.get(
-            "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
-        )
+        base_dir = basedir or env_path("PIO_FS_BASEDIR")
         os.makedirs(base_dir, exist_ok=True)
         return StorageConfig(
             sources={

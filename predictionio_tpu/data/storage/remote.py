@@ -49,10 +49,12 @@ from predictionio_tpu.data.storage.base import (
 )
 from predictionio_tpu.resilience.breaker import get_breaker
 from predictionio_tpu.resilience.retry import RetryPolicy
+from predictionio_tpu.utils.env import env_raw
+from predictionio_tpu.analysis import tsan as _tsan
 
 
 def _cfg(config: dict[str, str], key: str, env: str, default: str) -> str:
-    return config.get(key) or os.environ.get(env) or default
+    return config.get(key) or env_raw(env) or default
 
 
 class RemoteClient:
@@ -180,6 +182,10 @@ class RemoteClient:
                 )
 
                 def _attempt(_i: int) -> Any:
+                    # sanitizer hook (ISSUE 12): a lock held across a
+                    # blocking storage RPC wedges every waiter behind
+                    # one slow daemon — near-zero cost when off
+                    _tsan.note_blocking("storage.rpc")
                     action = _faults.fire("storage.rpc", corruptable=True)
                     conn = self._conn()
                     try:

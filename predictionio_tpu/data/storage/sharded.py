@@ -211,7 +211,7 @@ class ShardedEventStore(base.EventStore):
         self._hedge_counter = get_default_registry().counter(
             "storage_hedged_reads_total",
             "hedged idempotent replica reads by outcome",
-            ("outcome",),
+            ("outcome",),  # label-bound: literal outcome set
         )
         #: shard indices skipped by the most recent degraded broadcast
         #: read (empty when that read was complete). Best-effort operator

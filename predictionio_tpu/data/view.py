@@ -32,15 +32,13 @@ import numpy as np
 from predictionio_tpu.data.store.bimap import BiMap
 from predictionio_tpu.data.store.columnar import EventFrame
 from predictionio_tpu.data.store.event_store import EventStoreFacade
+from predictionio_tpu.utils.env import env_path
 
 log = logging.getLogger(__name__)
 
 
 def default_view_dir() -> str:
-    base = os.environ.get(
-        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
-    )
-    return os.path.join(base, "view")
+    return os.path.join(env_path("PIO_FS_BASEDIR"), "view")
 
 
 def _iso(t: Optional[_dt.datetime]) -> Optional[str]:

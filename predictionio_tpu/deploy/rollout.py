@@ -35,6 +35,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Optional, TYPE_CHECKING
 
+from predictionio_tpu.utils.env import env_bool, env_float, env_int
 from predictionio_tpu.deploy.registry import (
     ROLLOUT_ENTITY,
     LifecycleRecordStore,
@@ -54,17 +55,6 @@ VARIANT_CANDIDATE = "candidate"
 # ROLLOUT_ENTITY record per rollout scope on the shared record layer, so
 # a query-server restart mid-canary re-adopts the bake instead of
 # silently dropping it
-
-
-def _env_float(env: dict, key: str, default: float) -> float:
-    raw = env.get(key)
-    if raw is None or raw == "":
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        log.warning("ignoring malformed %s=%r", key, raw)
-        return default
 
 
 @dataclass
@@ -88,17 +78,17 @@ class RolloutConfig:
     ) -> "RolloutConfig":
         env = dict(os.environ if env is None else env)
         cfg = RolloutConfig(
-            fraction=_env_float(env, "PIO_ROLLOUT_FRACTION", 0.1),
-            window_s=_env_float(env, "PIO_ROLLOUT_WINDOW_S", 30.0),
-            interval_s=_env_float(env, "PIO_ROLLOUT_INTERVAL_S", 1.0),
-            min_requests=int(_env_float(env, "PIO_ROLLOUT_MIN_REQUESTS", 20)),
-            max_error_delta=_env_float(
-                env, "PIO_ROLLOUT_MAX_ERROR_DELTA", 0.05
+            fraction=env_float("PIO_ROLLOUT_FRACTION", env=env),
+            window_s=env_float("PIO_ROLLOUT_WINDOW_S", env=env),
+            interval_s=env_float("PIO_ROLLOUT_INTERVAL_S", env=env),
+            min_requests=env_int("PIO_ROLLOUT_MIN_REQUESTS", env=env),
+            max_error_delta=env_float(
+                "PIO_ROLLOUT_MAX_ERROR_DELTA", env=env
             ),
-            max_p99_ratio=_env_float(env, "PIO_ROLLOUT_MAX_P99_RATIO", 3.0),
-            bake_s=_env_float(env, "PIO_ROLLOUT_BAKE_S", 60.0),
-            shadow=env.get("PIO_ROLLOUT_SHADOW", "") in ("1", "true", "yes"),
-            min_agreement=_env_float(env, "PIO_ROLLOUT_MIN_AGREEMENT", 0.9),
+            max_p99_ratio=env_float("PIO_ROLLOUT_MAX_P99_RATIO", env=env),
+            bake_s=env_float("PIO_ROLLOUT_BAKE_S", env=env),
+            shadow=env_bool("PIO_ROLLOUT_SHADOW", env=env),
+            min_agreement=env_float("PIO_ROLLOUT_MIN_AGREEMENT", env=env),
         )
         for k, v in overrides.items():
             if v is None:
@@ -269,6 +259,11 @@ class RolloutController:
             )
             if self.config.shadow else None
         )
+        # fallback mirrors spawned after the pool closed mid-request:
+        # tracked so stop() joins them (ISSUE 12 thread-lifecycle —
+        # the old fire-and-forget spawn outlived the controller)
+        self._stray_lock = threading.Lock()
+        self._stray_shadows: list[threading.Thread] = []  # guarded-by: _stray_lock
 
     # -- persistence ------------------------------------------------------
     def _persist(self, **fields: Any) -> None:
@@ -387,6 +382,14 @@ class RolloutController:
             self._thread = None
         if self._shadow_pool is not None:
             self._shadow_pool.shutdown(wait=False)
+        with self._stray_lock:
+            strays = list(self._stray_shadows)
+        for t in strays:
+            t.join(timeout=2)
+        with self._stray_lock:
+            self._stray_shadows[:] = [
+                s for s in self._stray_shadows if s.is_alive()
+            ]
 
     # -- serving-path hooks ----------------------------------------------
     def record(self, variant: str, duration_s: float, error: bool) -> None:
@@ -416,7 +419,13 @@ class RolloutController:
                 return
             except RuntimeError:
                 pass  # pool shut down: the rollout just ended
-        threading.Thread(target=fn, name="rollout-shadow", daemon=True).start()
+        t = threading.Thread(target=fn, name="rollout-shadow", daemon=True)
+        with self._stray_lock:
+            self._stray_shadows[:] = [
+                s for s in self._stray_shadows if s.is_alive()
+            ]
+            self._stray_shadows.append(t)
+        t.start()
 
     # -- verdict loop -----------------------------------------------------
     def _loop(self) -> None:

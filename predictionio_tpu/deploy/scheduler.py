@@ -470,7 +470,8 @@ class TrainScheduler:
             tempfile.gettempdir(), "pio_train_jobs"
         )
         self._jobs_counter = get_default_registry().counter(
-            "train_jobs_total", "scheduler job outcomes", ("outcome",)
+            "train_jobs_total", "scheduler job outcomes",
+            ("outcome",),  # label-bound: literal outcome set
         )
 
     # -- lifecycle --------------------------------------------------------

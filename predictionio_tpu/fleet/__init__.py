@@ -65,11 +65,12 @@ def stage_serving_runtime(user_factors, item_factors, **kwargs):
         return False
     from predictionio_tpu.fleet import runtime as _runtime
 
-    budget = os.environ.get("PIO_SERVE_HBM_BYTES")
+    from predictionio_tpu.utils.env import env_opt_float
+
     return _runtime.ShardedRuntime(
         user_factors,
         item_factors,
-        device_budget_bytes=float(budget) if budget else None,
+        device_budget_bytes=env_opt_float("PIO_SERVE_HBM_BYTES"),
         **kwargs,
     )
 

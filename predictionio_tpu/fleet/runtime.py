@@ -358,6 +358,7 @@ def _scatter_rows(
 # serving executables opt into memory analysis like the dense serving
 # kernels: the per-signature AOT compile lands in warmup, and the
 # temp/output bytes feed the tenant cache's transient accounting
+_scatter_rows = _devprof.instrument("fleet.scatter_rows", _scatter_rows)
 _sharded_recommend = _devprof.instrument(
     "fleet.recommend_sharded", _sharded_recommend, memory=True
 )

@@ -42,6 +42,7 @@ import numpy as np
 
 from predictionio_tpu.data.store.bimap import BiMap
 from predictionio_tpu.obs import devprof as _devprof
+from predictionio_tpu.utils.env import env_int, env_str
 from predictionio_tpu.ops.segment import (
     batched_cg,
     chunked_edge_matvec,
@@ -760,7 +761,7 @@ def dense_eligible(
     Auto mode also requires DENSE_AUTO_MIN_EDGES so small (test-scale)
     trains keep their f32-exact windowed numerics unless PIO_DENSE_ALS=1
     opts in."""
-    env = os.environ.get("PIO_DENSE_ALS", "").strip()
+    env = env_str("PIO_DENSE_ALS").strip()
     if env == "0":
         return False
     if params.rank > GRAM_SOLVER_MAX_RANK:
@@ -769,9 +770,7 @@ def dense_eligible(
         return False
     if env != "1" and len(rows) < DENSE_AUTO_MIN_EDGES:
         return False
-    budget = int(
-        os.environ.get("PIO_DENSE_ALS_BYTES", DENSE_DEFAULT_BYTES)
-    )
+    budget = env_int("PIO_DENSE_ALS_BYTES", DENSE_DEFAULT_BYTES)
     if dense_dtype == "bf16":  # the default: predict what auto picks
         from predictionio_tpu.ops.dense import int8_scale
 
@@ -2066,6 +2065,19 @@ def _set_cols_cow(table, cols, values):
 @partial(jax.jit, donate_argnums=(0,))
 def _set_cols_donated(table, cols, values):
     return table.at[0, cols].set(values)
+
+
+# the publish-path jits are tiny row writes, but they ARE top-level
+# dispatch boundaries (every fold-in tick pays them): instrumenting
+# keeps the serving-state publish visible in the devprof report
+_set_rows_cow = _devprof.instrument("als.publish_rows_cow", _set_rows_cow)
+_set_rows_donated = _devprof.instrument(
+    "als.publish_rows_donated", _set_rows_donated
+)
+_set_cols_cow = _devprof.instrument("als.publish_cols_cow", _set_cols_cow)
+_set_cols_donated = _devprof.instrument(
+    "als.publish_cols_donated", _set_cols_donated
+)
 
 
 def _grow_table(table: jax.Array, n_rows: int, axis: int = 0) -> jax.Array:

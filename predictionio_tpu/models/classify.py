@@ -19,6 +19,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from predictionio_tpu.obs import devprof as _devprof
 
 from predictionio_tpu.ops.segment import segment_sum
 
@@ -70,6 +71,10 @@ def _nb_train(x, y, w, *, n_classes: int, lam: float):
     return log_prior, log_like
 
 
+_nb_scores = _devprof.instrument("classify.nb_scores", _nb_scores)
+_nb_train = _devprof.instrument("classify.nb_train", _nb_train)
+
+
 def train_naive_bayes(
     x: np.ndarray,
     y: np.ndarray,
@@ -114,6 +119,11 @@ def _nb_train_grid(x, y, w, lams, *, n_classes: int):
         return log_prior, log_like
 
     return jax.vmap(smooth)(lams)
+
+
+_nb_train_grid = _devprof.instrument(
+    "classify.nb_train_grid", _nb_train_grid
+)
 
 
 def train_naive_bayes_grid(
@@ -168,6 +178,10 @@ def _lr_train(
     return _lr_train_body(
         x, y, wt, lr, l2, n_classes=n_classes, iterations=iterations
     )
+
+
+_lr_scores = _devprof.instrument("classify.lr_scores", _lr_scores)
+_lr_train = _devprof.instrument("classify.lr_train", _lr_train)
 
 
 def train_logistic_regression(
@@ -247,6 +261,11 @@ def _lr_train_body(x, y, wt, lr, l2, *, n_classes: int, iterations: int):
 
     w0 = jnp.zeros((d + 1, n_classes), jnp.float32)
     return jax.lax.fori_loop(0, iterations, body, w0)
+
+
+_lr_train_grid = _devprof.instrument(
+    "classify.lr_train_grid", _lr_train_grid
+)
 
 
 def train_logistic_regression_grid(

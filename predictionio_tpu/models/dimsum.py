@@ -19,6 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from predictionio_tpu.obs import devprof as _devprof
 
 from predictionio_tpu.ops.topk import masked_top_k
 
@@ -38,6 +39,9 @@ def _cosine_topn(matrix: jax.Array, *, top_n: int):
     vals, idx = masked_top_k(cos, top_n, exclude)
     idx = jnp.where(vals > 0.0, idx, -1)
     return vals, idx
+
+
+_cosine_topn = _devprof.instrument("dimsum.cosine_topn", _cosine_topn)
 
 
 def column_cosine_topn(

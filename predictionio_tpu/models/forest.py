@@ -39,6 +39,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from predictionio_tpu.obs import devprof as _devprof
 
 from predictionio_tpu.ops.segment import segment_sum
 
@@ -204,6 +205,11 @@ def _train_forest_jit(
     return features, routes_f, routes_t, proba
 
 
+_train_forest_jit = _devprof.instrument(
+    "forest.train", _train_forest_jit
+)
+
+
 def _predict_tree(routes_f, routes_t, proba, xbin, depth: int):
     node = jnp.zeros(xbin.shape[0], jnp.int32)
     for level in range(depth):
@@ -222,6 +228,11 @@ def _predict_forest_jit(routes_f, routes_t, proba, xbin, *, depth: int):
 # ---------------------------------------------------------------------------
 # Public model
 # ---------------------------------------------------------------------------
+
+
+_predict_forest_jit = _devprof.instrument(
+    "forest.predict", _predict_forest_jit
+)
 
 
 @dataclass

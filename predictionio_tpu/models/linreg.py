@@ -20,6 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from predictionio_tpu.obs import devprof as _devprof
 
 
 @dataclass
@@ -47,6 +48,11 @@ def _normal_eq_terms(x, y, w):
         precision=jax.lax.Precision.HIGHEST,
     )[:, 0]
     return xtx, xty, jnp.sum(w), xw.sum(0), jnp.sum(w * y)
+
+
+_normal_eq_terms = _devprof.instrument(
+    "linreg.normal_eq_terms", _normal_eq_terms
+)
 
 
 def train_linear_regression(

@@ -25,6 +25,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from predictionio_tpu.obs import devprof as _devprof
 
 from predictionio_tpu.data.store.bimap import BiMap
 
@@ -53,6 +54,11 @@ def _simrank_jit(w: jax.Array, *, iterations: int, decay: float) -> jax.Array:
         return s * (1.0 - eye) + eye
 
     return jax.lax.fori_loop(0, iterations, body, eye)
+
+
+_simrank_jit = _devprof.instrument(
+    "simrank.iterate", _simrank_jit, scale_by="iterations"
+)
 
 
 def compute(

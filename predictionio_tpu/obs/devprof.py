@@ -59,6 +59,8 @@ from typing import Any, Callable, NamedTuple, Optional
 
 from predictionio_tpu.obs import jaxmon as _jaxmon
 from predictionio_tpu.obs.registry import MetricsRegistry, get_default_registry
+from predictionio_tpu.utils.env import env_bool, env_opt_float, env_raw
+from predictionio_tpu.analysis import tsan as _tsan
 
 # -- platform peaks ---------------------------------------------------------
 
@@ -112,13 +114,7 @@ PADDING_RATIO_BUCKETS: tuple[float, ...] = (
 
 
 def _env_float(name: str) -> Optional[float]:
-    raw = os.environ.get(name)
-    if not raw:
-        return None
-    try:
-        return float(raw)
-    except ValueError:
-        return None
+    return env_opt_float(name)
 
 
 def platform_info(dtype: Optional[str] = None) -> dict:
@@ -762,7 +758,7 @@ def report() -> dict:
 
 
 def _enabled() -> bool:
-    return os.environ.get("PIO_DEVPROF", "").strip() != "0"
+    return env_bool("PIO_DEVPROF")
 
 
 # -- the jit-boundary hook --------------------------------------------------
@@ -786,7 +782,7 @@ class _Instrumented:
         self.__doc__ = getattr(fn, "__doc__", None)
 
     def memory_enabled(self) -> bool:
-        env = os.environ.get("PIO_DEVPROF_MEMORY", "").strip()
+        env = (env_raw("PIO_DEVPROF_MEMORY") or "").strip()
         if env == "0":
             return False
         if env == "1":
@@ -794,6 +790,10 @@ class _Instrumented:
         return self.memory
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        # sanitizer hook (ISSUE 12): a lock held across a device
+        # dispatch serializes the whole server behind it — near-zero
+        # cost (one bool) when PIO_TSAN is off
+        _tsan.note_blocking("device.dispatch")
         if not _enabled() or "jax" not in sys.modules or _under_trace():
             return self.__wrapped__(*args, **kwargs)
         # call() fences all its own bookkeeping: the wrapped function

@@ -42,6 +42,7 @@ from predictionio_tpu.obs.monitor.tsdb import (
     sample_families,
 )
 from predictionio_tpu.utils.env import env_float
+from predictionio_tpu.utils.env import env_bool
 
 __all__ = [
     "TSDB",
@@ -62,7 +63,7 @@ __all__ = [
 
 
 def enabled() -> bool:
-    return os.environ.get("PIO_TSDB", "").strip() != "0"
+    return env_bool("PIO_TSDB")
 
 
 class Monitor:
@@ -149,6 +150,10 @@ class Monitor:
             stop_engine.stop()
         if stop_sampler is not None:
             stop_sampler.stop()
+        if stop_engine is not None or stop_sampler is not None:
+            # last detach also joins in-flight alert deliveries — a
+            # notification thread must not outlive the plane (ISSUE 12)
+            self.notifier.close(timeout=2.0)
 
     def _ensure_threads(self) -> None:
         with self._lock:
@@ -191,6 +196,8 @@ class Monitor:
 
         return get_default_registry().gauge(
             "alerts_firing", "SLO alerts currently firing (1) or not (0)",
+            # label-bound: declared SLO specs + external alerts, which
+            # remove() their series on resolve (ISSUE 9 round 5)
             ("slo",),
         )
 

@@ -22,6 +22,8 @@ import shlex
 import threading
 from typing import Any, Optional
 
+from predictionio_tpu.utils.env import env_str
+
 log = logging.getLogger(__name__)
 
 MAX_INFLIGHT = 4
@@ -38,6 +40,11 @@ class AlertNotifier:
         self.webhook_url = webhook_url
         self.exec_cmd = exec_cmd
         self._inflight = threading.Semaphore(MAX_INFLIGHT)
+        # in-flight delivery threads, so close() can JOIN them (ISSUE 12
+        # thread-lifecycle: the old fire-and-forget spawn could outlive
+        # the SLO engine that pushed the alert)
+        self._threads_lock = threading.Lock()
+        self._threads: set[threading.Thread] = set()  # guarded-by: _threads_lock
         if registry is None:
             from predictionio_tpu.obs.registry import get_default_registry
 
@@ -45,15 +52,14 @@ class AlertNotifier:
         self._counter = registry.counter(
             "alert_notifications_total",
             "alert notifications pushed, by sink and outcome",
-            ("sink", "outcome"),
+            ("sink", "outcome"),  # label-bound: literal sink/outcome sets
         )
 
     @staticmethod
     def from_env(env: Optional[dict] = None) -> "AlertNotifier":
-        env = os.environ if env is None else env
         return AlertNotifier(
-            webhook_url=(env.get("PIO_ALERT_WEBHOOK") or "").strip() or None,
-            exec_cmd=(env.get("PIO_ALERT_EXEC") or "").strip() or None,
+            webhook_url=env_str("PIO_ALERT_WEBHOOK", env=env).strip() or None,
+            exec_cmd=env_str("PIO_ALERT_EXEC", env=env).strip() or None,
         )
 
     def active(self) -> bool:
@@ -73,6 +79,8 @@ class AlertNotifier:
             target=self._deliver, args=(dict(alert),),
             name="alert-notify", daemon=True,
         )
+        with self._threads_lock:
+            self._threads.add(t)
         t.start()
 
     def _deliver(self, alert: dict[str, Any]) -> None:
@@ -84,6 +92,20 @@ class AlertNotifier:
                 self._exec(payload)
         finally:
             self._inflight.release()
+            with self._threads_lock:
+                self._threads.discard(threading.current_thread())
+
+    def close(self, timeout: float = TIMEOUT_S) -> None:
+        """Join in-flight deliveries — the owner (Monitor/SLO engine)
+        calls this on stop so no notification thread outlives it."""
+        with self._threads_lock:
+            pending = list(self._threads)
+        for t in pending:
+            t.join(timeout=timeout)
+        with self._threads_lock:
+            self._threads.difference_update(
+                t for t in pending if not t.is_alive()
+            )
 
     def _post(self, payload: str) -> None:
         import urllib.request

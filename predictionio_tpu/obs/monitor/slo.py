@@ -57,6 +57,7 @@ from predictionio_tpu.obs.monitor.tsdb import TSDB
 from predictionio_tpu.obs.registry import MetricsRegistry
 
 log = logging.getLogger(__name__)
+from predictionio_tpu.utils.env import env_str
 
 KINDS = ("availability", "latency", "up")
 
@@ -155,7 +156,7 @@ def load_slos(text: Optional[str] = None) -> list[SLOSpec]:
     """Parse `PIO_SLOS` (or an explicit string): a JSON array of spec
     objects, or ``@/path/to/slos.json``. Malformed input logs and
     yields [] — a typo'd spec must not take a server down."""
-    raw = text if text is not None else os.environ.get("PIO_SLOS", "")
+    raw = text if text is not None else env_str("PIO_SLOS")
     raw = (raw or "").strip()
     if not raw:
         return []
@@ -239,7 +240,7 @@ class SLOEngine:
             registry = get_default_registry()
         self._firing_gauge = registry.gauge(
             "alerts_firing", "SLO alerts currently firing (1) or not (0)",
-            ("slo",),
+            ("slo",),  # label-bound: operator-declared SLO spec names
         )
 
     # -- spec management ---------------------------------------------------

@@ -101,10 +101,10 @@ class TSDB:
         self.capacity = max(2, int(capacity))
         self.max_series = max(1, int(max_series))
         self._lock = threading.Lock()
-        self._series: "OrderedDict[tuple[str, LabelPairs], Series]" = (
+        self._series: "OrderedDict[tuple[str, LabelPairs], Series]" = (  # guarded-by: _lock
             OrderedDict()
         )
-        self.dropped_series = 0  # adds refused at the cardinality cap
+        self.dropped_series = 0  # adds refused at the cardinality cap  # guarded-by: _lock
 
     # -- writing -----------------------------------------------------------
     def add(self, name: str, labels: Optional[dict], value: float,

@@ -47,6 +47,8 @@ import uuid
 from collections import OrderedDict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+from predictionio_tpu.utils import env as _env
 from typing import Any, Callable, Iterator, Optional
 
 from predictionio_tpu.obs import tracing as _tracing
@@ -99,10 +101,7 @@ class Span:
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+    return _env.env_float(name, default)
 
 
 class SpanRecorder:
@@ -140,16 +139,16 @@ class SpanRecorder:
         self.max_spans_per_trace = 512
         self._lock = threading.Lock()
         # trace_id -> spans completed but not yet sampled-on
-        self._active: "OrderedDict[str, list[Span]]" = OrderedDict()
+        self._active: "OrderedDict[str, list[Span]]" = OrderedDict()  # guarded-by: _lock
         # trace_id -> {"spans": [...], "reason": keep-reason}
-        self._traces: "OrderedDict[str, dict]" = OrderedDict()
-        self._bridges: dict[str, Callable[[Span], None]] = {}
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
+        self._bridges: dict[str, Callable[[Span], None]] = {}  # guarded-by: _lock
         # query-triggered capture (ISSUE 8 satellite): capture_id ->
         # {"requested", "remaining", "trace_ids", ...}; the dispatcher
         # consumes one "batch credit" per device batch and force-keeps
         # that batch's traces regardless of the sample rate
-        self._captures: "OrderedDict[str, dict]" = OrderedDict()
-        self._forced: dict[str, str] = {}  # trace_id -> capture_id
+        self._captures: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
+        self._forced: dict[str, str] = {}  # trace_id -> capture_id  # guarded-by: _lock
 
     # -- recording ---------------------------------------------------------
     @contextmanager
@@ -274,7 +273,8 @@ class SpanRecorder:
         (typically `lambda sp: histogram.observe(sp.duration)`), so the
         span is the single source for both the trace and the metric.
         One callback per name — last registration wins."""
-        self._bridges[span_name] = observe
+        with self._lock:
+            self._bridges[span_name] = observe
 
     def unbridge(
         self, span_name: str,
@@ -283,8 +283,12 @@ class SpanRecorder:
         """Remove a bridge. With `observe`, removes only if it is still
         the registered callback — a stopped server must not tear down a
         newer server's bridge."""
-        if observe is None or self._bridges.get(span_name) is observe:
-            self._bridges.pop(span_name, None)
+        # check+pop under the recorder lock: a stopping server racing
+        # a newer server's registration must not observe its own bridge
+        # and then pop the replacement (ISSUE 12 lock-discipline find)
+        with self._lock:
+            if observe is None or self._bridges.get(span_name) is observe:
+                self._bridges.pop(span_name, None)
 
     # -- query-triggered capture (ISSUE 8 satellite) -----------------------
     def arm_capture(self, n_batches: int) -> str:

@@ -145,8 +145,8 @@ class OnlineConsumer:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._paused: Optional[str] = None
-        self._drift_paused = False  # drift pauses auto-clear on retrain
+        self._paused: Optional[str] = None  # guarded-by: _lock
+        self._drift_paused = False  # auto-clears on retrain  # guarded-by: _lock
         self._last_runtime: Any = None
         self._ticks_persisted = 0
         self._last_error: Optional[str] = None
@@ -212,7 +212,7 @@ class OnlineConsumer:
         self._rows_ctr = self.metrics.counter(
             "online_rows_folded_total",
             "factor rows re-solved by fold-in, by side",
-            ("side",),
+            ("side",),  # label-bound: literal user|item
         )
         self._tick_hist = self.metrics.histogram(
             "online_fold_tick_seconds",
@@ -226,12 +226,14 @@ class OnlineConsumer:
             "online_drift_score",
             "score-distribution drift of the folded model vs the "
             "last-trained baseline",
+            # label-bound: one scope per attached consumer (server +
+            # cached tenants — bounded by the tenant cache)
             ("scope",),
         )
         self._paused_gauge = self.metrics.gauge(
             "online_paused",
             "1 while fold-in is paused (drift breach or operator)",
-            ("scope",),
+            ("scope",),  # label-bound: one scope per attached consumer
         )
 
     # -- lifecycle ----------------------------------------------------------

@@ -51,6 +51,7 @@ first TPU deployment must re-run the parity suite in "tpu" mode.
 """
 
 from __future__ import annotations
+from predictionio_tpu.utils.env import env_str as _env_str
 
 import functools
 import os
@@ -199,7 +200,9 @@ def _make_kernel(
     jax.jit,
     static_argnames=("k", "interpret", "item_tile"),
 )
-def fused_recommend_topk(
+def fused_recommend_topk(  # lint: disable=jit-boundary — inner
+    # boundary: invoked inside als.recommend_serving / the sharded
+    # local(), both instrumented; this jit inlines into their traces
     q: jax.Array,  # (B, K) f32 — or int8 when quantized
     itf: jax.Array,  # (I_p, K) f32 — or int8 when quantized
     q_scale=None,  # (B, 1) f32 per-row dequant scales (int8 mode)
@@ -341,7 +344,7 @@ def resolve_mode(requested: str = "auto"):
         return None
     if requested == "interpret":
         return "interpret"
-    env = os.environ.get("PIO_PALLAS_RECOMMEND", "").strip()
+    env = _env_str("PIO_PALLAS_RECOMMEND").strip()
     if env == "0":
         return None
     if env == "interpret":

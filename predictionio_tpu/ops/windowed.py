@@ -31,6 +31,7 @@ examples/scala-parallel-recommendation/*/ALSAlgorithm.scala:50-57).
 """
 
 from __future__ import annotations
+from predictionio_tpu.utils.env import env_str as _env_str
 
 import os
 from dataclasses import dataclass
@@ -212,7 +213,7 @@ def resolve_pallas_mode(requested: str = "auto") -> Optional[str]:
         return "interpret"
     if requested in ("tpu", "1"):
         return "tpu" if windowed_pallas.available() else None
-    env = os.environ.get("PIO_PALLAS_WINDOWED", "").strip()
+    env = _env_str("PIO_PALLAS_WINDOWED").strip()
     if env == "0":
         return None
     if env == "interpret":

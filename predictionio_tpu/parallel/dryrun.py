@@ -12,6 +12,8 @@ reductions combined associatively — here, by XLA collectives over ICI.
 
 from __future__ import annotations
 
+from predictionio_tpu.utils.env import env_raw as _env_raw
+
 
 def run_dryrun(n_devices: int) -> None:
     """Body of the dry run. Requires >= n_devices visible jax devices."""
@@ -52,7 +54,7 @@ def run_dryrun(n_devices: int) -> None:
                 rank=rank, iterations=1, cg_iterations=2,
                 implicit_prefs=implicit,
             )
-            prior = _os.environ.get("PIO_PALLAS_WINDOWED")
+            prior = _env_raw("PIO_PALLAS_WINDOWED")
             if pallas:
                 _os.environ["PIO_PALLAS_WINDOWED"] = "interpret"
             try:
@@ -78,7 +80,7 @@ def run_dryrun(n_devices: int) -> None:
         d_rows = (keys // n_items).astype(np.int32)
         d_cols = (keys % n_items).astype(np.int32)
         d_vals = np.float32(1.0) + (keys % 5).astype(np.float32)
-        prior = _os.environ.get("PIO_DENSE_ALS")
+        prior = _env_raw("PIO_DENSE_ALS")
         _os.environ["PIO_DENSE_ALS"] = "1"
         try:
             factors = als.train(

@@ -57,11 +57,13 @@ class CircuitBreaker:
         self._state_gauge = registry.gauge(
             "resilience_breaker_state",
             "circuit breaker state (0 closed, 1 open, 2 half-open)",
+            # label-bound: one endpoint per configured storage source
             ("endpoint",),
         )
         self._transitions = registry.counter(
             "resilience_breaker_transitions_total",
             "circuit breaker state transitions, by destination state",
+            # label-bound: configured storage sources x literal states
             ("endpoint", "state"),
         )
         self._state_gauge.set(0.0, endpoint=name)

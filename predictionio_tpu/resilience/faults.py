@@ -40,6 +40,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
+from predictionio_tpu.utils.env import env_raw, env_str
+
 FAULT_POINTS = (
     "storage.rpc",
     "event.insert",
@@ -217,6 +219,7 @@ class FaultRegistry:
             get_default_registry().counter(
                 "faults_injected_total",
                 "injected faults fired, by point and mode",
+                # label-bound: registered fault points x literal modes
                 ("point", "mode"),
             ).inc(point=point, mode=mode)
         except Exception:
@@ -229,10 +232,10 @@ class FaultRegistry:
         below downgrades it to a warning so a typo'd env var cannot
         crash every server and the CLI alike."""
         env = env if env is not None else os.environ
-        text = env.get("PIO_FAULTS", "")
+        text = env_str("PIO_FAULTS", env=env)
         if not text:
             return
-        seed_s = env.get("PIO_FAULTS_SEED")
+        seed_s = env_raw("PIO_FAULTS_SEED", env=env)
         try:
             seed = int(seed_s) if seed_s else None
         except ValueError:

@@ -26,6 +26,7 @@ import time
 from typing import Any, Callable, Optional
 
 log = logging.getLogger(__name__)
+from predictionio_tpu.analysis import tsan as _tsan
 
 
 class ModelLoadError(RuntimeError):
@@ -175,12 +176,12 @@ class ModelCache:
         self._measure = measure or estimate_runtime_device_bytes
         self._transient = transient or serving_transient_bytes
         self._lock = threading.Lock()
-        self._entries: dict[str, CacheEntry] = {}
+        self._entries: dict[str, CacheEntry] = {}  # guarded-by: _lock
         # per-tenant build locks: a slow model load must serialize the
         # SAME tenant's concurrent misses (one build, many waiters) but
         # never block other tenants' hits
-        self._load_locks: dict[str, threading.Lock] = {}
-        self._seen: set[str] = set()  # tenants ever loaded → miss vs reload
+        self._load_locks: dict[str, threading.Lock] = {}  # guarded-by: _lock
+        self._seen: set[str] = set()  # tenants ever loaded  # guarded-by: _lock
         self.hits = 0
         self.misses = 0
         self.reloads = 0
@@ -235,9 +236,13 @@ class ModelCache:
                 entry.last_used = time.monotonic()
                 self.hits += 1
                 return entry
-            load_lock = self._load_locks.setdefault(
-                tenant.id, threading.Lock()
-            )
+            load_lock = self._load_locks.get(tenant.id)
+            if load_lock is None:
+                load_lock = self._load_locks[tenant.id] = threading.Lock()
+                # sanitizer: this lock's entire JOB is to be held across
+                # the device-staging model build (one build, many
+                # waiters; other tenants' hits never touch it)
+                _tsan.allow_blocking_lock(load_lock)
         with load_lock:
             # double-check: another thread may have finished the load
             # while this one waited on the per-tenant lock
@@ -424,7 +429,7 @@ class ModelCache:
             )
         return len(self._entries) > self.capacity
 
-    def _evict_locked(self) -> None:
+    def _evict_locked(self) -> None:  # lint: holds=_lock
         while self._over_capacity_locked():
             victims = [
                 e for e in self._entries.values()

@@ -41,11 +41,11 @@ class FairQueue:
         self._weight_of = weight_of
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
-        self._queues: dict[Optional[str], collections.deque] = {}
-        self._deficit: dict[Optional[str], float] = {}
+        self._queues: dict[Optional[str], collections.deque] = {}  # guarded-by: _lock|_not_empty
+        self._deficit: dict[Optional[str], float] = {}  # guarded-by: _lock|_not_empty
         # round-robin rotation of tenants with queued items
-        self._order: collections.deque = collections.deque()
-        self._size = 0
+        self._order: collections.deque = collections.deque()  # guarded-by: _lock|_not_empty
+        self._size = 0  # guarded-by: _lock|_not_empty
 
     def _weight(self, tenant: Optional[str]) -> float:
         if self._weight_of is None:
@@ -108,7 +108,7 @@ class FairQueue:
                         raise _q.Empty
                     self._not_empty.wait(remaining)
 
-    def _pop_locked(self) -> Any:
+    def _pop_locked(self) -> Any:  # lint: holds=_not_empty
         if not self._size:
             raise _q.Empty
         # DRR: visit the head tenant; a visit credits `weight`, serving

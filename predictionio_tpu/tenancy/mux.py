@@ -162,22 +162,24 @@ class TenantMux:
         self._requests = self.metrics.counter(
             "tenant_requests_total",
             "queries served per tenant (label set bounded)",
+            # label-bound: PIO_TENANT_METRIC_MAX cap + (other) overflow
             ("tenant", "outcome"),
         )
         self._serve_hist = self.metrics.histogram(
             "tenant_serve_seconds",
             "end-to-end serve time per tenant",
-            ("tenant",),
+            ("tenant",),  # label-bound: PIO_TENANT_METRIC_MAX + (other)
         )
         self._quota_rejected = self.metrics.counter(
             "tenant_quota_rejected_total",
             "admissions refused per tenant and quota resource (429s)",
+            # label-bound: PIO_TENANT_METRIC_MAX cap x literal resources
             ("tenant", "resource"),
         )
         self._device_seconds = self.metrics.counter(
             "tenant_device_seconds_total",
             "device time charged per tenant",
-            ("tenant",),
+            ("tenant",),  # label-bound: PIO_TENANT_METRIC_MAX + (other)
         )
         for name, fn in (
             ("tenant_cache_resident", lambda: self.cache.stats()["resident"]),

@@ -345,10 +345,11 @@ class _Handler(JsonHandler):
         disabled unless the operator set PIO_ROLLOUT_PROXY=1 (the `pio
         rollout` console talks to the query server directly and needs
         no gate)."""
-        import os as _os
         from urllib.parse import urlsplit
 
-        if not _os.environ.get("PIO_ROLLOUT_PROXY"):
+        from predictionio_tpu.utils.env import env_flag as _env_flag
+
+        if not _env_flag("PIO_ROLLOUT_PROXY"):
             raise HttpError(403, "rollout proxy is disabled: set "
                                  "PIO_ROLLOUT_PROXY=1 on this server to "
                                  "enable it")

@@ -28,6 +28,7 @@ from predictionio_tpu.data.storage.base import App, Channel
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.tools import common
 from predictionio_tpu.tools.common import CommandError
+from predictionio_tpu.utils.env import env_str as _env_str
 
 
 def _storage() -> Storage:
@@ -435,6 +436,97 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+KNOBS_BEGIN = "<!-- knobs:begin -->"
+KNOBS_END = "<!-- knobs:end -->"
+
+
+def _readme_knob_drift(readme_path: str, table: str) -> Optional[str]:
+    """None when the README knob section matches the registry; else a
+    human-readable drift description."""
+    try:
+        with open(readme_path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return f"cannot read {readme_path}: {e}"
+    try:
+        start = text.index(KNOBS_BEGIN) + len(KNOBS_BEGIN)
+        end = text.index(KNOBS_END)
+    except ValueError:
+        return (
+            f"{readme_path} has no {KNOBS_BEGIN} ... {KNOBS_END} "
+            "markers around the Configuration knobs table"
+        )
+    current = text[start:end].strip()
+    if current != table.strip():
+        return (
+            f"{readme_path} knob table is stale — regenerate with "
+            "`pio lint --knobs` and paste between the markers"
+        )
+    return None
+
+
+def cmd_lint(args) -> int:
+    """`pio lint`: run the in-tree invariant analyzer (ISSUE 12)."""
+    import json as _json
+
+    from predictionio_tpu.analysis import lint as _lint
+    from predictionio_tpu.utils.env import knobs_markdown
+
+    if args.tsan_report is not None:
+        path = args.tsan_report or "tsan-report.json"
+        try:
+            with open(path, encoding="utf-8") as f:
+                rep = _json.load(f)
+        except OSError as e:
+            return _fail(f"cannot read tsan report: {e}")
+        print(_json.dumps(rep, indent=2, sort_keys=True))
+        n = int(rep.get("findings_count", 0))
+        print(f"tsan findings: {n}")
+        return 1 if n else 0
+
+    if args.knobs:
+        table = knobs_markdown()
+        if args.check_readme:
+            drift = _readme_knob_drift(args.check_readme, table)
+            if drift is not None:
+                print(drift, file=sys.stderr)
+                return 1
+            print(f"{args.check_readme} knob table is fresh")
+            return 0
+        print(table, end="")
+        return 0
+
+    rules = _lint.all_rules()
+    if args.rule:
+        known = {r.name for r in rules}
+        unknown = [r for r in args.rule if r not in known]
+        if unknown:
+            return _fail(
+                f"unknown rule(s) {unknown}; available: {sorted(known)}"
+            )
+        rules = [r for r in rules if r.name in args.rule]
+    paths = args.paths or [_lint.package_root()]
+    findings, errors = _lint.lint_paths(paths, rules)
+    if args.json:
+        print(_json.dumps(
+            {
+                "findings": [f.as_dict() for f in findings],
+                "errors": errors,
+            },
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f)
+        for e in errors:
+            print(f"error: {e}", file=sys.stderr)
+        print(
+            f"pio lint: {len(findings)} finding(s), {len(errors)} "
+            f"error(s) across {len(rules)} rule(s)"
+        )
+    return 1 if findings or errors else 0
+
+
 def _fetch_json(url: str, path: str, timeout: float = 10.0) -> dict:
     """GET a server JSON surface: the one fetch helper every remote
     (`--url`) subcommand shares."""
@@ -749,7 +841,7 @@ def cmd_monitor(args) -> int:
     )
 
     targets = parse_targets(
-        args.targets or os.environ.get("PIO_MONITOR_TARGETS", "")
+        args.targets or _env_str("PIO_MONITOR_TARGETS")
     )
     if not targets:
         return _fail(
@@ -1697,6 +1789,31 @@ def build_parser() -> argparse.ArgumentParser:
         help="SLO specs: JSON array or @/path.json (default: PIO_SLOS)",
     )
     s.set_defaults(func=cmd_monitor)
+
+    s = sub.add_parser(
+        "lint",
+        help="run the in-tree invariant analyzer (ISSUE 12)",
+    )
+    s.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the package)")
+    s.add_argument("--rule", action="append", default=None,
+                   help="run only this rule (repeatable)")
+    s.add_argument("--json", action="store_true",
+                   help="emit findings as JSON")
+    s.add_argument(
+        "--knobs", action="store_true",
+        help="emit the env-knob registry as a markdown table",
+    )
+    s.add_argument(
+        "--check-readme", default=None, metavar="README",
+        help="with --knobs: verify the README knob table is fresh",
+    )
+    s.add_argument(
+        "--tsan-report", nargs="?", const="tsan-report.json",
+        default=None, metavar="PATH",
+        help="pretty-print a sanitizer JSON report (exit 1 on findings)",
+    )
+    s.set_defaults(func=cmd_lint)
 
     s = sub.add_parser(
         "alerts",

@@ -331,7 +331,7 @@ class Dashboard(ServerProcess):
                  port: int = 9000,
                  monitor_targets: Optional[str] = None,
                  scrape_interval_s: Optional[float] = None):
-        import os
+        from predictionio_tpu.utils.env import env_str
 
         super().__init__()
         self.storage = storage or Storage.get_instance()
@@ -339,7 +339,7 @@ class Dashboard(ServerProcess):
         self.port_config = port
         self.monitor_targets = (
             monitor_targets if monitor_targets is not None
-            else os.environ.get("PIO_MONITOR_TARGETS", "")
+            else env_str("PIO_MONITOR_TARGETS")
         )
         self.scrape_interval_s = scrape_interval_s
         self._scraper = None

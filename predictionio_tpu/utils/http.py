@@ -158,6 +158,8 @@ class JsonHandler(BaseHTTPRequestHandler):
             registry.counter(
                 "http_requests_total",
                 "HTTP requests served",
+                # label-bound: path through _route_label's table
+                # (cardinality-guard test), method/status from HTTP
                 ("server", "method", "path", "status"),
             ).inc(
                 server=label, method=self.command,
@@ -166,7 +168,7 @@ class JsonHandler(BaseHTTPRequestHandler):
             registry.histogram(
                 "http_request_seconds",
                 "request wall time, request line to response written",
-                ("server", "path"),
+                ("server", "path"),  # label-bound: _route_label table
             ).observe(duration, server=label, path=metric_path)
         _obs_tracing.log_access(
             server=label,
@@ -334,12 +336,12 @@ class JsonHandler(BaseHTTPRequestHandler):
         PIO_PROFILE_CAPTURE_DIR on the server process; 409 when jax is
         not loaded here or a capture is already running. Body:
         {"seconds": 2.0} (bounded to (0, 60])."""
-        import os as _os
         import time as _time
 
         from predictionio_tpu.obs import devprof as _devprof
+        from predictionio_tpu.utils.env import env_path as _env_path
 
-        cap_dir = _os.environ.get("PIO_PROFILE_CAPTURE_DIR")
+        cap_dir = _env_path("PIO_PROFILE_CAPTURE_DIR")
         if not cap_dir:
             self._respond(403, {
                 "message": "profiler capture is disabled: set "
@@ -379,11 +381,10 @@ class JsonHandler(BaseHTTPRequestHandler):
         PIO_FAULTS_ADMIN=1 on the server process. Body:
         {"set": "point:mode:prob[:param][,...]", "seed": N} and/or
         {"clear": "point" | true}."""
-        import os as _os
-
         from predictionio_tpu.resilience import faults as _faults
+        from predictionio_tpu.utils.env import env_flag as _env_flag
 
-        if not _os.environ.get("PIO_FAULTS_ADMIN"):
+        if not _env_flag("PIO_FAULTS_ADMIN"):
             self._respond(403, {
                 "message": "fault-injection admin is disabled: set "
                            "PIO_FAULTS_ADMIN=1 on this server to enable it"

@@ -149,7 +149,7 @@ def run_train(
     def _count_run(status: str) -> None:
         get_default_registry().counter(
             "train_runs_total", "train workflows by final status",
-            ("status",),
+            ("status",),  # label-bound: literal status set
         ).inc(status=status)
 
     try:

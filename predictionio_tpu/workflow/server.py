@@ -44,6 +44,7 @@ from predictionio_tpu.utils.http import (
 from predictionio_tpu.workflow.core import prepare_deploy_models
 
 log = logging.getLogger(__name__)
+from predictionio_tpu.analysis import tsan as _tsan
 
 OUTPUT_BLOCKER = "outputblocker"
 OUTPUT_SNIFFER = "outputsniffer"
@@ -224,8 +225,12 @@ class _Handler(JsonHandler):
                     self._respond(200, {"message": "Reload successful"})
             elif path == "/stop":
                 self._respond(200, {"message": "Shutting down"})
+                # lint: disable=thread-lifecycle — self-stop: the server
+                # cannot join the thread that tears it down (stop() joins
+                # THIS handler's pool); the thread exits with the process
                 threading.Thread(
-                    target=self.server.owner.stop, daemon=True
+                    target=self.server.owner.stop,
+                    name="server-self-stop", daemon=True,
                 ).start()
             else:
                 self._respond(404, {"message": "Not Found"})
@@ -1198,7 +1203,7 @@ class QueryServer(ServerProcess):
         self._shed_counter = self.metrics.counter(
             "queries_shed_total",
             "queries shed before device dispatch (503 + Retry-After)",
-            ("reason",),
+            ("reason",),  # label-bound: literal shed-reason set
         )
         # canary rollout (ISSUE 5): per-variant serve/error metrics under
         # a `variant` label — p99s come from the labeled histogram, the
@@ -1206,28 +1211,39 @@ class QueryServer(ServerProcess):
         self._variant_serve_hist = self.metrics.histogram(
             "variant_serve_seconds",
             "end-to-end serve time by rollout variant",
-            ("variant",),
+            ("variant",),  # label-bound: literal live|candidate
         )
         self._variant_requests = self.metrics.counter(
             "variant_requests_total", "queries served by rollout variant",
-            ("variant",),
+            ("variant",),  # label-bound: literal live|candidate
         )
         self._variant_errors = self.metrics.counter(
             "variant_errors_total",
             "failed queries (4xx/5xx/shed) by rollout variant",
-            ("variant",),
+            ("variant",),  # label-bound: literal live|candidate
         )
         # runtime-swap lock (ISSUE 5 satellite): /reload and rollout
         # promote/abort all mutate the served-runtime references; the
         # lock serializes them so two concurrent reloads cannot
         # interleave build_runtime with the swap
         self._swap_lock = threading.RLock()
-        self.candidate: Optional[EngineRuntime] = None
-        self.rollout = None  # Optional[RolloutController]
+        # sanitizer: reload/promote intentionally hold the swap lock
+        # across the candidate's device-staging build (two concurrent
+        # reloads must serialize); the SERVING path never takes this
+        # lock — queries ride runtime snapshots — so nothing user-facing
+        # blocks behind it
+        _tsan.allow_blocking_lock(self._swap_lock)
+        self.candidate: Optional[EngineRuntime] = None  # guarded-by: _swap_lock
+        self.rollout = None  # Optional[RolloutController]  # guarded-by: _swap_lock
         self.tenancy = None  # Optional[TenantMux] (ISSUE 6)
         self.online = None  # Optional[OnlineConsumer] (ISSUE 9)
         self.last_serving_sec = 0.0
         self.last_predict_sec = 0.0
+        # in-flight feedback POST threads: tracked so stop() joins them
+        # (ISSUE 12 thread-lifecycle — the old per-feedback spawn could
+        # outlive the server and POST into a torn-down event server)
+        self._feedback_lock = threading.Lock()
+        self._feedback_threads: set[threading.Thread] = set()  # guarded-by: _feedback_lock
         self.dispatcher: Optional[_BatchDispatcher] = None
         if self.config.micro_batch:
             self.dispatcher = _BatchDispatcher(
@@ -1268,6 +1284,10 @@ class QueryServer(ServerProcess):
         _spans.get_default_recorder().unbridge(
             "batch.queue_wait", self._queue_wait_bridge
         )
+        with self._feedback_lock:
+            pending_feedback = list(self._feedback_threads)
+        for t in pending_feedback:
+            t.join(timeout=11)  # POST timeout is 10s
         super().stop()  # also detaches the log shipper (ServerProcess)
 
     def _make_server(self) -> _Server:
@@ -1666,8 +1686,16 @@ class QueryServer(ServerProcess):
                 urllib.request.urlopen(req, timeout=10).read()
             except Exception:
                 log.exception("feedback event POST failed")
+            finally:
+                with self._feedback_lock:
+                    self._feedback_threads.discard(
+                        threading.current_thread()
+                    )
 
-        threading.Thread(target=post, daemon=True).start()
+        t = threading.Thread(target=post, name="feedback-post", daemon=True)
+        with self._feedback_lock:
+            self._feedback_threads.add(t)
+        t.start()
 
     # -- status page (reference CreateServer.scala:461-489 Twirl html) -----
     def status_html(self) -> str:

@@ -10,6 +10,20 @@ force_cpu_platform(n_devices=8, override=False)
 
 import pytest  # noqa: E402
 
+# thread-sanitizer integration (ISSUE 12): with PIO_TSAN=1 the lock
+# constructors are patched before any test runs, and session teardown
+# runs the thread-leak tripwire + writes the JSON findings report.
+# Delegated so plain `python -m pytest tests/` needs no -p flag.
+from predictionio_tpu.analysis import pytest_plugin as _tsan_plugin  # noqa: E402
+
+
+def pytest_configure(config):
+    _tsan_plugin.pytest_configure(config)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _tsan_plugin.pytest_sessionfinish(session, exitstatus)
+
 
 @pytest.fixture(scope="session")
 def mesh8():

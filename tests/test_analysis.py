@@ -1,0 +1,690 @@
+"""ISSUE 12: the in-tree invariant analyzer (`pio lint`) + thread
+sanitizer. Positive/negative fixture snippets per checker, suppression
+handling, the env-knob registry, the seeded AB/BA lock inversion, the
+thread-leak tripwire, the blocked-while-holding hook, the console
+round-trip — and the gate itself: the real package must lint clean."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.analysis import lint as lint_mod
+from predictionio_tpu.analysis import tsan
+from predictionio_tpu.utils import env as envmod
+
+
+def run_lint(tmp_path, source, rules=None, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    findings, errors = lint_mod.lint_paths([str(p)], rules)
+    assert not errors, errors
+    return findings
+
+
+def rules_named(*names):
+    by_name = {r.name: r for r in lint_mod.all_rules()}
+    return [by_name[n] for n in names]
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+GOOD_THREAD = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="worker", daemon=True
+        )
+
+    def _loop(self):
+        pass
+
+    def stop(self):
+        self._thread.join(timeout=5)
+'''
+
+BAD_THREAD_FIRE_AND_FORGET = '''
+import threading
+
+def kick():
+    threading.Thread(target=print, name="oops", daemon=True).start()
+'''
+
+BAD_THREAD_NO_NAME = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        pass
+
+    def stop(self):
+        self._thread.join()
+'''
+
+BAD_THREAD_NO_STOP = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._thread = threading.Thread(
+            target=print, name="w", daemon=True
+        )
+'''
+
+GOOD_THREAD_LOCAL_JOIN = '''
+import threading
+
+def run():
+    t = threading.Thread(target=print, name="t", daemon=True)
+    t.start()
+    t.join()
+'''
+
+GOOD_THREAD_TRACKED = '''
+import threading
+
+class Owner:
+    def __init__(self):
+        self._strays = []
+
+    def fire(self):
+        t = threading.Thread(target=print, name="s", daemon=True)
+        self._strays.append(t)
+        t.start()
+
+    def stop(self):
+        for t in self._strays:
+            t.join()
+'''
+
+
+class TestThreadLifecycle:
+    def test_owned_named_daemon_thread_is_clean(self, tmp_path):
+        assert run_lint(tmp_path, GOOD_THREAD) == []
+
+    def test_fire_and_forget_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, BAD_THREAD_FIRE_AND_FORGET)
+        assert any(f.rule == "thread-lifecycle" for f in fs)
+        assert any("fire-and-forget" in f.message for f in fs)
+
+    def test_missing_name_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, BAD_THREAD_NO_NAME)
+        assert any("without name=" in f.message for f in fs)
+
+    def test_missing_stop_join_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, BAD_THREAD_NO_STOP)
+        assert any("no stop()/join() path" in f.message for f in fs)
+
+    def test_local_join_and_tracked_stray_are_clean(self, tmp_path):
+        assert run_lint(tmp_path, GOOD_THREAD_LOCAL_JOIN) == []
+        assert run_lint(tmp_path, GOOD_THREAD_TRACKED) == []
+
+    def test_line_suppression(self, tmp_path):
+        src = BAD_THREAD_FIRE_AND_FORGET.replace(
+            'daemon=True).start()',
+            'daemon=True).start()  # lint: disable=thread-lifecycle — x',
+        )
+        assert run_lint(tmp_path, src) == []
+
+    def test_file_suppression(self, tmp_path):
+        src = "# lint: disable=thread-lifecycle — test file\n" + (
+            BAD_THREAD_FIRE_AND_FORGET
+        )
+        assert run_lint(tmp_path, src) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+GOOD_LOCKS = '''
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: _lock
+
+    def put(self, k, v):
+        with self._lock:
+            self._entries[k] = v
+
+    def _evict_locked(self):  # lint: holds=_lock
+        self._entries.clear()
+
+    def read(self):
+        return dict(self._entries)
+'''
+
+BAD_LOCKS = '''
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: _lock
+
+    def put(self, k, v):
+        self._entries[k] = v
+
+    def drop(self, k):
+        self._entries.pop(k, None)
+
+    def reset(self):
+        self._entries = {}
+'''
+
+ALT_LOCKS = '''
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._items = []  # guarded-by: _lock|_not_empty
+
+    def put(self, x):
+        with self._not_empty:
+            self._items.append(x)
+'''
+
+
+class TestLockDiscipline:
+    def test_guarded_mutations_under_lock_are_clean(self, tmp_path):
+        assert run_lint(tmp_path, GOOD_LOCKS) == []
+
+    def test_unlocked_mutations_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, BAD_LOCKS)
+        kinds = {f.message.split(" but ")[1].split(" outside")[0] for f in fs}
+        assert len(fs) == 3  # item-assign, .pop(), rebind
+        assert any("item-assigned" in k for k in kinds)
+        assert any(".pop() called" in k for k in kinds)
+        assert any("assigned" in k for k in kinds)
+
+    def test_condition_alternative_lock_accepted(self, tmp_path):
+        assert run_lint(tmp_path, ALT_LOCKS) == []
+
+    def test_init_is_exempt(self, tmp_path):
+        # the declaration itself is a mutation in __init__ — never flagged
+        assert run_lint(tmp_path, GOOD_LOCKS, rules_named("lock-discipline")) == []
+
+
+# ---------------------------------------------------------------------------
+# env-knobs
+# ---------------------------------------------------------------------------
+
+class TestEnvKnobs:
+    def test_raw_environ_read_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, 'import os\nx = os.environ.get("PIO_FOO")\n')
+        assert any(f.rule == "env-knobs" for f in fs)
+
+    def test_subscript_read_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, 'import os\nx = os.environ["PIO_FOO"]\n')
+        assert any(f.rule == "env-knobs" for f in fs)
+
+    def test_mapping_get_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, 'def f(env):\n    return env.get("PIO_X")\n')
+        assert any("captured env mapping" in f.message for f in fs)
+
+    def test_unregistered_parser_knob_flagged(self, tmp_path):
+        src = (
+            "from predictionio_tpu.utils.env import env_float\n"
+            'x = env_float("PIO_NOT_A_KNOB", 1.0)\n'
+        )
+        fs = run_lint(tmp_path, src)
+        assert any("not declared in the" in f.message for f in fs)
+
+    def test_registered_parser_and_writes_are_clean(self, tmp_path):
+        src = (
+            "import os\n"
+            "from predictionio_tpu.utils.env import env_float\n"
+            'x = env_float("PIO_TRACE_SAMPLE")\n'
+            'os.environ["PIO_TRACE_SAMPLE"] = "0.5"\n'  # writes allowed
+            'os.environ.pop("PIO_TRACE_SAMPLE", None)\n'
+            "y = dict(os.environ)\n"
+        )
+        assert run_lint(tmp_path, src) == []
+
+    def test_prefix_family_accepted(self, tmp_path):
+        src = (
+            "from predictionio_tpu.utils.env import env_raw\n"
+            'x = env_raw("PIO_STORAGE_SOURCES_PG_TYPE")\n'
+        )
+        assert run_lint(tmp_path, src) == []
+
+
+class TestEnvRegistry:
+    def test_typed_parsers(self, monkeypatch):
+        monkeypatch.setenv("PIO_TRACE_MAX", "42")
+        assert envmod.env_int("PIO_TRACE_MAX") == 42
+        monkeypatch.setenv("PIO_TRACE_MAX", "nonsense")
+        assert envmod.env_int("PIO_TRACE_MAX") == 256  # registry default
+        monkeypatch.setenv("PIO_ROLLOUT_SHADOW", "false")
+        assert envmod.env_bool("PIO_ROLLOUT_SHADOW") is False
+        monkeypatch.setenv("PIO_ROLLOUT_SHADOW", "yes")
+        assert envmod.env_bool("PIO_ROLLOUT_SHADOW") is True
+        monkeypatch.delenv("PIO_DEVPROF", raising=False)
+        assert envmod.env_bool("PIO_DEVPROF") is True  # flag default "1"
+        monkeypatch.setenv("PIO_DEVPROF", "0")
+        assert envmod.env_bool("PIO_DEVPROF") is False
+
+    def test_env_mapping_parameter(self):
+        env = {"PIO_ROLLOUT_BAKE_S": "5"}
+        assert envmod.env_float("PIO_ROLLOUT_BAKE_S", env=env) == 5.0
+        assert envmod.env_float("PIO_ROLLOUT_BAKE_S", env={}) == 60.0
+
+    def test_unregistered_read_raises(self):
+        with pytest.raises(ValueError, match="not declared"):
+            envmod.env_str("PIO_TOTALLY_UNKNOWN")
+
+    def test_prefix_lookup(self):
+        assert envmod.env_raw(
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", env={}
+        ) is None
+
+    def test_markdown_table_covers_registry(self):
+        table = envmod.knobs_markdown()
+        for knob in envmod.knob_registry():
+            assert knob.name in table
+        assert table.startswith("| Knob | Type | Default | Description |")
+
+
+# ---------------------------------------------------------------------------
+# jit-boundary
+# ---------------------------------------------------------------------------
+
+BAD_JIT = '''
+import jax
+from functools import partial
+
+@jax.jit
+def f(x):
+    return x
+
+@partial(jax.jit, static_argnames=("k",))
+def g(x, *, k):
+    return x
+'''
+
+GOOD_JIT = BAD_JIT + '''
+from predictionio_tpu.obs import devprof as _devprof
+f = _devprof.instrument("m.f", f)
+g = _devprof.instrument("m.g", g)
+'''
+
+HOST_CALL_JIT = '''
+import time
+import jax
+from predictionio_tpu.obs import devprof as _devprof
+
+@jax.jit
+def f(x):
+    return x * time.time()
+
+f = _devprof.instrument("m.f", f)
+'''
+
+BARE_PALLAS = '''
+from jax.experimental import pallas as pl
+
+def launch(x):
+    return pl.pallas_call(lambda r: r)(x)
+'''
+
+JITTED_PALLAS = '''
+import jax
+from jax.experimental import pallas as pl
+from predictionio_tpu.obs import devprof as _devprof
+
+@jax.jit
+def entry(x):
+    return launch(x)
+
+def launch(x):
+    return pl.pallas_call(lambda r: r)(x)
+
+entry = _devprof.instrument("m.entry", entry)
+'''
+
+
+class TestJitBoundary:
+    def test_uninstrumented_jit_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, BAD_JIT)
+        assert len([f for f in fs if f.rule == "jit-boundary"]) == 2
+
+    def test_instrumented_jit_clean(self, tmp_path):
+        assert run_lint(tmp_path, GOOD_JIT) == []
+
+    def test_host_clock_inside_jit_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, HOST_CALL_JIT)
+        assert any("time.time" in f.message for f in fs)
+
+    def test_bare_pallas_launch_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, BARE_PALLAS)
+        assert any("pallas_call" in f.message for f in fs)
+
+    def test_pallas_under_jitted_entry_clean(self, tmp_path):
+        assert run_lint(tmp_path, JITTED_PALLAS) == []
+
+
+# ---------------------------------------------------------------------------
+# metric-cardinality
+# ---------------------------------------------------------------------------
+
+BAD_METRIC_FAMILY = '''
+def attach(registry):
+    return registry.counter(
+        "requests_total", "requests", ("route",),
+    )
+'''
+
+GOOD_METRIC_FAMILY = '''
+def attach(registry):
+    return registry.counter(
+        "requests_total", "requests",
+        ("route",),  # label-bound: _route_label table
+    )
+'''
+
+BAD_METRIC_FEED = '''
+def count(counter, path):
+    counter.inc(route=f"/api/{path}")
+'''
+
+
+class TestMetricCardinality:
+    def test_unannotated_family_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, BAD_METRIC_FAMILY)
+        assert any(f.rule == "metric-cardinality" for f in fs)
+
+    def test_annotated_family_clean(self, tmp_path):
+        assert run_lint(tmp_path, GOOD_METRIC_FAMILY) == []
+
+    def test_constructed_label_value_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, BAD_METRIC_FEED)
+        assert any("f-string" in f.message for f in fs)
+
+    def test_unlabeled_family_ignored(self, tmp_path):
+        src = 'def f(r):\n    return r.counter("a", "b")\n'
+        assert run_lint(tmp_path, src) == []
+
+
+# ---------------------------------------------------------------------------
+# the gate: the real package lints clean
+# ---------------------------------------------------------------------------
+
+class TestRepoIsClean:
+    def test_package_lints_clean_with_all_rules(self):
+        findings, errors = lint_mod.lint_repo()
+        assert errors == []
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# dynamic sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def san():
+    tsan.reset()
+    tsan.enable()
+    try:
+        yield tsan
+    finally:
+        tsan.disable()
+        tsan.reset()
+
+
+class TestTsan:
+    def test_seeded_ab_ba_inversion_reports_cycle(self, san):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        with lock_a:
+            with lock_b:
+                pass
+        with lock_b:
+            with lock_a:
+                pass
+        rep = san.report(check_leaks=False)
+        assert rep["lock_order_cycles"], rep
+        cyc = rep["lock_order_cycles"][0]
+        assert len(cyc["sites"]) == 2
+        assert len(cyc["edges"]) == 2
+        assert all(e["stack"] for e in cyc["edges"])
+
+    def test_consistent_order_reports_no_cycle(self, san):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+        rep = san.report(check_leaks=False)
+        assert rep["lock_order_cycles"] == []
+        assert rep["edges_total"] == 1
+
+    def test_rlock_reentrancy_records_no_self_edge(self, san):
+        lk = threading.RLock()
+        with lk:
+            with lk:
+                pass
+        rep = san.report(check_leaks=False)
+        assert rep["lock_order_cycles"] == []
+        assert rep["edges_total"] == 0
+
+    def test_note_blocking_flags_held_lock(self, san):
+        lk = threading.Lock()
+        with lk:
+            san.note_blocking("device.dispatch")
+        rep = san.report(check_leaks=False)
+        assert rep["blocking_with_lock_held"], rep
+        b = rep["blocking_with_lock_held"][0]
+        assert b["kind"] == "device.dispatch"
+        assert b["held_sites"]
+
+    def test_note_blocking_without_lock_is_clean(self, san):
+        san.note_blocking("storage.rpc")
+        rep = san.report(check_leaks=False)
+        assert rep["blocking_with_lock_held"] == []
+
+    def test_allow_blocking_suppresses_declared_lock(self, san):
+        lk = threading.Lock()
+        san.allow_blocking("test_analysis.py")
+        with lk:
+            san.note_blocking("device.dispatch")
+        rep = san.report(check_leaks=False)
+        assert rep["blocking_with_lock_held"] == []
+
+    def test_thread_leak_tripwire(self, san):
+        release = threading.Event()
+        t = threading.Thread(
+            target=release.wait, name="leaky", daemon=True
+        )
+        t.start()
+        leaked = [d["name"] for d in san.leaked_threads()]
+        assert "leaky" in leaked
+        release.set()
+        t.join(timeout=5)
+        assert "leaky" not in [d["name"] for d in san.leaked_threads()]
+
+    def test_condition_compatibility(self, san):
+        # FairQueue builds a Condition over a sanitized Lock — the whole
+        # put/wait/get protocol must work through the proxy
+        from predictionio_tpu.tenancy.fair import FairQueue
+
+        q = FairQueue()
+
+        class Item:
+            tenant = None
+
+        q.put(Item())
+        got = q.get(timeout=2)
+        assert got is not None
+        rep = san.report(check_leaks=False)
+        assert rep["lock_order_cycles"] == []
+
+    def test_write_report(self, san, tmp_path):
+        lk = threading.Lock()
+        with lk:
+            pass
+        path = str(tmp_path / "rep.json")
+        out = san.write_report(path, check_leaks=False)
+        assert out == path
+        rep = json.loads(open(path).read())
+        assert rep["enabled"] is True
+        assert "findings_count" in rep
+
+    def test_disable_stops_recording(self):
+        tsan.reset()
+        tsan.enable()
+        lk = threading.Lock()
+        tsan.disable()
+        try:
+            other = threading.Lock()
+            with lk:  # proxy survives disable; records nothing
+                with other:
+                    pass
+            rep = tsan.report(check_leaks=False)
+            assert rep["edges_total"] == 0
+        finally:
+            tsan.reset()
+
+
+class TestBridgeRaceRegression:
+    """ISSUE 12 lock-discipline find: SpanRecorder.bridge/unbridge
+    mutated `_bridges` outside the recorder lock — unbridge's
+    check-then-pop could tear down a NEWER server's bridge when a stop
+    raced a registration. Both now run under the lock; hammer the
+    interleaving to keep it that way."""
+
+    def test_unbridge_does_not_drop_newer_registration(self):
+        from predictionio_tpu.obs.spans import SpanRecorder
+
+        rec = SpanRecorder(sample_rate=0.0)
+        stop = threading.Event()
+        errors = []
+
+        def spanner():
+            try:
+                while not stop.is_set():
+                    with rec.span("bridged.op"):
+                        pass
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        t = threading.Thread(target=spanner, name="spanner", daemon=True)
+        t.start()
+        try:
+            for _ in range(300):
+                old = lambda sp: None  # noqa: E731
+                new = lambda sp: None  # noqa: E731
+                rec.bridge("bridged.op", old)
+                rec.bridge("bridged.op", new)
+                rec.unbridge("bridged.op", old)  # stale unbridge: no-op
+                assert rec._bridges.get("bridged.op") is new
+                rec.unbridge("bridged.op", new)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert errors == []
+
+
+class TestNotifierJoinRegression:
+    """ISSUE 12 thread-lifecycle find: alert delivery threads were
+    fire-and-forget — a page in flight could outlive the SLO engine
+    that raised it. close() must join them."""
+
+    def test_close_joins_inflight_deliveries(self):
+        from predictionio_tpu.obs.monitor.notify import AlertNotifier
+        from predictionio_tpu.obs.registry import MetricsRegistry
+
+        n = AlertNotifier(
+            exec_cmd="sleep 0.2", registry=MetricsRegistry()
+        )
+        n.notify({"slo": "x", "transition": "inactive->firing"})
+        assert any(
+            t.name == "alert-notify" for t in threading.enumerate()
+        )
+        t0 = time.monotonic()
+        n.close(timeout=10)
+        assert time.monotonic() - t0 < 5
+        assert not any(
+            t.name == "alert-notify" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+
+# ---------------------------------------------------------------------------
+# console round-trip
+# ---------------------------------------------------------------------------
+
+class TestConsole:
+    def test_lint_exit_codes(self, tmp_path, capsys):
+        from predictionio_tpu.tools import console
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_THREAD_FIRE_AND_FORGET)
+        assert console.main(["lint", str(bad)]) == 1
+        out = capsys.readouterr().out
+        assert "thread-lifecycle" in out
+
+        bad.write_text(GOOD_THREAD)
+        assert console.main(["lint", str(bad)]) == 0
+
+    def test_lint_rule_filter_and_json(self, tmp_path, capsys):
+        from predictionio_tpu.tools import console
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_THREAD_FIRE_AND_FORGET)
+        rc = console.main(
+            ["lint", "--rule", "env-knobs", str(bad)]
+        )
+        assert rc == 0  # thread finding filtered out
+        capsys.readouterr()  # drain the first invocation's summary
+        rc = console.main(["lint", "--json", str(bad)])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"]
+        assert console.main(["lint", "--rule", "bogus", str(bad)]) == 1
+
+    def test_knobs_table(self, capsys):
+        from predictionio_tpu.tools import console
+
+        assert console.main(["lint", "--knobs"]) == 0
+        out = capsys.readouterr().out
+        assert "PIO_TSAN" in out and "PIO_FS_BASEDIR" in out
+
+    def test_knobs_readme_freshness(self):
+        import os
+
+        from predictionio_tpu.tools import console
+
+        readme = os.path.join(
+            os.path.dirname(lint_mod.package_root()), "README.md"
+        )
+        assert console.main(
+            ["lint", "--knobs", "--check-readme", readme]
+        ) == 0
+
+    def test_tsan_report_roundtrip(self, tmp_path, capsys):
+        from predictionio_tpu.tools import console
+
+        clean = tmp_path / "clean.json"
+        clean.write_text(json.dumps({"findings_count": 0}))
+        assert console.main(["lint", "--tsan-report", str(clean)]) == 0
+        dirty = tmp_path / "dirty.json"
+        dirty.write_text(json.dumps({
+            "findings_count": 1,
+            "lock_order_cycles": [{"sites": ["a", "b"], "edges": []}],
+        }))
+        assert console.main(["lint", "--tsan-report", str(dirty)]) == 1
