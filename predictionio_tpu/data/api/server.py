@@ -613,9 +613,9 @@ class EventServer(ServerProcess):
             # backends dedupe the whole batch on its stable req_id;
             # embedded backends are idempotent via the spill-time
             # event-id stamp (INSERT OR REPLACE semantics), so batching
-            # is safe there too. Only the sharded store — which has
-            # per-event req-id routing but no batch-level dedupe
-            # contract across shards — keeps the per-event path.
+            # is safe there too. The sharded store routes the batch to
+            # its owning shard groups under one stable derived req-id
+            # each (ISSUE 13 satellite), so it batches as well.
             def _insert_batch(events, app_id, channel_id, batch_req_id):
                 if batch_with_req_id is not None:
                     batch_with_req_id(events, app_id, channel_id,

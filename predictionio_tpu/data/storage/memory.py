@@ -53,6 +53,12 @@ class MemoryEventStore(base.EventStore):
         # whole namespace; this is the role of the reference's HBase
         # row-key prefix (entity-first key design, HBEventsUtil.scala)
         self._by_entity: dict[tuple, dict[str, set]] = {}
+        # (app_id, channel_id) → {target_entity_id: {event_id}} — the
+        # item fold-in history read (ISSUE 13 satellite): solving one
+        # item's factor row re-reads that ITEM's events, which is a
+        # target-entity point query — a posting list, not a namespace
+        # scan
+        self._by_target: dict[tuple, dict[str, set]] = {}
 
     def _bump(self, app_id: int, channel_id: Optional[int]) -> None:
         key = self._key(app_id, channel_id)
@@ -86,6 +92,7 @@ class MemoryEventStore(base.EventStore):
         with self._lock:
             self._ns.pop(self._key(app_id, channel_id), None)
             self._by_entity.pop(self._key(app_id, channel_id), None)
+            self._by_target.pop(self._key(app_id, channel_id), None)
             self._rev_log.pop(self._key(app_id, channel_id), None)
         return True
 
@@ -99,6 +106,9 @@ class MemoryEventStore(base.EventStore):
     def _index(self, app_id, channel_id) -> dict[str, set]:
         return self._by_entity.setdefault(self._key(app_id, channel_id), {})
 
+    def _target_index(self, app_id, channel_id) -> dict[str, set]:
+        return self._by_target.setdefault(self._key(app_id, channel_id), {})
+
     def insert(
         self, event: Event, app_id: int, channel_id: Optional[int] = None
     ) -> str:
@@ -109,6 +119,10 @@ class MemoryEventStore(base.EventStore):
                 self._index(app_id, channel_id).get(
                     prev.entity_id, set()
                 ).discard(eid)
+                if prev.target_entity_id is not None:
+                    self._target_index(app_id, channel_id).get(
+                        prev.target_entity_id, set()
+                    ).discard(eid)
                 self._note_stale(self._key(app_id, channel_id))
             key = self._key(app_id, channel_id)
             rev = self._revisions.get(key, 0) + 1
@@ -120,6 +134,10 @@ class MemoryEventStore(base.EventStore):
             self._index(app_id, channel_id).setdefault(
                 event.entity_id, set()
             ).add(eid)
+            if event.target_entity_id is not None:
+                self._target_index(app_id, channel_id).setdefault(
+                    event.target_entity_id, set()
+                ).add(eid)
             self._bump(app_id, channel_id)
             return eid
 
@@ -132,6 +150,10 @@ class MemoryEventStore(base.EventStore):
                 self._index(app_id, channel_id).get(
                     prev.entity_id, set()
                 ).discard(event_id)
+                if prev.target_entity_id is not None:
+                    self._target_index(app_id, channel_id).get(
+                        prev.target_entity_id, set()
+                    ).discard(event_id)
                 self._bump(app_id, channel_id)
                 if prev.revision is not None:
                     self._note_stale(self._key(app_id, channel_id))
@@ -191,6 +213,13 @@ class MemoryEventStore(base.EventStore):
                 ids = self._index(
                     query.app_id, query.channel_id
                 ).get(query.entity_id, ())
+                events = [table[i] for i in ids if i in table]
+            elif query.target_entity_id is not None:
+                # target posting list: the item fold-in history read
+                # touches only that item's events (ISSUE 13 satellite)
+                ids = self._target_index(
+                    query.app_id, query.channel_id
+                ).get(query.target_entity_id, ())
                 events = [table[i] for i in ids if i in table]
             else:
                 events = list(table.values())

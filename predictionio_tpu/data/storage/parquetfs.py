@@ -45,6 +45,9 @@ _SCHEMA = pa.schema(
         ("tags", pa.string()),  # JSON array
         ("pr_id", pa.string()),
         ("creation_time_ms", pa.int64()),
+        # server-assigned insert revision (ISSUE 13 satellite): nullable
+        # — rows exported from non-revision sources carry null
+        ("revision", pa.int64()),
     ]
 )
 
@@ -78,6 +81,7 @@ def events_to_table(events: Sequence[Event]) -> "pa.Table":
             "tags": [json.dumps(list(e.tags)) for e in events],
             "pr_id": [e.pr_id for e in events],
             "creation_time_ms": [_ms(e.creation_time) for e in events],
+            "revision": [e.revision for e in events],
         },
         schema=_SCHEMA,
     )
@@ -108,6 +112,7 @@ def table_to_events(
 
 
 def _row_to_event(cols: dict, i: int) -> Event:
+    rev = cols.get("revision")  # absent on pre-revision segment files
     return Event(
         event=cols["event"][i],
         entity_type=cols["entity_type"][i],
@@ -120,6 +125,9 @@ def _row_to_event(cols: dict, i: int) -> Event:
         pr_id=cols["pr_id"][i],
         creation_time=_from_ms(cols["creation_time_ms"][i]),
         event_id=cols["event_id"][i],
+        revision=(
+            int(rev[i]) if rev is not None and rev[i] is not None else None
+        ),
     )
 
 
@@ -136,6 +144,10 @@ class ParquetFSEventStore(EventStore):
         self._lock = threading.RLock()
         # (app, ch) → list[Event] pending write
         self._buffers: dict[tuple[int, Optional[int]], list[Event]] = {}
+        # (app, ch) → last server-assigned insert revision (ISSUE 13
+        # satellite); seeded lazily from the segment files' revision
+        # column so a restart continues the sequence
+        self._revisions: dict[tuple[int, Optional[int]], int] = {}
 
     # -- namespace plumbing ------------------------------------------------
     def _dir(self, app_id: int, channel_id: Optional[int]) -> str:
@@ -170,6 +182,7 @@ class ParquetFSEventStore(EventStore):
     def remove_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self._lock:
             self._buffers.pop((app_id, channel_id), None)
+            self._revisions.pop((app_id, channel_id), None)
             d = self._dir(app_id, channel_id)
             if os.path.isdir(d):
                 shutil.rmtree(d)
@@ -181,17 +194,40 @@ class ParquetFSEventStore(EventStore):
     ) -> str:
         return self.insert_batch([event], app_id, channel_id)[0]
 
+    def _seed_revisions(self, app_id: int, channel_id: Optional[int]) -> int:
+        """Max revision across the namespace's segment files (0 when none
+        carry the column). Caller holds the lock."""
+        import pyarrow.compute as pc
+
+        best = 0
+        for seg in self._segments(self._dir(app_id, channel_id)):
+            f = pq.ParquetFile(seg)
+            if "revision" not in f.schema_arrow.names:
+                continue
+            mx = pc.max(f.read(columns=["revision"]).column("revision"))
+            if mx.is_valid and int(mx.as_py()) > best:
+                best = int(mx.as_py())
+        return best
+
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> list[str]:
         with self._lock:
-            buf = self._buffers.setdefault((app_id, channel_id), [])
+            key = (app_id, channel_id)
+            if key not in self._revisions:
+                self._revisions[key] = self._seed_revisions(
+                    app_id, channel_id
+                )
+            rev = self._revisions[key]
+            buf = self._buffers.setdefault(key, [])
             ids = []
             for e in events:
                 if e.event_id is None:
                     e = e.with_id(new_event_id())
-                buf.append(e)
+                rev += 1
+                buf.append(e.with_revision(rev))
                 ids.append(e.event_id)
+            self._revisions[key] = rev
             if len(buf) >= self.FLUSH_THRESHOLD:
                 self._flush(app_id, channel_id)
             return ids
@@ -253,7 +289,24 @@ class ParquetFSEventStore(EventStore):
         segs = self._segments(d)
         if not segs:
             return None
-        tables = [pq.read_table(s, columns=columns) for s in segs]
+        tables = []
+        for s in segs:
+            names = pq.ParquetFile(s).schema_arrow.names
+            cols = (
+                [c for c in columns if c in names]
+                if columns is not None
+                else None
+            )
+            tables.append(pq.read_table(s, columns=cols))
+        if len({t.schema for t in tables}) > 1:
+            # pre-revision segment files next to new ones: unify by
+            # promoting missing columns to nulls
+            try:
+                return pa.concat_tables(
+                    tables, promote_options="default"
+                )
+            except TypeError:  # older pyarrow
+                return pa.concat_tables(tables, promote=True)
         return pa.concat_tables(tables)
 
     def _iter_events(
@@ -301,6 +354,71 @@ class ParquetFSEventStore(EventStore):
 
         mx = pc.max(table.column("creation_time_ms")).as_py() or 0
         return f"{table.num_rows}:{len(stones)}:{mx}"
+
+    def latest_revision(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> int:
+        with self._lock:
+            key = (app_id, channel_id)
+            if key not in self._revisions:
+                self._revisions[key] = self._seed_revisions(
+                    app_id, channel_id
+                )
+            return self._revisions[key]
+
+    def find_since(
+        self,
+        app_id: int,
+        after_revision: int,
+        channel_id: Optional[int] = None,
+        limit: Optional[int] = None,
+        shard: Optional[tuple[int, int]] = None,
+    ) -> list[Event]:
+        """Revision range read at segment-file granularity: each file's
+        revision column gates whether its rows decode at all — an idle
+        consumer tick against a big namespace touches one thin column
+        per file and materializes only the page's rows."""
+        with self._lock:
+            self._flush(app_id, channel_id)
+            d = self._dir(app_id, channel_id)
+            segs = self._segments(d)
+            stones = self._tombstones(d)
+        rows: list[Event] = []
+        for seg in segs:
+            f = pq.ParquetFile(seg)
+            if "revision" not in f.schema_arrow.names:
+                continue  # pre-revision rows are not tailable
+            revs = f.read(columns=["revision"]).column("revision")
+            # nulls → NaN, and NaN > cursor is False — one vectorized
+            # compare over the thin column
+            rev_np = revs.to_numpy(zero_copy_only=False).astype(np.float64)
+            hit = np.nonzero(rev_np > after_revision)[0]
+            if not len(hit):
+                continue
+            # decode ONLY the matching rows to Python objects: take()
+            # before any to_pylist. The Arrow-level file read is still
+            # whole-file (one row group per write_table, so row-group
+            # pruning has nothing to prune) but stays columnar-C-speed;
+            # the per-row Python cost — the part that dominated — is
+            # bounded by the page.
+            sub = pq.read_table(seg).take(hit)
+            cols = {
+                name: sub.column(name).to_pylist()
+                for name in sub.schema.names
+            }
+            for i in range(sub.num_rows):
+                e = _row_to_event(cols, i)
+                if e.event_id in stones:
+                    continue
+                if shard is not None and base.shard_of(
+                    e.entity_id, shard[1]
+                ) != shard[0]:
+                    continue
+                rows.append(e)
+        rows.sort(key=lambda e: e.revision)  # type: ignore[arg-type, return-value]
+        if limit is not None and limit >= 0:
+            rows = rows[:limit]
+        return rows
 
     def find(self, query: EventQuery) -> Iterator[Event]:
         matches = (

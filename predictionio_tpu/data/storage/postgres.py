@@ -207,6 +207,10 @@ class PostgresEventStore(base.EventStore):
     """Events: one table per (app, channel) — events_{appId}[_{channelId}]
     (reference JDBCUtils.eventTableName layout)."""
 
+    #: DB round trips release the GIL — sharded composites fan writes
+    #: out concurrently instead of running them inline (sharded.py)
+    IO_PARALLEL_WRITES = True
+
     def __init__(self, config: Optional[dict] = None, client: Optional[_PGClient] = None):
         self._client = client or _PGClient(config)
         self._known_tables: set[str] = set()
@@ -218,6 +222,35 @@ class PostgresEventStore(base.EventStore):
         "CREATE TABLE IF NOT EXISTS pio_data_versions "
         "(tbl TEXT PRIMARY KEY, ver BIGINT NOT NULL)"
     )
+
+    # server-assigned insert revisions (ISSUE 13 satellite, mirroring
+    # sqlite): one monotonic counter per events table, advanced under
+    # the client lock so the tail order cannot be skewed by
+    # client-supplied event times. No RETURNING — the update+select
+    # pair under the (reentrant) client lock works on every driver,
+    # including old-sqlite fake_pg hosts.
+    _REVISIONS_DDL = (
+        "CREATE TABLE IF NOT EXISTS pio_insert_revisions "
+        "(tbl TEXT PRIMARY KEY, rev BIGINT NOT NULL)"
+    )
+
+    def _next_revisions(self, name: str, n: int) -> int:
+        """Advance the table's revision counter by `n`; returns the
+        FIRST assigned revision."""
+        with self._client.lock:
+            self._client.execute(
+                _pg(
+                    "INSERT INTO pio_insert_revisions VALUES (?, ?) "
+                    "ON CONFLICT (tbl) DO UPDATE SET "
+                    "rev = pio_insert_revisions.rev + ?"
+                ),
+                (name, n, n),
+            )
+            rows = self._client.query(
+                _pg("SELECT rev FROM pio_insert_revisions WHERE tbl = ?"),
+                (name,),
+            )
+        return int(rows[0][0]) - n + 1
 
     def _bump(self, name: str) -> None:
         # exact write version: bumped on every mutation (incl. upsert
@@ -236,6 +269,7 @@ class PostgresEventStore(base.EventStore):
         if name in self._known_tables:
             return name
         self._client.execute(self._VERSIONS_DDL)
+        self._client.execute(self._REVISIONS_DDL)
         self._client.execute(
             f"""CREATE TABLE IF NOT EXISTS {name} (
                 id TEXT PRIMARY KEY,
@@ -248,14 +282,37 @@ class PostgresEventStore(base.EventStore):
                 eventTime BIGINT NOT NULL,
                 tags TEXT,
                 prId TEXT,
-                creationTime BIGINT NOT NULL)"""
+                creationTime BIGINT NOT NULL,
+                revision BIGINT)"""
         )
+        # migrate pre-revision tables in place; existing rows keep NULL
+        # revisions — only new inserts are tailable, which is what a
+        # consumer attached mid-life wants (sqlite.py discipline)
+        try:
+            self._client.execute(
+                f"ALTER TABLE {name} ADD COLUMN revision BIGINT"
+            )
+        except Exception:
+            pass  # column already exists
         self._client.execute(
             f"CREATE INDEX IF NOT EXISTS {name}_time ON {name} (eventTime, id)"
         )
         self._client.execute(
             f"CREATE INDEX IF NOT EXISTS {name}_entity "
             f"ON {name} (entityType, entityId)"
+        )
+        self._client.execute(
+            f"CREATE INDEX IF NOT EXISTS {name}_rev ON {name} (revision)"
+        )
+        # seed the counter from any revisions already present (a restart
+        # must continue the sequence, never reuse it)
+        self._client.execute(
+            _pg(
+                "INSERT INTO pio_insert_revisions VALUES (?, "
+                f"COALESCE((SELECT MAX(revision) FROM {name}), 0)) "
+                "ON CONFLICT (tbl) DO NOTHING"
+            ),
+            (name,),
         )
         self._known_tables.add(name)
         return name
@@ -280,7 +337,7 @@ class PostgresEventStore(base.EventStore):
             except Exception:
                 pass
 
-    def _row(self, event: Event, eid: str) -> tuple:
+    def _row(self, event: Event, eid: str, revision: int) -> tuple:
         return (
             eid,
             event.event,
@@ -293,17 +350,18 @@ class PostgresEventStore(base.EventStore):
             json.dumps(list(event.tags)) if event.tags else None,
             event.pr_id,
             _ms(event.creation_time),
+            revision,
         )
 
     _UPSERT = (
-        "INSERT INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?) "
+        "INSERT INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?) "
         "ON CONFLICT (id) DO UPDATE SET event=EXCLUDED.event, "
         "entityType=EXCLUDED.entityType, entityId=EXCLUDED.entityId, "
         "targetEntityType=EXCLUDED.targetEntityType, "
         "targetEntityId=EXCLUDED.targetEntityId, "
         "properties=EXCLUDED.properties, eventTime=EXCLUDED.eventTime, "
         "tags=EXCLUDED.tags, prId=EXCLUDED.prId, "
-        "creationTime=EXCLUDED.creationTime"
+        "creationTime=EXCLUDED.creationTime, revision=EXCLUDED.revision"
     )
 
     def insert(
@@ -311,20 +369,37 @@ class PostgresEventStore(base.EventStore):
     ) -> str:
         name = self._ensure_table(app_id, channel_id)
         eid = event.event_id or new_event_id()
-        self._client.execute(
-            _pg(self._UPSERT.format(t=name)), self._row(event, eid)
-        )
-        self._bump(name)
+        # revision assignment and the row write share ONE client-lock
+        # hold (sqlite.py discipline): released in between, a slower
+        # writer's rows could commit AFTER a faster writer's higher
+        # revisions became visible, and a tail consumer that advanced
+        # past them would skip those events forever. NOTE the lock is
+        # process-local — like the lock-serialized connection itself,
+        # the revision sequence assumes one writer PROCESS per database
+        # (multi-process deployments front postgres with the storage
+        # daemon, which is that single writer).
+        with self._client.lock:
+            rev = self._next_revisions(name, 1)
+            self._client.execute(
+                _pg(self._UPSERT.format(t=name)),
+                self._row(event, eid, rev),
+            )
+            self._bump(name)
         return eid
 
     def insert_batch(self, events, app_id, channel_id=None) -> list[str]:
         name = self._ensure_table(app_id, channel_id)
         eids = [e.event_id or new_event_id() for e in events]
-        self._client.executemany(
-            _pg(self._UPSERT.format(t=name)),
-            [self._row(e, i) for e, i in zip(events, eids)],
-        )
-        self._bump(name)
+        with self._client.lock:  # see insert(): assign+write atomically
+            rev0 = self._next_revisions(name, len(events)) if events else 0
+            self._client.executemany(
+                _pg(self._UPSERT.format(t=name)),
+                [
+                    self._row(e, i, rev0 + k)
+                    for k, (e, i) in enumerate(zip(events, eids))
+                ],
+            )
+            self._bump(name)
         return eids
 
     def delete(
@@ -353,7 +428,7 @@ class PostgresEventStore(base.EventStore):
     @staticmethod
     def _to_event(row: tuple) -> Event:
         (eid, event, etype, eidd, tetype, teid, props, etime, tags, pr_id,
-         ctime) = row
+         ctime, *rest) = row  # rest: revision (absent pre-migration)
         return Event(
             event=event,
             entity_type=etype,
@@ -366,6 +441,9 @@ class PostgresEventStore(base.EventStore):
             pr_id=pr_id,
             creation_time=_from_ms(ctime),
             event_id=eid,
+            revision=(
+                int(rest[0]) if rest and rest[0] is not None else None
+            ),
         )
 
     def get(
@@ -477,6 +555,55 @@ class PostgresEventStore(base.EventStore):
                 )
 
         return gen()
+
+    def latest_revision(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> int:
+        name = self._ensure_table(app_id, channel_id)
+        rows = self._client.query(
+            _pg("SELECT rev FROM pio_insert_revisions WHERE tbl = ?"),
+            (name,),
+        )
+        return int(rows[0][0]) if rows else 0
+
+    def find_since(
+        self,
+        app_id: int,
+        after_revision: int,
+        channel_id: Optional[int] = None,
+        limit: Optional[int] = None,
+        shard: Optional[tuple[int, int]] = None,
+    ) -> list[Event]:
+        """Indexed tail read via {table}_rev: revision > cursor, paged
+        by revision keyset so a shard filter (applied host-side — no
+        portable crc32 in SQL) never under-delivers a LIMIT."""
+        name = self._ensure_table(app_id, channel_id)
+        out: list[Event] = []
+        cursor = int(after_revision)
+        while True:
+            if limit is not None and 0 <= limit <= len(out):
+                return out[:limit]
+            n = self.FIND_PAGE
+            if shard is None and limit is not None and limit >= 0:
+                n = min(n, limit - len(out))
+            rows = self._client.query(
+                _pg(
+                    f"SELECT * FROM {name} WHERE revision > ? "
+                    f"ORDER BY revision ASC LIMIT {n}"
+                ),
+                (cursor,),
+            )
+            for r in rows:
+                if shard is not None and base.shard_of(
+                    r[3], shard[1]
+                ) != shard[0]:
+                    continue
+                out.append(self._to_event(r))
+                if limit is not None and 0 <= limit <= len(out):
+                    return out
+            if len(rows) < n:
+                return out
+            cursor = int(rows[-1][11])
 
     def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
         # count + exact write version (pio_data_versions): no collision

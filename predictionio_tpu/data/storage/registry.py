@@ -49,6 +49,10 @@ BACKENDS: dict[str, tuple[str, str]] = {
     # horizontally-sharded composite event store: N remote daemons,
     # entity-hash routed (the reference's HBase region-server role)
     "sharded": ("predictionio_tpu.data.storage.sharded", "Sharded"),
+    # columnar LSM event backend: fsync'd WAL ingest sealed into
+    # immutable column segments, the zero-copy train-loader source
+    # (ISSUE 13) — EVENTDATA only, pair it with a SQL/doc metadata source
+    "segmentfs": ("predictionio_tpu.data.storage.segmentfs", "SegmentFS"),
 }
 
 # DAO logical names → class suffix

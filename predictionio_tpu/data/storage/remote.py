@@ -298,6 +298,11 @@ class _RemoteDao:
 class RemoteEventStore(_RemoteDao, base.EventStore):
     DAO = "events"
 
+    #: writes release the GIL on the network wait — a sharded composite
+    #: should fan concurrent per-shard writes out to its pool rather
+    #: than run them inline (sharded.py ISSUE 13 routing)
+    IO_PARALLEL_WRITES = True
+
     def init_app(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         return self._call("init_app", app_id, channel_id)
 

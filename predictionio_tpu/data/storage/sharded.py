@@ -221,10 +221,23 @@ class ShardedEventStore(base.EventStore):
         self.last_degraded_shards: list[int] = []
         # broadcasts fan out concurrently: one wall-clock round trip for
         # N shards instead of N sequential ones (ADVICE r4: explicit-id
-        # eviction was O(N) round trips per insert)
+        # eviction was O(N) round trips per insert). Sized for several
+        # CONCURRENT callers (the event server's writer threads), not
+        # one: at exactly n_stores workers, 8 ingest writers funnel
+        # their per-shard bulk writes through n_stores threads and the
+        # composite throttles BELOW a single store (ISSUE 13 bench).
         self._pool = ThreadPoolExecutor(
-            max_workers=max(2, len(self._stores)),
+            max_workers=max(8, 4 * len(self._stores)),
             thread_name_prefix="shardcast",
+        )
+        # embedded (in-process) children share the caller's GIL: pool
+        # fan-out for their CPU-bound writes buys nothing and the hop
+        # costs more than a small write — those run inline. Children
+        # that declare IO_PARALLEL_WRITES (remote daemons, postgres)
+        # release the GIL on the network/DB wait, so fan-out is a
+        # genuine wall-clock win for them at any batch size.
+        self._all_local_children = not any(
+            getattr(s, "IO_PARALLEL_WRITES", False) for s in self._stores
         )
         # hedged primaries/hedges run on their OWN pool: _hedged_call
         # executes inside _broadcast's pool tasks, and submitting the
@@ -523,7 +536,10 @@ class ShardedEventStore(base.EventStore):
             home, self._stores[home].insert, event, app_id, channel_id,
             retries=0,
         )
-        self._replicate([(event.with_id(eid), home)], app_id, channel_id)
+        if self.replicas > 1:
+            self._replicate(
+                [(event.with_id(eid), home)], app_id, channel_id
+            )
         return eid
 
     def insert_with_req_id(
@@ -544,7 +560,10 @@ class ShardedEventStore(base.EventStore):
         eid = self._shard_call(
             home, fn, event, app_id, channel_id, req_id, retries=0,
         )
-        self._replicate([(event.with_id(eid), home)], app_id, channel_id)
+        if self.replicas > 1:
+            self._replicate(
+                [(event.with_id(eid), home)], app_id, channel_id
+            )
         return eid
 
     def _replicate(
@@ -581,6 +600,31 @@ class ShardedEventStore(base.EventStore):
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> list[str]:
+        return self._insert_batch_impl(events, app_id, channel_id, None)
+
+    def insert_batch_with_req_id(
+        self, events: Sequence[Event], app_id: int,
+        channel_id: Optional[int], req_id: str,
+    ) -> list[str]:
+        """Bulk insert under ONE caller-stable request id (ISSUE 13
+        satellite — the WAL batch-replay seam the sharded store lacked):
+        the batch routes to its owning shard groups as usual, and each
+        group lands under the DERIVED id ``{req_id}/s{shard}``. Grouping
+        is deterministic given the batch (entity hash), so a replay
+        re-send after a crash re-forms the same groups under the same
+        ids and each remote child's req-id dedupe replays its recorded
+        outcome — per-shard exactly-once without N per-event RPCs.
+        Children without the capability fall back to plain bulk insert
+        (spill-time event-id stamping makes a residual re-insert an
+        overwrite, not a duplicate)."""
+        return self._insert_batch_impl(events, app_id, channel_id, req_id)
+
+    def _insert_batch_impl(
+        self, events: Sequence[Event], app_id: int,
+        channel_id: Optional[int], req_id: Optional[str],
+    ) -> list[str]:
+        if not events:
+            return []
         # group per shard so each child gets ONE bulk write, then restore
         # input order for the returned ids (the batch API's per-event
         # status contract depends on positions)
@@ -604,17 +648,63 @@ class ShardedEventStore(base.EventStore):
         if evict_calls:
             self._broadcast(evict_calls)
         # per-shard writes fan out concurrently; outcomes are collected
-        # per shard so a partial failure stays attributable per EVENT
-        futs = {
-            sx: self._pool.submit(
-                self._shard_call, sx, self._stores[sx].insert_batch,
-                [e for _p, e in pairs], app_id, channel_id,
-                retries=0,  # re-invoking mints fresh req_ids (_shard_call)
+        # per shard so a partial failure stays attributable per EVENT.
+        # The LAST group runs inline on the caller thread: with one
+        # group (the common small-batch case) the pool round trip
+        # disappears entirely, and with several the caller contributes a
+        # worker instead of idling on futures.
+        def plan(sx: int):
+            child = self._stores[sx]
+            evs = [e for _p, e in groups[sx]]
+            batch_fn = (
+                getattr(child, "insert_batch_with_req_id", None)
+                if req_id is not None
+                else None
             )
-            for sx, pairs in groups.items()
-        }
+            if batch_fn is not None:
+                return batch_fn, (evs, app_id, channel_id, f"{req_id}/s{sx}")
+            return child.insert_batch, (evs, app_id, channel_id)
+
+        class _Done:
+            def __init__(self, value=None, err=None):
+                self._value, self._err = value, err
+
+            def result(self):
+                if self._err is not None:
+                    raise self._err
+                return self._value
+
+        def run_inline(sx: int) -> _Done:
+            batch_fn, args = plan(sx)
+            try:
+                return _Done(
+                    self._shard_call(sx, batch_fn, *args, retries=0)
+                )
+            except Exception as e:  # collected like any shard failure
+                return _Done(err=e)
+
+        order = list(groups)
+        futs: dict[int, Any] = {}
+        if self._all_local_children and len(events) < 256:
+            # small batches into EMBEDDED children (no remote RPC to
+            # overlap): a pool round trip per group costs more than the
+            # write itself — run every group on the caller thread
+            for sx in order:
+                futs[sx] = run_inline(sx)
+        else:
+            for sx in order[:-1]:
+                batch_fn, args = plan(sx)
+                futs[sx] = self._pool.submit(
+                    self._shard_call, sx, batch_fn, *args,
+                    retries=0,  # re-invoking mints fresh req_ids
+                )
+            futs[order[-1]] = run_inline(order[-1])
         out: list[Optional[str]] = [None] * len(events)
         committed: list[tuple[Event, int]] = []
+        # only stamp ids onto event copies when a replica write will
+        # consume them — with REPLICAS=1 the per-event with_id() replace
+        # (validation and all) was half the sharded batch-insert time
+        stamp = self.replicas > 1
         first_err: Optional[Exception] = None
         for sx, pairs in groups.items():
             try:
@@ -625,7 +715,8 @@ class ShardedEventStore(base.EventStore):
                 continue
             for (pos, e), eid in zip(pairs, ids):
                 out[pos] = eid
-                committed.append((e.with_id(eid), sx))
+                if stamp:
+                    committed.append((e.with_id(eid), sx))
         self._replicate(committed, app_id, channel_id)
         if first_err is not None:
             raise PartialBatchWriteError(out, first_err)

@@ -131,7 +131,34 @@ class DataView:
     ) -> EventFrame:
         facade = EventStoreFacade(storage)
         app_id, channel_id = facade.app_name_to_id(app_name, channel_name)
-        signature = storage.get_events().data_signature(app_id, channel_id)
+        store = storage.get_events()
+        if hasattr(store, "find_frame_parts"):
+            # segment-backed store (ISSUE 13): its sealed-rows cache is
+            # keyed by segment ids and folds only the unsealed tail per
+            # retrain — a second npz layer here would re-serialize the
+            # full frame every retrain for no avoided work. Delegate,
+            # and account the store's segment-cache outcome in the
+            # DataView counters so `pio status` reads one number.
+            before = dict(store.frame_cache_stats)
+            frame = facade.find_frame(
+                app_name=app_name,
+                channel_name=channel_name,
+                event_names=event_names,
+                entity_type=entity_type,
+                target_entity_type=target_entity_type,
+                start_time=start_time,
+                until_time=until_time,
+                value_prop=value_prop,
+                default_value=default_value,
+            )
+            hit = store.frame_cache_stats["hits"] > before["hits"]
+            DataView.stats["hits" if hit else "misses"] += 1
+            log.info(
+                "DataView segment-cache %s: %d events",
+                "hit" if hit else "miss", len(frame),
+            )
+            return frame
+        signature = store.data_signature(app_id, channel_id)
         query_key = hashlib.sha1(
             json.dumps(
                 {

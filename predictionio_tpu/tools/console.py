@@ -376,6 +376,23 @@ def cmd_status(args) -> int:
             print(f"[INFO]   {line}")
     except Exception as e:
         return _fail(f"storage verification failed: {e}")
+    events = storage.get_events()
+    if hasattr(events, "segment_stats"):
+        # segmentfs (ISSUE 13): surface the columnar store's shape —
+        # sealed segment count, unsealed tail depth, dead rows awaiting
+        # compaction — per app the metadata store knows about
+        try:
+            for app in storage.get_meta_data_apps().get_all():
+                st = events.segment_stats(app.id)
+                print(
+                    f"[INFO]   segmentfs app {app.id} ({app.name}): "
+                    f"{st['segments']} segment(s), "
+                    f"{st['sealed_rows']} sealed + {st['tail_rows']} tail "
+                    f"row(s), {st['dead_rows']} dead, "
+                    f"rev {st['max_revision']}"
+                )
+        except Exception as e:
+            print(f"[WARN] segmentfs stats unavailable: {e}")
     try:
         manifests = storage.get_meta_data_engine_manifests().get_all()
     except Exception as e:

@@ -29,11 +29,38 @@ def _ev(u="u1", i="i1", rating=5.0, name="rate"):
 # ---------------------------------------------------------------------------
 
 
+def _parquet_store(tmp):
+    from predictionio_tpu.data.storage.parquetfs import ParquetFSEventStore
+
+    return ParquetFSEventStore({"PATH": str(tmp / "pq")})
+
+
+def _segment_store(tmp):
+    from predictionio_tpu.data.storage.segmentfs import SegmentFSEventStore
+
+    return SegmentFSEventStore(
+        {"PATH": str(tmp / "seg"), "SEAL_INTERVAL_S": "3600"}
+    )
+
+
+def _postgres_store(tmp):
+    import fake_pg
+    from predictionio_tpu.data.storage.postgres import (
+        PostgresEventStore,
+        _PGClient,
+    )
+
+    return PostgresEventStore(client=_PGClient(conn=fake_pg.connect()))
+
+
 class TestInsertRevisions:
     @pytest.mark.parametrize("make", [
         lambda tmp: MemoryEventStore(),
         lambda tmp: SqliteEventStore({"PATH": str(tmp / "r.db")}),
-    ], ids=["memory", "sqlite"])
+        _parquet_store,
+        _postgres_store,
+        _segment_store,
+    ], ids=["memory", "sqlite", "parquetfs", "postgres", "segmentfs"])
     def test_monotonic_and_tailable(self, tmp_path, make):
         store = make(tmp_path)
         store.init_app(1)

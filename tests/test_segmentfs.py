@@ -1,0 +1,715 @@
+"""segmentfs (ISSUE 13): the columnar LSM event backend — seal/compact
+lifecycle, WAL crash recovery, exactly-once revision tails across seal
+and compaction, bit-identical find_frame parity, the target-entity
+posting read, the SegmentStager device path, DataView delegation, and
+the sharded batch-req-id routing satellite."""
+
+import datetime as dt
+import threading
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import EventQuery, StorageError
+from predictionio_tpu.data.storage.segmentfs import SegmentFSEventStore
+from predictionio_tpu.data.store.columnar import EventFrame
+
+UTC = dt.timezone.utc
+APP = 1
+
+
+def T(i):
+    return dt.datetime(2024, 1, 1, tzinfo=UTC) + dt.timedelta(hours=i)
+
+
+def ev(name, eid, t=0, etype="user", **kw):
+    return Event(
+        event=name, entity_type=etype, entity_id=eid, event_time=T(t), **kw
+    )
+
+
+def rate(u, i, r, t=0):
+    return ev(
+        "rate", u, t=t, target_entity_type="item", target_entity_id=i,
+        properties=DataMap({"rating": float(r)}),
+    )
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = SegmentFSEventStore(
+        {"PATH": str(tmp_path / "seg"), "SEAL_INTERVAL_S": "3600"}
+    )
+    s.init_app(APP)
+    yield s
+    s.close()
+
+
+def frames_equal(a: EventFrame, b: EventFrame):
+    np.testing.assert_array_equal(a.event_code, b.event_code)
+    np.testing.assert_array_equal(a.entity_idx, b.entity_idx)
+    np.testing.assert_array_equal(a.target_idx, b.target_idx)
+    np.testing.assert_array_equal(a.time_ms, b.time_ms)
+    np.testing.assert_array_equal(a.value, b.value)
+    assert a.event_vocab.to_dict() == b.event_vocab.to_dict()
+    assert a.entity_vocab.to_dict() == b.entity_vocab.to_dict()
+    assert a.target_vocab.to_dict() == b.target_vocab.to_dict()
+    assert a.entity_type == b.entity_type
+    assert a.target_entity_type == b.target_entity_type
+
+
+# ---------------------------------------------------------------------------
+# Sealed-state contract (the shared suite runs against the unsealed tail)
+# ---------------------------------------------------------------------------
+
+
+class TestSealedContract:
+    def test_contract_behaviors_survive_seal(self, store):
+        store.insert_batch(
+            [rate(f"u{i % 3}", f"i{i % 2}", i + 1, t=i) for i in range(8)],
+            APP,
+        )
+        store.insert(ev("$set", "u0", t=9, properties=DataMap({"a": 1})), APP)
+        assert store.seal(APP) == 9
+        # time order + filters
+        found = list(store.find(EventQuery(app_id=APP, event_names=["rate"])))
+        assert len(found) == 8
+        times = [e.event_time for e in found]
+        assert times == sorted(times)
+        # entity-scoped read (bloom + vocab gate)
+        u0 = list(
+            store.find(EventQuery(app_id=APP, entity_id="u0"))
+        )
+        assert {e.entity_id for e in u0} == {"u0"}
+        # aggregation folds the sealed $set
+        agg = store.aggregate_properties(APP, "user")
+        assert agg["u0"].to_dict() == {"a": 1}
+        # get + delete straight out of a sealed segment
+        eid = found[0].event_id
+        assert store.get(eid, APP).event == "rate"
+        assert store.delete(eid, APP)
+        assert store.get(eid, APP) is None
+        assert len(list(store.find(EventQuery(app_id=APP)))) == 8
+
+    def test_overwrite_sealed_row(self, store):
+        ids = store.insert_batch([rate("u1", "i1", 5), rate("u2", "i2", 4)], APP)
+        store.seal(APP)
+        store.insert(rate("u1", "i9", 3, t=5).with_id(ids[0]), APP)
+        got = store.get(ids[0], APP)
+        assert got.target_entity_id == "i9"
+        # the superseded sealed row is masked: one live copy of the id
+        all_ids = [e.event_id for e in store.find(EventQuery(app_id=APP))]
+        assert all_ids.count(ids[0]) == 1
+        # and the revision advanced (overwrite = new revision)
+        assert got.revision == 3
+
+    def test_channel_isolation_sealed(self, store):
+        store.init_app(APP, 7)
+        store.insert(rate("u1", "i1", 5), APP)
+        store.insert(rate("u2", "i2", 4), APP, 7)
+        store.seal(APP)
+        store.seal(APP, 7)
+        assert [
+            e.entity_id for e in store.find(EventQuery(app_id=APP))
+        ] == ["u1"]
+        assert [
+            e.entity_id
+            for e in store.find(EventQuery(app_id=APP, channel_id=7))
+        ] == ["u2"]
+
+
+# ---------------------------------------------------------------------------
+# WAL crash recovery + revision durability
+# ---------------------------------------------------------------------------
+
+
+class TestCrashRecovery:
+    def test_unsealed_tail_survives_crash(self, tmp_path):
+        path = str(tmp_path / "seg")
+        s1 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        s1.init_app(APP)
+        ids = s1.insert_batch([rate(f"u{i}", "i1", i + 1) for i in range(5)], APP)
+        # no close(): the process dies here; the fsync'd WAL is the truth
+        s2 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        evs = s2.find_since(APP, 0)
+        assert [e.revision for e in evs] == [1, 2, 3, 4, 5]
+        assert {e.event_id for e in evs} == set(ids)
+        assert s2.latest_revision(APP) == 5
+        s1._stop.set()  # reap the crashed store's sealer thread only
+        s2.close()
+
+    def test_torn_wal_tail_skipped(self, tmp_path):
+        path = str(tmp_path / "seg")
+        s1 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        s1.init_app(APP)
+        s1.insert_batch([rate("u1", "i1", 5), rate("u2", "i2", 4)], APP)
+        # crash mid-append: a torn trailing record (never acked)
+        (wal,) = (tmp_path / "seg" / "app_1").glob("wal-*.jsonl")
+        with open(wal, "a") as f:
+            f.write('[3, [["someid", "rate", "user", "u3"')
+        s2 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        assert [e.revision for e in s2.find_since(APP, 0)] == [1, 2]
+        # the torn record was never acked, so its revision is safely
+        # reassigned to the next insert
+        new_id = s2.insert(rate("u3", "i3", 1), APP)
+        assert s2.get(new_id, APP).revision == 3
+        s1._stop.set()
+        s2.close()
+
+    def test_crash_between_seal_and_wal_truncate(self, tmp_path):
+        """The seal-then-truncate window: segment published, WAL still
+        holding the sealed records — reopen must dedupe by revision."""
+        path = str(tmp_path / "seg")
+        s1 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        s1.init_app(APP)
+        s1.insert_batch([rate(f"u{i}", "i1", i + 1) for i in range(4)], APP)
+        (wal,) = (tmp_path / "seg" / "app_1").glob("wal-*.jsonl")
+        saved = wal.read_bytes()
+        s1.seal(APP)
+        wal.write_bytes(saved)  # resurrect: as if the reclaim never ran
+        s2 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        evs = s2.find_since(APP, 0)
+        assert [e.revision for e in evs] == [1, 2, 3, 4]  # no duplicates
+        assert len({e.event_id for e in evs}) == 4
+        s1._stop.set()
+        s2.close()
+
+    def test_revision_watermark_survives_deleted_tail(self, tmp_path):
+        """Deleting the newest tail rows then sealing must not rewind
+        the revision sequence across restart (the rev_floor file)."""
+        path = str(tmp_path / "seg")
+        s1 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        s1.init_app(APP)
+        ids = s1.insert_batch([rate(f"u{i}", "i1", 1) for i in range(3)], APP)
+        s1.delete(ids[-1], APP)  # rev 3 now dead
+        s1.seal(APP)  # sealed segment tops out at rev 2
+        s2 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        assert s2.latest_revision(APP) == 3
+        nid = s2.insert(rate("u9", "i1", 1), APP)
+        assert s2.get(nid, APP).revision == 4
+        s1._stop.set()
+        s2.close()
+
+
+# ---------------------------------------------------------------------------
+# Revision tail: exactly-once across seal + compaction
+# ---------------------------------------------------------------------------
+
+
+class TestRevisionTail:
+    def test_exactly_once_across_seal(self, store):
+        store.insert_batch([rate(f"u{i}", "i1", 1) for i in range(6)], APP)
+        page = store.find_since(APP, 0, limit=3)
+        cursor = page[-1].revision
+        store.seal(APP)
+        rest = store.find_since(APP, cursor)
+        got = [e.revision for e in page + rest]
+        assert got == [1, 2, 3, 4, 5, 6]
+
+    def test_exactly_once_across_compaction(self, store):
+        ids = []
+        for k in range(4):  # four small segments
+            ids += store.insert_batch(
+                [rate(f"u{k}_{i}", "i1", 1) for i in range(3)], APP
+            )
+            store.seal(APP)
+        page = store.find_since(APP, 0, limit=5)
+        cursor = page[-1].revision
+        assert store.compact(APP) == 3  # 4 → 1
+        rest = store.find_since(APP, cursor)
+        revs = [e.revision for e in page + rest]
+        assert revs == list(range(1, 13))
+        assert {e.event_id for e in page + rest} == set(ids)
+
+    def test_compaction_drops_dead_rows(self, store):
+        ids = store.insert_batch([rate(f"u{i}", "i1", 1) for i in range(4)], APP)
+        store.seal(APP)
+        store.insert_batch([rate(f"v{i}", "i1", 1) for i in range(4)], APP)
+        store.seal(APP)
+        store.delete(ids[0], APP)
+        store.insert(rate("uX", "i2", 2, t=9).with_id(ids[1]), APP)  # overwrite
+        store.seal(APP)
+        st = store.segment_stats(APP)
+        assert st["dead_rows"] == 2
+        store.compact(APP)
+        st = store.segment_stats(APP)
+        assert st["segments"] == 1 and st["dead_rows"] == 0
+        # 9 rows written; 1 deleted + 1 superseded by the overwrite
+        assert st["sealed_rows"] == 7
+        # content intact after the rewrite
+        live = list(store.find(EventQuery(app_id=APP)))
+        assert len(live) == 7
+        assert store.get(ids[1], APP).entity_id == "uX"
+        assert store.get(ids[0], APP) is None
+
+    def test_revision_streams_shape(self, store):
+        streams = store.revision_streams()
+        assert len(streams) == 1
+        key, s, shard = streams[0]
+        assert s is store and shard is None
+
+    def test_shard_filter_partitions(self, store):
+        store.insert_batch([rate(f"u{i}", "i1", 1) for i in range(10)], APP)
+        store.seal(APP)
+        s0 = store.find_since(APP, 0, shard=(0, 2))
+        s1 = store.find_since(APP, 0, shard=(1, 2))
+        assert len(s0) + len(s1) == 10
+        assert not ({e.event_id for e in s0} & {e.event_id for e in s1})
+
+
+# ---------------------------------------------------------------------------
+# find_frame: bit-identical parity with the row path
+# ---------------------------------------------------------------------------
+
+
+def _mixed_workload(rng, n=60):
+    out = []
+    for k in range(n):
+        if k % 7 == 3:
+            out.append(
+                ev("$set", f"u{k % 5}", t=k,
+                   properties=DataMap({"age": int(rng.randint(18, 60))}))
+            )
+        else:
+            props = {"rating": float(rng.randint(1, 6))}
+            if k % 5 == 0:
+                props.pop("rating")  # absent → default applies
+            out.append(
+                ev("rate" if k % 3 else "buy", f"u{int(rng.randint(6))}",
+                   t=int(rng.randint(48)), target_entity_type="item",
+                   target_entity_id=f"i{int(rng.randint(9))}",
+                   properties=DataMap(props))
+            )
+    return out
+
+
+class TestFrameParity:
+    @pytest.mark.parametrize("query_kw", [
+        {},
+        {"event_names": ["rate"]},
+        {"event_names": ["rate", "buy"], "entity_type": "user"},
+        {"start_time": T(10), "until_time": T(40)},
+        {"target_entity_type": "item"},
+        {"shard": (1, 3)},
+        {"filter_target_absent": True},
+    ])
+    def test_bit_identical_vs_from_events(self, store, query_kw):
+        rng = np.random.RandomState(7)
+        events = _mixed_workload(rng)
+        store.insert_batch(events[:25], APP)
+        store.seal(APP)
+        store.insert_batch(events[25:45], APP)
+        store.seal(APP)
+        store.insert_batch(events[45:], APP)  # unsealed tail
+        q = EventQuery(app_id=APP, **query_kw)
+        fast = store.find_frame(q, value_prop="rating", default_value=9.0)
+        ref = EventFrame.from_events(
+            store.find(q), value_prop="rating", default_value=9.0
+        )
+        frames_equal(fast, ref)
+        if "shard" not in query_kw:
+            assert len(fast) > 0
+        else:
+            # the three shard partitions cover the namespace exactly
+            total = sum(
+                len(
+                    store.find_frame(
+                        EventQuery(app_id=APP, shard=(i, 3)),
+                        value_prop="rating", default_value=9.0,
+                    )
+                )
+                for i in range(3)
+            )
+            assert total == len(
+                store.find_frame(
+                    EventQuery(app_id=APP), value_prop="rating",
+                    default_value=9.0,
+                )
+            )
+
+    def test_value_prop_overflow_fallback(self, store):
+        """A numeric prop past the per-segment column cap still reads
+        correctly through the sidecar fallback."""
+        props = {f"p{k:02d}": float(k) for k in range(20)}
+        store.insert_batch(
+            [
+                ev("rate", f"u{i}", t=i, target_entity_type="item",
+                   target_entity_id="i0", properties=DataMap(dict(props)))
+                for i in range(4)
+            ],
+            APP,
+        )
+        store.seal(APP)
+        seg = store._ns[(APP, None)].segments[0]
+        columnized = set(seg.footer["value_props"])
+        overflow = sorted(set(props) - columnized)
+        assert overflow, "cap did not bind — widen the workload"
+        q = EventQuery(app_id=APP)
+        fast = store.find_frame(q, value_prop=overflow[0], default_value=0.5)
+        ref = EventFrame.from_events(
+            store.find(q), value_prop=overflow[0], default_value=0.5
+        )
+        frames_equal(fast, ref)
+
+    def test_sealed_cache_folds_only_tail(self, store):
+        store.insert_batch([rate(f"u{i}", "i1", i + 1) for i in range(6)], APP)
+        store.seal(APP)
+        q = EventQuery(app_id=APP)
+        store.find_frame(q, value_prop="rating")
+        misses0 = store.frame_cache_stats["misses"]
+        # tail-only growth: the sealed arrays are reused
+        store.insert(rate("u9", "i2", 3, t=99), APP)
+        frame = store.find_frame(q, value_prop="rating")
+        assert store.frame_cache_stats["misses"] == misses0
+        assert store.frame_cache_stats["hits"] >= 1
+        assert "u9" in frame.entity_vocab
+        # a seal changes the segment set: miss, then hit again
+        store.seal(APP)
+        store.find_frame(q, value_prop="rating")
+        assert store.frame_cache_stats["misses"] == misses0 + 1
+
+    def test_exotic_queries_fall_back(self, store):
+        store.insert_batch([rate(f"u{i}", "i1", 1) for i in range(4)], APP)
+        store.seal(APP)
+        q = EventQuery(app_id=APP, entity_id="u1")
+        frame = store.find_frame(q)
+        assert len(frame) == 1
+        with pytest.raises(StorageError):
+            store.find_frame_parts(q)
+
+
+# ---------------------------------------------------------------------------
+# Target posting list (item fold-in index)
+# ---------------------------------------------------------------------------
+
+
+class TestTargetPosting:
+    def test_target_read_prunes_segments(self, store):
+        store.insert_batch([rate(f"u{i}", "iA", 1) for i in range(5)], APP)
+        store.seal(APP)
+        store.insert_batch([rate(f"u{i}", "iB", 1) for i in range(5)], APP)
+        store.seal(APP)
+        store.segments_scanned = 0
+        got = list(
+            store.find(
+                EventQuery(
+                    app_id=APP, target_entity_type="item",
+                    target_entity_id="iB",
+                )
+            )
+        )
+        assert len(got) == 5
+        assert all(e.target_entity_id == "iB" for e in got)
+        # only the iB segment was touched (footer posting-set prune)
+        assert store.segments_scanned == 1
+
+    def test_memory_target_index(self):
+        from predictionio_tpu.data.storage.memory import MemoryEventStore
+
+        s = MemoryEventStore()
+        ids = [s.insert(rate(f"u{i}", f"i{i % 2}", 1, t=i), APP) for i in range(6)]
+        got = list(s.find(EventQuery(app_id=APP, target_entity_id="i1")))
+        assert {e.entity_id for e in got} == {"u1", "u3", "u5"}
+        # index follows deletes and overwrites
+        s.delete(ids[1], APP)
+        s.insert(rate("u3", "i0", 1, t=3).with_id(ids[3]), APP)
+        got = list(s.find(EventQuery(app_id=APP, target_entity_id="i1")))
+        assert {e.entity_id for e in got} == {"u5"}
+
+
+# ---------------------------------------------------------------------------
+# Compaction vs concurrent tail reads (the race the ISSUE names)
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrency:
+    @pytest.mark.parametrize("seed", [0])
+    def test_compaction_vs_tail_read_race(self, tmp_path, seed):
+        store = SegmentFSEventStore({
+            "PATH": str(tmp_path / "race"),
+            "SEAL_INTERVAL_S": "3600",
+            "COMPACT_SEGMENTS": "2",
+        })
+        store.init_app(APP)
+        n_total, batch = 400, 20
+        errors: list[BaseException] = []
+        seen: list[str] = []
+
+        def writer():
+            try:
+                for b in range(n_total // batch):
+                    store.insert_batch(
+                        [
+                            rate(f"u{b}_{i}", f"i{i % 3}", 1, t=b)
+                            for i in range(batch)
+                        ],
+                        APP,
+                    )
+            except BaseException as e:  # noqa: BLE001 — fail the test
+                errors.append(e)
+
+        def maintainer():
+            try:
+                for _ in range(30):
+                    store.seal(APP)
+                    store.compact(APP)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                cursor = 0
+                while len(seen) < n_total and not errors:
+                    for e in store.find_since(APP, cursor, limit=64):
+                        seen.append(e.event_id)
+                        cursor = e.revision
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=f, name=f"race-{f.__name__}")
+            for f in (writer, maintainer, reader)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(seen) == n_total
+        assert len(set(seen)) == n_total  # exactly once
+        store.close()
+
+    def test_background_sealer_thread_joins(self, tmp_path):
+        store = SegmentFSEventStore({
+            "PATH": str(tmp_path / "bg"),
+            "SEAL_INTERVAL_S": "0.02",
+            "SEAL_AGE_S": "0.01",
+        })
+        store.init_app(APP)
+        store.insert_batch([rate(f"u{i}", "i1", 1) for i in range(8)], APP)
+        deadline = dt.datetime.now() + dt.timedelta(seconds=10)
+        while (
+            store.segment_stats(APP)["tail_rows"]
+            and dt.datetime.now() < deadline
+        ):
+            pass
+        assert store.segment_stats(APP)["tail_rows"] == 0  # sealer ran
+        sealer = store._sealer
+        store.close()
+        assert sealer is not None and not sealer.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Loader: SegmentStager device staging
+# ---------------------------------------------------------------------------
+
+
+class TestSegmentStager:
+    def test_staged_parity_and_sealed_reuse(self, store):
+        from predictionio_tpu.parallel.loader import SegmentStager
+
+        store.insert_batch(
+            [rate(f"u{i % 4}", f"i{i % 3}", i + 1, t=i) for i in range(12)],
+            APP,
+        )
+        store.seal(APP)
+        q = EventQuery(app_id=APP, event_names=["rate"])
+        stager = SegmentStager()
+        frame, staged = stager.stage(q_store := store, q, value_prop="rating")
+        assert stager.stats["sealed_restage"] == 1
+        np.testing.assert_array_equal(
+            np.asarray(staged["entity_idx"]), frame.entity_idx
+        )
+        np.testing.assert_array_equal(
+            np.asarray(staged["target_idx"]), frame.target_idx
+        )
+        np.testing.assert_array_equal(
+            np.asarray(staged["value"]), frame.value
+        )
+        assert np.asarray(staged["valid"]).sum() == len(frame)
+        bytes_full = stager.stats["bytes_staged"]
+        # tail-only growth: only the tail rows cross to the device
+        store.insert_batch([rate("u9", "i9", 2, t=99)], APP)
+        frame2, staged2 = stager.stage(q_store, q, value_prop="rating")
+        assert stager.stats["sealed_reuse"] == 1
+        assert stager.stats["sealed_restage"] == 1
+        tail_bytes = stager.stats["bytes_staged"] - bytes_full
+        assert 0 < tail_bytes < bytes_full
+        assert len(frame2) == len(frame) + 1
+        np.testing.assert_array_equal(
+            np.asarray(staged2["value"]), frame2.value
+        )
+        # the sealed prefix's codes were stable across the growth
+        np.testing.assert_array_equal(
+            np.asarray(staged2["entity_idx"])[: len(frame)],
+            frame.entity_idx,
+        )
+        # a seal invalidates: full restage
+        store.seal(APP)
+        stager.stage(q_store, q, value_prop="rating")
+        assert stager.stats["sealed_restage"] == 2
+
+    def test_staged_training_matches_row_path(self, store, mesh8):
+        from predictionio_tpu.models import als
+        from predictionio_tpu.parallel.loader import SegmentStager
+
+        rng = np.random.RandomState(3)
+        store.insert_batch(
+            [
+                rate(f"u{int(rng.randint(12))}", f"i{int(rng.randint(8))}",
+                     int(rng.randint(1, 6)), t=i)
+                for i in range(120)
+            ],
+            APP,
+        )
+        store.seal(APP)
+        q = EventQuery(app_id=APP, event_names=["rate"])
+        stager = SegmentStager()
+        frame, staged = stager.stage(store, q, value_prop="rating")
+        rows, cols, vals = frame.interactions()
+        params = als.ALSParams(rank=4, iterations=2)
+        direct = als.train(
+            rows, cols, vals, frame.n_entities, frame.n_targets, params
+        )
+        # staged arrays fetched back drive the same train
+        r = np.asarray(staged["entity_idx"])
+        c = np.asarray(staged["target_idx"])
+        v = np.asarray(staged["value"])
+        keep = c >= 0
+        f2 = EventFrame(
+            event_code=np.zeros(keep.sum(), np.int32),
+            entity_idx=r[keep],
+            target_idx=c[keep],
+            time_ms=np.zeros(keep.sum(), np.int64),
+            value=v[keep],
+            event_vocab=frame.event_vocab,
+            entity_vocab=frame.entity_vocab,
+            target_vocab=frame.target_vocab,
+        )
+        rows2, cols2, vals2 = f2.interactions()
+        via = als.train(
+            rows2, cols2, vals2, frame.n_entities, frame.n_targets, params
+        )
+        np.testing.assert_allclose(
+            direct.user_factors, via.user_factors, atol=1e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# DataView delegation
+# ---------------------------------------------------------------------------
+
+
+class TestDataViewDelegation:
+    def test_dataview_uses_segment_cache(self, tmp_path):
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.data.storage.registry import (
+            SourceConfig,
+            Storage,
+            StorageConfig,
+        )
+        from predictionio_tpu.data.view import DataView
+
+        cfg = StorageConfig(
+            sources={
+                "M": SourceConfig("M", "memory", {}),
+                "SEG": SourceConfig("SEG", "segmentfs", {
+                    "PATH": str(tmp_path / "seg"),
+                    "SEAL_INTERVAL_S": "3600",
+                }),
+            },
+            repositories={
+                "METADATA": "M", "EVENTDATA": "SEG", "MODELDATA": "M",
+            },
+        )
+        storage = Storage(cfg)
+        app_id = storage.get_meta_data_apps().insert(App(0, "segapp"))
+        store = storage.get_events()
+        store.init_app(app_id)
+        store.insert_batch(
+            [rate(f"u{i}", f"i{i % 2}", i + 1, t=i) for i in range(6)],
+            app_id,
+        )
+        store.seal(app_id)
+        view = DataView(view_dir=str(tmp_path / "view"))
+        DataView.stats = {"hits": 0, "misses": 0}
+        f1 = view.find_frame(storage, "segapp", value_prop="rating")
+        assert DataView.stats == {"hits": 0, "misses": 1}
+        view.find_frame(storage, "segapp", value_prop="rating")
+        assert DataView.stats == {"hits": 1, "misses": 1}
+        # tail growth is STILL a sealed-cache hit, with the tail folded
+        store.insert(rate("u9", "i0", 2, t=50), app_id)
+        f3 = view.find_frame(storage, "segapp", value_prop="rating")
+        assert DataView.stats == {"hits": 2, "misses": 1}
+        assert len(f3) == len(f1) + 1
+        # no npz files were written (delegation skips the disk layer)
+        assert not (tmp_path / "view").exists()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# Sharded batch replay routing (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+class _RecordingStore:
+    """Child-store stub recording batch req-ids and deduping on them —
+    the remote daemon's req-id contract in miniature."""
+
+    def __init__(self):
+        from predictionio_tpu.data.storage.memory import MemoryEventStore
+
+        self.inner = MemoryEventStore()
+        self.req_ids: list[str] = []
+        self._outcomes: dict[str, list[str]] = {}
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def insert_batch_with_req_id(self, events, app_id, channel_id, req_id):
+        self.req_ids.append(req_id)
+        if req_id in self._outcomes:  # replay the recorded outcome
+            return self._outcomes[req_id]
+        ids = self.inner.insert_batch(events, app_id, channel_id)
+        self._outcomes[req_id] = ids
+        return ids
+
+
+class TestShardedBatchReqId:
+    def test_routed_batches_under_stable_derived_ids(self):
+        from predictionio_tpu.data.storage.sharded import ShardedEventStore
+
+        children = [_RecordingStore(), _RecordingStore()]
+        sharded = ShardedEventStore(stores=children)  # type: ignore[arg-type]
+        events = [rate(f"u{i}", "i1", 1, t=i).with_id(f"e{i}") for i in range(10)]
+        ids = sharded.insert_batch_with_req_id(events, APP, None, "walb-abc")
+        assert ids == [f"e{i}" for i in range(10)]  # input order restored
+        per_shard = [c.req_ids for c in children]
+        assert per_shard[0] and per_shard[1]  # both shards got a group
+        assert set(per_shard[0]) == {"walb-abc/s0"}
+        assert set(per_shard[1]) == {"walb-abc/s1"}
+        # a replay re-send forms the same groups under the same derived
+        # ids, and each child's dedupe replays its recorded outcome
+        ids2 = sharded.insert_batch_with_req_id(events, APP, None, "walb-abc")
+        assert ids2 == ids
+        total = sum(
+            len(list(c.inner.find(EventQuery(app_id=APP))))
+            for c in children
+        )
+        assert total == 10  # no duplicates from the re-send
+
+    def test_children_without_capability_fall_back(self):
+        from predictionio_tpu.data.storage.memory import MemoryEventStore
+        from predictionio_tpu.data.storage.sharded import ShardedEventStore
+
+        children = [MemoryEventStore(), MemoryEventStore()]
+        sharded = ShardedEventStore(stores=children)
+        events = [rate(f"u{i}", "i1", 1).with_id(f"e{i}") for i in range(6)]
+        sharded.insert_batch_with_req_id(events, APP, None, "walb-x")
+        # event-id stamping makes the replay an overwrite, not a dup
+        sharded.insert_batch_with_req_id(events, APP, None, "walb-x")
+        total = sum(
+            len(list(c.find(EventQuery(app_id=APP)))) for c in children
+        )
+        assert total == 6

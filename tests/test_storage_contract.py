@@ -79,11 +79,23 @@ def _pg_fake_client():
     return _PGClient(conn=fake_pg.connect())
 
 
-@pytest.fixture(params=["memory", "sqlite", "parquetfs", "remote", "postgres"])
+@pytest.fixture(
+    params=["memory", "sqlite", "parquetfs", "remote", "postgres", "segmentfs"]
+)
 def events(request, tmp_path):
     server = None
     if request.param == "memory":
         store = MemoryEventStore()
+    elif request.param == "segmentfs":
+        from predictionio_tpu.data.storage.segmentfs import (
+            SegmentFSEventStore,
+        )
+
+        # long sealer interval: the contract must hold on the UNSEALED
+        # tail; seal/compact coverage lives in test_segmentfs.py
+        store = SegmentFSEventStore(
+            {"PATH": str(tmp_path / "seg"), "SEAL_INTERVAL_S": "3600"}
+        )
     elif request.param == "postgres":
         from predictionio_tpu.data.storage.postgres import PostgresEventStore
 
@@ -104,6 +116,7 @@ def events(request, tmp_path):
     store.init_app(APP)
     yield store
     store.remove_app(APP)
+    store.close()
     if server is not None:
         server.shutdown()
 
