@@ -263,6 +263,12 @@ class _Handler(JsonHandler):
                         404, "To see stats, launch Event Server with --stats"
                     )
                 self._respond(200, self.server.stats.get(auth.app_id))
+            elif path == "/segments/seal" and method == "POST":
+                self._segments_op("seal", self._auth(query))
+            elif path == "/segments/compact" and method == "POST":
+                self._segments_op("compact", self._auth(query))
+            elif path == "/segments/stats" and method == "GET":
+                self._segments_stats(self._auth(query))
             elif path.startswith("/webhooks/"):
                 self._webhooks(method, path, query)
             else:
@@ -272,6 +278,44 @@ class _Handler(JsonHandler):
         except Exception:
             log.exception("internal error on %s %s", method, self.path)
             self._respond(500, {"message": "internal server error"})
+
+    def _segments_store(self):
+        """The columnar segment store behind this server, or 404 — the
+        admin surface only exists on segmentfs-backed event data
+        (ISSUE 14 satellite, carried data-plane follow-up)."""
+        events = self.server.storage.get_events()
+        if not hasattr(events, "segment_stats"):
+            raise _HttpError(
+                404,
+                "event store backend has no segment surface (these "
+                "endpoints need source type 'segmentfs')",
+            )
+        return events
+
+    def _segments_op(self, op: str, auth: AuthData) -> None:
+        """POST /segments/seal|compact — synchronously seal the
+        unsealed tail / merge small adjacent segments for the access
+        key's app+channel (the background sealer runs on its own cadence;
+        operators sealing before a retrain or compacting after a purge
+        shouldn't have to wait for it)."""
+        events = self._segments_store()
+        try:
+            n = getattr(events, op)(auth.app_id, auth.channel_id)
+        except Exception as e:
+            raise _HttpError(503, f"{op} failed: {e}")
+        key = "sealedRows" if op == "seal" else "segmentsMerged"
+        self._respond(200, {key: int(n)})
+
+    def _segments_stats(self, auth: AuthData) -> None:
+        """GET /segments/stats — the store's segment shape (sealed
+        segment count, tail depth, dead rows, max revision) for the
+        access key's app+channel; `pio status --event-url` prints it."""
+        events = self._segments_store()
+        try:
+            st = events.segment_stats(auth.app_id, auth.channel_id)
+        except Exception as e:
+            raise _HttpError(503, f"segment stats failed: {e}")
+        self._respond(200, st)
 
     def _post_event(self, auth: AuthData) -> None:
         obj = self._json_body()

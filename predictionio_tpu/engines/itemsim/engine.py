@@ -96,6 +96,13 @@ class ItemSimAlgorithmParams:
     # the catalog (and the U-dim vectors) can exceed one chip's HBM,
     # and item-vocab growth needs no O(I²) recompute.
     shard_serving: bool = False
+    # serving dtype for the on-the-fly cosine vectors (ISSUE 14):
+    # "int8" per-row-quantizes the (I, U) column vectors (~1/4 the
+    # resident bytes — the U dim is the expensive one here), "bf16"
+    # halves them; cosine normalizes by the STAGED f32 norms either
+    # way. Applies to both the single-device staged state and the
+    # sharded tier.
+    serve_dtype: str = "f32"
 
 
 @dataclass
@@ -107,6 +114,8 @@ class ItemSimModel:
     # shard_serving: the raw (I, U) item column vectors; similarity is
     # computed on the fly from the sharded copies
     item_vectors: object = None  # Optional[np.ndarray]
+    # serving dtype for the staged/sharded on-the-fly cosine (ISSUE 14)
+    serve_dtype: str = "f32"
 
     def __post_init__(self):
         self._stage_lock = threading.Lock()
@@ -115,6 +124,7 @@ class ItemSimModel:
         state = dict(self.__dict__)
         # serving state + lock are not part of the pickled model
         state.pop("_sharded_runtime", None)
+        state.pop("_item_serving", None)
         state.pop("_stage_lock", None)
         return state
 
@@ -122,6 +132,7 @@ class ItemSimModel:
         # models pickled BEFORE these fields existed must keep loading
         state.setdefault("top_n", 50)
         state.setdefault("item_vectors", None)
+        state.setdefault("serve_dtype", "f32")
         self.__dict__.update(state)
         self._stage_lock = threading.Lock()
 
@@ -144,11 +155,30 @@ class ItemSimModel:
                     ),
                     self.item_vectors,
                     item_vocab=self.item_vocab,
+                    serve_dtype=self.serve_dtype,
                 )
                 if self._sharded_runtime is False:
                     return None
                 srt = self._sharded_runtime
             return srt
+
+    def item_serving(self):
+        """Single-device staged state for the on-the-fly cosine
+        (ISSUE 14): the column vectors stage ONCE (quantized when
+        serve_dtype opts in) and every query runs the fused
+        score+top-k — the per-query numpy (Q, I) cosine matmul and its
+        normalized matrix copy are gone."""
+        if self.item_vectors is None:
+            return None
+        with self._stage_lock:
+            sv = getattr(self, "_item_serving", None)
+            if sv is None:
+                from predictionio_tpu.models import als
+
+                sv = self._item_serving = als.stage_item_serving(
+                    self.item_vectors, serve_dtype=self.serve_dtype
+                )
+            return sv
 
     def sharded_info(self):
         srt = getattr(self, "_sharded_runtime", None)
@@ -172,6 +202,7 @@ class ItemSimAlgorithm(Algorithm):
                 item_vectors=np.ascontiguousarray(
                     pd.matrix.T.astype(np.float32)
                 ),
+                serve_dtype=getattr(self.params, "serve_dtype", "f32"),
             )
         scores, idx = dimsum.column_cosine_topn(
             pd.matrix, top_n=self.params.top_n, mesh=ctx.mesh
@@ -196,8 +227,11 @@ class ItemSimAlgorithm(Algorithm):
         total = np.zeros(n_items, dtype=np.float32)
         if model.item_vectors is not None:
             # on-the-fly similarity (shard_serving): sharded when > 1
-            # device is visible, host cosine otherwise — both truncate
-            # to top_n per query item exactly like the precomputed path
+            # device is visible, the STAGED fused cosine otherwise
+            # (ISSUE 14 — als.similar_serving off the resident column
+            # vectors; the per-query numpy cosine matmul is retired) —
+            # both truncate to top_n per query item exactly like the
+            # precomputed path
             srt = model.sharded_runtime()
             k = min(model.top_n, n_items)
             if srt is not None:
@@ -205,14 +239,12 @@ class ItemSimAlgorithm(Algorithm):
                     np.asarray(known, np.int64), k, exclude_self=True
                 )
             else:
-                from predictionio_tpu.models import ranking
-                from predictionio_tpu.ops.topk import NEG_INF
+                from predictionio_tpu.models import als
 
-                normed = ranking.l2_normalize(model.item_vectors)
-                scores = normed[known] @ normed.T
-                scores[np.arange(len(known)), known] = NEG_INF
-                idx = np.argsort(-scores, axis=1, kind="stable")[:, :k]
-                vals = np.take_along_axis(scores, idx, axis=1)
+                vals, idx = als.similar_serving(
+                    model.item_serving(),
+                    np.asarray(known, np.int64), k, exclude_self=True,
+                )
             from predictionio_tpu.ops.topk import NEG_INF
 
             for r in range(len(known)):

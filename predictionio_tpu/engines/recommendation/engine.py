@@ -257,12 +257,16 @@ class ALSAlgorithmParams:
     # top-k per shard + global merge. Off by default — single-chip
     # serving keeps the PR-2 resident-matrix path.
     shard_serving: bool = False
-    # serving dtype (ISSUE 11): "int8" quantizes BOTH factor matrices
-    # per-row at model publish/fold-in (half the resident bytes and the
-    # factor stream; int8xint8->int32 scoring, scale-product dequant in
-    # registers). Scores shift by the quantization error (~1% relative
-    # at serving rank — see tests/test_recommend_pallas.py bounds), so
-    # it is an explicit opt-in; "f32" keeps exact scoring.
+    # serving dtype (ISSUE 11/14): "int8" quantizes BOTH factor
+    # matrices per-row at model publish/fold-in (~1/3 the resident
+    # bytes and factor stream; int8xint8->int32 scoring, scale-product
+    # dequant in registers); "bf16" (ISSUE 14) is the middle ground —
+    # half the bytes, bf16xbf16->f32 scoring. Scores shift by the
+    # quantization/rounding error (~1% relative for int8 at serving
+    # rank — see tests/test_recommend_pallas.py bounds), so both are
+    # explicit opt-ins; "f32" keeps exact scoring. Applies to the
+    # single-device staged state AND the sharded tier (ISSUE 14
+    # brought ShardedRuntime to dtype parity).
     serve_dtype: str = "f32"
 
 
@@ -358,10 +362,62 @@ class ALSModel:
                     user_vocab=self.factors.user_vocab,
                     item_vocab=self.factors.item_vocab,
                     params=self.factors.params,
+                    # the sharded tier honors the model's serve dtype
+                    # (ISSUE 14): int8/bf16 slabs per shard
+                    serve_dtype=self.serve_dtype,
                 )
                 if self._sharded_runtime is False:
                     return None
             return self._sharded_runtime
+
+    def adopt_sharded(self, old_runtime, dirty_users=None, dirty_items=None):
+        """Fold-in publish hook for the SHARDED tier (ISSUE 14,
+        direction-1 item (c)): carry the predecessor's resident sharded
+        state by publishing ONLY the tick's dirty rows through
+        `ShardedRuntime.update_*_rows` — re-quantizing just those rows
+        and donating the slab once in-flight readers drain — instead of
+        re-staging f32 factor matrices per tick. Rows beyond the padded
+        shard extent (vocab growth) leave the state unstaged; the next
+        query rebuilds lazily (the amortized-growth contract)."""
+        if old_runtime is None or old_runtime is False:
+            return
+        # validate BOTH sides BEFORE mutating either: the runtime is
+        # shared in place with the still-serving predecessor, so a
+        # user-side write followed by an item-side growth refusal would
+        # leave the LIVE state half-updated with no rollback
+        for side, dirty in (("user", dirty_users), ("item", dirty_items)):
+            if dirty is not None and not old_runtime.rows_within_extent(
+                side, dirty[0]
+            ):
+                return  # vocab grew past the padded extent: lazy restage
+        try:
+            if dirty_users is not None:
+                ur, uv = dirty_users
+                if len(ur):
+                    old_runtime.update_user_rows(
+                        ur, uv,
+                        # within-pad growth must raise the live extent
+                        # or the grown rows stay masked dead (the
+                        # single-device publish's n_users/n_items twin)
+                        n_users=self.factors.user_factors.shape[0],
+                    )
+            if dirty_items is not None:
+                ir, iv = dirty_items
+                if len(ir):
+                    old_runtime.update_item_rows(
+                        ir, iv,
+                        n_items=self.factors.item_factors.shape[0],
+                    )
+            self._sharded_runtime = old_runtime
+        except Exception:
+            import logging as _logging
+
+            _logging.getLogger(__name__).exception(
+                "sharded dirty-row publish failed mid-carry; the "
+                "runtime may be half-updated — dropping the carry so "
+                "the next query restages from the folded factors"
+            )
+            self._sharded_runtime = None
 
     def sharded_info(self) -> Optional[dict]:
         """Shard layout for the server's fleet status (None when the
@@ -599,6 +655,41 @@ class ALSAlgorithm(Algorithm):
                         mask[qi, ix] = True
         return mask
 
+    def _exclusion_args(
+        self, model: ALSModel, queries: Sequence[Query]
+    ) -> tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """(dense mask, row list) — exactly one is set when any filter
+        applies. The common small-blacklist case ships a (B, E) int32
+        ROW LIST (ISSUE 14): a handful of ids per query instead of an
+        n_items-wide mask — the serving layer feeds it straight to the
+        fused kernel's row-list input (or bit-packs it at 1/32 the f32
+        bytes). Category/whitelist filters — which invert to most-of-
+        the-catalog exclusions — keep the dense mask, packed downstream."""
+        from predictionio_tpu.ops.recommend_pallas import (
+            ROWLIST_MAX,
+            rowlist_np,
+        )
+
+        if not any(
+            q.whitelist or q.blacklist or q.categories for q in queries
+        ):
+            return None, None
+        if any(q.whitelist is not None or q.categories for q in queries):
+            return self._exclusion_mask(model, queries), None
+        vocab = model.factors.item_vocab
+        lists: list[list[int]] = []
+        for q in queries:
+            rows = [
+                ix for it in (q.blacklist or [])
+                if (ix := vocab.get(it)) is not None
+            ]
+            lists.append(rows)
+        if max(len(r) for r in lists) > ROWLIST_MAX:
+            return self._exclusion_mask(model, queries), None
+        # the shared row-list wire convention (pow2 width, -1 pad)
+        # lives in ops/recommend_pallas.py — one owner, no drift
+        return None, rowlist_np(lists)
+
     def _predict_batch(
         self, model: ALSModel, queries: Sequence[Query]
     ) -> list[PredictedResult]:
@@ -617,10 +708,10 @@ class ALSAlgorithm(Algorithm):
         k_req = min(max(q.num for q in queries), n_items)
         k = topk_bucket(k_req, n_items)
         user_rows = np.array([u for _, u in known_ix], dtype=np.int64)
-        full_mask = self._exclusion_mask(model, queries)
-        sub_mask = (
-            full_mask[[i for i, _ in known_ix]] if full_mask is not None else None
-        )
+        full_mask, full_rows = self._exclusion_args(model, queries)
+        keep = [i for i, _ in known_ix]
+        sub_mask = full_mask[keep] if full_mask is not None else None
+        sub_rows = full_rows[keep] if full_rows is not None else None
         n_real = len(user_rows)
         bucket = batch_bucket(n_real)
         if bucket != n_real:
@@ -631,6 +722,13 @@ class ALSAlgorithm(Algorithm):
                 sub_mask = np.concatenate(
                     [sub_mask, np.zeros((bucket - n_real, sub_mask.shape[1]), bool)]
                 )
+            if sub_rows is not None:
+                sub_rows = np.concatenate([
+                    sub_rows,
+                    np.full(
+                        (bucket - n_real, sub_rows.shape[1]), -1, np.int32
+                    ),
+                ])
         # padding-waste accounting (ISSUE 3) lives HERE, at the pad site:
         # this is the only place that knows both the live row count
         # (vocab-known users, not the micro-batch's group size) and the
@@ -645,15 +743,17 @@ class ALSAlgorithm(Algorithm):
             # fleet sharded path (ISSUE 10): local top-k per shard +
             # global merge; factor state stays row-sharded in HBM
             scores, items = srt.recommend(
-                user_rows, k, exclude_mask=sub_mask
+                user_rows, k, exclude_mask=sub_mask,
+                exclude_rows=sub_rows,
             )
         else:
-            # staged serving state (ISSUE 11): fused one-pass kernel
-            # where the lowering runs, int8 when the params opt in,
-            # resident factor state either way
+            # staged serving state (ISSUE 11/14): fused one-pass kernel
+            # where the lowering runs, int8/bf16 when the params opt
+            # in, exclusion as a row list or packed bit words — never
+            # an f32 mask — and resident factor state either way
             scores, items = als.recommend_serving(
                 model.serving_state(), user_rows, k,
-                exclude_mask=sub_mask,
+                exclude_mask=sub_mask, exclude_rows=sub_rows,
             )
         _devprof.record_batch_padding(
             n_real, bucket, flops=_devprof.snapshot().flops - prof0.flops

@@ -139,28 +139,53 @@ class ALSSimilarParams:
     # so the catalog can exceed one chip's HBM — the same wiring the
     # recommendation engine got in PR 10.
     shard_serving: bool = False
+    # serving dtype for the basket cosine (ISSUE 14): "int8"/"bf16"
+    # stage quantized item factors and run the fused scaled-dot cosine
+    # (als.similar_vectors_serving); "f32" keeps the exact host path
+    # on CPU and the fused kernel where the TPU lowering runs.
+    serve_dtype: str = "f32"
 
 
 class SimilarModel:
     """Item factors + vocab; normalized factors cached across queries."""
 
-    def __init__(self, factors: als.ALSFactors):
+    def __init__(self, factors: als.ALSFactors, serve_dtype: str = "f32"):
         self.factors = factors
+        self.serve_dtype = serve_dtype
         self._normed = None
+        self._serving_state = None  # als.ServingFactors when staged
         self._sharded_runtime = None  # fleet.ShardedRuntime when active
         self._stage_lock = threading.Lock()
 
     # the cache is serving state, not part of the pickled model
     def __getstate__(self):
-        return {"factors": self.factors}
+        return {
+            "factors": self.factors,
+            "serve_dtype": self.serve_dtype,
+        }
 
     def __setstate__(self, state):
-        self.__init__(state["factors"])
+        # models pickled before serve_dtype existed must keep loading
+        self.__init__(
+            state["factors"], state.get("serve_dtype", "f32")
+        )
 
     def normed_item_factors(self) -> np.ndarray:
         if self._normed is None:
             self._normed = ranking.l2_normalize(self.factors.item_factors)
         return self._normed
+
+    def serving_state(self):
+        """Staged item-side serving state for the fused basket cosine
+        (ISSUE 14): quantized when serve_dtype opts in, resident
+        across queries. Locked like every other staging."""
+        with self._stage_lock:
+            if self._serving_state is None:
+                self._serving_state = als.stage_item_serving(
+                    self.factors.item_factors,
+                    serve_dtype=self.serve_dtype,
+                )
+            return self._serving_state
 
     def sharded_runtime(self):
         """Sharded serving state, staged lazily via the shared
@@ -181,6 +206,7 @@ class SimilarModel:
                     self.factors.user_factors,
                     self.factors.item_factors,
                     item_vocab=self.factors.item_vocab,
+                    serve_dtype=self.serve_dtype,
                 )
                 if self._sharded_runtime is False:
                     return None
@@ -225,29 +251,49 @@ class _SimilarBase(Algorithm):
             if getattr(self.params, "shard_serving", False)
             else None
         )
-        if srt is not None:
-            # sharded basket cosine (ISSUE 11 satellite): the mean
-            # query vector scores each shard's slab locally; only the
-            # (1, k) candidates ride the ICI merge. Mean of NORMALIZED
-            # vectors, like the host path; the sharded verb divides by
-            # the query norm, so multiply it back — the same query must
-            # yield the same SCORES regardless of device count, not
-            # just the same ranking (clients threshold on values).
-            # Filter masked entries on the RAW value first: a scale
-            # < 0.5 would otherwise lift NEG_INF past the filter bound.
-            q = model.normed_item_factors()[known].mean(axis=0)
+        def basket_result(vals, idx, qnorm):
+            # both device routes score the mean of NORMALIZED vectors
+            # and divide by the query norm (cosine), so multiply it
+            # back — the same query must yield the same SCORES as the
+            # host path regardless of route/device count, not just the
+            # same ranking (clients threshold on values). Filter masked
+            # entries on the RAW value FIRST: a scale < 0.5 would
+            # otherwise lift NEG_INF past the filter bound.
             from predictionio_tpu.ops.topk import NEG_INF
 
-            vals, idx = srt.similar_vectors(
-                q[None, :], query.num, exclude_mask=excluded[None, :]
-            )
-            qnorm = float(np.linalg.norm(q)) + 1e-9
             return PredictedResult(
                 item_scores=[
                     ItemScore(item=inv(int(ix)), score=float(s * qnorm))
                     for s, ix in zip(vals[0], idx[0])
                     if s > NEG_INF / 2
                 ]
+            )
+
+        if srt is not None:
+            # sharded basket cosine (ISSUE 11 satellite): the mean
+            # query vector scores each shard's slab locally; only the
+            # (1, k) candidates ride the ICI merge.
+            q = model.normed_item_factors()[known].mean(axis=0)
+            vals, idx = srt.similar_vectors(
+                q[None, :], query.num, exclude_mask=excluded[None, :]
+            )
+            return basket_result(
+                vals, idx, float(np.linalg.norm(q)) + 1e-9
+            )
+        serve_dtype = getattr(self.params, "serve_dtype", "f32")
+        from predictionio_tpu.ops.recommend_pallas import resolve_mode
+
+        if serve_dtype != "f32" or resolve_mode("auto") is not None:
+            # staged fused basket cosine (ISSUE 14): quantized resident
+            # item factors + one fused score+top-k dispatch; the host
+            # path survives as the exact-f32 CPU default
+            q = model.normed_item_factors()[known].mean(axis=0)
+            vals, idx = als.similar_vectors_serving(
+                model.serving_state(), q[None, :], query.num,
+                exclude_mask=excluded[None, :],
+            )
+            return basket_result(
+                vals, idx, float(np.linalg.norm(q)) + 1e-9
             )
         normed = model.normed_item_factors()
         scores = normed @ normed[known].mean(axis=0)
@@ -285,7 +331,9 @@ class ALSSimilarAlgorithm(_SimilarBase):
             item_vocab=pd.item_vocab,
             mesh=ctx.mesh,
         )
-        return SimilarModel(factors)
+        return SimilarModel(
+            factors, serve_dtype=getattr(self.params, "serve_dtype", "f32")
+        )
 
 
 class LikeAlgorithm(_SimilarBase):
@@ -312,7 +360,9 @@ class LikeAlgorithm(_SimilarBase):
             item_vocab=pd.item_vocab,
             mesh=ctx.mesh,
         )
-        return SimilarModel(factors)
+        return SimilarModel(
+            factors, serve_dtype=getattr(self.params, "serve_dtype", "f32")
+        )
 
 
 class SumScoreServing(Serving):

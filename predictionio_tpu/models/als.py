@@ -1856,11 +1856,15 @@ def recommend(
 # -- staged serving state (ISSUE 11) ----------------------------------------
 
 
+SERVE_DTYPES = ("f32", "bf16", "int8")
+
+
 @dataclass(frozen=True)
 class ServingFactors:
     """Device-resident serving-side factor state, staged ONCE and reused
     across every call (the donated-resident-state contract: per-query
-    traffic is the (B,) row ids and, when filters apply, the mask).
+    traffic is the (B,) row ids and, when filters apply, the packed
+    mask words or exclusion row list).
 
     `items` is row-padded to `ops.recommend_pallas.ITEM_PAD` so the
     fused kernel always finds a dividing tile; `n_items` is the live
@@ -1868,15 +1872,21 @@ class ServingFactors:
     on the XLA fallback). dtype "int8" holds BOTH matrices per-row
     symmetric-quantized with their scale vectors (users (U, 1),
     items (1, I_p)) — scoring is int8xint8->int32 with the scale outer
-    product dequantizing in registers."""
+    product dequantizing in registers; "bf16" (ISSUE 14, the middle
+    ground) halves the factor stream with bf16xbf16->f32 scoring and
+    no scale vectors. `item_inv_norm` carries the items' f32-row
+    inverse L2 norms so the cosine verbs (`similar_serving`, itemsim's
+    on-the-fly cosine) serve off the SAME resident slab — cosine is
+    the scaled dot, never a normalized copy in HBM."""
 
-    users: jax.Array  # (U, K) f32 | int8
-    items: jax.Array  # (I_p, K) f32 | int8 — pad rows zero
+    users: jax.Array  # (U, K) f32 | bf16 | int8
+    items: jax.Array  # (I_p, K) f32 | bf16 | int8 — pad rows zero
     user_scale: Optional[jax.Array]  # (U, 1) f32 when int8
     item_scale: Optional[jax.Array]  # (1, I_p) f32 when int8
     n_items: int
-    dtype: str  # "f32" | "int8"
+    dtype: str  # "f32" | "bf16" | "int8"
     mode: Optional[str]  # resolved pallas mode (None = XLA two-step)
+    item_inv_norm: Optional[jax.Array] = None  # (1, I_p) f32 — cosine
 
     @property
     def n_users(self) -> int:
@@ -1886,6 +1896,8 @@ class ServingFactors:
         total = float(self.users.nbytes + self.items.nbytes)
         if self.user_scale is not None:
             total += float(self.user_scale.nbytes + self.item_scale.nbytes)
+        if self.item_inv_norm is not None:
+            total += float(self.item_inv_norm.nbytes)
         return total
 
 
@@ -1899,14 +1911,44 @@ def stage_serving(
     Quantization happens HERE — at model publish / fold-in restage —
     never per query; `serving_publish_rows` keeps a folded tick from
     re-running this on anything but the dirty rows."""
+    return _stage_arrays(
+        np.asarray(factors.user_factors, np.float32),
+        np.asarray(factors.item_factors, np.float32),
+        serve_dtype, mode,
+    )
+
+
+def stage_item_serving(
+    item_matrix: np.ndarray,
+    serve_dtype: str = "f32",
+    mode: str = "auto",
+) -> ServingFactors:
+    """Item-only staging for cosine-only models (itemsim's (I, U)
+    column vectors): same ServingFactors contract with an empty user
+    side — `similar_serving` is the only verb that makes sense here."""
+    itf = np.asarray(item_matrix, np.float32)
+    return _stage_arrays(
+        np.zeros((0, itf.shape[1] if itf.ndim == 2 else 0), np.float32),
+        itf, serve_dtype, mode,
+    )
+
+
+def _stage_arrays(
+    uf: np.ndarray, itf: np.ndarray, serve_dtype: str, mode: str
+) -> ServingFactors:
     from predictionio_tpu.ops import recommend_pallas as _rp
 
-    if serve_dtype not in ("f32", "int8"):
-        raise ValueError(f"serve_dtype must be f32|int8, got {serve_dtype!r}")
-    uf = np.asarray(factors.user_factors, np.float32)
-    itf = np.asarray(factors.item_factors, np.float32)
+    if serve_dtype not in SERVE_DTYPES:
+        raise ValueError(
+            f"serve_dtype must be one of {SERVE_DTYPES}, got "
+            f"{serve_dtype!r}"
+        )
     n_items, k = itf.shape if itf.ndim == 2 else (0, uf.shape[1])
     i_p = _rp.pad_items(n_items)
+    # inverse norms from the PRE-quantization f32 rows: the cosine
+    # verbs normalize by the true magnitudes, identically across dtypes
+    inv = jax.device_put(_rp.inv_norms_np(itf, i_p))
+    resolved = _rp.resolve_mode(mode)
     if serve_dtype == "int8":
         uq, us = _rp.quantize_rows_np(uf)
         iq, isc = _rp.quantize_rows_np(itf)
@@ -1921,18 +1963,46 @@ def stage_serving(
             item_scale=jax.device_put(iscale),
             n_items=n_items,
             dtype="int8",
-            mode=_rp.resolve_mode(mode),
+            mode=resolved,
+            item_inv_norm=inv,
         )
-    items = np.zeros((i_p, k), np.float32)
+    np_dt = np.float32
+    items = np.zeros((i_p, k), np_dt)
     items[:n_items] = itf
+    users_dev = jax.device_put(uf)
+    items_dev = jax.device_put(items)
+    if serve_dtype == "bf16":
+        users_dev = users_dev.astype(jnp.bfloat16)
+        items_dev = items_dev.astype(jnp.bfloat16)
     return ServingFactors(
-        users=jax.device_put(uf),
-        items=jax.device_put(items),
+        users=users_dev,
+        items=items_dev,
         user_scale=None,
         item_scale=None,
         n_items=n_items,
-        dtype="f32",
-        mode=_rp.resolve_mode(mode),
+        dtype=serve_dtype,
+        mode=resolved,
+        item_inv_norm=inv,
+    )
+
+
+def _serve_dtype_of(items: jax.Array) -> str:
+    dt = str(items.dtype)
+    return "int8" if dt == "int8" else ("bf16" if dt == "bfloat16" else "f32")
+
+
+def _fused_or_xla_topk(
+    q, items, qs, isc, mask_bits, excl_rows, n_items, *, k, mode
+):
+    """One dispatch seam for every serving verb, shared with the
+    sharded tier: ops/recommend_pallas.py:fused_or_xla_topk (the fused
+    one-pass kernel where a mode resolved, else the XLA two-step with
+    IDENTICAL scoring + exclusion semantics — incl. the batch-size-
+    stable `q @ items.T` dot spelling its docstring records)."""
+    from predictionio_tpu.ops.recommend_pallas import fused_or_xla_topk
+
+    return fused_or_xla_topk(
+        q, items, qs, isc, mask_bits, excl_rows, n_items, k=k, mode=mode
     )
 
 
@@ -1943,7 +2013,8 @@ def _serve_recommend_jit(
     items: jax.Array,
     user_scale: Optional[jax.Array],
     item_scale: Optional[jax.Array],
-    mask: Optional[jax.Array],  # (B, I_p) — fused: f32 0/1; XLA: bool
+    mask_bits: Optional[jax.Array],  # (B, I_p/32) int32 packed words
+    excl_rows: Optional[jax.Array],  # (B, E) int32 row list, -1 padded
     n_items: jax.Array,  # () int32 live item count, TRACED — online
     # vocab growth within the pad must not retrace the serving program
     *,
@@ -1953,49 +2024,152 @@ def _serve_recommend_jit(
     """The staged-state serving program: gather the query block from the
     resident user matrix, then either the fused one-pass Pallas kernel
     (mode "tpu"/"interpret") or the XLA two-step fallback — both share
-    the int8 scoring semantics (quantized gather, int32 accumulate,
-    scale-product dequant) so a mode change never changes scores."""
+    the int8/bf16 scoring semantics (quantized gather, int32/f32
+    accumulate, scale-product dequant) so a mode change never changes
+    scores."""
     int8 = items.dtype == jnp.int8
     q = users[rows]
     qs = user_scale[rows] if int8 else None
-    if mode is not None:
-        from predictionio_tpu.ops.recommend_pallas import (
-            fused_recommend_topk,
-        )
-
-        return fused_recommend_topk(
-            q, items, qs, item_scale, mask,
-            k=k, n_items=n_items, interpret=(mode == "interpret"),
-        )
-    if int8:
-        s = jax.lax.dot_general(
-            q, items, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        ).astype(jnp.float32) * qs * item_scale
-    else:
-        s = q @ items.T
-    if mask is not None:
-        s = jnp.where(mask, NEG_INF, s)
-    # pad rows sink strictly BELOW the mask value (they must lose to
-    # legitimately masked real items); k <= n_items is capped on host,
-    # so a pad column can never be selected
-    col = jnp.arange(items.shape[0], dtype=jnp.int32)
-    s = jnp.where(
-        (col >= n_items)[None, :], jnp.finfo(jnp.float32).min, s
+    isc = item_scale if int8 else None
+    return _fused_or_xla_topk(
+        q, items, qs, isc, mask_bits, excl_rows, n_items, k=k, mode=mode
     )
-    return jax.lax.top_k(s, k)
+
+
+@partial(jax.jit, static_argnames=("k", "mode"))
+def _serve_similar_jit(
+    rows: jax.Array,  # (B,) int32 item rows — the per-call traffic
+    items: jax.Array,
+    item_scale: Optional[jax.Array],
+    item_inv_norm: jax.Array,  # (1, I_p) f32
+    mask_bits: Optional[jax.Array],
+    excl_rows: Optional[jax.Array],
+    n_items: jax.Array,
+    *,
+    k: int,
+    mode: Optional[str],
+):
+    """Fused cosine `similar` off the SAME resident item slab as
+    recommend (ISSUE 14 tentpole part 1): cosine(q, x) =
+    (q·x)·(1/|q|)·(1/|x|) — the inverse norms ride the kernel's scale
+    inputs, so no normalized factor copy ever exists in HBM. int8
+    composes: the effective scales are (dequant scale · inverse norm)
+    per side."""
+    q = items[rows]
+    inv_q = item_inv_norm[0, rows][:, None]  # (B, 1)
+    if items.dtype == jnp.int8:
+        qs = item_scale[0, rows][:, None] * inv_q
+        isc = item_scale * item_inv_norm
+    else:
+        qs = inv_q
+        isc = item_inv_norm
+    return _fused_or_xla_topk(
+        q, items, qs, isc, mask_bits, excl_rows, n_items, k=k, mode=mode
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "mode"))
+def _serve_similar_vecs_jit(
+    vecs: jax.Array,  # (B, K) f32 query vectors (basket means)
+    items: jax.Array,
+    item_scale: Optional[jax.Array],
+    item_inv_norm: jax.Array,
+    mask_bits: Optional[jax.Array],
+    excl_rows: Optional[jax.Array],
+    n_items: jax.Array,
+    *,
+    k: int,
+    mode: Optional[str],
+):
+    """Cosine top-k against ARBITRARY f32 query vectors (the
+    similarproduct basket mean) from the staged state: the query side
+    quantizes in-jit for int8 slabs (quantize_rows_jnp), norms fold
+    into the scale product like every other cosine verb."""
+    from predictionio_tpu.ops.recommend_pallas import quantize_rows_jnp
+
+    inv_q = 1.0 / (
+        jnp.linalg.norm(vecs, axis=-1, keepdims=True) + 1e-9
+    )
+    if items.dtype == jnp.int8:
+        q, qscale = quantize_rows_jnp(vecs)
+        qs = qscale * inv_q
+        isc = item_scale * item_inv_norm
+    else:
+        q = vecs.astype(items.dtype)
+        qs = inv_q
+        isc = item_inv_norm
+    return _fused_or_xla_topk(
+        q, items, qs, isc, mask_bits, excl_rows, n_items, k=k, mode=mode
+    )
 
 
 # serving kernels opt into memory analysis (bucket-ladder warmup pays the
-# duplicate AOT compile); the int8 signatures roofline against the int8
-# peak via devprof's dtype-aware table (ISSUE 11 satellite) — args[2]
-# is the resident item matrix, whose dtype IS the MXU dtype here
+# duplicate AOT compile); int8/bf16 signatures roofline against their
+# dtype's peak via devprof's dtype-aware table (ISSUE 11 satellite) —
+# only the call site knows the resident item matrix IS the MXU dtype
 _serve_recommend_jit = _devprof.instrument(
     "als.recommend_serving", _serve_recommend_jit, memory=True,
-    dtype_of=lambda args, kwargs: (
-        "int8" if str(getattr(args[2], "dtype", "")) == "int8" else "f32"
-    ),
+    dtype_of=lambda args, kwargs: _serve_dtype_of(args[2]),
 )
+_serve_similar_jit = _devprof.instrument(
+    "als.similar_serving", _serve_similar_jit, memory=True,
+    dtype_of=lambda args, kwargs: _serve_dtype_of(args[1]),
+)
+_serve_similar_vecs_jit = _devprof.instrument(
+    "als.similar_vecs_serving", _serve_similar_vecs_jit, memory=True,
+    dtype_of=lambda args, kwargs: _serve_dtype_of(args[1]),
+)
+
+
+def _exclusion_device_args(
+    serving: ServingFactors,
+    batch: int,
+    exclude_mask: Optional[np.ndarray],
+    exclude_rows: Optional[np.ndarray],
+    extra_rows: Optional[np.ndarray] = None,
+):
+    """Host-side exclusion packing shared by the serving verbs: a row
+    list (the common small-blacklist case) ships (B, E) int32 at a
+    pow2-bucketed width; anything wider — or a dense mask — packs to
+    bit words at 1/32 the f32 bytes. `extra_rows` appends one
+    always-excluded row per query (similar's exclude_self)."""
+    from predictionio_tpu.ops import recommend_pallas as _rp
+
+    i_p = int(serving.items.shape[0])
+    if exclude_mask is not None:
+        mask = np.asarray(exclude_mask, bool)
+        if extra_rows is not None:
+            mask = mask.copy()
+            mask[np.arange(batch), np.asarray(extra_rows)] = True
+        return jnp.asarray(_rp.pack_mask_np(mask, i_p)), None
+    if exclude_rows is not None and extra_rows is None:
+        # fast path: an already -1-padded (B, E) int32 array (the
+        # engines' _exclusion_args builds exactly this) ships as-is —
+        # re-listing every cell through Python ints per micro-batch
+        # would cost more than the exclusion itself
+        ex = np.asarray(exclude_rows, np.int32)
+        if ex.shape[1] <= _rp.ROWLIST_MAX:
+            return None, (jnp.asarray(ex) if ex.shape[1] else None)
+    lists: list[list[int]] = [[] for _ in range(batch)]
+    if exclude_rows is not None:
+        for b, row in enumerate(exclude_rows):
+            lists[b] = [int(x) for x in row if int(x) >= 0]
+    if extra_rows is not None:
+        for b, r in enumerate(np.asarray(extra_rows)):
+            lists[b].append(int(r))
+    widest = max((len(r) for r in lists), default=0)
+    if widest == 0:
+        return None, None
+    if widest > _rp.ROWLIST_MAX:
+        # too wide for the unrolled compare chain: scatter host-side
+        # into packed words instead (still 1/32 the f32 mask bytes)
+        mask = np.zeros((batch, i_p), bool)
+        for b, row in enumerate(lists):
+            hits = np.asarray(row, np.int64)
+            hits = hits[(hits >= 0) & (hits < i_p)]
+            mask[b, hits] = True
+        return jnp.asarray(_rp.pack_mask_np(mask, i_p)), None
+    return None, jnp.asarray(_rp.rowlist_np(lists))
 
 
 def recommend_serving(
@@ -2003,10 +2177,12 @@ def recommend_serving(
     user_indices: np.ndarray,
     k: int,
     exclude_mask: Optional[np.ndarray] = None,  # (B, n_items) bool
+    exclude_rows: Optional[np.ndarray] = None,  # (B, E) int, -1 padded
 ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k items from staged serving state; same (scores, indices)
     contract as `recommend`. ONE device dispatch; only the row ids (and
-    the mask, when filters apply) cross host->device."""
+    the packed exclusion words / row list, when filters apply) cross
+    host->device."""
     k = min(int(k), serving.n_items)
     if k <= 0 or serving.n_users == 0:
         b = len(np.asarray(user_indices))
@@ -2014,21 +2190,72 @@ def recommend_serving(
             np.zeros((b, 0), np.float32), np.zeros((b, 0), np.int64),
         )
     rows = jnp.asarray(np.asarray(user_indices, np.int32))
-    mask_dev = None
-    if exclude_mask is not None:
-        # mask at the PADDED width either way, so the compiled shape is
-        # independent of the live n_items (vocab growth reuses it):
-        # f32 0/1 for the fused kernel (Mosaic vector compare lowers
-        # for f32 only), bool for the XLA fallback
-        mask = np.asarray(exclude_mask, bool)
-        i_p = int(serving.items.shape[0])
-        dt = np.float32 if serving.mode is not None else bool
-        mf = np.zeros((mask.shape[0], i_p), dt)
-        mf[:, : mask.shape[1]] = mask
-        mask_dev = jnp.asarray(mf)
+    bits, ex = _exclusion_device_args(
+        serving, int(rows.shape[0]), exclude_mask, exclude_rows
+    )
     vals, idx = _serve_recommend_jit(
         rows, serving.users, serving.items, serving.user_scale,
-        serving.item_scale, mask_dev,
+        serving.item_scale, bits, ex,
+        jnp.asarray(serving.n_items, jnp.int32),
+        k=k, mode=serving.mode,
+    )
+    return np.asarray(vals), np.asarray(idx)
+
+
+def similar_serving(
+    serving: ServingFactors,
+    item_indices: np.ndarray,
+    k: int,
+    exclude_self: bool = True,
+    exclude_mask: Optional[np.ndarray] = None,
+    exclude_rows: Optional[np.ndarray] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Fused cosine top-k for a batch of item rows off the staged
+    state — `als.similar` and itemsim's on-the-fly column cosine both
+    route here (ISSUE 14). exclude_self rides the row-list fast path
+    (one entry per query) unless a dense mask is already in play."""
+    k = min(int(k), serving.n_items)
+    rows_np = np.asarray(item_indices, np.int32)
+    if k <= 0 or serving.n_items == 0:
+        return (
+            np.zeros((len(rows_np), 0), np.float32),
+            np.zeros((len(rows_np), 0), np.int64),
+        )
+    bits, ex = _exclusion_device_args(
+        serving, len(rows_np), exclude_mask, exclude_rows,
+        extra_rows=rows_np if exclude_self else None,
+    )
+    vals, idx = _serve_similar_jit(
+        jnp.asarray(rows_np), serving.items, serving.item_scale,
+        serving.item_inv_norm, bits, ex,
+        jnp.asarray(serving.n_items, jnp.int32),
+        k=k, mode=serving.mode,
+    )
+    return np.asarray(vals), np.asarray(idx)
+
+
+def similar_vectors_serving(
+    serving: ServingFactors,
+    vectors: np.ndarray,  # (B, K) f32 query vectors
+    k: int,
+    exclude_mask: Optional[np.ndarray] = None,
+    exclude_rows: Optional[np.ndarray] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Cosine top-k against arbitrary query vectors (similarproduct's
+    basket mean) from the staged state."""
+    k = min(int(k), serving.n_items)
+    vecs = np.asarray(vectors, np.float32)
+    if k <= 0 or serving.n_items == 0:
+        return (
+            np.zeros((len(vecs), 0), np.float32),
+            np.zeros((len(vecs), 0), np.int64),
+        )
+    bits, ex = _exclusion_device_args(
+        serving, len(vecs), exclude_mask, exclude_rows
+    )
+    vals, idx = _serve_similar_vecs_jit(
+        jnp.asarray(vecs), serving.items, serving.item_scale,
+        serving.item_inv_norm, bits, ex,
         jnp.asarray(serving.n_items, jnp.int32),
         k=k, mode=serving.mode,
     )
@@ -2120,6 +2347,7 @@ def serving_publish_rows(
     )
     users, uscale = serving.users, serving.user_scale
     items, iscale = serving.items, serving.item_scale
+    inv = serving.item_inv_norm
     int8 = serving.dtype == "int8"
 
     if user_rows is not None and len(user_rows) > 0:
@@ -2140,7 +2368,7 @@ def serving_publish_rows(
             else:
                 uscale = _set_rows_cow(uscale, ur, jnp.asarray(s[:, None]))
         else:
-            users = set_rows(users, ur, jnp.asarray(uv))
+            users = set_rows(users, ur, jnp.asarray(uv, users.dtype))
     elif n_users > serving.n_users:
         users = _grow_table(users, n_users)
         if int8:
@@ -2151,31 +2379,41 @@ def serving_publish_rows(
         iv = np.asarray(item_vals, np.float32)
         i_p = int(items.shape[0])
         grown = n_items_new > i_p  # growth past the staged pad headroom
+        i_p_new = _rp.pad_items(n_items_new)
         if grown:
-            items = _grow_table(items, _rp.pad_items(n_items_new))
+            items = _grow_table(items, i_p_new)
         set_rows = _set_rows_donated if grown else _set_rows_cow
+        set_cols = _set_cols_donated if grown else _set_cols_cow
         if int8:
             q, s = _rp.quantize_rows_np(iv)
             items = set_rows(items, ir, jnp.asarray(q))
             if grown:
-                iscale = _grow_table(
-                    iscale, _rp.pad_items(n_items_new), axis=1
-                )
-                iscale = _set_cols_donated(iscale, ir, jnp.asarray(s))
-            else:
-                iscale = _set_cols_cow(iscale, ir, jnp.asarray(s))
+                iscale = _grow_table(iscale, i_p_new, axis=1)
+            iscale = set_cols(iscale, ir, jnp.asarray(s))
         else:
-            items = set_rows(items, ir, jnp.asarray(iv))
+            items = set_rows(items, ir, jnp.asarray(iv, items.dtype))
+        if inv is not None:
+            # the cosine verbs' inverse norms track the dirty rows'
+            # NEW f32 magnitudes — a fold tick must not serve stale
+            # norms under similar while recommend sees fresh factors
+            if grown:
+                inv = _grow_table(inv, i_p_new, axis=1)
+            inv = set_cols(
+                inv, ir, jnp.asarray(_rp.inv_norms_np(iv)[0])
+            )
     elif n_items_new > int(items.shape[0]):
         items = _grow_table(items, _rp.pad_items(n_items_new))
         if int8:
             iscale = _grow_table(
                 iscale, _rp.pad_items(n_items_new), axis=1
             )
+        if inv is not None:
+            inv = _grow_table(inv, _rp.pad_items(n_items_new), axis=1)
 
     return ServingFactors(
         users=users, items=items, user_scale=uscale, item_scale=iscale,
         n_items=n_items_new, dtype=serving.dtype, mode=serving.mode,
+        item_inv_norm=inv,
     )
 
 

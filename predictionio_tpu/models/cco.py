@@ -175,25 +175,37 @@ _SCORE_BLOCK_I = 8192  # item rows per scan step — bounds the gathered
 # (block·top_n, B) intermediate at catalog scale
 
 
-@partial(jax.jit, static_argnames=("j_sizes", "k"))
+@partial(jax.jit, static_argnames=("j_sizes", "k", "mode", "packed"))
 def _batch_score_topk_jit(
     corr_idx: tuple,  # per indicator: (I, T_m) int32, -1 padded
     corr_scores: tuple,  # per indicator: (I, T_m) float32
     histories: tuple,  # per indicator: (B, H_m) int32, -1 padded
-    exclude: jax.Array,  # (B, E) int32 item-space indices, -1 padded
+    exclude: jax.Array,  # (B, E) int32 rows / (B, I_p/32) int32 words
     *,
     j_sizes: tuple,  # per indicator: its target-vocab size J_m (static)
     k: int,
+    mode=None,  # resolved pallas mode for the fused tail (None = XLA)
+    packed: bool = False,  # exclude arrived as bit-packed mask words
 ):
     """One device program for a whole query batch: per indicator, scatter
     each user's history into a (B, J+1) membership table, gather it at the
     correlator indices (item-row blocks scanned to bound memory), and
     accumulate weighted hits; then mask the per-query exclusion set and
     top-k. Replaces the per-(query × indicator) numpy loop — the UR
-    serving hot path runs as ONE jit dispatch per micro-batch."""
+    serving hot path runs as ONE jit dispatch per micro-batch.
+
+    The exclusion+top-k tail is the verb-agnostic fused kernel's
+    precomputed-score mode (ISSUE 14): with `mode` set the accumulated
+    total streams through `ops.recommend_pallas.fused_masked_topk` —
+    no masked (B, I) score COPY, no (B, I) exclusion-mask
+    materialization (the packed words / row list apply in registers).
+    The XLA tail keeps identical semantics for exact mode parity."""
+    from predictionio_tpu.ops import recommend_pallas as _rp
+
     n_items = corr_idx[0].shape[0]
-    bsz = exclude.shape[0]
-    total = jnp.zeros((bsz, n_items), jnp.float32)
+    bsz = histories[0].shape[0]
+    i_p = _rp.pad_items(n_items) if mode is not None else n_items
+    total = jnp.zeros((bsz, i_p), jnp.float32)
     for idx, sc, hist, j in zip(corr_idx, corr_scores, histories, j_sizes):
         i, t = idx.shape
         hist_safe = jnp.where(hist >= 0, hist, j)
@@ -222,11 +234,26 @@ def _batch_score_topk_jit(
             )
 
         _, outs = jax.lax.scan(body, None, (idx_c, sc_c))
-        total = total + outs.reshape(-1, bsz)[:i].T
-    ex_safe = jnp.where(exclude >= 0, exclude, n_items)
-    ex_mask = jnp.zeros((bsz, n_items + 1), bool)
-    ex_mask = ex_mask.at[jnp.arange(bsz)[:, None], ex_safe].set(True)
-    total = jnp.where(ex_mask[:, :n_items], NEG_INF, total)
+        # pad rows beyond i carry only padded-correlator zeros, so the
+        # i_p-wide slice is exact (they are dead in both tails anyway)
+        total = total + outs.reshape(-1, bsz)[:i_p].T
+    if mode is not None:
+        return _rp.fused_masked_topk(
+            total,
+            mask_bits=exclude if packed else None,
+            exclude_rows=None if packed else exclude,
+            k=k, n_items=n_items, interpret=(mode == "interpret"),
+        )
+    if packed:
+        ex_mask = _rp.unpack_mask_jnp(exclude, n_items)
+    else:
+        ex_safe = jnp.where(exclude >= 0, exclude, n_items)
+        ex_mask = jnp.zeros((bsz, n_items + 1), bool)
+        ex_mask = ex_mask.at[
+            jnp.arange(bsz)[:, None], ex_safe
+        ].set(True)
+        ex_mask = ex_mask[:, :n_items]
+    total = jnp.where(ex_mask, NEG_INF, total)
     return jax.lax.top_k(total, k)
 
 
@@ -242,16 +269,41 @@ def batch_score_topk(
     histories: list,  # per indicator: (B, H) int32 np, -1 padded
     exclude: np.ndarray,  # (B, E) int32, -1 padded (item space)
     k: int,
+    mode: str = "auto",
 ) -> tuple[np.ndarray, np.ndarray]:
     """Batched UR history scoring + exclusion + top-k in one device
     dispatch. Returns (scores (B, k), item indices (B, k)); entries with
-    score <= 0 carry no LLR evidence (callers filter positive-only)."""
+    score <= 0 carry no LLR evidence (callers filter positive-only).
+
+    `mode` gates the fused tail (resolve_mode contract: "auto" → tpu
+    where the lowering runs / "interpret" for tests / None|"off" → the
+    XLA tail). Narrow exclusion sets ride the kernel's row-list input
+    untouched; wider ones bit-pack HOST-side (1/32 the f32-equivalent
+    mask bytes over the wire and in HBM)."""
+    from predictionio_tpu.ops import recommend_pallas as _rp
+
+    resolved = _rp.resolve_mode(mode)
+    exclude = np.asarray(exclude, np.int32)
+    packed = False
+    ex_dev = exclude
+    if resolved is not None and exclude.shape[1] > _rp.ROWLIST_MAX:
+        n_items = int(np.asarray(indicator_tables[0][0]).shape[0])
+        i_p = _rp.pad_items(n_items)
+        mask = np.zeros((exclude.shape[0], i_p), bool)
+        for b in range(exclude.shape[0]):
+            hits = exclude[b]
+            hits = hits[(hits >= 0) & (hits < i_p)]
+            mask[b, hits] = True
+        ex_dev = _rp.pack_mask_np(mask, i_p)
+        packed = True
     vals, idx = _batch_score_topk_jit(
         tuple(jnp.asarray(t[0]) for t in indicator_tables),
         tuple(jnp.asarray(t[1]) for t in indicator_tables),
         tuple(jnp.asarray(h) for h in histories),
-        jnp.asarray(exclude),
+        jnp.asarray(ex_dev),
         j_sizes=tuple(int(t[2]) for t in indicator_tables),
         k=k,
+        mode=resolved,
+        packed=packed,
     )
     return np.asarray(vals), np.asarray(idx)

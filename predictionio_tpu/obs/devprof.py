@@ -246,6 +246,13 @@ class _Exec:
     compile_seconds: float = 0.0
     flops_total: float = 0.0
     bytes_total: float = 0.0
+    # per-dtype accumulation (ISSUE 14 satellite, carried devprof
+    # follow-up): a MIXED-dtype executable (fused int8+f32 serving
+    # verbs, a model serving f32 while its canary serves int8) used to
+    # roofline everything against its LATEST signature's peak column —
+    # dtype → [flops, device_seconds, invocations] splits it so each
+    # column rooflines against its own peak
+    dtype_totals: dict = field(default_factory=dict)
 
 
 class ProfTotals(NamedTuple):
@@ -462,6 +469,13 @@ class DeviceProfiler:
                 rec.device_seconds += dt
                 rec.flops_total += analysis.flops * scale
                 rec.bytes_total += analysis.bytes_accessed * scale
+                if analysis.dtype is not None:
+                    t = rec.dtype_totals.setdefault(
+                        analysis.dtype, [0.0, 0.0, 0]
+                    )
+                    t[0] += analysis.flops * scale
+                    t[1] += dt
+                    t[2] += 1
         except Exception:
             pass
         return out
@@ -676,22 +690,49 @@ class DeviceProfiler:
         # dtype-aware (ISSUE 11): a signature that declared a compute
         # dtype rooflines against THAT column — int8 serving kernels
         # against the int8 peak, not the bf16 one. The latest signature
-        # decides (mixed-dtype executables are rare; the field says so).
+        # decides the LEGACY scalar fields; mixed-dtype executables
+        # additionally get per-dtype columns below (ISSUE 14).
         peak_f, peak_h = plat.get("peak_flops"), plat.get("peak_hbm_bps")
+
+        def dtyped_peak(dt: str):
+            # dtyped columns resolve once per report via the shared
+            # cache, keeping the once-per-report invariant above
+            cache = dtype_peaks if dtype_peaks is not None else {}
+            if dt not in cache:
+                cache[dt] = platform_info(dt).get("peak_flops")
+            return cache[dt]
+
         if latest.dtype is not None:
             out["dtype"] = latest.dtype
             if latest.dtype in ("int8", "f32"):
-                # dtyped columns resolve once per report via the shared
-                # cache, keeping the once-per-report invariant above
-                cache = dtype_peaks if dtype_peaks is not None else {}
-                if latest.dtype not in cache:
-                    cache[latest.dtype] = platform_info(
-                        latest.dtype
-                    ).get("peak_flops")
-                dt_peak = cache[latest.dtype]
+                dt_peak = dtyped_peak(latest.dtype)
                 if dt_peak:
                     peak_f = dt_peak
                     out["peak_flops_dtype"] = dt_peak
+        if rec.dtype_totals:
+            # per-dtype columns (ISSUE 14 satellite): every dtype this
+            # executable ran at rooflines against ITS OWN peak — a
+            # mixed int8+f32 verb no longer reports only the latest
+            # signature's column
+            cols = {}
+            for dt, (fl, sec, inv) in sorted(rec.dtype_totals.items()):
+                col = {
+                    "flops_total": fl,
+                    "device_seconds": round(sec, 6),
+                    "invocations": inv,
+                }
+                dt_peak = (
+                    dtyped_peak(dt) if dt in ("int8", "f32")
+                    else plat.get("peak_flops")
+                )
+                if dt_peak:
+                    col["peak_flops"] = dt_peak
+                    if sec > 0 and fl > 0:
+                        col["mfu"] = round(
+                            min(1.0, fl / sec / dt_peak), 8
+                        )
+                cols[dt] = col
+            out["dtypes"] = cols
         if peak_f and rec.device_seconds > 0 and rec.flops_total > 0:
             out["mfu"] = round(
                 min(1.0, rec.flops_total / rec.device_seconds / peak_f), 8

@@ -396,15 +396,14 @@ class ALSFoldIn:
         buffers, donated into grown private ones), so a tick
         re-transfers its dirty rows, never a factor matrix.
 
-        Fleet note (ISSUE 10): a staged `_sharded_runtime` deliberately
-        does NOT carry over — both factor sides live in one sharded
-        state object, and publishing into it incrementally needs the
-        tick's dirty-row indices plumbed through here
-        (`ShardedRuntime.update_user_rows/update_item_rows` exist for
-        exactly that; ROADMAP fleet follow-up). Until then a sharded
-        tenant re-stages lazily on the next query — a per-tick transfer
-        plus the same transient 2× the dense copy-on-write publish
-        pays, so size per-shard HBM budgets accordingly."""
+        Fleet (ISSUE 14, direction-1 item (c)): a staged
+        `_sharded_runtime` now carries over the same way — the tick's
+        dirty rows publish into the RESIDENT sharded slabs through
+        `adopt_sharded` → `ShardedRuntime.update_*_rows` (re-quantizing
+        only the dirty rows; the slab donates into the row write once
+        in-flight readers drain), never an f32 restage. A changed side
+        without row attribution — or vocab growth past the padded shard
+        extent — drops the carry and the next query restages lazily."""
         cls = type(model)
         cats = getattr(model, "item_categories", None)
         if cats is not None and len(cats) < new_factors.item_factors.shape[0]:
@@ -430,13 +429,25 @@ class ALSFoldIn:
         # every changed side has row attribution (a side changed
         # without rows cannot be expressed as row writes — the clone
         # restages lazily instead of serving stale factors).
-        if hasattr(new_model, "adopt_serving"):
-            users_safe = not users_changed or dirty_users is not None
-            items_safe = not items_changed or dirty_items is not None
-            if users_safe and items_safe:
-                new_model.adopt_serving(
-                    getattr(model, "_serving_state", None),
-                    dirty_users=dirty_users if users_changed else None,
-                    dirty_items=dirty_items if items_changed else None,
-                )
+        users_safe = not users_changed or dirty_users is not None
+        items_safe = not items_changed or dirty_items is not None
+        if hasattr(new_model, "adopt_serving") and users_safe and items_safe:
+            new_model.adopt_serving(
+                getattr(model, "_serving_state", None),
+                dirty_users=dirty_users if users_changed else None,
+                dirty_items=dirty_items if items_changed else None,
+            )
+        # sharded tier (ISSUE 14): same dirty-row contract against the
+        # resident sharded slabs — the False "single device" sentinel
+        # and an unstaged None both skip
+        srt = getattr(model, "_sharded_runtime", None)
+        if (
+            srt and hasattr(new_model, "adopt_sharded")
+            and users_safe and items_safe
+        ):
+            new_model.adopt_sharded(
+                srt,
+                dirty_users=dirty_users if users_changed else None,
+                dirty_items=dirty_items if items_changed else None,
+            )
         return new_model

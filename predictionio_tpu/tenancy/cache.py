@@ -102,6 +102,13 @@ def estimate_runtime_device_bytes(runtime: Any) -> float:
             for v in x:
                 walk(v)
         else:
+            if (
+                type(x).__name__ == "Mesh"
+                and type(x).__module__.startswith("jax")
+            ):
+                # a mesh's lazily-cached device-id/axis arrays are host
+                # metadata, not HBM — walking into it would charge them
+                return
             d = getattr(x, "__dict__", None)
             if d is not None:
                 for v in d.values():

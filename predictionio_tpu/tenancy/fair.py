@@ -88,18 +88,30 @@ class FairQueue:
         with self._lock:
             return {t for t, dq in self._queues.items() if dq}
 
-    def get_nowait(self) -> Any:
+    def get_nowait(self, skip: Optional[set] = None) -> Any:
         with self._lock:
-            return self._pop_locked()
+            return self._pop_locked(skip)
 
-    def get(self, timeout: Optional[float] = None) -> Any:
+    def get(
+        self, timeout: Optional[float] = None,
+        skip: Optional[set] = None,
+    ) -> Any:
+        """Pop the next item by DRR. `skip` (ISSUE 14 satellite —
+        continuous-batching admission caps) names tenant keys whose
+        items must stay queued this call: when every backlogged tenant
+        is skipped the call behaves as empty, so the dispatcher's
+        assembling bucket keeps room for the un-capped tenants'
+        arrivals instead of filling with one tenant's backlog."""
         deadline = (
             None if timeout is None else time.monotonic() + timeout
         )
         with self._not_empty:
             while True:
                 if self._size:
-                    return self._pop_locked()
+                    try:
+                        return self._pop_locked(skip)
+                    except _q.Empty:
+                        pass  # only skipped tenants queued: wait
                 if deadline is None:
                     self._not_empty.wait()
                 else:
@@ -108,13 +120,21 @@ class FairQueue:
                         raise _q.Empty
                     self._not_empty.wait(remaining)
 
-    def _pop_locked(self) -> Any:  # lint: holds=_not_empty
+    def _pop_locked(self, skip: Optional[set] = None) -> Any:  # lint: holds=_not_empty
         if not self._size:
+            raise _q.Empty
+        if skip and all(
+            (t in skip) or not dq for t, dq in self._queues.items()
+        ):
+            # nothing servable outside the skip set — progress below
+            # would otherwise spin on skip-rotations forever
             raise _q.Empty
         # DRR: visit the head tenant; a visit credits `weight`, serving
         # one item debits 1. Progress is guaranteed — every full
         # rotation credits each backlogged tenant at least min-weight,
-        # so some deficit crosses 1 within ceil(1/min_weight) rotations.
+        # so some deficit crosses 1 within ceil(1/min_weight) rotations
+        # (skipped tenants rotate past without credit: an admission cap
+        # must not bank DRR priority for the capped tenant).
         while True:
             tenant = self._order[0]
             dq = self._queues.get(tenant)
@@ -125,6 +145,9 @@ class FairQueue:
                 self._order.popleft()
                 self._queues.pop(tenant, None)
                 self._deficit.pop(tenant, None)
+                continue
+            if skip and tenant in skip:
+                self._order.rotate(-1)
                 continue
             deficit = self._deficit[tenant]
             if deficit < 1.0:

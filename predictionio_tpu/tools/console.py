@@ -393,6 +393,34 @@ def cmd_status(args) -> int:
                 )
         except Exception as e:
             print(f"[WARN] segmentfs stats unavailable: {e}")
+    if getattr(args, "event_url", None):
+        # live-server passthrough (ISSUE 14 satellite): the RUNNING
+        # event server's segment surface — the daemon shape where this
+        # process has no direct segmentfs handle
+        try:
+            import urllib.parse
+
+            key = getattr(args, "access_key", None) or ""
+            url = (
+                args.event_url.rstrip("/")
+                + "/segments/stats?accessKey="
+                + urllib.parse.quote(key)
+            )
+            import json as _json
+            import urllib.request
+
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                st = _json.loads(resp.read().decode())
+            print(
+                f"[INFO] event server {args.event_url}: "
+                f"{st.get('segments')} segment(s), "
+                f"{st.get('sealed_rows')} sealed + "
+                f"{st.get('tail_rows')} tail row(s), "
+                f"{st.get('dead_rows')} dead, "
+                f"rev {st.get('max_revision')}"
+            )
+        except Exception as e:
+            print(f"[WARN] event-server segment stats unavailable: {e}")
     try:
         manifests = storage.get_meta_data_engine_manifests().get_all()
     except Exception as e:
@@ -1691,6 +1719,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     # status
     s = sub.add_parser("status", help="verify environment + storage")
+    s.add_argument(
+        "--event-url",
+        help="also query a RUNNING event server's GET /segments/stats "
+             "(ISSUE 14: the segmentfs admin surface) instead of only "
+             "the locally-opened store",
+    )
+    s.add_argument(
+        "--access-key",
+        help="access key for --event-url (picks the app/channel whose "
+             "segment stats to read)",
+    )
     s.set_defaults(func=cmd_status)
 
     # metrics (ISSUE 1: registry exposition from the console)

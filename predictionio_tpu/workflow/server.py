@@ -86,6 +86,15 @@ class QueryServerConfig:
     # arrivals queued behind it unbatched. "windowed" restores the
     # PR-2 adaptive-window behavior (bench.py A/Bs the two under load).
     batching: str = "continuous"
+    # adaptive continuous-batching admission (ISSUE 14 satellite,
+    # carried serving-kernel follow-up): while a bucket ASSEMBLES in
+    # continuous mode with more than one tenant stream active, each
+    # tenant may claim at most `admission_cap` slots of it (0 = auto:
+    # max_batch // active streams, floor 1) — a hog's backlog cannot
+    # fill the whole assembling bucket ahead of other tenants'
+    # still-arriving queries; its overflow simply waits for the next
+    # bucket. Untenanted traffic counts as one stream.
+    admission_cap: int = 0
     # tenant-aware drain (ISSUE 11 satellite, carried tenancy
     # follow-up): with tenants active, stop lingering for full depth as
     # soon as every still-backlogged tenant is represented in the
@@ -660,6 +669,7 @@ class _BatchDispatcher:
         pipeline_depth: int = 4,
         batching: str = "continuous",
         tenant_drain: bool = True,
+        admission_cap: int = 0,
     ):
         from concurrent.futures import ThreadPoolExecutor
 
@@ -678,6 +688,7 @@ class _BatchDispatcher:
         self.max_batch = max_batch
         self.batching = batching
         self.tenant_drain = tenant_drain
+        self.admission_cap = max(0, int(admission_cap))
         self.pipeline_depth = max(1, pipeline_depth)
         self._retired = 0  # buckets retired — continuous mode's signal
         self._pool = ThreadPoolExecutor(
@@ -1002,8 +1013,9 @@ class _BatchDispatcher:
                 getattr(self, "last_batch_sec", 0.0) * 1.2,
             )
             while len(batch) < self.max_batch:
+                skip = self._admission_skip(batch)
                 try:
-                    batch.append(self._queue.get_nowait())
+                    batch.append(self._queue.get_nowait(skip=skip))
                     continue
                 except _q.Empty:
                     pass
@@ -1040,7 +1052,9 @@ class _BatchDispatcher:
                     if _t.monotonic() >= hard_deadline:
                         break  # wedged in-flight batch: don't hold queries
                     try:
-                        batch.append(self._queue.get(timeout=0.002))
+                        batch.append(
+                            self._queue.get(timeout=0.002, skip=skip)
+                        )
                     except _q.Empty:
                         pass
                     continue
@@ -1103,6 +1117,26 @@ class _BatchDispatcher:
                         p.fut.set_exception(
                             RuntimeError("query server stopped")
                         )
+
+    def _admission_skip(self, batch: list) -> Optional[set]:
+        """Tenants whose slots in the ASSEMBLING bucket are used up
+        (ISSUE 14 satellite — adaptive continuous-batching admission).
+        Only continuous mode caps, and only with more than one active
+        stream: a solo tenant (or untenanted traffic alone) keeps the
+        whole bucket. Auto cap = max_batch // active streams."""
+        if self.batching != "continuous":
+            return None
+        counts: dict = {}
+        for p in batch:
+            counts[p.tenant] = counts.get(p.tenant, 0) + 1
+        active = set(counts) | self._queue.backlogged()
+        if len(active) <= 1 or not (active - {None}):
+            return None
+        cap = self.admission_cap or max(
+            1, self.max_batch // len(active)
+        )
+        skip = {t for t, c in counts.items() if c >= cap}
+        return skip or None
 
     def _shed_dead(self, entries: list) -> list:
         """Drop cancelled/deadline-expired entries, failing their futures
@@ -1254,6 +1288,7 @@ class QueryServer(ServerProcess):
                 self.config.pipeline_depth,
                 batching=getattr(self.config, "batching", "continuous"),
                 tenant_drain=getattr(self.config, "tenant_drain", True),
+                admission_cap=getattr(self.config, "admission_cap", 0),
             )
 
     def start(self) -> int:

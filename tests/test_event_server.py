@@ -272,3 +272,73 @@ def test_webhooks_mailchimp_form(server):
     status, body = req(server, f"/events/{body['eventId']}.json?accessKey=KEY")
     assert body["event"] == "subscribe"
     assert body["entityId"] == "8a25ff1d98"
+
+
+# ---------------------------------------------------------------------------
+# segmentfs admin endpoints (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def seg_server(tmp_path):
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    cfg = StorageConfig(
+        sources={
+            "M": SourceConfig("M", "memory", {}),
+            "SEG": SourceConfig("SEG", "segmentfs", {
+                "PATH": str(tmp_path / "seg"),
+                "SEAL_INTERVAL_S": "3600",
+            }),
+        },
+        repositories={
+            "METADATA": "M", "EVENTDATA": "SEG", "MODELDATA": "M",
+        },
+    )
+    storage = Storage(cfg)
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name="segapp"))
+    storage.get_events().init_app(app_id)
+    storage.get_meta_data_access_keys().insert(
+        AccessKey(key="SKEY", app_id=app_id, events=())
+    )
+    srv = EventServer(
+        storage, EventServerConfig(ip="127.0.0.1", port=0)
+    )
+    port = srv.start()
+    yield port, storage, app_id
+    srv.stop()
+
+
+def test_segments_admin_endpoints(seg_server):
+    port, storage, app_id = seg_server
+    # ingest some events, then inspect / seal / compact over HTTP
+    for i in range(5):
+        status, _ = req(
+            port, "/events.json?accessKey=SKEY", "POST",
+            dict(EVENT, entityId=f"u{i}"),
+        )
+        assert status == 201
+    status, st = req(port, "/segments/stats?accessKey=SKEY")
+    assert status == 200
+    assert st["tail_rows"] == 5 and st["segments"] == 0
+    status, body = req(port, "/segments/seal?accessKey=SKEY", "POST")
+    assert status == 200 and body["sealedRows"] == 5
+    status, st = req(port, "/segments/stats?accessKey=SKEY")
+    assert st["tail_rows"] == 0 and st["segments"] == 1
+    status, body = req(port, "/segments/compact?accessKey=SKEY", "POST")
+    assert status == 200 and body["segmentsMerged"] == 0
+    # auth still gates the admin surface
+    status, _ = req(port, "/segments/stats?accessKey=WRONG")
+    assert status == 401
+
+
+def test_segments_endpoints_404_without_segmentfs(server):
+    status, body = req(server, "/segments/stats?accessKey=KEY")
+    assert status == 404
+    assert "segmentfs" in body["message"]
+    status, _ = req(server, "/segments/seal?accessKey=KEY", "POST")
+    assert status == 404

@@ -35,14 +35,15 @@ def _pad(itf, i_p):
 
 
 def _fused(uf, itf, k, mask=None):
+    from predictionio_tpu.ops.recommend_pallas import pack_mask_np
+
     i_p = pad_items(itf.shape[0])
-    mask_p = None
+    bits = None
     if mask is not None:
-        mask_p = np.zeros((uf.shape[0], i_p), np.float32)
-        mask_p[:, : mask.shape[1]] = mask
-        mask_p = jnp.asarray(mask_p)
+        # exclusion ships bit-packed (ISSUE 14): 1/32 the f32 bytes
+        bits = jnp.asarray(pack_mask_np(mask, i_p))
     return fused_recommend_topk(
-        jnp.asarray(uf), jnp.asarray(_pad(itf, i_p)), None, None, mask_p,
+        jnp.asarray(uf), jnp.asarray(_pad(itf, i_p)), None, None, bits,
         k=k, n_items=itf.shape[0], interpret=True,
     )
 

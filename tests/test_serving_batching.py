@@ -342,3 +342,83 @@ def test_itemsim_sharded_model_pickles_without_runtime():
     clone = pickle.loads(pickle.dumps(model))
     assert getattr(clone, "_sharded_runtime", None) is None
     assert np.array_equal(clone.item_vectors, m)
+
+
+def test_continuous_admission_caps_per_tenant():
+    """ISSUE 14 satellite: while a bucket assembles in continuous mode
+    with >1 tenant stream active, one tenant's backlog may claim at
+    most max_batch // streams slots — the hog's overflow waits for the
+    next bucket instead of filling this one ahead of other tenants."""
+    d = S._BatchDispatcher(
+        _Owner(), 1.0, 8, 30.0, 1, batching="continuous"
+    )
+    comps = []
+    orig = d._run_group
+
+    def wrap(rt, group):
+        comps.append([p.tenant for p in group])
+        return orig(rt, group)
+
+    d._run_group = wrap
+    rt = _runtime(device_s=0.3)
+    threads = [
+        threading.Thread(
+            target=lambda: d.submit(object(), rt, timeout=10)
+        )
+    ]
+    threads[0].start()
+    time.sleep(0.05)  # bucket A in flight — the assembly window opens
+    # both streams must be VISIBLE (queued) before the hog backlog can
+    # fill the bucket, so the goods go first — the cap engages as soon
+    # as more than one stream is active
+    for tenant in ["good"] * 2 + ["hog"] * 10:
+        t = threading.Thread(
+            target=lambda tn=tenant: d.submit(
+                object(), rt, timeout=10, tenant=tn
+            )
+        )
+        t.start()
+        threads.append(t)
+        if tenant == "good":
+            time.sleep(0.01)
+    time.sleep(0.1)  # everything queued while A still flies
+    for t in threads:
+        t.join()
+    d.stop()
+    # bucket A is the solo blocker; the first capped bucket holds BOTH
+    # good queries and at most 8 // 2 = 4 hog entries; hog overflow
+    # lands in later buckets
+    assert comps[0] == [None]
+    first = comps[1]
+    assert first.count("good") == 2, comps
+    assert first.count("hog") <= 4, comps
+    assert sum(c.count("hog") for c in comps) == 10
+
+
+def test_admission_cap_noop_for_single_stream():
+    """A solo tenant (or untenanted traffic) keeps the whole bucket —
+    the cap only engages with competing streams."""
+    d = S._BatchDispatcher(
+        _Owner(), 1.0, 8, 30.0, 1, batching="continuous"
+    )
+    sizes = _record_batches(d)
+    rt = _runtime(device_s=0.25)
+    threads = [
+        threading.Thread(
+            target=lambda: d.submit(object(), rt, timeout=10, tenant="t")
+        )
+    ]
+    threads[0].start()
+    time.sleep(0.05)
+    for _ in range(7):
+        t = threading.Thread(
+            target=lambda: d.submit(object(), rt, timeout=10, tenant="t")
+        )
+        t.start()
+        threads.append(t)
+    time.sleep(0.05)
+    for t in threads:
+        t.join()
+    d.stop()
+    assert sizes[0] == 1
+    assert max(sizes[1:]) == 7, sizes  # uncapped single stream
