@@ -2341,6 +2341,258 @@ def bench_sharded_ingestion():
     }
 
 
+def bench_gateway():
+    """ISSUE 15 (BENCH_r09): the replicated serving tier. Three stub
+    replica subprocesses (echo engine, deterministic 2% stragglers)
+    behind an in-process gateway over shared sqlite:
+
+    - routing overhead: through-gateway p50 minus direct-to-replica
+      p50 on the SAME single-client loop, plus the gateway's own
+      routing-decision histogram,
+    - hedged vs unhedged p99 under a concurrent hammer against the
+      straggler tail (hedging OFF first so the tail is measured, then
+      ON — `gateway_hedged_p99_ratio` < 1 is the win),
+    - zero-drop failover: kill -9 one replica mid-hammer and count
+      in-deadline failures (`gateway_failover_dropped`, bar: 0),
+    - deadline honesty: every hedge carries the REMAINING budget, so
+      the replicas' deadline-shed counters record any post-deadline
+      work the gateway dispatched (`gateway_post_deadline_work`,
+      bar: 0 — hedging must never exceed the budget).
+
+    Stub replicas mean no jax and no training: the numbers isolate the
+    GATEWAY's added cost and its availability math, which is exactly
+    what this tier contributes."""
+    import shutil
+    import signal as _signal
+    import socket as _socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import threading
+    import urllib.request as _rq
+
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+    from predictionio_tpu.gateway import GatewayConfig, GatewayServer
+
+    tmp = tempfile.mkdtemp(prefix="bench-gateway-")
+    db = os.path.join(tmp, "gateway.db")
+    storage = Storage(StorageConfig(
+        sources={"SQL": SourceConfig("SQL", "sqlite", {"PATH": db})},
+        repositories={
+            "METADATA": "SQL", "EVENTDATA": "SQL", "MODELDATA": "SQL",
+        },
+    ))
+
+    def free_port() -> int:
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(rid: str, port: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update({
+            "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQL_PATH": db,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+            "PIO_REPLICA_HEARTBEAT_S": "0.2",
+            "JAX_PLATFORMS": "cpu",
+        })
+        return subprocess.Popen(
+            [_sys.executable, "-m",
+             "predictionio_tpu.gateway.replica_main",
+             "--stub", "--ip", "127.0.0.1", "--port", str(port),
+             "--replica-id", rid,
+             "--state-dir", os.path.join(tmp, f"state-{rid}"),
+             # every 50th query sleeps 200 ms: a 2% straggler tail, so
+             # the rolling p95 hedge trigger stays FAST (stragglers
+             # are beyond it) while p99 sits on the tail — the shape
+             # hedging is built for. A tail rate at/above 5% would
+             # push p95 onto the straggler itself and the hedge would
+             # rightly fire too late to help.
+             "--slow-every", "50", "--slow-ms", "200"],
+            env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    ports = {f"r{i}": free_port() for i in range(3)}
+    procs = {rid: spawn(rid, port) for rid, port in ports.items()}
+    gw = GatewayServer(storage, GatewayConfig(
+        ip="127.0.0.1", port=0, sync_interval_s=0.15,
+        replica_stale_after_s=1.5, scrape=False,
+        hedge=False,  # phase-controlled below
+        hedge_min_ms=40.0, breaker_threshold=2, breaker_cooldown_s=0.5,
+    ))
+    gport = gw.start()
+
+    def post(port, body, deadline_ms=8000, timeout=15):
+        req = _rq.Request(
+            f"http://127.0.0.1:{port}/queries.json",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-PIO-Deadline": str(deadline_ms)},
+            method="POST",
+        )
+        with _rq.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    def loop_p50(port, n, tag):
+        times = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            post(port, {"q": f"{tag}-{i}"})
+            times.append(time.perf_counter() - t0)
+        return float(np.percentile(times, 50)) * 1e3
+
+    def hammer(n_clients, per_client, tag, deadline_ms=8000):
+        times: list[float] = []
+        failed: list[str] = []
+        lock = threading.Lock()
+
+        def run(c):
+            for i in range(per_client):
+                t0 = time.perf_counter()
+                try:
+                    post(gport, {"q": f"{tag}-{c}-{i}"},
+                         deadline_ms=deadline_ms)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        times.append(dt)
+                except Exception as e:
+                    with lock:
+                        failed.append(str(e))
+
+        threads = [
+            threading.Thread(target=run, args=(c,), daemon=True)
+            for c in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.perf_counter() - t0
+        return times, failed, wall
+
+    def replica_shed_total() -> float:
+        total = 0.0
+        from predictionio_tpu.obs.monitor import parse_prometheus_text
+
+        for rid, port in ports.items():
+            if procs.get(rid) is None or procs[rid].poll() is not None:
+                continue
+            try:
+                with _rq.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ) as r:
+                    body = r.read().decode(errors="replace")
+            except OSError:
+                continue
+            for name, labels, value in parse_prometheus_text(body):
+                if (
+                    name == "queries_shed_total"
+                    and labels.get("reason") == "deadline"
+                ):
+                    total += value
+        return total
+
+    out: dict = {"replicas": 3}
+    try:
+        # wait for discovery
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            gw.sync_once()
+            _ring, states = gw._route_snapshot()
+            if sum(1 for st in states.values() if st.routable()) >= 3:
+                break
+            time.sleep(0.2)
+
+        n_probe = 60 if SMALL else 200
+        # warm both paths (keep-alives, straggler counters past 0)
+        loop_p50(ports["r0"], 25, "warm-direct")
+        loop_p50(gport, 25, "warm-gw")
+        direct_p50 = loop_p50(ports["r0"], n_probe, "direct")
+        via_p50 = loop_p50(gport, n_probe, "via")
+        out["gateway_direct_p50_ms"] = round(direct_p50, 3)
+        out["gateway_via_p50_ms"] = round(via_p50, 3)
+        out["gateway_routing_overhead_p50_ms"] = round(
+            max(0.0, via_p50 - direct_p50), 3
+        )
+        out["gateway_routing_decision_p50_ms"] = round(
+            gw._routing_hist.quantile(0.5) * 1e3, 4
+        )
+
+        # hedged-vs-unhedged p99 against the 2% straggler tail
+        n_clients = 8 if SMALL else 16
+        per_client = 30 if SMALL else 60
+        gw.config.hedge = False
+        unhedged, failed_u, _ = hammer(n_clients, per_client, "unhedged")
+        gw.config.hedge = True
+        hedged, failed_h, wall_h = hammer(n_clients, per_client, "hedged")
+        unhedged_p99 = float(np.percentile(unhedged, 99)) * 1e3
+        hedged_p99 = float(np.percentile(hedged, 99)) * 1e3
+        out["gateway_unhedged_p99_ms"] = round(unhedged_p99, 2)
+        out["gateway_hedged_p99_ms"] = round(hedged_p99, 2)
+        out["gateway_hedged_p99_ratio"] = round(
+            hedged_p99 / unhedged_p99, 3
+        ) if unhedged_p99 > 0 else None
+        out["gateway_hedges_sent"] = int(gw._hedges.value(outcome="sent"))
+        out["gateway_hedges_won"] = int(gw._hedges.value(outcome="won"))
+        out["gateway_hedge_phase_qps"] = round(
+            len(hedged) / wall_h, 1
+        ) if wall_h > 0 else None
+        # deadline honesty: the replicas' own deadline-shed counters
+        # record any gateway dispatch that arrived past its budget
+        out["gateway_post_deadline_work"] = replica_shed_total()
+        out["gateway_hedge_failed"] = len(failed_u) + len(failed_h)
+
+        # zero-drop failover: kill -9 one replica mid-hammer (the
+        # hammer is sized to straddle the kill AND the ejection window,
+        # so post-kill queries actually exercise failover)
+        dropped: list[str] = []
+        times_k: list[float] = []
+        per_failover = 150 if SMALL else 300
+
+        def kill_later():
+            time.sleep(0.4)
+            victim = procs.pop("r2")
+            victim.send_signal(_signal.SIGKILL)
+            victim.wait(timeout=10)
+
+        killer = threading.Thread(target=kill_later, daemon=True)
+        killer.start()
+        times_k, dropped, _ = hammer(
+            n_clients, per_failover, "failover"
+        )
+        killer.join(timeout=20)
+        out["gateway_failover_dropped"] = len(dropped)
+        out["gateway_failover_total"] = int(gw._failovers.value())
+        out["gateway_failover_p99_ms"] = round(
+            float(np.percentile(times_k, 99)) * 1e3, 2
+        ) if times_k else None
+        out["host_cpus"] = os.cpu_count()
+        out["note"] = (
+            "stub replicas (echo engine, 2% 200 ms stragglers): the "
+            "numbers isolate gateway-added routing/hedging/failover "
+            "cost from model compute"
+        )
+    finally:
+        gw.stop()
+        for proc in procs.values():
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main():
     rows, cols, vals = make_data()
     tpu = bench_tpu(rows, cols, vals)
@@ -2630,5 +2882,9 @@ if __name__ == "__main__":
         # bench round on the storage layer doesn't pay for the full
         # train/serve gauntlet
         print(json.dumps(bench_data_plane()))
+    elif "--gateway" in _sys.argv:
+        # focused ISSUE-15 emission (BENCH_r09): the replicated serving
+        # tier alone — stub replicas, no jax, no training
+        print(json.dumps(bench_gateway()))
     else:
         main()

@@ -173,6 +173,8 @@ class EventValidation:
             "pio_pr", "pio_model_version", "pio_train_job",
             "pio_tenant", "pio_rollout", "pio_online_cursor",
             "pio_job_claim", "pio_fleet_worker",
+            # serving-replica presence records (ISSUE 15)
+            "pio_query_replica",
         }
     )
 

@@ -27,6 +27,7 @@ from typing import Any, Optional
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import StorageError
 from predictionio_tpu.utils.env import env_path
+from predictionio_tpu.analysis import tsan as _tsan
 
 # repository name → env default source type (reference Storage.scala:140-142)
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
@@ -147,6 +148,11 @@ class Storage:
         self._clients: dict[str, Any] = {}
         self._daos: dict[tuple[str, str], Any] = {}
         self._lock = threading.RLock()
+        # sanitizer: the factory lock is held across first-construction
+        # DAO work BY DESIGN (one construction, many waiters) — and a
+        # sqlite DAO's construction commits its DDL, a declared
+        # blocking point (ISSUE 15 satellite)
+        _tsan.allow_blocking_lock(self._lock)
 
     # -- singleton --------------------------------------------------------
     @classmethod

@@ -86,8 +86,13 @@ class RemoteClient:
                      "0.05")
             ),
         )
-        self.breaker = get_breaker(
-            f"storage:{self.host}:{self.port}",
+        # per-DAO breakers (ISSUE 15 satellite, carried PR-4 follow-up):
+        # one daemon fronts several DAO tables, and an events-path outage
+        # must not fail-fast the metadata path — each DAO gets its own
+        # breaker under the shared endpoint prefix (lazily, on first
+        # call; kwargs configure only the first construction, same
+        # process-global discipline as before)
+        self._breaker_kwargs = dict(
             failure_threshold=int(
                 _cfg(config, "BREAKER_THRESHOLD", "PIO_BREAKER_THRESHOLD", "5")
             ),
@@ -95,6 +100,21 @@ class RemoteClient:
                 _cfg(config, "BREAKER_COOLDOWN", "PIO_BREAKER_COOLDOWN", "10")
             ),
         )
+        self._dao_breakers: dict = {}
+
+    def breaker_for(self, dao: str):
+        """The process-global breaker guarding ONE DAO of this endpoint,
+        memoized per client so the hot RPC path skips the global breaker
+        registry lock (a racing first call just resolves the same
+        registry singleton twice)."""
+        breaker = self._dao_breakers.get(dao)
+        if breaker is None:
+            breaker = self._dao_breakers[dao] = get_breaker(
+                f"storage:{self.host}:{self.port}/{dao}",
+                dao=dao,
+                **self._breaker_kwargs,
+            )
+        return breaker
 
     def _conn(self) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
@@ -147,17 +167,18 @@ class RemoteClient:
         # shipped NO id) — and receives this span's id as X-Parent-Span,
         # so the daemon's own server span parents under this one across
         # the process boundary.
+        breaker = self.breaker_for(dao)
         with _spans.get_default_recorder().span(
             "storage.rpc", dao=dao, method=method,
             server=f"storage-client:{self.host}:{self.port}",
         ) as sp:
             headers["X-Request-ID"] = _tracing.current_trace_id()
             headers["X-Parent-Span"] = sp.span_id
-            if not self.breaker.allow():
-                sp.attrs["breaker_state"] = self.breaker.state
+            if not breaker.allow():
+                sp.attrs["breaker_state"] = breaker.state
                 raise StorageCircuitOpenError(
                     f"storage server {self.host}:{self.port}: circuit "
-                    f"breaker open (failing fast)"
+                    f"breaker open for the {dao} DAO (failing fast)"
                 )
             # From here on, allow() may have claimed the half-open probe
             # slot: EVERY exit must either record a verdict or release
@@ -228,23 +249,23 @@ class RemoteClient:
                     http.client.HTTPException, OSError,
                     _faults.FaultInjected,
                 ) as e:
-                    self.breaker.record_failure()
+                    breaker.record_failure()
                     verdict_recorded = True
-                    sp.attrs["breaker_state"] = self.breaker.state
+                    sp.attrs["breaker_state"] = breaker.state
                     raise StorageUnreachableError(
                         f"storage server {self.host}:{self.port} "
                         f"unreachable: {e}"
                     ) from e
                 # the endpoint answered — breaker-wise that is health,
                 # even if the answer is an application-level error
-                self.breaker.record_success()
+                breaker.record_success()
                 verdict_recorded = True
             finally:
                 if not verdict_recorded:
                     # aborted without touching the endpoint (deadline
                     # expiry, injected corruption, garbage response):
                     # free a claimed probe slot, change nothing else
-                    self.breaker.release_probe()
+                    breaker.release_probe()
             if not payload.get("ok"):
                 if payload.get("shed"):
                     # the daemon refused the work because OUR deadline

@@ -90,6 +90,7 @@ from typing import Any, Iterator, Optional, Sequence
 
 import numpy as np
 
+from predictionio_tpu.analysis import tsan as _tsan
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
@@ -661,6 +662,12 @@ class _Namespace:
         self._wal_file.write(line)
         self._wal_file.flush()
         if self.fsync:
+            # blocking point (ISSUE 15 satellite): ingest holds the
+            # store lock across this fsync BY DESIGN (fsync-before-ack
+            # + revision assignment are one critical section; the store
+            # lock is declared allowed) — any OTHER lock held into
+            # insert_batch is a finding
+            _tsan.note_blocking("wal.fsync")
             os.fsync(self._wal_file.fileno())
 
     def wal_rotate(self) -> list[str]:
@@ -741,6 +748,17 @@ class SegmentFSEventStore(base.EventStore):
         self.compact_segments = int(config.get("COMPACT_SEGMENTS", 8))
         self.compact_max_rows = int(config.get("COMPACT_MAX_ROWS", 65536))
         self._lock = threading.RLock()
+        _tsan.allow_blocking_lock(self._lock)  # holds the WAL fsync by design
+        # cross-process writer guard (ISSUE 15 satellite, carried
+        # PR-13 item (c)): segmentfs assumes ONE writer process per
+        # PATH — a second process interleaving WAL appends and seals
+        # would corrupt the revision sequence silently. An exclusive
+        # POSIX record lock on <PATH>/.writer.lock makes the second
+        # process fail FAST with a clear error instead. lockf locks
+        # are per-process, so crash-recovery tests (and a same-process
+        # reopen after an unclean "crash") still work — the guard
+        # targets exactly the cross-process double-writer.
+        self._writer_lock_file = self._acquire_writer_lock()
         self._ns: dict[tuple[int, Optional[int]], _Namespace] = {}
         # sealed-rows frame cache: query key → (validity token, arrays)
         self._frame_cache: dict[tuple, tuple[tuple, dict]] = {}
@@ -748,6 +766,55 @@ class SegmentFSEventStore(base.EventStore):
         self.segments_scanned = 0  # target-posting prune introspection
         self._stop = threading.Event()
         self._sealer: Optional[threading.Thread] = None
+
+    # -- cross-process writer guard ---------------------------------------
+    def _acquire_writer_lock(self):
+        """Exclusive fcntl.lockf on <PATH>/.writer.lock. Held for the
+        store's lifetime (released in close(), or by the OS when the
+        process dies — which is what lets a restart after kill -9
+        reopen immediately). A second PROCESS gets StorageError with
+        the holder's pid instead of silent WAL/segment corruption."""
+        try:
+            import fcntl
+        except ImportError:  # non-POSIX: no guard, preserve behavior
+            return None
+        lock_path = os.path.join(self.base, ".writer.lock")
+        f = open(lock_path, "a+")
+        try:
+            fcntl.lockf(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            try:
+                f.seek(0)
+                holder = f.read().strip() or "unknown"
+            except OSError:
+                holder = "unknown"
+            f.close()
+            raise StorageError(
+                f"segmentfs store at {self.base!r} is already open for "
+                f"writing by another process (pid {holder}); segmentfs "
+                "allows ONE writer process per PATH — route writes "
+                "through the storage daemon, or close the other process"
+            )
+        f.truncate(0)
+        f.write(f"{os.getpid()}\n")
+        f.flush()
+        return f
+
+    def _release_writer_lock(self) -> None:
+        f = self._writer_lock_file
+        if f is None:
+            return
+        self._writer_lock_file = None
+        try:
+            import fcntl
+
+            fcntl.lockf(f, fcntl.LOCK_UN)
+        except Exception:
+            pass
+        try:
+            f.close()
+        except OSError:
+            pass
 
     # -- sealer thread -----------------------------------------------------
     def _ensure_sealer(self) -> None:
@@ -808,6 +875,7 @@ class SegmentFSEventStore(base.EventStore):
             except Exception:
                 log.exception("segmentfs close-time seal failed")
             ns.close()
+        self._release_writer_lock()
 
     # -- namespace plumbing ------------------------------------------------
     def _dir(self, app_id: int, channel_id: Optional[int]) -> str:

@@ -31,6 +31,8 @@ from predictionio_tpu.data.storage.base import (
 )
 import secrets
 
+from predictionio_tpu.analysis import tsan as _tsan
+
 _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 
 
@@ -57,10 +59,22 @@ class _SqliteClient:
             "pio_shard", 2, base.shard_of, deterministic=True
         )
         self.lock = threading.RLock()
+        # sanitizer (ISSUE 15 satellite): the client lock is held
+        # across commit() by design — one connection, serialized
+        # writers; declaring it points the blocking hook at OTHER
+        # locks wrongly held across a sqlite commit
+        _tsan.allow_blocking_lock(self.lock)
 
     @property
     def conn(self) -> sqlite3.Connection:
         return self._conn
+
+    def commit(self) -> None:
+        """Commit with the blocking point declared: under
+        synchronous=NORMAL this is a WAL flush — disk-speed, not
+        memory-speed — and locks held across it are findings."""
+        _tsan.note_blocking("sqlite.commit")
+        self._conn.commit()
 
 
 class SqliteEventStore(base.EventStore):
@@ -156,7 +170,7 @@ class SqliteEventStore(base.EventStore):
                 "ON CONFLICT(tbl) DO NOTHING",
                 (name,),
             )
-            self._client.conn.commit()
+            self._client.commit()
         self._known_tables.add(name)
         return name
 
@@ -168,13 +182,13 @@ class SqliteEventStore(base.EventStore):
         name = self._table_name(app_id, channel_id)
         with self._client.lock:
             self._client.conn.execute(f"DROP TABLE IF EXISTS {name}")
-            self._client.conn.commit()
+            self._client.commit()
         self._known_tables.discard(name)
         return True
 
     def close(self) -> None:
         with self._client.lock:
-            self._client.conn.commit()
+            self._client.commit()
 
     def _row(self, event: Event, eid: str, revision: int) -> tuple:
         return (
@@ -204,7 +218,7 @@ class SqliteEventStore(base.EventStore):
                 self._row(event, eid, rev),
             )
             self._bump(name)
-            self._client.conn.commit()
+            self._client.commit()
         return eid
 
     def insert_batch(self, events, app_id, channel_id=None) -> list[str]:
@@ -220,7 +234,7 @@ class SqliteEventStore(base.EventStore):
                 ],
             )
             self._bump(name)
-            self._client.conn.commit()
+            self._client.commit()
         return ids
 
     def delete(
@@ -233,7 +247,7 @@ class SqliteEventStore(base.EventStore):
             )
             if cur.rowcount > 0:
                 self._bump(name)
-            self._client.conn.commit()
+            self._client.commit()
             return cur.rowcount > 0
 
     @staticmethod
@@ -437,12 +451,12 @@ class _MetaBase:
         self._client = client or _SqliteClient(config)
         with self._client.lock:
             self._client.conn.execute(self.DDL)
-            self._client.conn.commit()
+            self._client.commit()
 
     def _exec(self, sql: str, params=()):
         with self._client.lock:
             cur = self._client.conn.execute(sql, params)
-            self._client.conn.commit()
+            self._client.commit()
             return cur
 
     def _query(self, sql: str, params=()):

@@ -120,10 +120,26 @@ class RolloutConfig:
         }
 
 
-def sticky_candidate(raw_request: bytes, fraction: float) -> bool:
+def route_bucket(raw_request: bytes) -> int:
+    """The sticky routing bucket of one request body: crc32 % 10000.
+    Computed ONCE per request — at the gateway when one fronts the
+    replica tier (forwarded as X-PIO-Route-Hash so every replica agrees
+    on the canary fraction end-to-end), else at the replica itself."""
+    return zlib.crc32(raw_request) % 10_000
+
+
+def sticky_candidate(
+    raw_request: bytes, fraction: float, bucket: Optional[int] = None
+) -> bool:
     """Hash-of-request routing: the same request body always lands on the
-    same variant (sticky), and the candidate share tracks `fraction`."""
-    return (zlib.crc32(raw_request) % 10_000) < fraction * 10_000
+    same variant (sticky), and the candidate share tracks `fraction`.
+    `bucket` (ISSUE 15) overrides the locally-computed hash with the
+    gateway's — a replica behind the gateway must make the same canary
+    decision the gateway's hash implies, or a hedged/failed-over retry
+    could flip variants mid-request."""
+    if bucket is None:
+        bucket = route_bucket(raw_request)
+    return bucket < fraction * 10_000
 
 
 class VariantWindow:

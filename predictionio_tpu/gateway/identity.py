@@ -1,0 +1,50 @@
+"""Durable replica identity (ISSUE 15: the replica-correctness fix).
+
+N replicas folding the same event stream must never share an online
+fold-in cursor — two writers on one single-writer cursor record
+leapfrog each other's positions and double-fold events (the PR-9
+caveat, until now an operator convention: "name each replica's
+cursor"). The convention becomes automatic here: every replica derives
+a **durable** identity persisted next to its local state, the identity
+is stamped into the replica registry record, and the query server's
+`attach_online` appends it to the default cursor name — a replica
+restart resumes ITS cursor (crash-resume preserved), while a second
+replica on the same storage gets a different one by construction.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import uuid
+
+log = logging.getLogger(__name__)
+
+_ID_FILE = "replica.id"
+
+
+def replica_identity(state_dir: str) -> str:
+    """The durable replica id persisted under `state_dir` (created on
+    first call, re-read forever after). The id doubles as the online
+    cursor-name suffix, so durability here IS cursor-resume
+    correctness: a fresh id per boot would orphan the old cursor and
+    re-fold its whole window."""
+    state_dir = os.path.expanduser(state_dir)
+    os.makedirs(state_dir, exist_ok=True)
+    path = os.path.join(state_dir, _ID_FILE)
+    try:
+        with open(path) as f:
+            rid = f.read().strip()
+        if rid:
+            return rid
+    except OSError:
+        pass
+    rid = f"replica-{uuid.uuid4().hex[:12]}"
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(rid + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    log.info("minted durable replica identity %s at %s", rid, path)
+    return rid

@@ -1,0 +1,137 @@
+"""Replica registry: heartbeating presence records for query-server
+replicas (ISSUE 15 tentpole part 1).
+
+The exact mechanism the training fleet's `pio_fleet_worker` records
+proved (fleet/coordinator.py): each replica appends a heartbeating
+record to the shared lifecycle record layer, every reader folds the
+entity to see who is alive, and a crashed replica simply goes stale.
+Replicas get their own entity (`pio_query_replica`) rather than riding
+the worker entity: a serving replica is not a claimable train worker,
+and `pio fleet status` must not count one as spare train capacity.
+
+The record carries what the GATEWAY needs to route:
+
+- ``id`` — the durable replica identity (gateway/identity.py), which is
+  also the suffix of the replica's online fold-in cursor record, so N
+  replicas folding one stream never share a cursor,
+- ``url`` — the advertised base URL queries proxy to,
+- ``engines`` / ``tenants`` — what this replica serves (informational;
+  routing today assumes a homogeneous tier per gateway),
+- ``serve_dtype`` — the replica's serving-precision tier (f32/bf16/
+  int8), surfaced so operators can see a mixed-tier fleet at a glance,
+- ``draining`` — set during graceful drain so the gateway stops
+  routing BEFORE the replica stops answering,
+- ``heartbeat_at`` / ``inflight`` — liveness + load, compacted to one
+  live beat event per replica (the worker-registry discipline).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.deploy.registry import LifecycleRecordStore
+
+REPLICA_ENTITY = "pio_query_replica"
+
+
+@dataclass
+class ReplicaInfo:
+    """One serving replica's presence record."""
+
+    id: str
+    url: str = ""
+    host: str = ""
+    pid: int = 0
+    started_at: str = ""
+    heartbeat_at: float = 0.0
+    engines: list[str] = field(default_factory=list)
+    tenants: list[str] = field(default_factory=list)
+    serve_dtype: str = "f32"
+    draining: bool = False
+    inflight: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id, "url": self.url, "host": self.host,
+            "pid": self.pid, "started_at": self.started_at,
+            "heartbeat_at": self.heartbeat_at,
+            "engines": list(self.engines), "tenants": list(self.tenants),
+            "serve_dtype": self.serve_dtype, "draining": self.draining,
+            "inflight": self.inflight,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ReplicaInfo":
+        r = ReplicaInfo(id=d.get("id", ""))
+        for k in (
+            "url", "host", "pid", "started_at", "heartbeat_at",
+            "engines", "tenants", "serve_dtype", "draining", "inflight",
+        ):
+            if d.get(k) is not None:
+                setattr(r, k, d[k])
+        return r
+
+
+class ReplicaRegistry:
+    """CRUD + liveness over replica records (shared record layer)."""
+
+    def __init__(self, storage: Storage):
+        self._store = LifecycleRecordStore(storage)
+
+    def upsert(self, info: ReplicaInfo) -> None:
+        self._store.append(REPLICA_ENTITY, info.id, info.to_dict())
+
+    def heartbeat(
+        self, replica_id: str, prev_event_id: Optional[str],
+        inflight: int = 0, draining: Optional[bool] = None,
+    ) -> str:
+        """Heartbeat with compaction (one live beat event per replica).
+        Carries `id` for the same reason worker beats do: a record a
+        peer GC'd during a connectivity gap must not resurrect
+        identity-less. `draining` rides the beat when set so the drain
+        flag cannot be lost to a concurrent beat's last-write-wins."""
+        props: dict[str, Any] = {
+            "id": replica_id,
+            "heartbeat_at": time.time(),
+            "inflight": int(inflight),
+        }
+        if draining is not None:
+            props["draining"] = bool(draining)
+        eid = self._store.append(REPLICA_ENTITY, replica_id, props)
+        if prev_event_id:
+            self._store.discard(prev_event_id)
+        return eid
+
+    def set_draining(self, replica_id: str, draining: bool = True) -> None:
+        self._store.append(REPLICA_ENTITY, replica_id, {
+            "id": replica_id, "draining": bool(draining),
+        })
+
+    def remove(self, replica_id: str) -> None:
+        self._store.purge(REPLICA_ENTITY, replica_id)
+
+    def get(self, replica_id: str) -> Optional[ReplicaInfo]:
+        d = self._store.fold(REPLICA_ENTITY, replica_id).get(replica_id)
+        return ReplicaInfo.from_dict(d) if d else None
+
+    def list(self) -> list[ReplicaInfo]:
+        return [
+            ReplicaInfo.from_dict(d)
+            for d in self._store.fold(REPLICA_ENTITY).values()
+        ]
+
+    def live(self, stale_after_s: float = 5.0) -> list[ReplicaInfo]:
+        cutoff = time.time() - stale_after_s
+        return [r for r in self.list() if r.heartbeat_at >= cutoff]
+
+    def gc(self, stale_after_s: float = 60.0) -> list[str]:
+        """Purge records of replicas dead for much longer than the
+        liveness horizon (a kill -9'd replica can't deregister)."""
+        cutoff = time.time() - stale_after_s
+        doomed = [r.id for r in self.list() if r.heartbeat_at < cutoff]
+        for rid in doomed:
+            self.remove(rid)
+        return doomed

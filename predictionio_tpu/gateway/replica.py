@@ -1,0 +1,220 @@
+"""Replica membership for a query server (ISSUE 15).
+
+`ReplicaMember` makes one `QueryServer` a citizen of the replicated
+serving tier: it derives the durable replica identity, registers a
+heartbeating `pio_query_replica` record (engines/tenants served,
+serve_dtype tier, advertised URL), and implements graceful drain — the
+three-step zero-drop retirement the gateway drives:
+
+1. the record's ``draining`` flag flips (the gateway's sync pass stops
+   routing new queries here within one sync interval),
+2. the replica finishes its in-flight queries (tracked by the server's
+   in-flight counter; late stragglers the gateway raced in still get
+   answers — draining refuses nothing),
+3. the server stops and the record is removed.
+
+Attaching a member also stamps the replica id into the server, which
+changes the DEFAULT online fold-in cursor name (workflow/server.py
+`attach_online`): per-replica cursor identity stops being an operator
+convention (the PR-9 caveat) and becomes automatic.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.gateway.identity import replica_identity
+from predictionio_tpu.gateway.registry import ReplicaInfo, ReplicaRegistry
+from predictionio_tpu.utils.env import env_float
+
+log = logging.getLogger(__name__)
+
+
+def _utcnow_iso() -> str:
+    import datetime as _dt
+
+    return _dt.datetime.now(_dt.timezone.utc).isoformat()
+
+
+@dataclass
+class ReplicaConfig:
+    """Replica-membership knobs."""
+
+    # where the durable replica id lives (a per-replica local path —
+    # NOT the shared storage; two replicas sharing it would share an
+    # identity, which is exactly the bug this exists to kill)
+    state_dir: str = "~/.predictionio_tpu/replica"
+    # explicit identity override (tests; wins over state_dir)
+    replica_id: Optional[str] = None
+    url: str = ""  # advertised base URL (http://host:port)
+    engines: list[str] = field(default_factory=list)
+    tenants: list[str] = field(default_factory=list)
+    serve_dtype: str = "f32"
+    heartbeat_interval_s: float = field(
+        default_factory=lambda: env_float("PIO_REPLICA_HEARTBEAT_S", 1.0)
+    )
+    # drain: max seconds to wait for in-flight queries before stopping
+    drain_timeout_s: float = 30.0
+    # post-drain grace for gateway-raced stragglers to arrive
+    drain_grace_s: float = 0.25
+
+
+class ReplicaMember:
+    """One query server's presence in the replicated tier."""
+
+    def __init__(
+        self,
+        storage: Storage,
+        server,
+        config: Optional[ReplicaConfig] = None,
+    ):
+        self.storage = storage
+        self.server = server
+        self.config = config or ReplicaConfig()
+        self.replica_id = self.config.replica_id or replica_identity(
+            self.config.state_dir
+        )
+        self.registry = ReplicaRegistry(storage)
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_event: Optional[str] = None
+        self._lock = threading.Lock()
+        self._draining = False  # guarded-by: _lock
+        self._drain_thread: Optional[threading.Thread] = None  # guarded-by: _lock
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        url = self.config.url
+        if not url:
+            # late-bound: the server's port is only known after start
+            url = f"http://127.0.0.1:{self.server.port}"
+        self.url = url
+        self.registry.upsert(ReplicaInfo(
+            id=self.replica_id,
+            url=url,
+            host=socket.gethostname(),
+            pid=os.getpid(),
+            started_at=_utcnow_iso(),
+            heartbeat_at=time.time(),
+            engines=list(self.config.engines),
+            tenants=list(self.config.tenants),
+            serve_dtype=self.config.serve_dtype,
+            draining=False,
+        ))
+        self._stop.clear()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, name="replica-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        t = self._hb_thread
+        if t is not None:
+            t.join(timeout=self.config.heartbeat_interval_s + 5)
+            self._hb_thread = None
+        with self._lock:
+            dt = self._drain_thread
+        if dt is not None and dt is not threading.current_thread():
+            dt.join(timeout=1.0)
+        if deregister:
+            try:
+                self.registry.remove(self.replica_id)
+            except Exception:
+                log.debug(
+                    "replica deregister failed (non-fatal)", exc_info=True
+                )
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.config.heartbeat_interval_s):
+            try:
+                with self._lock:
+                    draining = self._draining
+                # only ever ASSERT draining on a beat, never deny it: a
+                # gateway that flagged the record but whose drain notify
+                # was lost must not have the flag erased by our next
+                # last-write-wins beat (registration's upsert is the one
+                # place draining legitimately resets to False)
+                self._hb_event = self.registry.heartbeat(
+                    self.replica_id, self._hb_event,
+                    inflight=self.server.inflight_queries,
+                    draining=True if draining else None,
+                )
+            except Exception:
+                log.warning(
+                    "replica heartbeat failed (storage down?); continuing",
+                    exc_info=True,
+                )
+
+    # -- graceful drain ----------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def drain(self) -> bool:
+        """Begin graceful retirement; returns False when already
+        draining. Flags the record (the gateway stops routing), then a
+        background thread waits out in-flight queries and stops the
+        server — which also deregisters this member."""
+        with self._lock:
+            if self._draining:
+                return False
+            self._draining = True
+            # the thread stops the server, which joins THIS member's
+            # heartbeat thread — same self-stop shape as the /stop
+            # route; it exits with the process
+            # lint: disable=thread-lifecycle — self-stop: drain tears
+            # down the server that owns this member; joined best-effort
+            # in stop() when the stop arrives from elsewhere first
+            self._drain_thread = threading.Thread(
+                target=self._drain_and_stop, name="replica-drain",
+                daemon=True,
+            )
+        try:
+            self.registry.set_draining(self.replica_id, True)
+        except Exception:
+            log.warning(
+                "drain flag write failed; gateway will stop routing on "
+                "the next heartbeat instead", exc_info=True,
+            )
+        self._drain_thread.start()
+        return True
+
+    def _drain_and_stop(self) -> None:
+        deadline = time.monotonic() + self.config.drain_timeout_s
+        # wait for the gateway to observe the flag and for in-flight
+        # queries (including stragglers it raced in) to finish
+        while time.monotonic() < deadline:
+            if self.server.inflight_queries == 0:
+                time.sleep(self.config.drain_grace_s)
+                if self.server.inflight_queries == 0:
+                    break
+            else:
+                time.sleep(0.05)
+        log.info(
+            "replica %s drained (inflight=%d); stopping",
+            self.replica_id, self.server.inflight_queries,
+        )
+        try:
+            self.server.stop()
+        except Exception:
+            log.exception("post-drain server stop failed")
+
+    # -- reporting ---------------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        return {
+            "replica_id": self.replica_id,
+            "url": getattr(self, "url", self.config.url),
+            "draining": self.draining,
+            "inflight": self.server.inflight_queries,
+            "serve_dtype": self.config.serve_dtype,
+            "engines": list(self.config.engines),
+        }

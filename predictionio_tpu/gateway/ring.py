@@ -1,0 +1,75 @@
+"""Consistent-hash ring with bounded-load overflow (ISSUE 15 part 2).
+
+Tenants/apps hash onto replicas through a classic virtual-node ring:
+each replica owns `vnodes` points on a 64-bit circle, a key routes to
+the first point clockwise of its hash, and membership changes remap
+only the keys adjacent to the joining/leaving replica — which is
+exactly what a tenant-model cache wants (a scale-up must not shuffle
+every tenant's runtime onto a cold replica).
+
+Plain consistent hashing lets one hot tenant pin one replica at
+saturation while its neighbors idle. `ordered()` therefore returns the
+full ring ORDER for a key and the router walks it with the
+bounded-load rule (Mirrokni et al.'s consistent hashing with bounded
+loads): a replica already carrying more than ``factor ×`` the mean
+in-flight load is skipped, so overflow spills to the next replica on
+the ring — deterministically, preserving as much stickiness as the
+load bound allows.
+
+Stdlib only (hashlib); the gateway is a data-plane process and must
+never pay the jax import.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Optional
+
+
+def _h64(key: str) -> int:
+    return int.from_bytes(
+        hashlib.md5(key.encode()).digest()[:8], "big"
+    )
+
+
+class HashRing:
+    """Immutable ring over a replica-id set (rebuild on membership
+    change — membership is small and changes are rare)."""
+
+    def __init__(self, replica_ids: list[str], vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self.replica_ids = sorted(set(replica_ids))
+        points: list[tuple[int, str]] = []
+        for rid in self.replica_ids:
+            for v in range(self.vnodes):
+                points.append((_h64(f"{rid}#{v}"), rid))
+        points.sort()
+        self._hashes = [p[0] for p in points]
+        self._owners = [p[1] for p in points]
+
+    def __len__(self) -> int:
+        return len(self.replica_ids)
+
+    def ordered(self, key: str) -> list[str]:
+        """Every replica in ring order starting at `key`'s successor —
+        position 0 is the sticky owner, the rest are the deterministic
+        overflow/hedge/failover sequence."""
+        if not self._hashes:
+            return []
+        idx = bisect.bisect_right(self._hashes, _h64(key))
+        seen: set[str] = set()
+        out: list[str] = []
+        n = len(self._hashes)
+        for i in range(n):
+            rid = self._owners[(idx + i) % n]
+            if rid not in seen:
+                seen.add(rid)
+                out.append(rid)
+                if len(out) == len(self.replica_ids):
+                    break
+        return out
+
+    def owner(self, key: str) -> Optional[str]:
+        ordered = self.ordered(key)
+        return ordered[0] if ordered else None

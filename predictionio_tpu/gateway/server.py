@@ -1,0 +1,941 @@
+"""`pio gateway`: the L7 router in front of N query-server replicas
+(ISSUE 15 tentpole; ROADMAP direction 2).
+
+One replica crash must never be a tenant-visible outage. The gateway:
+
+- **discovers** replicas from the shared replica registry (heartbeating
+  ``pio_query_replica`` records, the worker-record mechanism),
+- **routes** each query by consistent hash — tenant id (model-cache
+  locality) or, untenanted, the request's own crc32 bucket — with
+  bounded-load overflow to the next replica on the ring, and forwards
+  the routing bucket as ``X-PIO-Route-Hash`` so sticky canary routing
+  holds end-to-end no matter which replica (or hedge) answers,
+- treats **health as a first-class signal**: a per-replica circuit
+  breaker (resilience/breaker.py) fed by real proxy outcomes, active
+  ``/health`` probes for traffic-free re-admission, and the passive
+  ``up{instance}`` + SLO burn-rate series from an embedded
+  :class:`FleetScraper` — any of stale heartbeat / open breaker /
+  scrape-down / firing per-instance SLO ejects a replica from routing;
+  recovery on any probe path re-admits it,
+- **hedges**: a query still unanswered at the replica's rolling p95
+  mark is speculatively re-sent to the next replica on the ring; the
+  first good answer wins and the loser is bounded by the same
+  propagated ``X-PIO-Deadline`` (no post-deadline device work). Network
+  failures fail over the ring the same way — queries are idempotent,
+  which is why ONLY the query routes hedge/retry,
+- **drains** zero-drop: flag the registry record, stop routing, let
+  the replica answer its in-flight queries, then it stops itself,
+- **autoscales** closed-loop: the :class:`Autoscaler` policy consumes
+  SLO burn + per-replica concurrency each sync pass and spawns/drains
+  through a ReplicaManager, with tenant-prefetch hints POSTed to
+  joining replicas so a scale-up doesn't cold-start every tenant.
+
+Import discipline: the gateway is a data-plane process — stdlib +
+obs/resilience only, never jax.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import predictionio_tpu.resilience.deadline as _deadline
+import predictionio_tpu.obs.tracing as _tracing
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.deploy.rollout import route_bucket
+from predictionio_tpu.gateway.autoscale import Autoscaler
+from predictionio_tpu.gateway.registry import ReplicaInfo, ReplicaRegistry
+from predictionio_tpu.gateway.ring import HashRing
+from predictionio_tpu.obs import server_registry
+from predictionio_tpu.obs.monitor import FleetScraper, get_monitor
+from predictionio_tpu.resilience.breaker import CLOSED, CircuitBreaker
+from predictionio_tpu.utils.env import (
+    env_bool,
+    env_float,
+    env_int,
+)
+from predictionio_tpu.utils.http import (
+    JsonHandler,
+    ServerProcess,
+    ThreadedServer,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class GatewayConfig:
+    ip: str = "0.0.0.0"
+    port: int = 8100
+    # discovery/health cadence
+    sync_interval_s: float = field(
+        default_factory=lambda: env_float("PIO_GATEWAY_SYNC_S", 0.5)
+    )
+    # heartbeat age past which a replica stops being routable
+    replica_stale_after_s: float = field(
+        default_factory=lambda: env_float("PIO_GATEWAY_STALE_S", 3.0)
+    )
+    # hedging: on by default, floor on the speculative delay so a cold
+    # latency window doesn't hedge every single query
+    hedge: bool = field(
+        default_factory=lambda: env_bool("PIO_GATEWAY_HEDGE")
+    )
+    hedge_min_ms: float = field(
+        default_factory=lambda: max(
+            1.0, env_float("PIO_GATEWAY_HEDGE_MIN_MS", 25.0)
+        )
+    )
+    # bounded-load consistent hashing: skip a replica carrying more
+    # than factor x the mean in-flight load
+    load_factor: float = field(
+        default_factory=lambda: env_float("PIO_GATEWAY_LOAD_FACTOR", 1.5)
+    )
+    vnodes: int = field(
+        default_factory=lambda: env_int("PIO_GATEWAY_VNODES", 64)
+    )
+    # per-attempt socket timeout (the deadline budget caps it further)
+    attempt_timeout_s: float = 30.0
+    # passive scrape cadence (up{instance} + burn-rate inputs)
+    scrape_interval_s: float = field(
+        default_factory=lambda: env_float("PIO_SCRAPE_INTERVAL_S", 10.0)
+    )
+    scrape: bool = True
+    # breaker knobs for the per-replica circuits
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 2.0
+    # proxy worker pool (hedges double up, so >= 2x expected clients
+    # is unnecessary — attempts are short and the pool queues)
+    pool_size: int = 32
+    # how many recently-routed tenants to remember for prefetch hints
+    prefetch_tenants: int = 256
+
+
+class _ReplicaState:
+    """The gateway's live view of one replica."""
+
+    __slots__ = (
+        "info", "breaker", "lock", "inflight", "lat",
+        "scrape_down", "slo_firing", "alive", "last_probe_at",
+    )
+
+    def __init__(self, info: ReplicaInfo, breaker: CircuitBreaker):
+        self.info = info
+        self.breaker = breaker
+        self.lock = threading.Lock()
+        self.inflight = 0  # guarded-by: lock
+        self.lat: deque[float] = deque(maxlen=64)  # guarded-by: lock
+        self.scrape_down = False
+        self.slo_firing = False
+        self.alive = True  # heartbeat fresh as of the last sync pass
+        self.last_probe_at = 0.0
+
+    # -- accounting (called from proxy worker threads) --------------------
+    def enter(self) -> None:
+        with self.lock:
+            self.inflight += 1
+
+    def exit(self, latency_s: Optional[float]) -> None:
+        with self.lock:
+            self.inflight -= 1
+            if latency_s is not None:
+                self.lat.append(latency_s)
+
+    def inflight_now(self) -> int:
+        with self.lock:
+            return self.inflight
+
+    def p95_s(self) -> Optional[float]:
+        with self.lock:
+            if len(self.lat) < 8:
+                return None  # too cold to trust
+            vs = sorted(self.lat)
+        return vs[min(len(vs) - 1, int(0.95 * len(vs)))]
+
+    def routable(self) -> bool:
+        return (
+            self.alive
+            and not self.info.draining
+            and not self.scrape_down
+            and not self.slo_firing
+            # anything but CLOSED stays out of the ring: the sync
+            # loop's active /health probe pays the half-open recovery
+            # attempt, never a real query
+            and self.breaker.state == CLOSED
+        )
+
+    def eject_reasons(self) -> list[str]:
+        reasons = []
+        if not self.alive:
+            reasons.append("stale_heartbeat")
+        if self.info.draining:
+            reasons.append("draining")
+        if self.scrape_down:
+            reasons.append("scrape_down")
+        if self.slo_firing:
+            reasons.append("slo_burn")
+        state = self.breaker.state
+        if state != CLOSED:
+            reasons.append(f"breaker_{state}")
+        return reasons
+
+
+class _AttemptFailed(Exception):
+    """One proxy attempt failed at the transport layer (the failover
+    trigger); HTTP answers of any status are NOT this."""
+
+
+class _GatewayHandler(JsonHandler):
+    server: "_GatewayHttp"  # type: ignore[assignment]
+
+    def do_GET(self):
+        self._drain_body()
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        gw = self.server.owner
+        try:
+            if path in ("/", "/gateway/status"):
+                self._respond(200, gw.status())
+            elif path == "/health":
+                self._respond(200, {"status": "alive"})
+            elif path == "/metrics":
+                self._serve_metrics()
+            elif path == "/alerts":
+                self._serve_alerts()
+            elif path == "/debug/tsdb":
+                self._serve_debug_tsdb()
+            elif path == "/debug/traces":
+                self._serve_debug_traces()
+            elif path == "/debug/faults":
+                self._serve_debug_faults()
+            else:
+                self._respond(404, {"message": "Not Found"})
+        except Exception as e:
+            log.exception("GET %s failed", path)
+            self._respond(500, {"message": str(e)})
+
+    def do_POST(self):
+        self._drain_body()
+        path = self.path.split("?")[0].rstrip("/")
+        gw = self.server.owner
+        if path == "/queries.json":
+            self._proxy_query(path, self.headers.get("X-PIO-Tenant") or None)
+        elif path.startswith("/tenants/") and path.endswith("/queries.json"):
+            parts = [p for p in path.split("/") if p]
+            if len(parts) == 3:
+                self._proxy_query(path, parts[1])
+            else:
+                self._respond(404, {"message": "Not Found"})
+        elif path == "/gateway/drain":
+            body = self._json_body()
+            rid = body.get("replica") if isinstance(body, dict) else None
+            if not rid:
+                self._respond(400, {"message": "'replica' is required"})
+                return
+            try:
+                result = gw.drain_replica(str(rid))
+            except KeyError:
+                self._respond(404, {"message": f"no replica {rid!r}"})
+            else:
+                self._respond(202, result)
+        else:
+            self._respond(404, {"message": "Not Found"})
+
+    def _proxy_query(self, path: str, tenant_id: Optional[str]) -> None:
+        gw = self.server.owner
+        status, body, headers = gw.proxy(path, self._raw_body, tenant_id)
+        self._respond(status, body, headers=headers)
+
+
+class _GatewayHttp(ThreadedServer):
+    owner: "GatewayServer"
+
+
+class GatewayServer(ServerProcess):
+    """The gateway process: routing state + the HTTP front."""
+
+    _name = "gateway"
+
+    def __init__(
+        self,
+        storage: Storage,
+        config: Optional[GatewayConfig] = None,
+        autoscaler: Optional[Autoscaler] = None,
+    ):
+        super().__init__()
+        self.storage = storage
+        self.config = config or GatewayConfig()
+        self.registry = ReplicaRegistry(storage)
+        self.autoscaler = autoscaler
+        self.metrics = server_registry()
+        self._requests = self.metrics.counter(
+            "gateway_requests_total",
+            "queries through the gateway, by outcome",
+            ("outcome",),  # label-bound: literal outcome set
+        )
+        self._hedges = self.metrics.counter(
+            "gateway_hedges_total",
+            "speculative hedge requests, by outcome",
+            ("outcome",),  # label-bound: literal sent|won
+        )
+        self._failovers = self.metrics.counter(
+            "gateway_failover_total",
+            "attempts re-sent to the next replica after a transport "
+            "failure",
+        )
+        self._ejections = self.metrics.counter(
+            "gateway_ejections_total",
+            "replica ejections from routing, by reason",
+            ("reason",),  # label-bound: literal eject-reason set
+        )
+        self._routing_hist = self.metrics.histogram(
+            "gateway_routing_seconds",
+            "gateway-added routing overhead: request read to first "
+            "attempt dispatched",
+        )
+        self._replicas_gauge = self.metrics.gauge(
+            "gateway_replicas", "replicas known / routable",
+            ("state",),  # label-bound: literal known|routable
+        )
+        # routing state: the sync thread REPLACES these references
+        # atomically; proxy threads snapshot them without a lock
+        self._state_lock = threading.Lock()
+        self._replicas: dict[str, _ReplicaState] = {}  # guarded-by: _state_lock
+        self._ring = HashRing([], vnodes=self.config.vnodes)
+        self._recent_tenants: "OrderedDict[str, bool]" = OrderedDict()  # guarded-by: _state_lock
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.config.pool_size,
+            thread_name_prefix="gateway-proxy",
+        )
+        self._tl = threading.local()  # per-thread conns, keyed by url
+        self._stop = threading.Event()
+        self._sync_thread: Optional[threading.Thread] = None
+        self._scraper: Optional[FleetScraper] = None
+        # in-flight hint/drain-notify threads, joined on stop
+        self._hint_lock = threading.Lock()
+        self._hint_threads: set[threading.Thread] = set()  # guarded-by: _hint_lock
+
+    # -- lifecycle ---------------------------------------------------------
+    def _make_server(self) -> _GatewayHttp:
+        server = _GatewayHttp((self.config.ip, self.config.port),
+                              _GatewayHandler)
+        server.owner = self
+        server.metrics = self.metrics
+        server.metrics_label = "gateway"
+        return server
+
+    def start(self) -> int:
+        port = super().start()
+        if self.config.scrape:
+            self._scraper = FleetScraper(
+                get_monitor().tsdb, [],
+                interval_s=self.config.scrape_interval_s,
+            )
+            self._scraper.start()
+        self._stop.clear()
+        self.sync_once()  # route from the first request, not the first tick
+        self._sync_thread = threading.Thread(
+            target=self._sync_loop, name="gateway-sync", daemon=True
+        )
+        self._sync_thread.start()
+        return port
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._sync_thread
+        if t is not None:
+            t.join(timeout=self.config.sync_interval_s + 5)
+            self._sync_thread = None
+        if self._scraper is not None:
+            self._scraper.stop()
+            self._scraper = None
+        if self.autoscaler is not None and self.autoscaler.manager:
+            self.autoscaler.manager.stop()
+        self._pool.shutdown(wait=False)
+        with self._hint_lock:
+            pending = list(self._hint_threads)
+        for ht in pending:
+            ht.join(timeout=5)
+        super().stop()
+
+    # -- discovery / health sync -------------------------------------------
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.config.sync_interval_s):
+            try:
+                self.sync_once()
+            except Exception:
+                log.exception("gateway sync pass failed; will retry")
+
+    def sync_once(self) -> None:
+        """One discovery+health pass. Public so tests drive it without
+        the thread."""
+        try:
+            records = self.registry.list()
+        except Exception:
+            # storage blip: keep routing on the last-known state — the
+            # breakers still protect against actually-dead replicas
+            log.warning(
+                "replica registry read failed; serving last-known fleet",
+                exc_info=True,
+            )
+            return
+        now = time.time()
+        cutoff = now - self.config.replica_stale_after_s
+        tsdb = get_monitor().tsdb
+        with self._state_lock:
+            states = dict(self._replicas)
+        prev_routable = {
+            rid for rid, st in states.items() if st.routable()
+        }
+        seen: set[str] = set()
+        for info in records:
+            if not info.id or not info.url:
+                continue
+            seen.add(info.id)
+            st = states.get(info.id)
+            if st is None:
+                st = _ReplicaState(info, CircuitBreaker(
+                    f"replica:{info.id}",
+                    failure_threshold=self.config.breaker_threshold,
+                    cooldown_s=self.config.breaker_cooldown_s,
+                    registry=self.metrics,
+                ))
+                states[info.id] = st
+            before = st.routable()
+            st.info = info
+            st.alive = info.heartbeat_at >= cutoff
+            up = tsdb.latest("up", {"instance": info.id})
+            st.scrape_down = up is not None and up <= 0.0
+            st.slo_firing = self._slo_firing(info.id)
+            if before and not st.routable():
+                for reason in st.eject_reasons():
+                    self._ejections.inc(reason=reason)
+                log.warning(
+                    "replica %s ejected from routing: %s",
+                    info.id, ",".join(st.eject_reasons()),
+                )
+        for rid in list(states):
+            if rid not in seen:
+                del states[rid]  # record GC'd / deregistered
+        # traffic-free re-admission: actively probe non-routable
+        # replicas whose record still heartbeats — a breaker opened by
+        # a transient outage must not stay open forever just because
+        # routing (rightly) sends it no traffic to recover on
+        for st in states.values():
+            if (
+                st.alive and not st.info.draining and not st.routable()
+                and now - st.last_probe_at
+                >= self.config.breaker_cooldown_s
+            ):
+                st.last_probe_at = now
+                self._probe(st)
+        routable = sorted(
+            rid for rid, st in states.items() if st.routable()
+        )
+        ring = HashRing(routable, vnodes=self.config.vnodes)
+        with self._state_lock:
+            self._replicas = states
+            self._ring = ring
+        self._replicas_gauge.set(float(len(states)), state="known")
+        self._replicas_gauge.set(float(len(routable)), state="routable")
+        if self._scraper is not None:
+            targets = sorted(
+                (st.info.id, st.info.url) for st in states.values()
+            )
+            if targets != sorted(self._scraper.targets):
+                self._scraper.targets = list(targets)
+        # scale-up warm-start: tell JOINING replicas which of the
+        # recently-routed tenants now hash onto them
+        joined = set(routable) - prev_routable
+        if joined:
+            self._send_prefetch_hints(joined, ring, states)
+        if self.autoscaler is not None:
+            self._autoscale(routable, states)
+
+    def _slo_firing(self, replica_id: str) -> bool:
+        """A firing SLO whose spec names this replica's instance ejects
+        it (burn-rate-aware routing: the monitoring plane's verdict,
+        not just liveness)."""
+        engine = get_monitor().engine
+        if engine is None:
+            return False
+        try:
+            for row in engine.payload()["slos"]:
+                if (
+                    row["state"] == "firing"
+                    and row["spec"].get("instance") == replica_id
+                ):
+                    return True
+        except Exception:
+            return False
+        return False
+
+    def _probe(self, st: _ReplicaState) -> None:
+        """Active /health probe through the replica's breaker — success
+        closes it (re-admission), failure re-arms the cooldown."""
+        if not st.breaker.allow():
+            return
+        ok = False
+        try:
+            conn = http.client.HTTPConnection(
+                *self._host_port(st.info.url), timeout=2
+            )
+            try:
+                conn.request("GET", "/health")
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status == 200
+            finally:
+                conn.close()
+        except (http.client.HTTPException, OSError):
+            # same failure scope as _attempt: a socket that accepts but
+            # talks garbage (BadStatusLine) is a failed probe, not an
+            # escape that would leave the half-open slot claimed forever
+            ok = False
+        if ok:
+            st.breaker.record_success()
+            log.info("replica %s re-admitted (health probe ok)", st.info.id)
+        else:
+            st.breaker.record_failure()
+
+    @staticmethod
+    def _host_port(url: str) -> tuple[str, int]:
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url if "://" in url else f"http://{url}")
+        return parts.hostname or "127.0.0.1", parts.port or 80
+
+    def _send_prefetch_hints(
+        self, joined: set, ring: HashRing, states: dict
+    ) -> None:
+        with self._state_lock:
+            recent = list(self._recent_tenants)
+        if not recent:
+            return
+        hints: dict[str, list[str]] = {}
+        for tenant in recent:
+            owner = ring.owner(tenant)
+            if owner in joined:
+                hints.setdefault(owner, []).append(tenant)
+        for rid, tenants in hints.items():
+            url = states[rid].info.url
+
+            def send(url=url, tenants=tenants, rid=rid):
+                try:
+                    conn = http.client.HTTPConnection(
+                        *self._host_port(url), timeout=5
+                    )
+                    try:
+                        conn.request(
+                            "POST", "/replica/prefetch",
+                            body=json.dumps({"tenants": tenants}).encode(),
+                            headers={"Content-Type": "application/json"},
+                        )
+                        conn.getresponse().read()
+                    finally:
+                        conn.close()
+                    log.info(
+                        "prefetch hint sent to joining replica %s "
+                        "(%d tenants)", rid, len(tenants),
+                    )
+                except Exception:
+                    log.debug(
+                        "prefetch hint to %s failed", rid, exc_info=True
+                    )
+                finally:
+                    with self._hint_lock:
+                        self._hint_threads.discard(
+                            threading.current_thread()
+                        )
+
+            t = threading.Thread(
+                target=send, name="gateway-hint", daemon=True
+            )
+            with self._hint_lock:
+                self._hint_threads.add(t)
+            t.start()
+
+    def _autoscale(self, routable: list[str], states: dict) -> None:
+        n = len(routable)
+        total = sum(states[rid].inflight_now() for rid in routable)
+        mean = total / n if n else 0.0
+        burn = None
+        engine = get_monitor().engine
+        if engine is not None:
+            burns = [
+                st.fast_burn
+                for st in (engine.status(s.name) for s in engine.specs())
+                if st is not None and st.fast_burn is not None
+            ]
+            if burns:
+                burn = max(burns)
+        drain_candidate = None
+        if n > 1:
+            rid = min(routable, key=lambda r: states[r].inflight_now())
+            drain_candidate = (rid, states[rid].info.url)
+        try:
+            self.autoscaler.evaluate(
+                replicas=n, mean_inflight=mean, burn=burn,
+                drain_candidate=drain_candidate,
+            )
+        except Exception:
+            log.exception("autoscaler evaluation failed")
+
+    # -- routing -----------------------------------------------------------
+    def _route_snapshot(self) -> tuple[HashRing, dict[str, _ReplicaState]]:
+        with self._state_lock:
+            return self._ring, self._replicas
+
+    def candidates(self, key: str) -> list[str]:
+        """Replica ids to try, in order: ring order from the key's
+        owner, bounded-load overloaded replicas demoted to the back
+        (still reachable as failover/hedge targets)."""
+        ring, states = self._route_snapshot()
+        ordered = [
+            rid for rid in ring.ordered(key)
+            if rid in states and states[rid].routable()
+        ]
+        if len(ordered) <= 1:
+            return ordered
+        loads = {rid: states[rid].inflight_now() for rid in ordered}
+        cap = max(
+            1.0,
+            self.config.load_factor
+            * (sum(loads.values()) + 1) / len(ordered),
+        )
+        light = [rid for rid in ordered if loads[rid] <= cap]
+        heavy = [rid for rid in ordered if loads[rid] > cap]
+        return light + heavy
+
+    def note_tenant(self, tenant_id: str) -> None:
+        with self._state_lock:
+            self._recent_tenants.pop(tenant_id, None)
+            self._recent_tenants[tenant_id] = True
+            while len(self._recent_tenants) > self.config.prefetch_tenants:
+                self._recent_tenants.popitem(last=False)
+
+    # -- the proxy hot path -------------------------------------------------
+    def proxy(
+        self, path: str, body: bytes, tenant_id: Optional[str]
+    ) -> tuple[int, Any, dict]:
+        """Route one query: returns (status, json-able body, headers)."""
+        t0 = time.perf_counter()
+        if _deadline.expired():
+            self._requests.inc(outcome="shed")
+            return 503, {"message": "deadline expired; request shed"}, {
+                "Retry-After": "1",
+            }
+        bucket = route_bucket(body)
+        key = tenant_id if tenant_id is not None else f"q{bucket}"
+        if tenant_id is not None:
+            self.note_tenant(tenant_id)
+        candidates = self.candidates(key)
+        if not candidates:
+            self._requests.inc(outcome="no_replica")
+            return 503, {"message": "no routable replica"}, {
+                "Retry-After": "1",
+            }
+        headers = {"Content-Type": "application/json",
+                   "X-PIO-Route-Hash": str(bucket)}
+        if tenant_id is not None and not path.startswith("/tenants/"):
+            headers["X-PIO-Tenant"] = tenant_id
+        tid = _tracing.current_trace_id()
+        if tid:
+            headers["X-Request-ID"] = tid
+        self._routing_hist.observe(time.perf_counter() - t0)
+        return self._dispatch(path, body, headers, candidates)
+
+    def _dispatch(
+        self, path: str, body: bytes, headers: dict, candidates: list[str]
+    ) -> tuple[int, Any, dict]:
+        """Primary + hedge + failover race over `candidates`. At most
+        two attempts are ever in flight (the primary and one hedge);
+        transport failures walk further down the ring. Every attempt
+        carries the REMAINING deadline budget, so an abandoned loser
+        can't do post-deadline work downstream."""
+        _ring, states = self._route_snapshot()
+        inflight: dict = {}  # future -> (rid, is_hedge)
+        next_i = 0
+        hedged = False
+        last_answer: Optional[tuple[int, bytes, dict]] = None
+
+        def launch(is_hedge: bool) -> None:
+            nonlocal next_i
+            rid = candidates[next_i]
+            next_i += 1
+            fut = self._pool.submit(
+                self._attempt, states.get(rid), path, body, dict(headers)
+            )
+            inflight[fut] = (rid, is_hedge)
+
+        launch(False)
+        hedge_delay = self._hedge_delay_s(candidates[0], states)
+        hedge_at = time.monotonic() + hedge_delay
+        while True:
+            rem = _deadline.remaining()
+            if rem is not None and rem <= 0:
+                # the client stopped waiting; in-flight attempts are
+                # bounded by the budget they carry
+                self._requests.inc(outcome="shed")
+                return 503, {
+                    "message": "deadline expired during dispatch",
+                }, {"Retry-After": "1"}
+            timeout = 0.25
+            if not hedged and self.config.hedge and next_i < len(candidates):
+                timeout = min(timeout, max(0.0, hedge_at - time.monotonic()))
+            if rem is not None:
+                timeout = min(timeout, rem)
+            done, _pending = wait(
+                list(inflight), timeout=timeout,
+                return_when=FIRST_COMPLETED,
+            )
+            for fut in done:
+                rid, is_hedge = inflight.pop(fut)
+                try:
+                    status, rbody, rheaders = fut.result()
+                except _AttemptFailed:
+                    # transport failure: fail over to the next replica
+                    # on the ring (the breaker already recorded it)
+                    if next_i < len(candidates):
+                        self._failovers.inc()
+                        launch(False)
+                    elif not inflight:
+                        self._requests.inc(outcome="error")
+                        return 502, {
+                            "message": "all replicas failed",
+                        }, {"Retry-After": "1"}
+                    continue
+                if status < 500:
+                    if is_hedge:
+                        self._hedges.inc(outcome="won")
+                    self._requests.inc(
+                        outcome="hedged" if hedged else "ok"
+                    )
+                    return self._relay(status, rbody, rheaders)
+                # a 5xx answer: keep it as the fallback, prefer any
+                # other attempt still running or launchable
+                last_answer = (status, rbody, rheaders)
+                if not inflight and next_i < len(candidates):
+                    self._failovers.inc()
+                    launch(False)
+                elif not inflight:
+                    self._requests.inc(outcome="error")
+                    return self._relay(*last_answer)
+            if (
+                not hedged
+                and self.config.hedge
+                and next_i < len(candidates)
+                and inflight
+                and time.monotonic() >= hedge_at
+            ):
+                hedged = True
+                self._hedges.inc(outcome="sent")
+                launch(True)
+            if not inflight:
+                if last_answer is not None:
+                    self._requests.inc(outcome="error")
+                    return self._relay(*last_answer)
+                self._requests.inc(outcome="error")
+                return 502, {"message": "no replica answered"}, {
+                    "Retry-After": "1",
+                }
+
+    def _hedge_delay_s(
+        self, rid: str, states: dict[str, _ReplicaState]
+    ) -> float:
+        st = states.get(rid)
+        p95 = st.p95_s() if st is not None else None
+        floor = self.config.hedge_min_ms / 1000.0
+        return max(floor, p95) if p95 is not None else floor
+
+    @staticmethod
+    def _relay(status: int, rbody: bytes, rheaders: dict) -> tuple:
+        try:
+            payload = json.loads(rbody.decode() or "null")
+        except ValueError:
+            payload = {"message": rbody.decode(errors="replace")}
+        fwd = {}
+        if rheaders.get("Retry-After"):
+            fwd["Retry-After"] = rheaders["Retry-After"]
+        return status, payload, fwd
+
+    def _attempt(
+        self, st: Optional[_ReplicaState], path: str, body: bytes,
+        headers: dict,
+    ) -> tuple[int, bytes, dict]:
+        """One proxied attempt against one replica — fully
+        self-accounting (breaker verdict, in-flight count, latency
+        window), so the dispatch race can abandon it safely."""
+        if st is None:
+            raise _AttemptFailed("replica vanished from routing state")
+        breaker = st.breaker
+        if not breaker.allow():
+            raise _AttemptFailed(f"breaker open for {st.info.id}")
+        # re-stamp the REMAINING budget at send time (not dispatch
+        # time): a hedge fired 200 ms in hands the replica 200 ms less
+        rem = _deadline.remaining()
+        if rem is not None:
+            if rem <= 0:
+                breaker.release_probe()
+                raise _AttemptFailed("deadline expired before attempt")
+            headers[_deadline.HEADER] = str(max(0, int(rem * 1000)))
+        st.enter()
+        t0 = time.perf_counter()
+        verdict = False
+        latency: Optional[float] = None
+        try:
+            try:
+                # connect() lives inside the failure scope too: a
+                # refused connection to a crashed replica is exactly
+                # the failover trigger
+                conn = self._replica_conn(st.info.id, st.info.url)
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                rheaders = {
+                    k: v for k, v in resp.getheaders()
+                    if k.lower() == "retry-after"
+                }
+            except (http.client.HTTPException, OSError) as e:
+                self._drop_conn(st.info.id)
+                breaker.record_failure()
+                verdict = True
+                raise _AttemptFailed(str(e)) from e
+            breaker.record_success()
+            verdict = True
+            latency = time.perf_counter() - t0
+            return resp.status, data, rheaders
+        finally:
+            if not verdict:
+                breaker.release_probe()
+            st.exit(latency)
+
+    # per-thread keep-alive connections, one per replica (the
+    # RemoteClient pattern — proxy threads are pooled, so the map stays
+    # bounded at pool_size x replicas). Keyed by (rid, url): a replica
+    # that re-registers at a new URL after a crash-restart must not be
+    # reached through a cached conn to its old address — every pooled
+    # thread would fail over, re-tripping the breaker the health probe
+    # just closed.
+    def _replica_conn(self, rid: str, url: str) -> http.client.HTTPConnection:
+        conns = getattr(self._tl, "conns", None)
+        if conns is None:
+            conns = self._tl.conns = {}
+        cached = conns.get(rid)
+        if cached is not None and cached[0] == url:
+            return cached[1]
+        if cached is not None:
+            try:
+                cached[1].close()
+            except Exception:
+                pass
+        import socket as _socket
+
+        host, port = self._host_port(url)
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.config.attempt_timeout_s
+        )
+        conn.connect()
+        conn.sock.setsockopt(
+            _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+        )
+        conns[rid] = (url, conn)
+        return conn
+
+    def _drop_conn(self, rid: str) -> None:
+        conns = getattr(self._tl, "conns", None)
+        if conns is not None:
+            cached = conns.pop(rid, None)
+            if cached is not None:
+                try:
+                    cached[1].close()
+                except Exception:
+                    pass
+
+    # -- drain / status ----------------------------------------------------
+    def drain_replica(self, replica_id: str) -> dict:
+        """Operator-initiated graceful drain: flag the record so every
+        gateway stops routing, then tell the replica to finish its
+        in-flight queries and stop."""
+        _ring, states = self._route_snapshot()
+        st = states.get(replica_id)
+        if st is None:
+            raise KeyError(replica_id)
+        st.info.draining = True  # local effect now, record next sync
+        try:
+            self.registry.set_draining(replica_id, True)
+        except Exception:
+            log.warning(
+                "drain flag write failed; relying on the replica's own "
+                "record update", exc_info=True,
+            )
+        url = st.info.url
+
+        def tell():
+            try:
+                conn = http.client.HTTPConnection(
+                    *self._host_port(url), timeout=5
+                )
+                try:
+                    conn.request(
+                        "POST", "/replica/drain", body=b"{}",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    conn.getresponse().read()
+                finally:
+                    conn.close()
+            except Exception:
+                log.warning(
+                    "drain notify to %s failed (replica may already be "
+                    "down)", replica_id, exc_info=True,
+                )
+            finally:
+                with self._hint_lock:
+                    self._hint_threads.discard(threading.current_thread())
+
+        t = threading.Thread(target=tell, name="gateway-hint", daemon=True)
+        with self._hint_lock:
+            self._hint_threads.add(t)
+        t.start()
+        self.sync_once()
+        return {"replica": replica_id, "draining": True}
+
+    def status(self) -> dict[str, Any]:
+        ring, states = self._route_snapshot()
+        replicas = []
+        for rid in sorted(states):
+            st = states[rid]
+            p95 = st.p95_s()
+            replicas.append({
+                "id": rid,
+                "url": st.info.url,
+                "routable": st.routable(),
+                "eject_reasons": st.eject_reasons(),
+                "breaker": st.breaker.state,
+                "inflight": st.inflight_now(),
+                "p95_ms": None if p95 is None else round(p95 * 1e3, 2),
+                "draining": st.info.draining,
+                "serve_dtype": st.info.serve_dtype,
+                "engines": list(st.info.engines),
+                "heartbeat_age_s": round(
+                    max(0.0, time.time() - st.info.heartbeat_at), 1
+                ),
+            })
+        out: dict[str, Any] = {
+            "replicas": replicas,
+            "routable": sum(1 for r in replicas if r["routable"]),
+            "ring_size": len(ring),
+            "hedge": {
+                "enabled": self.config.hedge,
+                "min_ms": self.config.hedge_min_ms,
+            },
+            "load_factor": self.config.load_factor,
+        }
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.status()
+        return out

@@ -39,9 +39,12 @@ from predictionio_tpu.obs.monitor.slo import (
 from predictionio_tpu.obs.monitor.tsdb import (
     TSDB,
     MetricsSampler,
+    SnapshotWriter,
+    load_snapshot,
     sample_families,
+    save_snapshot,
 )
-from predictionio_tpu.utils.env import env_float
+from predictionio_tpu.utils.env import env_float, env_path
 from predictionio_tpu.utils.env import env_bool
 
 __all__ = [
@@ -53,12 +56,15 @@ __all__ = [
     "SLOSpec",
     "AlertStatus",
     "Monitor",
+    "SnapshotWriter",
     "enabled",
     "get_monitor",
     "load_slos",
+    "load_snapshot",
     "parse_prometheus_text",
     "parse_targets",
     "sample_families",
+    "save_snapshot",
 ]
 
 
@@ -82,11 +88,28 @@ class Monitor:
             capacity=int(env_float("PIO_TSDB_POINTS", 720)),
             max_series=int(env_float("PIO_TSDB_MAX_SERIES", 4096)),
         )
+        # snapshot persistence (ISSUE 15 satellite): with a path
+        # configured, history survives restarts — reload here, persist
+        # periodically (and on last detach) below
+        self.snapshot_path = env_path("PIO_TSDB_SNAPSHOT") or None
+        self.snapshot_interval_s = env_float(
+            "PIO_TSDB_SNAPSHOT_INTERVAL_S", 60.0
+        )
+        if self.snapshot_path and enabled():
+            restored = load_snapshot(self.tsdb, self.snapshot_path)
+            if restored:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "restored %d TSDB series from %s",
+                    restored, self.snapshot_path,
+                )
         self._lock = threading.Lock()
         self._attached: list[tuple[int, str, Any]] = []  # (token, label, reg)
         self._next_token = 1
         self._sampler: Optional[MetricsSampler] = None
         self._engine: Optional[SLOEngine] = None
+        self._snapshotter: Optional[SnapshotWriter] = None
         self._slos: list[SLOSpec] = load_slos()
         # push sinks (ISSUE 9 satellite): webhook/exec fired on
         # pending→firing (and resolve) transitions — SLO alerts AND the
@@ -137,7 +160,7 @@ class Monitor:
     def detach(self, token: Optional[int]) -> None:
         if token is None:
             return
-        stop_sampler = stop_engine = None
+        stop_sampler = stop_engine = stop_snapshotter = None
         with self._lock:
             self._attached = [
                 row for row in self._attached if row[0] != token
@@ -145,11 +168,16 @@ class Monitor:
             if not self._attached:
                 stop_sampler, self._sampler = self._sampler, None
                 stop_engine, self._engine = self._engine, None
+                stop_snapshotter, self._snapshotter = (
+                    self._snapshotter, None
+                )
         # join OUTSIDE the lock: the threads' loops call back into us
         if stop_engine is not None:
             stop_engine.stop()
         if stop_sampler is not None:
             stop_sampler.stop()
+        if stop_snapshotter is not None:
+            stop_snapshotter.stop()  # also writes the final snapshot
         if stop_engine is not None or stop_sampler is not None:
             # last detach also joins in-flight alert deliveries — a
             # notification thread must not outlive the plane (ISSUE 12)
@@ -170,6 +198,12 @@ class Monitor:
                     on_transition=self._on_transition,
                 )
                 self._engine.start()
+            if self._snapshotter is None and self.snapshot_path:
+                self._snapshotter = SnapshotWriter(
+                    self.tsdb, self.snapshot_path,
+                    interval_s=self.snapshot_interval_s,
+                )
+                self._snapshotter.start()
 
     # -- SLOs --------------------------------------------------------------
     def set_slos(self, specs: list[SLOSpec]) -> None:

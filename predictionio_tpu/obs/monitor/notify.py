@@ -22,6 +22,7 @@ import shlex
 import threading
 from typing import Any, Optional
 
+from predictionio_tpu.analysis import tsan as _tsan
 from predictionio_tpu.utils.env import env_str
 
 log = logging.getLogger(__name__)
@@ -110,6 +111,10 @@ class AlertNotifier:
     def _post(self, payload: str) -> None:
         import urllib.request
 
+        # blocking point (ISSUE 15 satellite): webhook delivery is a
+        # network wait — a caller's lock held into notify() delivery
+        # would serialize alerting behind a wedged sink
+        _tsan.note_blocking("alert.sink")
         try:
             req = urllib.request.Request(
                 self.webhook_url,
@@ -127,6 +132,7 @@ class AlertNotifier:
     def _exec(self, payload: str) -> None:
         import subprocess
 
+        _tsan.note_blocking("alert.sink")
         try:
             argv = shlex.split(self.exec_cmd)
             proc = subprocess.run(

@@ -273,6 +273,151 @@ class TSDB:
             self.dropped_series = 0
 
 
+# -- snapshot persistence (ISSUE 15 satellite) -------------------------------
+#
+# The rings are process memory: a monitor (or gateway) restart used to
+# forget every up{instance} / burn-rate point it ever saw — the SLO
+# engine's slow window went blind for an hour and the gateway's health
+# history reset to zero exactly when an operator most needs it. Like
+# the event WAL, the fix is a bounded on-disk image: periodically
+# serialize the rings (atomic tmp+rename, size-capped by dropping the
+# OLDEST points per series first), reload on start, and tolerate a
+# corrupt/truncated file by starting empty — history is an
+# observability aid, never worth refusing to boot over.
+
+SNAPSHOT_VERSION = 1
+
+
+def save_snapshot(tsdb: TSDB, path: str,
+                  max_bytes: int = 8 * 1024 * 1024) -> int:
+    """Write the TSDB's rings to `path` (atomic replace). Returns the
+    bytes written. The file is bounded: per-series points shrink
+    (newest kept) until the serialized image fits `max_bytes`."""
+    import json
+    import os
+
+    with tsdb._lock:
+        rows = [
+            {
+                "name": s.name,
+                "labels": s.labels_dict(),
+                "kind": s.kind,
+                "points": [[round(t, 3), v] for t, v in s.points],
+            }
+            for s in tsdb._series.values()
+        ]
+    cap = max((len(r["points"]) for r in rows), default=0)
+    while True:
+        data = json.dumps({
+            "v": SNAPSHOT_VERSION,
+            "saved_at": time.time(),
+            "capacity": tsdb.capacity,
+            "series": rows,
+        }, separators=(",", ":")).encode()
+        if len(data) <= max_bytes or cap <= 2:
+            break
+        cap = max(2, cap // 2)
+        rows = [
+            dict(r, points=r["points"][-cap:]) for r in rows
+        ]
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(data)
+
+
+def load_snapshot(tsdb: TSDB, path: str) -> int:
+    """Reload a snapshot into `tsdb`; returns series restored. A
+    missing, corrupt, or future-versioned file loads nothing (warned,
+    never raised) — a bad snapshot must not take the process down."""
+    import json
+    import logging
+
+    log = logging.getLogger(__name__)
+    try:
+        with open(path, "rb") as f:
+            payload = json.loads(f.read())
+        if payload.get("v") != SNAPSHOT_VERSION:
+            log.warning(
+                "ignoring TSDB snapshot %s: unknown version %r",
+                path, payload.get("v"),
+            )
+            return 0
+        loaded = 0
+        for row in payload["series"]:
+            name, labels = row["name"], row["labels"]
+            kind = row.get("kind", "gauge")
+            ok = True
+            for t, v in row["points"]:
+                ok = tsdb.add(name, labels, float(v), kind, float(t))
+                if not ok:
+                    break  # cardinality cap: counted by add()
+            if ok:
+                loaded += 1
+        return loaded
+    except FileNotFoundError:
+        return 0
+    except Exception:
+        log.warning(
+            "ignoring corrupt TSDB snapshot %s (starting with empty "
+            "history)", path, exc_info=True,
+        )
+        return 0
+
+
+class SnapshotWriter:
+    """Background thread persisting the rings every `interval_s`; a
+    final snapshot lands on stop() (which joins — the no-leaked-threads
+    contract every monitor thread follows)."""
+
+    thread_name = "tsdb-snapshot"
+
+    def __init__(self, tsdb: TSDB, path: str, interval_s: float = 60.0,
+                 max_bytes: int = 8 * 1024 * 1024):
+        self.tsdb = tsdb
+        self.path = path
+        self.interval_s = max(0.05, float(interval_s))
+        self.max_bytes = int(max_bytes)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def write_once(self) -> int:
+        try:
+            return save_snapshot(self.tsdb, self.path, self.max_bytes)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "TSDB snapshot write failed; history continues "
+                "in-memory", exc_info=True,
+            )
+            return 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=self.thread_name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+            self.write_once()  # final image so a clean stop loses nothing
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.write_once()
+
+
 # -- the in-process sampler --------------------------------------------------
 
 #: quantiles materialized per histogram child at each sample tick

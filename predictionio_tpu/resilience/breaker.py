@@ -9,10 +9,19 @@ one probe flies at a time — concurrent callers keep failing fast until
 the probe reports.
 
 Every state transition lands on the metrics registry:
-`resilience_breaker_state{endpoint}` (0 closed / 1 open / 2 half-open)
-and `resilience_breaker_transitions_total{endpoint,state}` — the
-acceptance surface `/metrics` scrapes. Call sites additionally stamp the
-state onto their spans (`storage.rpc` carries `breaker_state`).
+`resilience_breaker_state{endpoint,dao}` (0 closed / 1 open / 2
+half-open) and `resilience_breaker_transitions_total{endpoint,dao,state}`
+— the acceptance surface `/metrics` scrapes. Call sites additionally
+stamp the state onto their spans (`storage.rpc` carries
+`breaker_state`).
+
+Breakers key by endpoint **and DAO** (ISSUE 15 satellite, the carried
+PR-4 follow-up): one storage daemon fronts several DAO tables, and an
+events-table outage (a wedged events ingest path, a partial schema
+migration) must fail fast ONLY the events path — the metadata DAO on
+the same daemon keeps answering, so the query server can still resolve
+tenants and models while ingestion is dark. Non-DAO breakers (the
+gateway's per-replica ones) leave `dao` empty.
 """
 
 from __future__ import annotations
@@ -40,8 +49,10 @@ class CircuitBreaker:
         cooldown_s: float = 10.0,
         registry=None,
         clock: Callable[[], float] = time.monotonic,
+        dao: str = "",
     ):
         self.name = name
+        self.dao = dao
         self.failure_threshold = max(1, int(failure_threshold))
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
@@ -57,16 +68,16 @@ class CircuitBreaker:
         self._state_gauge = registry.gauge(
             "resilience_breaker_state",
             "circuit breaker state (0 closed, 1 open, 2 half-open)",
-            # label-bound: one endpoint per configured storage source
-            ("endpoint",),
+            # label-bound: configured storage sources x fixed DAO set
+            ("endpoint", "dao"),
         )
         self._transitions = registry.counter(
             "resilience_breaker_transitions_total",
             "circuit breaker state transitions, by destination state",
-            # label-bound: configured storage sources x literal states
-            ("endpoint", "state"),
+            # label-bound: configured storage sources x DAOs x states
+            ("endpoint", "dao", "state"),
         )
-        self._state_gauge.set(0.0, endpoint=name)
+        self._state_gauge.set(0.0, endpoint=name, dao=dao)
 
     @property
     def state(self) -> str:
@@ -137,8 +148,12 @@ class CircuitBreaker:
             self._opened_at = None
             self._failures = 0
         try:
-            self._state_gauge.set(_STATE_VALUE[to], endpoint=self.name)
-            self._transitions.inc(endpoint=self.name, state=to)
+            self._state_gauge.set(
+                _STATE_VALUE[to], endpoint=self.name, dao=self.dao
+            )
+            self._transitions.inc(
+                endpoint=self.name, dao=self.dao, state=to
+            )
         except Exception:
             pass  # metrics hiccups must never break the call path
 

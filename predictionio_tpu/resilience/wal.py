@@ -39,6 +39,8 @@ import time
 import uuid
 from typing import Any, Callable, Optional
 
+from predictionio_tpu.analysis import tsan as _tsan
+
 
 class EventWAL:
     def __init__(self, directory: str, fsync: bool = True):
@@ -46,6 +48,12 @@ class EventWAL:
         self.fsync = fsync
         os.makedirs(self.dir, exist_ok=True)
         self._lock = threading.Lock()  # append path + pending counter
+        # sanitizer (ISSUE 15 satellite): the append lock is HELD
+        # across the spill fsync by design — the fsync-before-ack
+        # ordering and the pending counter are one critical section;
+        # declaring it keeps the note_blocking hook below pointed at
+        # OTHER locks callers might wrongly hold across a spill
+        _tsan.allow_blocking_lock(self._lock)
         self._replay_lock = threading.Lock()  # one replayer at a time
         self._seq = 0
         self._current_path: Optional[str] = None
@@ -141,6 +149,10 @@ class EventWAL:
             self._current_file.write(line)
             self._current_file.flush()
             if self.fsync:
+                # blocking point (ISSUE 15 satellite): a lock held
+                # across a WAL fsync serializes every waiter behind
+                # one disk flush
+                _tsan.note_blocking("wal.fsync")
                 os.fsync(self._current_file.fileno())
             self._pending += 1
         return req_id
@@ -196,6 +208,7 @@ class EventWAL:
                             ack_f.write(rec["req_id"] + "\n")
                             ack_f.flush()
                             if self.fsync:
+                                _tsan.note_blocking("wal.fsync")
                                 os.fsync(ack_f.fileno())
                             with self._lock:
                                 self._pending -= 1
@@ -290,6 +303,7 @@ class EventWAL:
                             )
                             ack_f.flush()
                             if self.fsync:
+                                _tsan.note_blocking("wal.fsync")
                                 os.fsync(ack_f.fileno())
                             with self._lock:
                                 self._pending -= len(chunk)

@@ -481,10 +481,12 @@ class TenantMux:
         self.quota.release(tenant_id)
 
     # -- routing ------------------------------------------------------------
-    def route(self, tenant: Tenant, raw_request: bytes):
+    def route(self, tenant: Tenant, raw_request: bytes, bucket=None):
         """→ (runtime, variant, cache_lease). Candidate traffic rides
         the tenant's active rollout fraction, sticky by request hash —
-        the exact sticky_candidate the single-tenant path uses."""
+        the exact sticky_candidate the single-tenant path uses.
+        `bucket` (ISSUE 15) is the gateway's pre-computed routing hash,
+        so replicas behind a gateway agree on the canary decision."""
         from predictionio_tpu.deploy.rollout import sticky_candidate
 
         host = self._hosts.get(tenant.id)
@@ -494,7 +496,9 @@ class TenantMux:
                 candidate is not None
                 and rollout is not None
                 and not rollout.config.shadow
-                and sticky_candidate(raw_request, rollout.config.fraction)
+                and sticky_candidate(
+                    raw_request, rollout.config.fraction, bucket=bucket
+                )
             ):
                 return candidate, "candidate", None
         entry = self.cache.acquire(tenant)

@@ -1260,6 +1260,78 @@ def cmd_fleet(args) -> int:
         return 0
 
 
+def cmd_gateway(args) -> int:
+    """`pio gateway run|status|replicas|drain` — the replicated serving
+    tier's L7 router (ISSUE 15). `run` serves; `status` prints a running
+    gateway's view (--url) ; `replicas` lists the shared registry's
+    replica records; `drain` gracefully retires one replica."""
+    import json as _json
+
+    if args.gateway_action == "run":
+        from predictionio_tpu.gateway import (
+            Autoscaler,
+            AutoscalerConfig,
+            GatewayConfig,
+            GatewayServer,
+        )
+
+        storage = _storage()
+        cfg = GatewayConfig(ip=args.ip, port=args.port)
+        if args.no_hedge:
+            cfg.hedge = False
+        autoscaler = None
+        if args.autoscale:
+            # policy without a manager: decisions are logged + counted
+            # (gateway_scale_events_total) for an external actuator to
+            # consume; the subprocess manager is a test/bench tool
+            autoscaler = Autoscaler(None, AutoscalerConfig(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+            ))
+        gw = GatewayServer(storage, cfg, autoscaler=autoscaler)
+        port = gw.start()
+        print(f"[INFO] gateway listening on {args.ip}:{port}")
+        import threading as _threading
+
+        try:
+            _threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            gw.stop()
+        return 0
+    if args.gateway_action == "replicas":
+        from predictionio_tpu.gateway import ReplicaRegistry
+
+        import time as _time
+
+        rows = ReplicaRegistry(_storage()).list()
+        if not rows:
+            print("[INFO] no replica records")
+            return 0
+        now = _time.time()
+        for r in sorted(rows, key=lambda r: r.id):
+            age = max(0.0, now - r.heartbeat_at)
+            print(
+                f"[INFO] {r.id}: {r.url} engines={','.join(r.engines) or '-'} "
+                f"dtype={r.serve_dtype} heartbeat_age={age:.1f}s"
+                f"{' DRAINING' if r.draining else ''}"
+            )
+        return 0
+    base = args.url or "http://127.0.0.1:8100"
+    if args.gateway_action == "status":
+        print(_json.dumps(
+            _server_call(base, "/gateway/status"), indent=2
+        ))
+        return 0
+    # drain
+    result = _server_call(
+        base, "/gateway/drain", {"replica": args.replica}
+    )
+    print(f"[INFO] drain initiated: {_json.dumps(result)}")
+    return 0
+
+
 def cmd_models(args) -> int:
     """`pio models list|show|promote|rollback|gc` — the version registry."""
     from predictionio_tpu.deploy.registry import ModelRegistry
@@ -1966,6 +2038,39 @@ def build_parser() -> argparse.ArgumentParser:
     fw.add_argument("--process-id", type=int, default=0,
                     help="this worker's process id")
     fw.set_defaults(func=cmd_fleet)
+
+    s = sub.add_parser(
+        "gateway",
+        help="replicated serving tier: L7 router with health-aware "
+             "routing, hedged queries, and closed-loop autoscaling",
+    )
+    gsub = s.add_subparsers(dest="gateway_action", required=True)
+    gr = gsub.add_parser("run", help="run the gateway process")
+    gr.add_argument("--ip", default="0.0.0.0")
+    gr.add_argument("--port", type=int, default=8100)
+    gr.add_argument("--no-hedge", action="store_true",
+                    help="disable speculative hedged queries")
+    gr.add_argument("--autoscale", action="store_true",
+                    help="run the autoscaler policy (decision log + "
+                         "gateway_scale_events_total)")
+    gr.add_argument("--min-replicas", type=int, default=1)
+    gr.add_argument("--max-replicas", type=int, default=8)
+    gr.set_defaults(func=cmd_gateway)
+    gs = gsub.add_parser("status", help="a running gateway's fleet view")
+    gs.add_argument("--url", default=None,
+                    help="gateway base URL (default http://127.0.0.1:8100)")
+    gs.set_defaults(func=cmd_gateway)
+    gl = gsub.add_parser(
+        "replicas", help="replica records in the shared registry"
+    )
+    gl.set_defaults(func=cmd_gateway)
+    gd = gsub.add_parser(
+        "drain", help="gracefully retire one replica (zero-drop)"
+    )
+    gd.add_argument("replica", help="replica id to drain")
+    gd.add_argument("--url", default=None,
+                    help="gateway base URL (default http://127.0.0.1:8100)")
+    gd.set_defaults(func=cmd_gateway)
 
     s = sub.add_parser(
         "models", help="model version registry"

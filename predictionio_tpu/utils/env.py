@@ -106,6 +106,25 @@ _k("PIO_TENANT_SYNC_S", "float", 10.0,
 _k("PIO_TENANT_METRIC_MAX", "int", 50,
    "Distinct tenant label values before metrics collapse to (other).")
 
+# -- gateway / replicated serving (ISSUE 15) ---------------------------------
+_k("PIO_GATEWAY_SYNC_S", "float", 0.5,
+   "Seconds between gateway discovery/health sync passes.")
+_k("PIO_GATEWAY_STALE_S", "float", 3.0,
+   "Replica heartbeat age (s) past which the gateway stops routing "
+   "to it.")
+_k("PIO_GATEWAY_HEDGE", "bool", True,
+   "Hedged queries: speculate to the next replica at the p95 mark.")
+_k("PIO_GATEWAY_HEDGE_MIN_MS", "float", 25.0,
+   "Floor (ms) on the hedge delay while a replica's latency window "
+   "is cold.")
+_k("PIO_GATEWAY_LOAD_FACTOR", "float", 1.5,
+   "Bounded-load consistent hashing: skip replicas over factor x the "
+   "mean in-flight load.")
+_k("PIO_GATEWAY_VNODES", "int", 64,
+   "Virtual nodes per replica on the consistent-hash ring.")
+_k("PIO_REPLICA_HEARTBEAT_S", "float", 1.0,
+   "Seconds between a replica's registry heartbeats.")
+
 # -- online learning ---------------------------------------------------------
 _k("PIO_ONLINE_TICK_S", "float", 0.5,
    "Seconds between online fold-in consumer ticks.")
@@ -160,6 +179,10 @@ _k("PIO_MONITOR_TARGETS", "str", "",
    "scraper (pio monitor, dashboard).")
 _k("PIO_SCRAPE_INTERVAL_S", "float", 10.0,
    "Seconds between fleet-scraper /metrics polls.")
+_k("PIO_TSDB_SNAPSHOT", "path", "",
+   "Path persisting the TSDB rings across restarts (empty = off).")
+_k("PIO_TSDB_SNAPSHOT_INTERVAL_S", "float", 60.0,
+   "Seconds between TSDB snapshot writes.")
 _k("PIO_ALERT_WEBHOOK", "str", "",
    "URL POSTed one JSON alert per SLO/external alert transition.")
 _k("PIO_ALERT_EXEC", "str", "",

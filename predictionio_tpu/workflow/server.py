@@ -211,6 +211,13 @@ class _Handler(JsonHandler):
                 self._respond(200, self.server.owner.online_status())
             elif path == "/fleet/status":
                 self._respond(200, self.server.owner.fleet_serving_status())
+            elif path == "/health":
+                # cheap liveness for the gateway's active probes (the
+                # status page renders HTML and walks the runtime; a
+                # probe must cost neither)
+                self._respond(200, {"status": "alive"})
+            elif path == "/replica/status":
+                self._respond(200, self.server.owner.replica_status())
             elif path == "/tenants" or path.startswith("/tenants/"):
                 self._tenants_get(path)
             elif path == "/metrics":
@@ -268,6 +275,35 @@ class _Handler(JsonHandler):
             except Exception as e:
                 log.exception("reload failed")
                 self._respond(500, {"message": str(e)})
+        elif path == "/replica/drain":
+            # graceful drain (ISSUE 15): the gateway (or an operator)
+            # retires this replica — flag the registry record so
+            # routing stops, finish in-flight queries, then stop
+            owner = self.server.owner
+            if owner.replica is None:
+                self._respond(
+                    404, {"message": "not a replica (no member attached)"}
+                )
+            elif owner.replica.drain():
+                self._respond(202, owner.replica_status())
+            else:
+                self._respond(409, {"message": "already draining"})
+        elif path == "/replica/prefetch":
+            # scale-up warm-start (ISSUE 15): the gateway tells a
+            # JOINING replica which tenants will hash onto it, so the
+            # first real query is a cache hit instead of a model load
+            owner = self.server.owner
+            body = self._json_body()
+            tenants = (
+                body.get("tenants") if isinstance(body, dict) else None
+            ) or []
+            if not isinstance(tenants, list):
+                self._respond(400, {"message": "'tenants' must be a list"})
+            else:
+                accepted = owner.prefetch_tenants(
+                    [str(t) for t in tenants]
+                )
+                self._respond(200, {"accepted": accepted})
         elif path in ("/online/pause", "/online/resume"):
             owner = self.server.owner
             if owner.online is None:
@@ -404,9 +440,31 @@ class _Handler(JsonHandler):
         self._respond(404, {"message": "Not Found"})
 
     def _queries(self, tenant_id: Optional[str] = None):
+        """In-flight accounting wrapper: graceful drain (ISSUE 15)
+        waits for this count to reach zero before the replica stops,
+        so a retiring replica answers everything it admitted."""
+        owner = self.server.owner
+        owner.inflight_enter()
+        try:
+            self._queries_inner(tenant_id)
+        finally:
+            owner.inflight_exit()
+
+    def _queries_inner(self, tenant_id: Optional[str] = None):
         """The serving hot path (reference CreateServer.scala:490-613)."""
         owner = self.server.owner
         t0 = time.perf_counter()
+        # sticky routing bucket (ISSUE 15): a gateway fronting this
+        # replica computes crc32(body) % 10000 ONCE and forwards it, so
+        # every replica (and every hedged retry) makes the same canary
+        # decision; absent the header, the replica hashes locally
+        bucket: Optional[int] = None
+        rh = self.headers.get("X-PIO-Route-Hash")
+        if rh:
+            try:
+                bucket = int(rh) % 10_000
+            except ValueError:
+                bucket = None
         # load shedding (ISSUE 4): a query whose propagated deadline
         # (X-PIO-Deadline, set as the ambient deadline by JsonHandler)
         # already passed is refused BEFORE parsing, batching, or device
@@ -496,11 +554,15 @@ class _Handler(JsonHandler):
                 from predictionio_tpu.tenancy import ModelLoadError
 
                 try:
-                    rt, variant, lease = mux.route(tenant, self._raw_body)
+                    rt, variant, lease = mux.route(
+                        tenant, self._raw_body, bucket=bucket
+                    )
                 except ModelLoadError as e:
                     raise _HttpError(503, str(e))
             else:
-                rt, variant = owner.pick_runtime(self._raw_body)
+                rt, variant = owner.pick_runtime(
+                    self._raw_body, bucket=bucket
+                )
             custom_from = getattr(
                 rt.query_serializer, "query_from_json", None
             )
@@ -564,7 +626,8 @@ class _Handler(JsonHandler):
                 # are single-tenant surfaces; tenant traffic must not
                 # leak into the server rollout's agreement windows
                 owner.maybe_shadow(
-                    self._raw_body, query_json, shadow_reference
+                    self._raw_body, query_json, shadow_reference,
+                    bucket=bucket,
                 )
                 owner.feedback_async(query_json, result)
             for plugin in owner.output_sniffers:
@@ -1271,6 +1334,14 @@ class QueryServer(ServerProcess):
         self.rollout = None  # Optional[RolloutController]  # guarded-by: _swap_lock
         self.tenancy = None  # Optional[TenantMux] (ISSUE 6)
         self.online = None  # Optional[OnlineConsumer] (ISSUE 9)
+        self.replica = None  # Optional[ReplicaMember] (ISSUE 15)
+        # in-flight query count (ISSUE 15): graceful drain waits on it
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0  # guarded-by: _inflight_lock
+        # in-flight tenant-prefetch warm threads (ISSUE 15): tracked so
+        # stop() joins them, same discipline as the feedback threads
+        self._prefetch_lock = threading.Lock()
+        self._prefetch_threads: set[threading.Thread] = set()  # guarded-by: _prefetch_lock
         self.last_serving_sec = 0.0
         self.last_predict_sec = 0.0
         # in-flight feedback POST threads: tracked so stop() joins them
@@ -1306,6 +1377,11 @@ class QueryServer(ServerProcess):
         return port
 
     def stop(self) -> None:
+        if self.replica is not None:
+            # deregister + join the heartbeat thread BEFORE the server
+            # goes down, so the gateway never routes to a dead port
+            # that still looks alive in the registry
+            self.replica.stop()
         if self.online is not None:
             # the consumer thread joins on server stop — same discipline
             # as the monitor/mux/dispatcher threads (ISSUE 9 CI guard)
@@ -1323,6 +1399,10 @@ class QueryServer(ServerProcess):
             pending_feedback = list(self._feedback_threads)
         for t in pending_feedback:
             t.join(timeout=11)  # POST timeout is 10s
+        with self._prefetch_lock:
+            pending_prefetch = list(self._prefetch_threads)
+        for t in pending_prefetch:
+            t.join(timeout=5)
         super().stop()  # also detaches the log shipper (ServerProcess)
 
     def _make_server(self) -> _Server:
@@ -1362,11 +1442,14 @@ class QueryServer(ServerProcess):
         self._shed_counter.inc(reason=reason)
 
     # -- canary rollout (ISSUE 5) ------------------------------------------
-    def pick_runtime(self, raw_request: bytes) -> tuple[EngineRuntime, str]:
+    def pick_runtime(
+        self, raw_request: bytes, bucket: Optional[int] = None
+    ) -> tuple[EngineRuntime, str]:
         """Route one request: a sticky hash-of-request fraction lands on
         the candidate while a non-shadow rollout is active. Snapshot the
         references ONCE — a concurrent swap must not split a request
-        across two runtimes."""
+        across two runtimes. `bucket` (ISSUE 15) is the gateway's
+        pre-computed routing hash when one fronts this replica."""
         from predictionio_tpu.deploy.rollout import sticky_candidate
 
         candidate, rollout = self.candidate, self.rollout
@@ -1374,7 +1457,9 @@ class QueryServer(ServerProcess):
             candidate is not None
             and rollout is not None
             and not rollout.config.shadow
-            and sticky_candidate(raw_request, rollout.config.fraction)
+            and sticky_candidate(
+                raw_request, rollout.config.fraction, bucket=bucket
+            )
         ):
             return candidate, "candidate"
         return self.runtime, "live"
@@ -1387,6 +1472,80 @@ class QueryServer(ServerProcess):
             return "candidate"
         return "live"
 
+    # -- replica membership (ISSUE 15) -------------------------------------
+    def inflight_enter(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def inflight_exit(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    @property
+    def inflight_queries(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def attach_replica(self, member) -> None:
+        """Join the replicated serving tier: register the heartbeating
+        replica record and adopt the durable replica identity — which
+        also scopes any online fold-in cursor attached afterwards, so N
+        replicas on one stream never share a cursor."""
+        self.replica = member
+        member.start()
+
+    def replica_status(self) -> dict:
+        if self.replica is None:
+            return {"state": "detached"}
+        return dict(self.replica.status(), state="attached")
+
+    def prefetch_tenants(self, tenant_ids: list[str]) -> list[str]:
+        """Warm the tenant model cache off the serving path (the
+        gateway's scale-up hint). Best-effort: unknown tenants and
+        failed loads are skipped — the replica must come up regardless."""
+        mux = self.tenancy
+        if mux is None or not tenant_ids:
+            return []
+        accepted = [str(t) for t in tenant_ids[:64]]
+
+        def warm():
+            try:
+                for tid in accepted:
+                    try:
+                        tenant = mux.admit(tid)
+                    except Exception:
+                        log.debug(
+                            "prefetch warm of tenant %r failed", tid,
+                            exc_info=True,
+                        )
+                        continue
+                    # admit holds a concurrency slot until done — a
+                    # failed model load must still release it or the
+                    # tenant's quota leaks one slot per failed warm
+                    lease = None
+                    try:
+                        _rt, _variant, lease = mux.route(tenant, b"")
+                    except Exception:
+                        log.debug(
+                            "prefetch warm of tenant %r failed", tid,
+                            exc_info=True,
+                        )
+                    finally:
+                        mux.done(tid, lease)
+            finally:
+                with self._prefetch_lock:
+                    self._prefetch_threads.discard(
+                        threading.current_thread()
+                    )
+
+        t = threading.Thread(
+            target=warm, name="tenant-prefetch", daemon=True
+        )
+        with self._prefetch_lock:
+            self._prefetch_threads.add(t)
+        t.start()
+        return accepted
+
     # -- online learning (ISSUE 9) -----------------------------------------
     def attach_online(
         self, app_id: int, config=None, channel_id: Optional[int] = None,
@@ -1394,9 +1553,15 @@ class QueryServer(ServerProcess):
     ):
         """Attach a streaming fold-in consumer: events for `app_id` tail
         into this server's live runtime between retrains. Pass a
-        pre-built `consumer` to override the default wiring (tests)."""
+        pre-built `consumer` to override the default wiring (tests).
+
+        With a replica member attached (ISSUE 15), the DEFAULT cursor
+        record name gains the durable replica id — two replicas folding
+        the same stream automatically use distinct single-writer
+        cursors instead of relying on the operator to name them."""
         from predictionio_tpu.online import (
             OnlineConsumer,
+            OnlineConsumerConfig,
             ServerApplyHost,
         )
 
@@ -1411,10 +1576,22 @@ class QueryServer(ServerProcess):
                     "tick?); refusing to start a second writer on its "
                     "cursor"
                 )
-        self.online = consumer or OnlineConsumer(
-            self.storage, ServerApplyHost(self), app_id,
-            config=config, channel_id=channel_id, metrics=self.metrics,
-        )
+        if consumer is None:
+            config = config or OnlineConsumerConfig()
+            if config.name is None and self.replica is not None:
+                config = dataclasses.replace(
+                    config,
+                    name=(
+                        f"online/{app_id}/server"
+                        f"@{self.replica.replica_id}"
+                    ),
+                )
+            consumer = OnlineConsumer(
+                self.storage, ServerApplyHost(self), app_id,
+                config=config, channel_id=channel_id,
+                metrics=self.metrics,
+            )
+        self.online = consumer
         self.online.start()
         return self.online
 
@@ -1487,7 +1664,10 @@ class QueryServer(ServerProcess):
         if rollout is not None:
             rollout.record(variant, seconds, error)
 
-    def maybe_shadow(self, raw: bytes, query_json: Any, result: Any) -> None:
+    def maybe_shadow(
+        self, raw: bytes, query_json: Any, result: Any,
+        bucket: Optional[int] = None,
+    ) -> None:
         """Shadow mode: mirror a fraction of live traffic to the
         candidate OFF the response path and score result agreement.
         The mirror runs the CANDIDATE's full serving path — its own
@@ -1502,7 +1682,9 @@ class QueryServer(ServerProcess):
             candidate is None
             or rollout is None
             or not rollout.config.shadow
-            or not sticky_candidate(raw, rollout.config.fraction)
+            or not sticky_candidate(
+                raw, rollout.config.fraction, bucket=bucket
+            )
             or not rollout.try_shadow()
         ):
             return

@@ -294,7 +294,7 @@ def test_breaker_opens_then_half_open_probe_recovers(tmp_path):
         conn.close()
         store._client._local.conn = None
 
-    breaker = store._client.breaker
+    breaker = store._client.breaker_for("events")
     for _ in range(2):  # two real failures trip the threshold
         with pytest.raises(StorageUnreachableError):
             store.init_app(1)

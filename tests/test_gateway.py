@@ -1,0 +1,698 @@
+"""Gateway unit tests (ISSUE 15): consistent-hash ring, replica
+registry + durable identity, routing/bounded-load/hedge/failover
+against in-process stub replicas, sticky canary bucket forwarding,
+autoscaler policy, and the per-replica online-cursor regression."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.storage.registry import (
+    SourceConfig,
+    Storage,
+    StorageConfig,
+)
+from predictionio_tpu.gateway import (
+    Autoscaler,
+    AutoscalerConfig,
+    GatewayConfig,
+    GatewayServer,
+    HashRing,
+    ReplicaConfig,
+    ReplicaInfo,
+    ReplicaMember,
+    ReplicaRegistry,
+    replica_identity,
+)
+from predictionio_tpu.gateway.replica_main import stub_runtime
+from predictionio_tpu.workflow.server import QueryServer, QueryServerConfig
+
+
+def _memory_storage() -> Storage:
+    return Storage(StorageConfig(
+        sources={"M": SourceConfig("M", "memory", {})},
+        repositories={
+            "METADATA": "M", "EVENTDATA": "M", "MODELDATA": "M",
+        },
+    ))
+
+
+def _post(port, path, body, headers=None, timeout=15):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "null"), dict(e.headers)
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+class TestHashRing:
+    def test_ordered_is_a_permutation(self):
+        ring = HashRing(["a", "b", "c", "d"])
+        order = ring.ordered("tenant-42")
+        assert sorted(order) == ["a", "b", "c", "d"]
+        # deterministic
+        assert ring.ordered("tenant-42") == order
+
+    def test_membership_change_remaps_minimally(self):
+        """Removing one replica must only remap the keys it owned —
+        the property the tenant model cache depends on."""
+        full = HashRing(["a", "b", "c", "d"])
+        less = HashRing(["a", "b", "c"])
+        keys = [f"k{i}" for i in range(500)]
+        moved = sum(
+            1 for k in keys
+            if full.owner(k) != "d" and full.owner(k) != less.owner(k)
+        )
+        assert moved == 0, "keys not owned by the removed replica moved"
+
+    def test_distribution_is_roughly_even(self):
+        ring = HashRing(["a", "b", "c"], vnodes=64)
+        from collections import Counter
+
+        counts = Counter(ring.owner(f"k{i}") for i in range(3000))
+        assert set(counts) == {"a", "b", "c"}
+        assert min(counts.values()) > 500  # no starved replica
+
+    def test_empty_ring(self):
+        assert HashRing([]).ordered("x") == []
+        assert HashRing([]).owner("x") is None
+
+
+# ---------------------------------------------------------------------------
+# replica registry + durable identity
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaRegistry:
+    def test_upsert_heartbeat_live_stale_gc(self):
+        reg = ReplicaRegistry(_memory_storage())
+        reg.upsert(ReplicaInfo(
+            id="r1", url="http://h:1", heartbeat_at=time.time(),
+            engines=["als"], serve_dtype="int8",
+        ))
+        reg.upsert(ReplicaInfo(
+            id="r2", url="http://h:2", heartbeat_at=time.time() - 3600,
+        ))
+        assert {r.id for r in reg.list()} == {"r1", "r2"}
+        assert [r.id for r in reg.live(stale_after_s=5)] == ["r1"]
+        got = reg.get("r1")
+        assert got.serve_dtype == "int8" and got.engines == ["als"]
+        assert reg.gc(stale_after_s=60) == ["r2"]
+        assert {r.id for r in reg.list()} == {"r1"}
+
+    def test_heartbeat_compacts_to_one_live_event(self):
+        storage = _memory_storage()
+        reg = ReplicaRegistry(storage)
+        reg.upsert(ReplicaInfo(id="r1", url="http://h:1"))
+        prev = None
+        for _ in range(10):
+            prev = reg.heartbeat("r1", prev, inflight=3)
+        from predictionio_tpu.gateway.registry import REPLICA_ENTITY
+
+        events = reg._store.events(REPLICA_ENTITY, "r1")
+        assert len(events) <= 2  # initial upsert + one live beat
+        got = reg.get("r1")
+        assert got.inflight == 3 and got.url == "http://h:1"
+
+    def test_draining_flag_survives_heartbeats(self):
+        reg = ReplicaRegistry(_memory_storage())
+        reg.upsert(ReplicaInfo(id="r1", url="http://h:1"))
+        reg.set_draining("r1", True)
+        prev = reg.heartbeat("r1", None, inflight=0)
+        reg.heartbeat("r1", prev, inflight=0)
+        assert reg.get("r1").draining is True
+
+    def test_replica_identity_is_durable(self, tmp_path):
+        d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+        rid = replica_identity(d1)
+        assert rid.startswith("replica-")
+        assert replica_identity(d1) == rid  # restart resumes the SAME id
+        assert replica_identity(d2) != rid  # second replica differs
+
+
+# ---------------------------------------------------------------------------
+# sticky canary bucket forwarding
+# ---------------------------------------------------------------------------
+
+
+class TestStickyBucket:
+    def test_bucket_overrides_local_hash(self):
+        from predictionio_tpu.deploy.rollout import (
+            route_bucket,
+            sticky_candidate,
+        )
+
+        raw = b'{"user": "u1"}'
+        local = sticky_candidate(raw, 0.5)
+        assert sticky_candidate(raw, 0.5, bucket=route_bucket(raw)) == local
+        # forced buckets pick the variant regardless of the body
+        assert sticky_candidate(raw, 0.5, bucket=0) is True
+        assert sticky_candidate(raw, 0.5, bucket=9999) is False
+
+    def test_pick_runtime_honors_gateway_bucket(self):
+        """The replica's canary decision must follow the forwarded
+        bucket, not its own hash — a hedged retry landing on another
+        replica gets the same variant."""
+        from types import SimpleNamespace
+
+        storage = _memory_storage()
+        srv = QueryServer(
+            storage, stub_runtime("r1"),
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        candidate = stub_runtime("r1-candidate")
+        srv.candidate = candidate
+        srv.rollout = SimpleNamespace(
+            config=SimpleNamespace(shadow=False, fraction=0.5),
+            st=SimpleNamespace(state="canary"),
+        )
+        raw = b'{"q": 1}'
+        rt_low, variant_low = srv.pick_runtime(raw, bucket=0)
+        rt_high, variant_high = srv.pick_runtime(raw, bucket=9999)
+        assert (variant_low, variant_high) == ("candidate", "live")
+        assert rt_low is candidate and rt_high is srv.runtime
+
+    def test_route_hash_header_parsed_end_to_end(self):
+        """POST with X-PIO-Route-Hash reaches pick_runtime as the
+        bucket (captured via a spy)."""
+        storage = _memory_storage()
+        srv = QueryServer(
+            storage, stub_runtime("r1"),
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        seen = []
+        original = srv.pick_runtime
+
+        def spy(raw, bucket=None):
+            seen.append(bucket)
+            return original(raw, bucket=bucket)
+
+        srv.pick_runtime = spy
+        port = srv.start()
+        try:
+            status, _, _ = _post(
+                port, "/queries.json", {"q": 1},
+                headers={"X-PIO-Route-Hash": "1234"},
+            )
+            assert status == 200
+            status, _, _ = _post(port, "/queries.json", {"q": 2})
+            assert status == 200
+        finally:
+            srv.stop()
+        assert seen == [1234, None]
+
+
+# ---------------------------------------------------------------------------
+# gateway routing against in-process stub replicas
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fleet():
+    """3 stub replicas + a gateway on shared memory storage. Yields
+    (gateway, gateway_port, replicas: list[QueryServer], storage)."""
+    storage = _memory_storage()
+    replicas = []
+    for i in range(3):
+        rid = f"r{i}"
+        srv = QueryServer(
+            storage, stub_runtime(rid),
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        srv.start()
+        srv.attach_replica(ReplicaMember(storage, srv, ReplicaConfig(
+            replica_id=rid, url=f"http://127.0.0.1:{srv.port}",
+            heartbeat_interval_s=0.2,
+        )))
+        replicas.append(srv)
+    gw = GatewayServer(storage, GatewayConfig(
+        ip="127.0.0.1", port=0, sync_interval_s=0.15,
+        replica_stale_after_s=2.0, scrape=False,
+        hedge=True, hedge_min_ms=60.0,
+        breaker_threshold=2, breaker_cooldown_s=0.3,
+    ))
+    gport = gw.start()
+    yield gw, gport, replicas, storage
+    gw.stop()
+    for srv in replicas:
+        srv.stop()
+
+
+class TestGatewayRouting:
+    def test_routes_to_all_replicas_and_forwards_bucket(self, fleet):
+        gw, gport, replicas, _storage = fleet
+        seen = set()
+        for i in range(30):
+            status, body, _ = _post(gport, "/queries.json", {"q": i})
+            assert status == 200
+            seen.add(body["replica"])
+        assert seen == {"r0", "r1", "r2"}
+        status, st = _get(gport, "/gateway/status")
+        assert status == 200 and st["routable"] == 3
+
+    def test_same_body_is_sticky(self, fleet):
+        _gw, gport, _replicas, _storage = fleet
+        who = {
+            _post(gport, "/queries.json", {"q": "fixed"})[1]["replica"]
+            for _ in range(8)
+        }
+        assert len(who) == 1  # crc32 bucket → same ring key every time
+
+    def test_failover_absorbs_dead_replica(self, fleet):
+        """A registered-but-dead replica (fresh heartbeat, closed port)
+        costs failovers, never client errors; its breaker opens and it
+        is ejected."""
+        gw, gport, _replicas, storage = fleet
+        ReplicaRegistry(storage).upsert(ReplicaInfo(
+            id="rdead", url="http://127.0.0.1:1",
+            heartbeat_at=time.time() + 3600,
+        ))
+        gw.sync_once()
+        for i in range(40):
+            status, _body, _ = _post(
+                gport, "/queries.json", {"q": i},
+                headers={"X-PIO-Deadline": "8000"},
+            )
+            assert status == 200
+        gw.sync_once()
+        _s, st = _get(gport, "/gateway/status")
+        dead = next(r for r in st["replicas"] if r["id"] == "rdead")
+        assert not dead["routable"]
+        assert any(
+            reason.startswith("breaker_")
+            for reason in dead["eject_reasons"]
+        )
+        assert gw._failovers.value() >= 1
+
+    def test_stale_heartbeat_ejects_and_fresh_readmits(self, fleet):
+        gw, _gport, replicas, _storage = fleet
+        victim = replicas[0]
+        member = victim.replica
+        # freeze heartbeats (the SIGSTOP'd-process shape)
+        member._stop.set()
+        member._hb_thread.join()
+        member._hb_thread = None
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            gw.sync_once()
+            _ring, states = gw._route_snapshot()
+            if not states["r0"].routable():
+                break
+            time.sleep(0.2)
+        _ring, states = gw._route_snapshot()
+        assert not states["r0"].routable()
+        assert "stale_heartbeat" in states["r0"].eject_reasons()
+        # heartbeats resume → re-admitted
+        member._stop.clear()
+        import threading
+
+        member._hb_thread = threading.Thread(
+            target=member._hb_loop, name="replica-heartbeat", daemon=True
+        )
+        member._hb_thread.start()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            gw.sync_once()
+            _ring, states = gw._route_snapshot()
+            if states["r0"].routable():
+                break
+            time.sleep(0.2)
+        assert states["r0"].routable()
+
+    def test_hedge_beats_straggler(self, fleet):
+        """A query stuck on a slow REPLICA is answered by the hedge on
+        the next replica long before the straggler finishes. The
+        straggler is replica-side (every query on r-slow sleeps), so
+        the hedged copy of the SAME body is fast elsewhere."""
+        gw, gport, _replicas, _storage = fleet
+        slow = _replicas[0]
+        # make replica r0 slow for every query it serves
+        slow.runtime.algorithms[0].slow_every = 1
+        slow.runtime.algorithms[0].slow_ms = 3000.0
+        # find a body whose PRIMARY is the slow replica
+        import zlib
+
+        body = None
+        for i in range(2000):
+            cand_body = {"q": f"probe-{i}"}
+            raw = json.dumps(cand_body).encode()
+            key = f"q{zlib.crc32(raw) % 10000}"
+            if gw.candidates(key) and gw.candidates(key)[0] == "r0":
+                body = cand_body
+                break
+        assert body is not None
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gport}/queries.json",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-PIO-Deadline": "10000"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=20) as r:
+            answer = json.loads(r.read().decode())
+        elapsed = time.perf_counter() - t0
+        assert answer["replica"] != "r0"  # the hedge won
+        assert elapsed < 2.5, (
+            f"hedge did not rescue the straggler ({elapsed:.2f}s)"
+        )
+        assert gw._hedges.value(outcome="sent") >= 1
+        assert gw._hedges.value(outcome="won") >= 1
+
+    def test_deadline_expired_is_shed_at_gateway(self, fleet):
+        _gw, gport, _replicas, _storage = fleet
+        status, body, headers = _post(
+            gport, "/queries.json", {"q": 1},
+            headers={"X-PIO-Deadline": "0"},
+        )
+        assert status == 503
+        assert headers.get("Retry-After") == "1"
+        assert "shed" in body["message"]
+
+    def test_no_replica_503(self):
+        storage = _memory_storage()
+        gw = GatewayServer(storage, GatewayConfig(
+            ip="127.0.0.1", port=0, sync_interval_s=30, scrape=False,
+        ))
+        gport = gw.start()
+        try:
+            status, body, headers = _post(gport, "/queries.json", {"q": 1})
+            assert status == 503
+            assert "no routable replica" in body["message"]
+            assert headers.get("Retry-After") == "1"
+        finally:
+            gw.stop()
+
+    def test_drain_flag_stops_routing(self, fleet):
+        gw, gport, replicas, storage = fleet
+        ReplicaRegistry(storage).set_draining("r1", True)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            gw.sync_once()
+            _ring, states = gw._route_snapshot()
+            if not states["r1"].routable():
+                break
+            time.sleep(0.1)
+        assert "draining" in states["r1"].eject_reasons()
+        for i in range(20):
+            status, body, _ = _post(gport, "/queries.json", {"q": i})
+            assert status == 200
+            assert body["replica"] != "r1"
+
+    def test_bounded_load_spills_hot_key(self, fleet):
+        """With the sticky owner saturated past factor x mean load,
+        the key's traffic spills to the next replica on the ring."""
+        gw, _gport, _replicas, _storage = fleet
+        key = "tenant-hot"
+        _ring, states = gw._route_snapshot()
+        first = gw.candidates(key)[0]
+        # saturate the sticky owner
+        for _ in range(50):
+            states[first].enter()
+        try:
+            spilled = gw.candidates(key)
+            assert spilled[0] != first
+            assert first in spilled  # still a failover target, demoted
+        finally:
+            for _ in range(50):
+                states[first].exit(None)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler policy
+# ---------------------------------------------------------------------------
+
+
+class _FakeManager:
+    def __init__(self):
+        self.spawned = 0
+        self.drained = []
+
+    def spawn(self):
+        self.spawned += 1
+        return f"spawn-{self.spawned}"
+
+    def drain(self, replica_id, url):
+        self.drained.append(replica_id)
+        return True
+
+    def stop(self):
+        pass
+
+
+class TestAutoscaler:
+    def _scaler(self, **cfg):
+        from predictionio_tpu.obs.registry import MetricsRegistry
+
+        class Clock:
+            t = 1000.0
+
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        mgr = _FakeManager()
+        scaler = Autoscaler(
+            mgr,
+            AutoscalerConfig(**cfg),
+            registry=MetricsRegistry(),
+            clock=clock,
+        )
+        return scaler, mgr, clock
+
+    def test_min_replicas_floor_spawns_even_in_cooldown(self):
+        scaler, mgr, clock = self._scaler(
+            min_replicas=2, cooldown_s=60, floor_boot_grace_s=5,
+        )
+        d = scaler.evaluate(replicas=1, mean_inflight=0.0, burn=None)
+        assert d.action == "spawn" and mgr.spawned == 1
+        # the freshly-spawned replica is still booting: re-firing the
+        # floor every evaluation pass would be a process storm
+        assert scaler.evaluate(replicas=1, mean_inflight=0.0, burn=None) is None
+        # ... but the 60 s cooldown does NOT delay recovering the
+        # floor — only the short boot grace does
+        clock.t += 6
+        d = scaler.evaluate(replicas=1, mean_inflight=0.0, burn=None)
+        assert d.action == "spawn" and mgr.spawned == 2
+
+    def test_burn_triggers_spawn_and_cooldown_holds(self):
+        scaler, mgr, clock = self._scaler(
+            min_replicas=1, max_replicas=4, cooldown_s=30,
+            scale_up_burn=14.4,
+        )
+        d = scaler.evaluate(replicas=2, mean_inflight=1.0, burn=20.0)
+        assert d.action == "spawn" and "burn" in d.reason
+        assert scaler.evaluate(replicas=2, mean_inflight=1.0, burn=20.0) is None
+        clock.t += 31
+        d = scaler.evaluate(replicas=3, mean_inflight=1.0, burn=20.0)
+        assert d.action == "spawn" and mgr.spawned == 2
+
+    def test_saturation_triggers_spawn_max_rail_holds(self):
+        scaler, mgr, clock = self._scaler(
+            min_replicas=1, max_replicas=2, target_inflight=8,
+            cooldown_s=0,
+        )
+        d = scaler.evaluate(replicas=1, mean_inflight=9.0, burn=None)
+        assert d.action == "spawn"
+        clock.t += 1
+        assert scaler.evaluate(replicas=2, mean_inflight=9.0, burn=None) is None
+
+    def test_idle_drains_least_loaded(self):
+        scaler, mgr, clock = self._scaler(
+            min_replicas=1, target_inflight=8, cooldown_s=0,
+            scale_down_fraction=0.25,
+        )
+        d = scaler.evaluate(
+            replicas=3, mean_inflight=0.5, burn=0.1,
+            drain_candidate=("r2", "http://h:2"),
+        )
+        assert d.action == "drain" and d.target == "r2"
+        assert mgr.drained == ["r2"]
+
+    def test_decisions_land_on_log_and_counter(self):
+        scaler, mgr, clock = self._scaler(min_replicas=1, cooldown_s=0)
+        scaler.evaluate(replicas=0, mean_inflight=0, burn=None)
+        st = scaler.status()
+        assert st["decisions"][-1]["action"] == "spawn"
+        assert scaler._events.value(action="spawn") == 1
+
+
+# ---------------------------------------------------------------------------
+# per-replica online cursor identity (the acceptance regression)
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaCursorIdentity:
+    def test_two_replicas_get_distinct_default_cursors(self, tmp_path):
+        """The PR-9 caveat made automatic: two replicas folding the
+        same app's stream derive DISTINCT durable cursor records from
+        their replica identity — no shared-cursor double-fold."""
+        storage = _memory_storage()
+        cursor_ids = []
+        for name in ("a", "b"):
+            srv = QueryServer(
+                storage, stub_runtime(name),
+                QueryServerConfig(
+                    ip="127.0.0.1", port=0, micro_batch=False
+                ),
+            )
+            srv.start()
+            srv.attach_replica(ReplicaMember(storage, srv, ReplicaConfig(
+                state_dir=str(tmp_path / name),
+                url=f"http://127.0.0.1:{srv.port}",
+                heartbeat_interval_s=30,
+            )))
+            consumer = srv.attach_online(app_id=1)
+            cursor_ids.append(consumer.cursor_id)
+            rid = srv.replica.replica_id
+            assert rid in consumer.cursor_id, (
+                "cursor name must carry the durable replica id"
+            )
+            srv.stop()
+        assert cursor_ids[0] != cursor_ids[1], (
+            "two replicas would share one single-writer cursor record"
+        )
+        # restart of replica "a" resumes the SAME cursor (durability)
+        srv = QueryServer(
+            storage, stub_runtime("a2"),
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        srv.start()
+        srv.attach_replica(ReplicaMember(storage, srv, ReplicaConfig(
+            state_dir=str(tmp_path / "a"),
+            url=f"http://127.0.0.1:{srv.port}",
+            heartbeat_interval_s=30,
+        )))
+        consumer = srv.attach_online(app_id=1)
+        assert consumer.cursor_id == cursor_ids[0]
+        srv.stop()
+
+    def test_explicit_cursor_name_still_wins(self):
+        from predictionio_tpu.online import OnlineConsumerConfig
+
+        storage = _memory_storage()
+        srv = QueryServer(
+            storage, stub_runtime("a"),
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        srv.start()
+        srv.attach_replica(ReplicaMember(storage, srv, ReplicaConfig(
+            replica_id="rX", url=f"http://127.0.0.1:{srv.port}",
+            heartbeat_interval_s=30,
+        )))
+        consumer = srv.attach_online(
+            app_id=1, config=OnlineConsumerConfig(name="custom/cursor")
+        )
+        assert consumer.cursor_id == "custom/cursor"
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica endpoints
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaEndpoints:
+    def test_health_and_replica_status(self):
+        storage = _memory_storage()
+        srv = QueryServer(
+            storage, stub_runtime("r1"),
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        port = srv.start()
+        try:
+            status, body = _get(port, "/health")
+            assert status == 200 and body["status"] == "alive"
+            status, body = _get(port, "/replica/status")
+            assert status == 200 and body["state"] == "detached"
+            srv.attach_replica(ReplicaMember(storage, srv, ReplicaConfig(
+                replica_id="r1", url=f"http://127.0.0.1:{port}",
+                heartbeat_interval_s=30,
+            )))
+            status, body = _get(port, "/replica/status")
+            assert body["state"] == "attached"
+            assert body["replica_id"] == "r1"
+        finally:
+            srv.stop()
+
+    def test_prefetch_endpoint_without_tenancy_accepts_nothing(self):
+        storage = _memory_storage()
+        srv = QueryServer(
+            storage, stub_runtime("r1"),
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        port = srv.start()
+        try:
+            status, body, _ = _post(
+                port, "/replica/prefetch", {"tenants": ["t1", "t2"]}
+            )
+            assert status == 200 and body["accepted"] == []
+            status, _body, _ = _post(
+                port, "/replica/prefetch", {"tenants": "nope"}
+            )
+            assert status == 400
+        finally:
+            srv.stop()
+
+    def test_drain_endpoint_finishes_inflight_then_stops(self):
+        storage = _memory_storage()
+        srv = QueryServer(
+            storage, stub_runtime("r1"),
+            QueryServerConfig(ip="127.0.0.1", port=0, micro_batch=False),
+        )
+        port = srv.start()
+        member = ReplicaMember(storage, srv, ReplicaConfig(
+            replica_id="r1", url=f"http://127.0.0.1:{port}",
+            heartbeat_interval_s=0.2, drain_grace_s=0.05,
+        ))
+        srv.attach_replica(member)
+        # a slow in-flight query rides out the drain
+        import threading
+
+        results = []
+
+        def slow_query():
+            results.append(_post(
+                port, "/queries.json", {"q": 1, "sleep_ms": 600},
+                timeout=20,
+            ))
+
+        t = threading.Thread(target=slow_query, daemon=True)
+        t.start()
+        time.sleep(0.15)  # let it arrive
+        status, body, _ = _post(port, "/replica/drain", {})
+        assert status == 202 and body["draining"] is True
+        status2, _body2, _ = _post(port, "/replica/drain", {})
+        assert status2 == 409  # already draining
+        t.join(timeout=20)
+        assert results and results[0][0] == 200, (
+            "in-flight query was dropped by the drain"
+        )
+        # the drain thread stops the server
+        deadline = time.time() + 10
+        while time.time() < deadline and srv._server is not None:
+            time.sleep(0.1)
+        assert srv._server is None
+        # record removed on clean retirement
+        assert ReplicaRegistry(storage).get("r1") is None

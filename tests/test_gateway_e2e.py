@@ -1,0 +1,326 @@
+"""Gateway chaos e2e (ISSUE 15 acceptance): kill -9 one of three
+replica PROCESSES under a 64-client hammer — zero in-deadline queries
+lost (hedge/failover absorbs), the dead replica's breaker opens, it is
+ejected, its `up{instance}` goes 0, and a restart re-admits it; plus
+drain-is-zero-drop and stale-heartbeat ejection."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.data.storage.registry import (
+    SourceConfig,
+    Storage,
+    StorageConfig,
+)
+from predictionio_tpu.gateway import (
+    GatewayConfig,
+    GatewayServer,
+    ReplicaRegistry,
+)
+from predictionio_tpu.obs.monitor import get_monitor
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _sqlite_storage(tmp_path) -> Storage:
+    return Storage(StorageConfig(
+        sources={
+            "SQL": SourceConfig(
+                "SQL", "sqlite", {"PATH": str(tmp_path / "gateway.db")}
+            ),
+        },
+        repositories={
+            "METADATA": "SQL", "EVENTDATA": "SQL", "MODELDATA": "SQL",
+        },
+    ))
+
+
+def _spawn_replica(tmp_path, rid: str, port: int,
+                   slow_every: int = 0) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": str(REPO) + os.pathsep + env.get("PYTHONPATH", ""),
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "gateway.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+        "PIO_REPLICA_HEARTBEAT_S": "0.2",
+        "JAX_PLATFORMS": "cpu",
+    })
+    out = open(tmp_path / f"{rid}.log", "w")
+    argv = [
+        sys.executable, "-m", "predictionio_tpu.gateway.replica_main",
+        "--stub", "--ip", "127.0.0.1", "--port", str(port),
+        "--replica-id", rid,
+        "--state-dir", str(tmp_path / f"state-{rid}"),
+    ]
+    if slow_every:
+        argv += ["--slow-every", str(slow_every), "--slow-ms", "400"]
+    return subprocess.Popen(
+        argv, env=env, cwd=REPO, stdout=out, stderr=subprocess.STDOUT,
+    )
+
+
+def _wait_routable(gw, n: int, timeout: float = 30.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        gw.sync_once()
+        _ring, states = gw._route_snapshot()
+        if sum(1 for st in states.values() if st.routable()) >= n:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"never reached {n} routable replicas; states="
+        f"{[(rid, st.eject_reasons()) for rid, st in states.items()]}"
+    )
+
+
+def _post_query(gport, body, deadline_ms=8000, timeout=12):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gport}/queries.json",
+        data=json.dumps(body).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-PIO-Deadline": str(deadline_ms),
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+class _Hammer:
+    """N client threads looping queries until stopped; every request
+    carries an 8 s deadline, so ANY failure is an in-deadline loss."""
+
+    def __init__(self, gport: int, clients: int = 64):
+        self.gport = gport
+        self.clients = clients
+        self.sent = 0
+        self.failed: list[str] = []
+        self.replicas_seen: set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self):
+        for i in range(self.clients):
+            t = threading.Thread(
+                target=self._run, args=(i,), name=f"hammer-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _run(self, i: int):
+        n = 0
+        while not self._stop.is_set():
+            n += 1
+            body = {"q": f"c{i}-{n}"}
+            try:
+                status, answer = _post_query(self.gport, body)
+                with self._lock:
+                    self.sent += 1
+                    if status != 200:
+                        self.failed.append(f"{body}: HTTP {status}")
+                    else:
+                        self.replicas_seen.add(answer["replica"])
+            except Exception as e:
+                with self._lock:
+                    self.sent += 1
+                    self.failed.append(f"{body}: {e}")
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=15)
+
+
+@pytest.fixture()
+def gateway_fleet(tmp_path):
+    """3 stub replica subprocesses + an in-process gateway over shared
+    sqlite storage."""
+    storage = _sqlite_storage(tmp_path)
+    procs = {}
+    ports = {}
+    for i in range(3):
+        rid = f"r{i}"
+        ports[rid] = _free_port()
+        procs[rid] = _spawn_replica(tmp_path, rid, ports[rid])
+    gw = GatewayServer(storage, GatewayConfig(
+        ip="127.0.0.1", port=0, sync_interval_s=0.15,
+        replica_stale_after_s=1.5, scrape=True, scrape_interval_s=0.4,
+        hedge=True, hedge_min_ms=60.0,
+        breaker_threshold=2, breaker_cooldown_s=0.5,
+    ))
+    gport = gw.start()
+    try:
+        _wait_routable(gw, 3)
+        yield gw, gport, procs, ports, tmp_path, storage
+    finally:
+        gw.stop()
+        for proc in procs.values():
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except Exception:
+                pass
+
+
+def test_kill9_replica_zero_inflight_loss_then_rejoin(gateway_fleet):
+    """The acceptance chaos: 64 clients hammering, one of three
+    replicas SIGKILLed mid-hammer. Zero in-deadline queries lost; the
+    dead replica is ejected (breaker/heartbeat/up all say so) and a
+    restart re-admits it."""
+    gw, gport, procs, ports, tmp_path, _storage = gateway_fleet
+    hammer = _Hammer(gport, clients=64)
+    hammer.start()
+    try:
+        time.sleep(1.5)  # steady state, all three answering
+        victim = procs.pop("r0")
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        time.sleep(3.0)  # hammer rides through the failure
+    finally:
+        hammer.stop()
+    assert hammer.sent > 200, "hammer produced too little traffic"
+    assert not hammer.failed, (
+        f"{len(hammer.failed)}/{hammer.sent} in-deadline queries lost; "
+        f"first: {hammer.failed[:5]}"
+    )
+    assert {"r1", "r2"} <= hammer.replicas_seen
+
+    # ejection: the gateway stopped routing to r0, and says why
+    deadline = time.time() + 15
+    reasons: list = []
+    while time.time() < deadline:
+        gw.sync_once()
+        _ring, states = gw._route_snapshot()
+        st = states.get("r0")
+        if st is not None and not st.routable():
+            reasons = st.eject_reasons()
+            break
+        time.sleep(0.2)
+    assert reasons, "dead replica was never ejected"
+
+    # the passive signal agrees: up{instance=r0} goes 0 on the
+    # gateway's embedded scraper
+    deadline = time.time() + 15
+    up = None
+    while time.time() < deadline:
+        up = get_monitor().tsdb.latest("up", {"instance": "r0"})
+        if up == 0.0:
+            break
+        time.sleep(0.3)
+    assert up == 0.0, f"up{{instance=r0}} never went 0 (last={up})"
+
+    # restart with the SAME durable identity: re-admission
+    procs["r0"] = _spawn_replica(tmp_path, "r0", _free_port())
+    deadline = time.time() + 30
+    readmitted = False
+    while time.time() < deadline:
+        gw.sync_once()
+        _ring, states = gw._route_snapshot()
+        st = states.get("r0")
+        if st is not None and st.routable():
+            readmitted = True
+            break
+        time.sleep(0.3)
+    assert readmitted, "restarted replica was never re-admitted"
+    # and it actually serves again through the gateway
+    seen = set()
+    for i in range(60):
+        _status, answer = _post_query(gport, {"q": f"rejoin-{i}"})
+        seen.add(answer["replica"])
+    assert "r0" in seen, "re-admitted replica receives no traffic"
+
+
+def test_drain_is_zero_drop(gateway_fleet):
+    """Graceful drain under load: the drained replica finishes its
+    in-flight queries, the gateway routes around it, nothing fails,
+    and the replica process exits cleanly."""
+    gw, gport, procs, ports, _tmp, storage = gateway_fleet
+    hammer = _Hammer(gport, clients=32)
+    hammer.start()
+    try:
+        time.sleep(1.0)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gport}/gateway/drain",
+            data=json.dumps({"replica": "r1"}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 202
+        # the replica drains, stops, and its process exits 0
+        proc = procs.pop("r1")
+        assert proc.wait(timeout=60) == 0
+        time.sleep(1.0)  # hammer keeps running on the survivors
+    finally:
+        hammer.stop()
+    assert not hammer.failed, (
+        f"drain dropped {len(hammer.failed)} queries; "
+        f"first: {hammer.failed[:5]}"
+    )
+    # clean retirement removed the record
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ReplicaRegistry(storage).get("r1") is None:
+            break
+        time.sleep(0.2)
+    assert ReplicaRegistry(storage).get("r1") is None
+    gw.sync_once()
+    _ring, states = gw._route_snapshot()
+    assert "r1" not in states
+
+
+def test_stale_heartbeat_ejection_and_recovery(gateway_fleet):
+    """A wedged replica (SIGSTOP: alive socket, frozen heartbeat) is
+    ejected on heartbeat staleness alone, and re-admitted when it
+    thaws."""
+    gw, _gport, procs, _ports, _tmp, _storage = gateway_fleet
+    frozen = procs["r2"]
+    os.kill(frozen.pid, signal.SIGSTOP)
+    try:
+        deadline = time.time() + 20
+        ejected = False
+        while time.time() < deadline:
+            gw.sync_once()
+            _ring, states = gw._route_snapshot()
+            st = states.get("r2")
+            if st is not None and "stale_heartbeat" in st.eject_reasons():
+                ejected = True
+                break
+            time.sleep(0.2)
+        assert ejected, "frozen replica was never ejected as stale"
+    finally:
+        os.kill(frozen.pid, signal.SIGCONT)
+    deadline = time.time() + 20
+    readmitted = False
+    while time.time() < deadline:
+        gw.sync_once()
+        _ring, states = gw._route_snapshot()
+        st = states.get("r2")
+        if st is not None and st.routable():
+            readmitted = True
+            break
+        time.sleep(0.2)
+    assert readmitted, "thawed replica was never re-admitted"

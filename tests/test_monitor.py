@@ -452,7 +452,9 @@ class TestBurnRate:
 # ---------------------------------------------------------------------------
 
 
-MONITOR_THREADS = ("tsdb-sampler", "slo-engine", "fleet-scraper")
+MONITOR_THREADS = (
+    "tsdb-sampler", "slo-engine", "fleet-scraper", "tsdb-snapshot",
+)
 
 
 def _monitor_threads():
@@ -841,3 +843,79 @@ class TestHbmCache:
         # exactly the model arrays — the dispatch transient is the
         # cache's budget-level reservation, not part of the entry
         assert nbytes == rt.models[0].nbytes
+
+
+# ---------------------------------------------------------------------------
+# TSDB snapshot persistence (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestTsdbSnapshot:
+    def test_round_trip(self, tmp_path):
+        from predictionio_tpu.obs.monitor import (
+            TSDB, load_snapshot, save_snapshot,
+        )
+
+        t = TSDB(capacity=10)
+        for i in range(20):  # ring wraps: only the newest 10 persist
+            t.add("up", {"instance": "r0"}, i % 2, "gauge", 1000.0 + i)
+            t.add("reqs_total", {"p": "/q"}, i * 3, "counter", 1000.0 + i)
+        path = str(tmp_path / "snap.json")
+        assert save_snapshot(t, path) > 0
+        t2 = TSDB(capacity=10)
+        assert load_snapshot(t2, path) == 2
+        assert t2.latest("up", {"instance": "r0"}) == t.latest(
+            "up", {"instance": "r0"}
+        )
+        (series,) = t2.matching("reqs_total")
+        assert len(series.points) == 10
+        assert series.kind == "counter"
+
+    def test_corrupt_snapshot_tolerated(self, tmp_path):
+        from predictionio_tpu.obs.monitor import TSDB, load_snapshot
+
+        path = tmp_path / "snap.json"
+        path.write_bytes(b"{definitely not json")
+        t = TSDB()
+        assert load_snapshot(t, str(path)) == 0
+        assert t.series_count() == 0
+        # missing file is silent too
+        assert load_snapshot(t, str(tmp_path / "nope.json")) == 0
+
+    def test_bounded_file_size_drops_oldest_points(self, tmp_path):
+        from predictionio_tpu.obs.monitor import (
+            TSDB, load_snapshot, save_snapshot,
+        )
+
+        big = TSDB(capacity=720, max_series=10_000)
+        for s in range(100):
+            for i in range(720):
+                big.add("m", {"s": str(s)}, float(i), "gauge", float(i))
+        path = str(tmp_path / "snap.json")
+        n = save_snapshot(big, path, max_bytes=50_000)
+        assert n <= 50_000
+        t2 = TSDB(capacity=720, max_series=10_000)
+        assert load_snapshot(t2, path) == 100  # every series survives...
+        (series,) = [
+            s for s in t2.matching("m", {"s": "7"})
+        ]
+        # ...with the NEWEST points kept
+        assert series.points[-1][1] == 719.0
+
+    def test_monitor_persists_across_restart(self, tmp_path, monkeypatch):
+        """The wiring: a Monitor with PIO_TSDB_SNAPSHOT set writes on
+        last detach and a fresh Monitor (the restart) reloads the
+        history — the gateway's up{instance}/burn windows survive."""
+        snap = str(tmp_path / "monitor-snap.json")
+        monkeypatch.setenv("PIO_TSDB_SNAPSHOT", snap)
+        monitor = Monitor()
+        monitor.sampler_interval_s = 0.05
+        token = monitor.attach("a", MetricsRegistry())
+        monitor.tsdb.add("up", {"instance": "r9"}, 1.0, "gauge")
+        monitor.detach(token)  # joins + final snapshot
+        assert _monitor_threads() == []
+        import os
+
+        assert os.path.exists(snap)
+        reborn = Monitor()
+        assert reborn.tsdb.latest("up", {"instance": "r9"}) == 1.0

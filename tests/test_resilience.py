@@ -61,8 +61,8 @@ def test_breaker_opens_after_threshold_and_fails_fast():
     assert b.state == "open"
     assert not b.allow()  # fail fast, no probe before cooldown
     assert reg.gauge(
-        "resilience_breaker_state", "", ("endpoint",)
-    ).value(endpoint="test-endpoint") == 1.0
+        "resilience_breaker_state", "", ("endpoint", "dao")
+    ).value(endpoint="test-endpoint", dao="") == 1.0
 
 
 def test_breaker_half_open_probe_recovers():
@@ -79,11 +79,14 @@ def test_breaker_half_open_probe_recovers():
     assert b.allow()
     # transition counter saw closed→open→half_open→closed
     ctr = reg.counter(
-        "resilience_breaker_transitions_total", "", ("endpoint", "state")
+        "resilience_breaker_transitions_total", "",
+        ("endpoint", "dao", "state"),
     )
-    assert ctr.value(endpoint="test-endpoint", state="open") == 1
-    assert ctr.value(endpoint="test-endpoint", state="half_open") == 1
-    assert ctr.value(endpoint="test-endpoint", state="closed") == 1
+    assert ctr.value(endpoint="test-endpoint", dao="", state="open") == 1
+    assert ctr.value(
+        endpoint="test-endpoint", dao="", state="half_open"
+    ) == 1
+    assert ctr.value(endpoint="test-endpoint", dao="", state="closed") == 1
 
 
 def test_breaker_failed_probe_reopens():
@@ -548,7 +551,7 @@ def test_client_deadline_expiry_does_not_wedge_breaker(tmp_path):
             "RETRY_ATTEMPTS": "1", "BREAKER_THRESHOLD": "1",
             "BREAKER_COOLDOWN": "0.0",
         })
-        breaker = store._client.breaker
+        breaker = store._client.breaker_for("events")
         # trip the breaker with an injected outage
         faults_mod.install(
             faults_mod.FaultSpec("storage.rpc", "error", 1.0)
@@ -663,3 +666,76 @@ def test_fault_admin_validates_before_clearing(tmp_path):
         _os.environ.pop("PIO_FAULTS_ADMIN", None)
         faults_mod.clear()
         d.stop()
+
+
+def test_per_dao_breakers_isolate_events_outage(tmp_path):
+    """ISSUE 15 satellite (carried PR-4 follow-up): breakers key by
+    endpoint+DAO — an open EVENTS breaker fails only the events path
+    fast, while the metadata DAOs on the same daemon keep answering."""
+    from predictionio_tpu.data.api.storage_server import StorageServer
+    from predictionio_tpu.data.storage.base import (
+        App,
+        StorageCircuitOpenError,
+    )
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.obs.registry import get_default_registry
+    from predictionio_tpu.resilience.breaker import reset_breakers
+
+    reset_breakers()
+    try:
+        cfg = StorageConfig(
+            sources={"S": SourceConfig(
+                "S", "sqlite", {"PATH": str(tmp_path / "dao.db")}
+            )},
+            repositories={
+                "METADATA": "S", "EVENTDATA": "S", "MODELDATA": "S",
+            },
+        )
+        daemon = StorageServer(
+            Storage(cfg), host="127.0.0.1", port=0
+        ).start()
+        remote_cfg = StorageConfig(
+            sources={"R": SourceConfig("R", "remote", {
+                "HOST": "127.0.0.1", "PORT": str(daemon.port),
+                "RETRY_ATTEMPTS": "1", "BREAKER_THRESHOLD": "2",
+                "BREAKER_COOLDOWN": "60",
+            })},
+            repositories={
+                "METADATA": "R", "EVENTDATA": "R", "MODELDATA": "R",
+            },
+        )
+        storage = Storage(remote_cfg)
+        apps = storage.get_meta_data_apps()
+        events = storage.get_events()
+        app_id = apps.insert(App(0, "daoapp"))
+        events.init_app(app_id)
+
+        client = events._client
+        ev_breaker = client.breaker_for("events")
+        meta_breaker = client.breaker_for("apps")
+        assert ev_breaker is not meta_breaker
+
+        # trip ONLY the events breaker (the split under test: the old
+        # process-global per-endpoint breaker would have opened both)
+        ev_breaker.record_failure()
+        ev_breaker.record_failure()
+        assert ev_breaker.state == "open"
+
+        with pytest.raises(StorageCircuitOpenError):
+            events.init_app(app_id)
+        # ...while the metadata path on the SAME daemon still serves
+        assert apps.get(app_id).name == "daoapp"
+        assert meta_breaker.state == "closed"
+
+        # the state gauge carries the dao dimension
+        gauge = get_default_registry().gauge(
+            "resilience_breaker_state", "", ("endpoint", "dao")
+        )
+        ep = f"storage:127.0.0.1:{daemon.port}"
+        assert gauge.value(endpoint=f"{ep}/events", dao="events") == 1.0
+        assert gauge.value(endpoint=f"{ep}/apps", dao="apps") == 0.0
+        daemon.shutdown()
+    finally:
+        reset_breakers()

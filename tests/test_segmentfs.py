@@ -769,3 +769,77 @@ class TestEventsPage:
             _b.shard_of(e.entity_id, 2) == 0 for e in sharded
         )
         assert len(sharded) == 3
+
+
+# ---------------------------------------------------------------------------
+# Cross-process writer guard (ISSUE 15 satellite, carried PR-13 item (c))
+# ---------------------------------------------------------------------------
+
+
+class TestWriterGuard:
+    def test_second_writer_process_fails_fast(self, tmp_path):
+        """A second PROCESS opening the same PATH must fail with a
+        clear error instead of silently corrupting the WAL/segment
+        sequence (fcntl.lockf is per-process, so the in-process
+        crash-recovery tests above are unaffected)."""
+        import subprocess
+        import sys
+        import textwrap
+
+        path = str(tmp_path / "seg")
+        store = SegmentFSEventStore(
+            {"PATH": path, "SEAL_INTERVAL_S": "3600"}
+        )
+        store.init_app(APP)
+        store.insert(rate("u1", "i1", 5), APP)
+        child = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent(f"""
+                from predictionio_tpu.data.storage.base import StorageError
+                from predictionio_tpu.data.storage.segmentfs import (
+                    SegmentFSEventStore,
+                )
+                try:
+                    SegmentFSEventStore({{"PATH": {path!r}}})
+                except StorageError as e:
+                    assert "another process" in str(e), str(e)
+                    print("REFUSED")
+                else:
+                    print("ACQUIRED")
+            """)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert child.returncode == 0, child.stderr
+        assert "REFUSED" in child.stdout, (
+            f"second writer process was not refused: {child.stdout!r} "
+            f"{child.stderr!r}"
+        )
+        store.close()
+        # after close the lock is released: a new process may open it
+        child2 = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent(f"""
+                from predictionio_tpu.data.storage.segmentfs import (
+                    SegmentFSEventStore,
+                )
+                s = SegmentFSEventStore({{"PATH": {path!r}}})
+                assert s.latest_revision({APP}) == 1
+                s.close()
+                print("ACQUIRED")
+            """)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert child2.returncode == 0, child2.stderr
+        assert "ACQUIRED" in child2.stdout
+
+    def test_same_process_crash_reopen_still_allowed(self, tmp_path):
+        """The guard is cross-PROCESS only: an unclean in-process
+        reopen (the crash-recovery pattern every TestCrashRecovery test
+        uses) keeps working because POSIX record locks don't conflict
+        within one process."""
+        path = str(tmp_path / "seg")
+        s1 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        s1.init_app(APP)
+        s1.insert(rate("u1", "i1", 5), APP)
+        s2 = SegmentFSEventStore({"PATH": path, "SEAL_INTERVAL_S": "3600"})
+        assert s2.latest_revision(APP) == 1
+        s1._stop.set()
+        s2.close()
