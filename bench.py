@@ -2593,6 +2593,229 @@ def bench_gateway():
     return out
 
 
+def bench_fleetobs():
+    """ISSUE 16 (BENCH_r10): the fleet observability plane.
+
+    - SLO evaluation with recording rules: the engine's recorded fast
+      path (read one precomputed slo_error_ratio point per window)
+      versus the raw rescan (re-walk every matching 720-point ring) on
+      the SAME fleet-shaped TSDB — `fleetobs_slo_eval_ratio` ≤ 0.5 is
+      the bar,
+    - gateway routing p50 with the WHOLE plane attached (request
+      tracing, /metrics scraping, the cross-process trace collector
+      polling every replica): `fleetobs_gateway_via_p50_ms` must stay
+      within 1.15× of BENCH_r09's untraced gateway_via_p50_ms.
+    """
+    import shutil
+    import socket as _socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import urllib.request as _rq
+
+    from predictionio_tpu.obs.monitor.slo import (
+        SLOEngine,
+        SLOSpec,
+        record_slo_ratios,
+    )
+    from predictionio_tpu.obs.monitor.tsdb import TSDB
+    from predictionio_tpu.obs.registry import MetricsRegistry
+
+    out: dict = {}
+
+    # -- SLO eval: recorded fast path vs raw rescan at full rings ----------
+    db = TSDB(capacity=720)
+    now = time.time()
+    instances = ("r0", "r1", "r2")
+    # full 720-point rings per series — the steady-state shape after
+    # one TSDB retention period of scraping a 3-replica fleet
+    for i in range(720):
+        t = now - (719 - i)
+        for inst in instances:
+            for status, v in (("200", 100.0 * i), ("500", 1.0 * i)):
+                db.add(
+                    "http_requests_total",
+                    {"server": "query", "path": "/queries.json",
+                     "status": status, "instance": inst},
+                    v, "counter", t,
+                )
+            db.add("up", {"instance": inst}, 1.0, "gauge", t)
+    specs = [
+        SLOSpec(name="avail-sum", kind="availability", objective=0.9,
+                aggregate="sum", min_samples=1),
+        SLOSpec(name="avail-mean", kind="availability", objective=0.9,
+                aggregate="mean", min_samples=1),
+        SLOSpec(name="fleet-up", kind="up", objective=0.9,
+                aggregate="mean", min_samples=1),
+        SLOSpec(name="avail-local", kind="availability", objective=0.9,
+                min_samples=1),
+    ]
+    iters = 30 if SMALL else 100
+
+    def eval_ms(engine) -> float:
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            engine.evaluate_once(now=now)
+            times.append(time.perf_counter() - t0)
+        return float(np.percentile(times, 50)) * 1e3
+
+    engine = SLOEngine(db, specs, registry=MetricsRegistry())
+    engine.recorded_max_age_s = 0.0  # raw rescan only
+    raw_ms = eval_ms(engine)
+    t0 = time.perf_counter()
+    recorded_points = record_slo_ratios(db, specs, now=now)
+    recording_pass_ms = (time.perf_counter() - t0) * 1e3
+    engine.recorded_max_age_s = 3600.0  # fast path always fresh
+    recorded_ms = eval_ms(engine)
+    out["fleetobs_slo_specs"] = len(specs)
+    out["fleetobs_slo_eval_raw_ms"] = round(raw_ms, 4)
+    out["fleetobs_slo_eval_recorded_ms"] = round(recorded_ms, 4)
+    out["fleetobs_slo_eval_ratio"] = round(
+        recorded_ms / raw_ms, 4
+    ) if raw_ms > 0 else None
+    out["fleetobs_recording_pass_ms"] = round(recording_pass_ms, 4)
+    out["fleetobs_recording_points"] = recorded_points
+
+    # -- gateway p50 with tracing + collector attached ---------------------
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+    from predictionio_tpu.gateway import GatewayConfig, GatewayServer
+
+    tmp = tempfile.mkdtemp(prefix="bench-fleetobs-")
+    dbfile = os.path.join(tmp, "gateway.db")
+    storage = Storage(StorageConfig(
+        sources={"SQL": SourceConfig("SQL", "sqlite", {"PATH": dbfile})},
+        repositories={
+            "METADATA": "SQL", "EVENTDATA": "SQL", "MODELDATA": "SQL",
+        },
+    ))
+
+    def free_port() -> int:
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(rid: str, port: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update({
+            "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQL_PATH": dbfile,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+            "PIO_REPLICA_HEARTBEAT_S": "0.2",
+            "JAX_PLATFORMS": "cpu",
+        })
+        return subprocess.Popen(
+            [_sys.executable, "-m",
+             "predictionio_tpu.gateway.replica_main",
+             "--stub", "--ip", "127.0.0.1", "--port", str(port),
+             "--replica-id", rid,
+             "--state-dir", os.path.join(tmp, f"state-{rid}"),
+             # same 2% straggler tail as BENCH_r09, so the p50s compare
+             "--slow-every", "50", "--slow-ms", "200"],
+            env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    ports = {f"r{i}": free_port() for i in range(3)}
+    procs = {rid: spawn(rid, port) for rid, port in ports.items()}
+    old_collect = os.environ.get("PIO_TRACE_COLLECT")
+    os.environ["PIO_TRACE_COLLECT"] = "1"
+    gw = GatewayServer(storage, GatewayConfig(
+        ip="127.0.0.1", port=0, sync_interval_s=0.15,
+        replica_stale_after_s=1.5,
+        scrape=True, scrape_interval_s=0.5,  # plane ON (unlike r09)
+        hedge=True, hedge_min_ms=40.0,
+        breaker_threshold=2, breaker_cooldown_s=0.5,
+    ))
+    gport = gw.start()
+
+    def post(port, body):
+        req = _rq.Request(
+            f"http://127.0.0.1:{port}/queries.json",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-PIO-Deadline": "8000"},
+            method="POST",
+        )
+        with _rq.urlopen(req, timeout=15) as r:
+            return json.loads(r.read().decode())
+
+    def loop_p50(port, n, tag):
+        times = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            post(port, {"q": f"{tag}-{i}"})
+            times.append(time.perf_counter() - t0)
+        return float(np.percentile(times, 50)) * 1e3
+
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            gw.sync_once()
+            _ring, states = gw._route_snapshot()
+            if sum(1 for st in states.values() if st.routable()) >= 3:
+                break
+            time.sleep(0.2)
+        n_probe = 60 if SMALL else 200
+        loop_p50(ports["r0"], 25, "warm-direct")
+        loop_p50(gport, 25, "warm-gw")
+        direct_p50 = loop_p50(ports["r0"], n_probe, "direct")
+        via_p50 = loop_p50(gport, n_probe, "via")
+        out["fleetobs_gateway_direct_p50_ms"] = round(direct_p50, 3)
+        out["fleetobs_gateway_via_p50_ms"] = round(via_p50, 3)
+        out["fleetobs_gateway_overhead_p50_ms"] = round(
+            max(0.0, via_p50 - direct_p50), 3
+        )
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        col = get_monitor().collector
+        if col is not None:
+            # let the collector drain its last poll cycle, then prove
+            # the plane actually ran during the measurement
+            time.sleep(1.0)
+            col.collect_once()
+            st = col.status()
+            out["fleetobs_traces_assembled"] = st["assembled"]
+            out["fleetobs_collector_polls"] = st["polls"]
+        try:
+            with open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_r09.json",
+            )) as f:
+                r09_p50 = float(json.load(f)["gateway_via_p50_ms"])
+            out["fleetobs_gateway_p50_vs_r09"] = round(
+                via_p50 / r09_p50, 3
+            )
+        except (OSError, KeyError, ValueError):
+            out["fleetobs_gateway_p50_vs_r09"] = None
+        out["host_cpus"] = os.cpu_count()
+        out["note"] = (
+            "same stub-replica harness as BENCH_r09 with the whole "
+            "observability plane attached (tracing, scraping, trace "
+            "collector); fleetobs_gateway_p50_vs_r09 is the tax"
+        )
+    finally:
+        gw.stop()
+        if old_collect is None:
+            os.environ.pop("PIO_TRACE_COLLECT", None)
+        else:
+            os.environ["PIO_TRACE_COLLECT"] = old_collect
+        for proc in procs.values():
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main():
     rows, cols, vals = make_data()
     tpu = bench_tpu(rows, cols, vals)
@@ -2886,5 +3109,9 @@ if __name__ == "__main__":
         # focused ISSUE-15 emission (BENCH_r09): the replicated serving
         # tier alone — stub replicas, no jax, no training
         print(json.dumps(bench_gateway()))
+    elif "--fleetobs" in _sys.argv:
+        # focused ISSUE-16 emission (BENCH_r10): the observability
+        # plane — recording-rule SLO eval + the traced-gateway tax
+        print(json.dumps(bench_fleetobs()))
     else:
         main()

@@ -31,6 +31,7 @@ import json
 import threading
 from typing import Any, Iterator, Optional
 
+from predictionio_tpu.analysis import tsan as _tsan
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event, new_event_id
 from predictionio_tpu.data.storage import base
@@ -111,6 +112,11 @@ class _PGClient:
     def __init__(self, config: Optional[dict] = None, conn: Any = None):
         config = config or {}
         self.lock = threading.RLock()
+        # sanitizer (carried from the sqlite backend): the client lock
+        # is held across commit() by design — one connection,
+        # serialized writers; declaring it points the blocking hook at
+        # OTHER locks wrongly held across a postgres commit
+        _tsan.allow_blocking_lock(self.lock)
         if conn is not None:  # injected by tests (fake driver)
             self.conn = conn
             return
@@ -139,12 +145,19 @@ class _PGClient:
         except Exception:
             pass
 
+    def commit(self) -> None:
+        """Commit with the blocking point declared (the sqlite.commit
+        pattern): a server round trip plus fsync on the far side —
+        locks other than self.lock held across it are findings."""
+        _tsan.note_blocking("postgres.commit")
+        self.conn.commit()
+
     def execute(self, sql: str, params: tuple = ()) -> Any:
         with self.lock:
             cur = self.conn.cursor()
             try:
                 cur.execute(sql, params)
-                self.conn.commit()
+                self.commit()
             except Exception:
                 # roll back so one failed statement can't leave the shared
                 # connection in 'current transaction is aborted' and poison
@@ -161,7 +174,7 @@ class _PGClient:
                 rows = cur.fetchall()
                 # close the read transaction — otherwise the connection
                 # sits 'idle in transaction' until a server timeout kills it
-                self.conn.commit()
+                self.commit()
             except Exception:
                 self._rollback_quietly()
                 raise
@@ -178,7 +191,7 @@ class _PGClient:
             try:
                 cur.execute(sql, params)
                 rows = cur.fetchall()
-                self.conn.commit()
+                self.commit()
             except Exception:
                 self._rollback_quietly()
                 raise
@@ -191,7 +204,7 @@ class _PGClient:
             cur = self.conn.cursor()
             try:
                 cur.executemany(sql, rows)
-                self.conn.commit()
+                self.commit()
             except Exception:
                 self._rollback_quietly()
                 raise
@@ -333,7 +346,7 @@ class PostgresEventStore(base.EventStore):
         # connection here would kill the metadata/model DAOs too
         with self._client.lock:
             try:
-                self._client.conn.commit()
+                self._client.commit()
             except Exception:
                 pass
 

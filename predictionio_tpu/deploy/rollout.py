@@ -152,9 +152,12 @@ class VariantWindow:
         self._samples: collections.deque = collections.deque()
         self._agree: collections.deque = collections.deque()
 
-    def add(self, duration_s: float, error: bool) -> None:
+    def add(self, duration_s: float, error: bool,
+            trace_id: Optional[str] = None) -> None:
         with self._lock:
-            self._samples.append((time.monotonic(), duration_s, error))
+            self._samples.append(
+                (time.monotonic(), duration_s, error, trace_id)
+            )
             self._trim()
 
     def add_agreement(self, agree: bool) -> None:
@@ -174,8 +177,8 @@ class VariantWindow:
             samples = list(self._samples)
             agree = list(self._agree)
         n = len(samples)
-        errors = sum(1 for _, _, e in samples if e)
-        durations = sorted(d for _, d, _ in samples)
+        errors = sum(1 for _, _, e, _tid in samples if e)
+        durations = sorted(d for _, d, _, _tid in samples)
         p99 = durations[min(n - 1, int(0.99 * n))] if n else 0.0
         out = {
             "count": n,
@@ -186,6 +189,15 @@ class VariantWindow:
             ),
             "p99_ms": p99 * 1000.0,
         }
+        # worst-sample exemplar (ISSUE 16): a p99-ratio rollback verdict
+        # should hand the operator the trace behind its slowest sample
+        if n:
+            _t, worst_d, _e, worst_tid = max(
+                samples, key=lambda s: s[1]
+            )
+            if worst_tid:
+                out["worst_trace_id"] = worst_tid
+                out["worst_ms"] = worst_d * 1000.0
         if agree:
             out["agreement"] = sum(1 for _, a in agree if a) / len(agree)
             out["shadow_count"] = len(agree)
@@ -411,7 +423,12 @@ class RolloutController:
     def record(self, variant: str, duration_s: float, error: bool) -> None:
         w = self.windows.get(variant)
         if w is not None:
-            w.add(duration_s, error)
+            from predictionio_tpu.obs.tracing import current_trace_id
+
+            # the serving path calls this on the handler thread, where
+            # the request's trace id is ambient — it becomes the
+            # window's worst-sample exemplar (ISSUE 16)
+            w.add(duration_s, error, trace_id=current_trace_id())
 
     def record_agreement(self, agree: bool) -> None:
         self.windows[VARIANT_CANDIDATE].add_agreement(agree)

@@ -41,6 +41,7 @@ from predictionio_tpu.deploy.scheduler import (
     TrainScheduler,
 )
 from predictionio_tpu.fleet.distributed import DistributedConfig
+from predictionio_tpu.utils.env import env_str
 
 log = logging.getLogger(__name__)
 
@@ -67,6 +68,9 @@ class WorkerInfo:
     process_id: int = 0
     num_processes: int = 1
     devices: int = 0
+    # advertised /metrics URL (PIO_WORKER_METRICS_URL): lets
+    # `pio fleet status` scrape live device gauges off each worker
+    metrics_url: str = ""
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -76,6 +80,7 @@ class WorkerInfo:
             "running_jobs": self.running_jobs, "capacity": self.capacity,
             "process_id": self.process_id,
             "num_processes": self.num_processes, "devices": self.devices,
+            "metrics_url": self.metrics_url,
         }
 
     @staticmethod
@@ -84,6 +89,7 @@ class WorkerInfo:
         for k in (
             "host", "pid", "started_at", "heartbeat_at", "running_jobs",
             "capacity", "process_id", "num_processes", "devices",
+            "metrics_url",
         ):
             if d.get(k) is not None:
                 setattr(w, k, d[k])
@@ -237,6 +243,7 @@ class FleetMember:
             process_id=dist.process_id,
             num_processes=dist.num_processes,
             devices=self._device_count(),
+            metrics_url=env_str("PIO_WORKER_METRICS_URL").strip(),
         ))
         self._stop.clear()
         self._hb_thread = threading.Thread(
@@ -279,11 +286,46 @@ class FleetMember:
                 )
 
 
+#: device-relevant gauge families `pio fleet status` pulls off each
+#: worker's /metrics (jaxmon.py exports; everything else is noise here)
+_DEVICE_FAMILIES = (
+    "jax_jit_compile_count",
+    "jax_jit_compile_seconds_total",
+    "jax_live_buffer_count",
+    "jax_live_buffer_bytes",
+)
+
+
+def worker_device_info(
+    metrics_url: str, timeout_s: float = 2.0
+) -> Optional[dict[str, float]]:
+    """Scrape one worker's advertised /metrics for its live device
+    gauges (ISSUE 16); None when unreachable or nothing exported."""
+    import urllib.request
+
+    from predictionio_tpu.obs.monitor.scrape import parse_prometheus_text
+
+    try:
+        with urllib.request.urlopen(metrics_url, timeout=timeout_s) as r:
+            body = r.read().decode(errors="replace")
+    except Exception as e:
+        log.debug("worker metrics scrape %s failed: %s", metrics_url, e)
+        return None
+    out: dict[str, float] = {}
+    for name, _labels, value in parse_prometheus_text(body):
+        if name in _DEVICE_FAMILIES:
+            out[name] = out.get(name, 0.0) + value
+    return out or None
+
+
 def fleet_status(
-    storage: Storage, stale_after_s: float = 10.0
+    storage: Storage, stale_after_s: float = 10.0,
+    probe_devices: bool = True,
 ) -> dict[str, Any]:
     """Operator view of the fleet: live/stale workers + queue depth
-    (the `pio fleet status` payload)."""
+    (the `pio fleet status` payload). Live workers that advertise a
+    metrics URL (PIO_WORKER_METRICS_URL) additionally get a
+    ``device_info`` dict scraped off their /metrics."""
     registry = WorkerRegistry(storage)
     queue = JobQueue(storage)
     workers = registry.list()
@@ -292,16 +334,25 @@ def fleet_status(
     by_status: dict[str, int] = {}
     for j in jobs:
         by_status[j.status] = by_status.get(j.status, 0) + 1
+
+    def _row(w: WorkerInfo) -> dict[str, Any]:
+        live = w.heartbeat_at >= cutoff
+        row = dict(
+            w.to_dict(),
+            live=live,
+            heartbeat_age_s=round(
+                max(0.0, time.time() - w.heartbeat_at), 1
+            ),
+        )
+        if probe_devices and live and w.metrics_url:
+            info = worker_device_info(w.metrics_url)
+            if info is not None:
+                row["device_info"] = info
+        return row
+
     return {
         "workers": [
-            dict(
-                w.to_dict(),
-                live=w.heartbeat_at >= cutoff,
-                heartbeat_age_s=round(
-                    max(0.0, time.time() - w.heartbeat_at), 1
-                ),
-            )
-            for w in sorted(workers, key=lambda w: w.id)
+            _row(w) for w in sorted(workers, key=lambda w: w.id)
         ],
         "live_workers": sum(
             1 for w in workers if w.heartbeat_at >= cutoff

@@ -557,6 +557,27 @@ _sharded_fold_in = _devprof.instrument(
 )
 
 
+#: XLA's CPU collectives run every per-device program on one shared
+#: inter-op pool and rendezvous ALL participants before any may finish.
+#: Two multi-device executables in flight at once can split the pool's
+#: threads across their rendezvous sets on small hosts and starve both
+#: forever (observed: concurrent recommend() readers under the 8-way
+#: virtual test mesh on 1-2 vCPUs wedge in AllReduce with every thread
+#: asleep). Collective dispatch on the cpu platform therefore
+#: serializes through one process-wide lock — held only around the
+#: launch+block, never while waiting on reader leases, so it is always
+#: the innermost lock. Real accelerator streams don't share a host
+#: thread pool and skip the lock entirely.
+_CPU_COLLECTIVE_LOCK = threading.Lock()
+
+
+def _collective_guard(mesh):
+    devs = mesh.devices
+    if devs.size > 1 and devs.flat[0].platform == "cpu":
+        return _CPU_COLLECTIVE_LOCK
+    return contextlib.nullcontext()
+
+
 # ---------------------------------------------------------------------------
 # the runtime
 # ---------------------------------------------------------------------------
@@ -768,12 +789,12 @@ class ShardedRuntime:
             bits = self._pack_rows(exclude_rows)
         else:
             bits = self._pack_mask(exclude_mask)
-        with self._lease() as st:
-            vals, idx = _sharded_recommend(
+        with self._lease() as st, _collective_guard(self.mesh):
+            vals, idx = jax.block_until_ready(_sharded_recommend(
                 rows, st.uf, st.itf, st.uscale, st.iscale, bits,
                 k=k, n_items=self.n_items, mesh=self.mesh,
                 mode=self.serve_mode,
-            )
+            ))
         return np.asarray(vals), np.asarray(idx)
 
     def _pack_rows(self, exclude_rows) -> Optional[jax.Array]:
@@ -818,12 +839,12 @@ class ShardedRuntime:
         k = min(int(k), self.n_items)
         vecs = jnp.asarray(np.asarray(vectors, np.float32))
         bits = self._pack_mask(exclude_mask)
-        with self._lease() as st:
-            vals, idx = _sharded_similar_vecs(
+        with self._lease() as st, _collective_guard(self.mesh):
+            vals, idx = jax.block_until_ready(_sharded_similar_vecs(
                 vecs, st.itf, st.iscale, st.iinv, bits,
                 k=k, n_items=self.n_items, mesh=self.mesh,
                 mode=self.serve_mode,
-            )
+            ))
         return np.asarray(vals), np.asarray(idx)
 
     def similar_items(
@@ -834,12 +855,12 @@ class ShardedRuntime:
     ) -> tuple[np.ndarray, np.ndarray]:
         k = min(int(k), self.n_items)
         rows = jnp.asarray(np.asarray(item_indices, np.int32))
-        with self._lease() as st:
-            vals, idx = _sharded_similar(
+        with self._lease() as st, _collective_guard(self.mesh):
+            vals, idx = jax.block_until_ready(_sharded_similar(
                 rows, st.itf, st.iscale, st.iinv, None,
                 k=k, n_items=self.n_items, mesh=self.mesh,
                 exclude_self=exclude_self, mode=self.serve_mode,
-            )
+            ))
         return np.asarray(vals), np.asarray(idx)
 
     def fold_in_rows(
@@ -874,15 +895,16 @@ class ShardedRuntime:
                 fixed, scale, scale_cols = st.itf, st.iscale, True
             else:
                 fixed, scale, scale_cols = st.uf, st.uscale, False
-            solved = _sharded_fold_in(
-                fixed, scale,
-                jnp.asarray(idx), jnp.asarray(val), jnp.asarray(ok),
-                jnp.float32(params.lambda_), jnp.float32(params.alpha),
-                implicit=params.implicit_prefs,
-                cg_iterations=params.cg_iterations,
-                mesh=self.mesh,
-                scale_cols=scale_cols,
-            )
+            with _collective_guard(self.mesh):
+                solved = jax.block_until_ready(_sharded_fold_in(
+                    fixed, scale,
+                    jnp.asarray(idx), jnp.asarray(val), jnp.asarray(ok),
+                    jnp.float32(params.lambda_), jnp.float32(params.alpha),
+                    implicit=params.implicit_prefs,
+                    cg_iterations=params.cg_iterations,
+                    mesh=self.mesh,
+                    scale_cols=scale_cols,
+                ))
         return np.asarray(solved)[:r_real]
 
     # -- state updates -----------------------------------------------------
@@ -953,27 +975,41 @@ class ShardedRuntime:
                 scols = (
                     _scatter_cols_donated if donate else _scatter_cols
                 )
-                if side == "user":
-                    uf = srows(st.uf, rows_dev, vals_dev, mesh=self.mesh)
-                    uscale = st.uscale
-                    if scale_dev is not None:
-                        uscale = srows(
-                            st.uscale, rows_dev, scale_dev[:, None],
-                            mesh=self.mesh,
+                # the guard also covers the COW fallback: its scatters
+                # run WHILE readers keep serving, and an unserialized
+                # overlap of two cpu collectives is exactly the pool-
+                # starvation wedge the lock exists for. block before
+                # releasing so no scatter is still in flight when the
+                # next reader launches.
+                with _collective_guard(self.mesh):
+                    if side == "user":
+                        uf = srows(
+                            st.uf, rows_dev, vals_dev, mesh=self.mesh
                         )
-                    new = st._replace(uf=uf, uscale=uscale)
-                else:
-                    itf = srows(st.itf, rows_dev, vals_dev, mesh=self.mesh)
-                    iscale = st.iscale
-                    if scale_dev is not None:
-                        iscale = scols(
-                            st.iscale, rows_dev, scale_dev,
-                            mesh=self.mesh,
+                        uscale = st.uscale
+                        if scale_dev is not None:
+                            uscale = srows(
+                                st.uscale, rows_dev, scale_dev[:, None],
+                                mesh=self.mesh,
+                            )
+                        new = st._replace(uf=uf, uscale=uscale)
+                    else:
+                        itf = srows(
+                            st.itf, rows_dev, vals_dev, mesh=self.mesh
                         )
-                    iinv = scols(
-                        st.iinv, rows_dev, inv_dev, mesh=self.mesh
-                    )
-                    new = st._replace(itf=itf, iscale=iscale, iinv=iinv)
+                        iscale = st.iscale
+                        if scale_dev is not None:
+                            iscale = scols(
+                                st.iscale, rows_dev, scale_dev,
+                                mesh=self.mesh,
+                            )
+                        iinv = scols(
+                            st.iinv, rows_dev, inv_dev, mesh=self.mesh
+                        )
+                        new = st._replace(
+                            itf=itf, iscale=iscale, iinv=iinv
+                        )
+                    new = jax.block_until_ready(new)
                 # ONE atomic swap: readers see either the old or the
                 # new state tuple, never a torn value/scale pair (the
                 # COW fallback admits readers during these scatters)

@@ -96,6 +96,16 @@ class ReplicaMember:
             # late-bound: the server's port is only known after start
             url = f"http://127.0.0.1:{self.server.port}"
         self.url = url
+        # stamp replica identity on every span this server emits, so
+        # the fleet trace collector's assembled tree shows WHICH
+        # replica answered each gateway attempt (ISSUE 16)
+        srv = getattr(self.server, "_server", None)
+        if srv is not None:
+            # merge, don't replace: the query server already stamped
+            # its engine identity here (workflow/server.py)
+            attrs = dict(getattr(srv, "span_attrs", None) or {})
+            attrs["replica"] = self.replica_id
+            srv.span_attrs = attrs
         self.registry.upsert(ReplicaInfo(
             id=self.replica_id,
             url=url,

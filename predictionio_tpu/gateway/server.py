@@ -54,7 +54,12 @@ from predictionio_tpu.gateway.autoscale import Autoscaler
 from predictionio_tpu.gateway.registry import ReplicaInfo, ReplicaRegistry
 from predictionio_tpu.gateway.ring import HashRing
 from predictionio_tpu.obs import server_registry
-from predictionio_tpu.obs.monitor import FleetScraper, get_monitor
+from predictionio_tpu.obs import spans as _spans
+from predictionio_tpu.obs.monitor import (
+    FleetScraper,
+    TraceCollector,
+    get_monitor,
+)
 from predictionio_tpu.resilience.breaker import CLOSED, CircuitBreaker
 from predictionio_tpu.utils.env import (
     env_bool,
@@ -316,6 +321,7 @@ class GatewayServer(ServerProcess):
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._scraper: Optional[FleetScraper] = None
+        self._collector: Optional[TraceCollector] = None
         # in-flight hint/drain-notify threads, joined on stop
         self._hint_lock = threading.Lock()
         self._hint_threads: set[threading.Thread] = set()  # guarded-by: _hint_lock
@@ -337,6 +343,15 @@ class GatewayServer(ServerProcess):
                 interval_s=self.config.scrape_interval_s,
             )
             self._scraper.start()
+            if env_bool("PIO_TRACE_COLLECT"):
+                # same cadence as the scrape pass: both lists sync from
+                # the replica registry, and the trace hold window only
+                # has to cover one poll of skew
+                self._collector = TraceCollector(
+                    interval_s=self.config.scrape_interval_s,
+                )
+                get_monitor().set_collector(self._collector)
+                self._collector.start()
         self._stop.clear()
         self.sync_once()  # route from the first request, not the first tick
         self._sync_thread = threading.Thread(
@@ -354,6 +369,12 @@ class GatewayServer(ServerProcess):
         if self._scraper is not None:
             self._scraper.stop()
             self._scraper = None
+        if self._collector is not None:
+            self._collector.stop()
+            mon = get_monitor()
+            if mon.collector is self._collector:
+                mon.set_collector(None)
+            self._collector = None
         if self.autoscaler is not None and self.autoscaler.manager:
             self.autoscaler.manager.stop()
         self._pool.shutdown(wait=False)
@@ -449,6 +470,10 @@ class GatewayServer(ServerProcess):
             )
             if targets != sorted(self._scraper.targets):
                 self._scraper.targets = list(targets)
+            if self._collector is not None and targets != sorted(
+                self._collector.targets
+            ):
+                self._collector.targets = list(targets)
         # scale-up warm-start: tell JOINING replicas which of the
         # recently-routed tenants now hash onto them
         joined = set(routable) - prev_routable
@@ -648,10 +673,24 @@ class GatewayServer(ServerProcess):
         if tid:
             headers["X-Request-ID"] = tid
         self._routing_hist.observe(time.perf_counter() - t0)
-        return self._dispatch(path, body, headers, candidates)
+        # the root of the cross-process trace this side of the handler:
+        # one gateway.request per proxied query, one gateway.attempt
+        # child per primary/hedge/failover try (recorded off-thread by
+        # _attempt — pool threads don't inherit this context)
+        with _spans.get_default_recorder().span(
+            "gateway.request", server="gateway", path=path,
+        ) as gsp:
+            status, payload, fwd = self._dispatch(
+                path, body, headers, candidates, gsp
+            )
+            gsp.attrs["status"] = status
+            if status >= 500:
+                gsp.error = True
+        return status, payload, fwd
 
     def _dispatch(
-        self, path: str, body: bytes, headers: dict, candidates: list[str]
+        self, path: str, body: bytes, headers: dict,
+        candidates: list[str], gsp: Optional[_spans.Span] = None,
     ) -> tuple[int, Any, dict]:
         """Primary + hedge + failover race over `candidates`. At most
         two attempts are ever in flight (the primary and one hedge);
@@ -667,9 +706,15 @@ class GatewayServer(ServerProcess):
         def launch(is_hedge: bool) -> None:
             nonlocal next_i
             rid = candidates[next_i]
+            kind = (
+                "primary" if next_i == 0
+                else "hedge" if is_hedge else "failover"
+            )
+            ring_pos = next_i
             next_i += 1
             fut = self._pool.submit(
-                self._attempt, states.get(rid), path, body, dict(headers)
+                self._attempt, states.get(rid), path, body, dict(headers),
+                gsp, kind, ring_pos,
             )
             inflight[fut] = (rid, is_hedge)
 
@@ -766,15 +811,49 @@ class GatewayServer(ServerProcess):
 
     def _attempt(
         self, st: Optional[_ReplicaState], path: str, body: bytes,
-        headers: dict,
+        headers: dict, gsp: Optional[_spans.Span] = None,
+        kind: str = "primary", ring_pos: int = 0,
     ) -> tuple[int, bytes, dict]:
         """One proxied attempt against one replica — fully
         self-accounting (breaker verdict, in-flight count, latency
         window), so the dispatch race can abandon it safely."""
+        # attempt span built by hand: this runs on a pool thread, where
+        # the handler's ContextVars don't exist — trace identity comes
+        # explicitly from the gateway.request span, and the headers
+        # carry it onward so the replica's server span parents here
+        sp: Optional[_spans.Span] = None
+        p0 = time.perf_counter()
+        if gsp is not None:
+            sp = _spans.Span(
+                trace_id=gsp.trace_id,
+                span_id=_spans.new_span_id(),
+                name="gateway.attempt",
+                parent_span_id=gsp.span_id,
+                start=time.time(),
+                attrs={
+                    "server": "gateway",
+                    "kind": kind,
+                    "ring_pos": ring_pos,
+                    "replica": st.info.id if st is not None else None,
+                },
+            )
+            headers["X-Request-ID"] = gsp.trace_id
+            headers["X-Parent-Span"] = sp.span_id
+
+        def finish(outcome: str, error: bool) -> None:
+            if sp is None:
+                return
+            sp.duration = time.perf_counter() - p0
+            sp.attrs["outcome"] = outcome
+            sp.error = error
+            _spans.get_default_recorder().record(sp, finalize=False)
+
         if st is None:
+            finish("vanished", True)
             raise _AttemptFailed("replica vanished from routing state")
         breaker = st.breaker
         if not breaker.allow():
+            finish("breaker_open", True)
             raise _AttemptFailed(f"breaker open for {st.info.id}")
         # re-stamp the REMAINING budget at send time (not dispatch
         # time): a hedge fired 200 ms in hands the replica 200 ms less
@@ -782,6 +861,7 @@ class GatewayServer(ServerProcess):
         if rem is not None:
             if rem <= 0:
                 breaker.release_probe()
+                finish("deadline", True)
                 raise _AttemptFailed("deadline expired before attempt")
             headers[_deadline.HEADER] = str(max(0, int(rem * 1000)))
         st.enter()
@@ -805,10 +885,12 @@ class GatewayServer(ServerProcess):
                 self._drop_conn(st.info.id)
                 breaker.record_failure()
                 verdict = True
+                finish("transport_error", True)
                 raise _AttemptFailed(str(e)) from e
             breaker.record_success()
             verdict = True
             latency = time.perf_counter() - t0
+            finish(str(resp.status), resp.status >= 500)
             return resp.status, data, rheaders
         finally:
             if not verdict:

@@ -16,6 +16,8 @@ Knobs (read when the monitor is created; mutable attributes after):
   PIO_SLO_INTERVAL_S     SLO evaluation period    (default 15)
   PIO_SLOS               JSON SLO spec array, or @/path.json
   PIO_MONITOR_TARGETS    fleet scrape targets (dashboard / pio monitor)
+  PIO_RECORDING_RULES    derived-series recording rules (ISSUE 16)
+  PIO_TENANT_SLO_PRESETS auto-derive per-tenant SLOs at mux attach
 """
 
 from __future__ import annotations
@@ -24,6 +26,7 @@ import os
 import threading
 from typing import Any, Optional
 
+from predictionio_tpu.obs.monitor.collector import TraceCollector
 from predictionio_tpu.obs.monitor.notify import AlertNotifier
 from predictionio_tpu.obs.monitor.scrape import (
     FleetScraper,
@@ -35,16 +38,21 @@ from predictionio_tpu.obs.monitor.slo import (
     SLOEngine,
     SLOSpec,
     load_slos,
+    record_slo_ratios,
+    tenant_slo_presets,
 )
 from predictionio_tpu.obs.monitor.tsdb import (
     TSDB,
     MetricsSampler,
+    RecordingRule,
     SnapshotWriter,
+    evaluate_rules,
+    load_recording_rules,
     load_snapshot,
     sample_families,
     save_snapshot,
 )
-from predictionio_tpu.utils.env import env_float, env_path
+from predictionio_tpu.utils.env import env_float, env_int, env_path
 from predictionio_tpu.utils.env import env_bool
 
 __all__ = [
@@ -52,19 +60,25 @@ __all__ = [
     "AlertNotifier",
     "MetricsSampler",
     "FleetScraper",
+    "RecordingRule",
     "SLOEngine",
     "SLOSpec",
     "AlertStatus",
     "Monitor",
     "SnapshotWriter",
+    "TraceCollector",
     "enabled",
+    "evaluate_rules",
     "get_monitor",
+    "load_recording_rules",
     "load_slos",
     "load_snapshot",
     "parse_prometheus_text",
     "parse_targets",
+    "record_slo_ratios",
     "sample_families",
     "save_snapshot",
+    "tenant_slo_presets",
 ]
 
 
@@ -111,6 +125,22 @@ class Monitor:
         self._engine: Optional[SLOEngine] = None
         self._snapshotter: Optional[SnapshotWriter] = None
         self._slos: list[SLOSpec] = load_slos()
+        # per-tenant presets (ISSUE 16): auto-derived at mux attach,
+        # kept apart from the operator's _slos — an operator spec with
+        # the same name always wins in the union fed to the engine
+        self._presets: list[SLOSpec] = []
+        # recording rules (ISSUE 16): evaluated on the sampler tick
+        # via MetricsSampler.post_sample — no extra thread
+        self.recording_rules: list[RecordingRule] = load_recording_rules()
+        # the fleet trace collector, when this process runs one
+        # (gateways, dashboards, `pio monitor`) — registered via
+        # set_collector; its lifecycle stays with its owner
+        self.collector: Optional[TraceCollector] = None
+        # scraped exemplar index (ISSUE 16): family → trace id →
+        # (value, ts), fed by the fleet scraper's `# EXEMPLAR` lines;
+        # merged with the local registries' exemplars on read
+        self._exemplars: dict[str, dict[str, tuple[float, float]]] = {}
+        self._exemplar_cap = max(16, 4 * env_int("PIO_TRACE_EXEMPLARS"))
         # push sinks (ISSUE 9 satellite): webhook/exec fired on
         # pending→firing (and resolve) transitions — SLO alerts AND the
         # externally-raised ones below
@@ -189,13 +219,21 @@ class Monitor:
                 return
             if self._sampler is None:
                 self._sampler = MetricsSampler(
-                    self.tsdb, self._families, self.sampler_interval_s
+                    self.tsdb, self._families, self.sampler_interval_s,
+                    post_sample=self._post_sample,
                 )
                 self._sampler.start()
-            if self._engine is None and self._slos:
+            specs = self._slo_union_locked()
+            if self._engine is None and specs:
                 self._engine = SLOEngine(
-                    self.tsdb, self._slos, self.slo_interval_s,
+                    self.tsdb, specs, self.slo_interval_s,
                     on_transition=self._on_transition,
+                )
+                # recorded fast path: trust ratios no staler than ~2
+                # sampler ticks (plus slack); beyond that the engine
+                # rescans raw rings itself
+                self._engine.recorded_max_age_s = (
+                    2.5 * self.sampler_interval_s
                 )
                 self._engine.start()
             if self._snapshotter is None and self.snapshot_path:
@@ -205,7 +243,26 @@ class Monitor:
                 )
                 self._snapshotter.start()
 
+    def _post_sample(self, tsdb: TSDB, now: float) -> None:
+        """Recording pass, on the sampler thread right after each raw
+        snapshot: user recording rules first, then the per-SLO ratio
+        series the engine's fast path reads."""
+        if self.recording_rules:
+            evaluate_rules(tsdb, self.recording_rules, now)
+        with self._lock:
+            specs = self._slo_union_locked()
+        if specs:
+            record_slo_ratios(tsdb, specs, now)
+
     # -- SLOs --------------------------------------------------------------
+    def _slo_union_locked(self) -> list[SLOSpec]:
+        """Operator specs + tenant presets; an operator spec shadows a
+        preset with the same name."""
+        names = {s.name for s in self._slos}
+        return self._slos + [
+            p for p in self._presets if p.name not in names
+        ]
+
     def set_slos(self, specs: list[SLOSpec]) -> None:
         """Install/replace the SLO set; starts the engine if servers are
         already attached (tests and `pio monitor` configure this way,
@@ -213,12 +270,120 @@ class Monitor:
         with self._lock:
             self._slos = list(specs)
             if self._engine is not None:
-                self._engine.set_specs(self._slos)
+                self._engine.set_specs(self._slo_union_locked())
         self._ensure_threads()
+
+    def apply_tenant_presets(self, tenant_ids) -> None:
+        """Install auto-derived per-tenant SLO presets (the mux calls
+        this on attach/refresh when PIO_TENANT_SLO_PRESETS is set).
+        No-op when the tenant set is unchanged."""
+        specs = tenant_slo_presets(tenant_ids)
+        with self._lock:
+            if [s.name for s in specs] == [
+                s.name for s in self._presets
+            ]:
+                return
+            self._presets = specs
+            if self._engine is not None:
+                self._engine.set_specs(self._slo_union_locked())
+        self._ensure_threads()
+
+    # -- trace collector + exemplars (ISSUE 16) ----------------------------
+    def set_collector(self, collector: Optional[TraceCollector]) -> None:
+        """Register (or clear) this process's fleet trace collector so
+        `GET /debug/traces?fleet=1` and alert enrichment reach it. The
+        owner (gateway / dashboard / `pio monitor`) keeps start/stop."""
+        self.collector = collector
+
+    def note_exemplar(self, family: str, trace_id: str, value: float,
+                      ts: Optional[float] = None) -> None:
+        """Index one scraped exemplar: bounded per family, one slot per
+        trace id, evicting the fastest when full — the index always
+        holds the slowest traces seen."""
+        import time as _time
+
+        ts = _time.time() if ts is None else float(ts)
+        with self._lock:
+            d = self._exemplars.setdefault(family, {})
+            prev = d.get(trace_id)
+            if prev is not None:
+                if value > prev[0]:
+                    d[trace_id] = (value, ts)
+                return
+            if len(d) >= self._exemplar_cap:
+                floor_tid = min(d, key=lambda t: d[t])
+                if value <= d[floor_tid][0]:
+                    return
+                del d[floor_tid]
+            d[trace_id] = (value, ts)
+
+    def exemplars(self, family: Optional[str] = None,
+                  limit: int = 8) -> list[dict]:
+        """Slowest-first exemplars across the scraped fleet index AND
+        the local registries' histogram families, deduped by trace id."""
+        from predictionio_tpu.obs.registry import HistogramFamily
+
+        rows: list[dict] = []
+        with self._lock:
+            for fam, d in self._exemplars.items():
+                if family and fam != family:
+                    continue
+                rows.extend(
+                    {"family": fam, "trace_id": tid,
+                     "value": v, "ts": ts}
+                    for tid, (v, ts) in d.items()
+                )
+        for f in self._families():
+            if isinstance(f, HistogramFamily) and (
+                not family or f.name == family
+            ):
+                rows.extend({"family": f.name, **ex}
+                            for ex in f.exemplars())
+        rows.sort(key=lambda r: r["value"], reverse=True)
+        seen: set[str] = set()
+        out: list[dict] = []
+        for r in rows:
+            if r["trace_id"] in seen:
+                continue
+            seen.add(r["trace_id"])
+            out.append(r)
+            if len(out) >= max(1, limit):
+                break
+        return out
+
+    def _enrich_alert(self, payload: dict) -> dict:
+        """Attach evidence to a firing alert: the slowest exemplar
+        trace ids from the relevant latency family, plus the slowest
+        assembled fleet traces when a collector runs here — the alert
+        links straight to `pio trace show --fleet <id>`."""
+        spec = payload.get("spec") or {}
+        fam = (
+            "tenant_serve_seconds" if spec.get("tenant")
+            else "http_request_seconds"
+        )
+        try:
+            exs = self.exemplars(family=fam, limit=4) or self.exemplars(
+                limit=4
+            )
+        except Exception:
+            exs = []
+        if exs:
+            payload["exemplars"] = exs
+        collector = self.collector
+        if collector is not None:
+            try:
+                slow = collector.slowest(limit=3)
+            except Exception:
+                slow = []
+            if slow:
+                payload["fleet_traces"] = slow
+        return payload
 
     def _on_transition(
         self, payload: dict, old_state: str, new_state: str
     ) -> None:
+        if new_state == "firing":
+            payload = self._enrich_alert(dict(payload))
         if new_state in ("firing", "resolved"):
             self.notifier.notify(dict(
                 payload, transition=f"{old_state}->{new_state}"
@@ -318,6 +483,9 @@ class Monitor:
             }
         else:
             out = {"enabled": True, **engine.payload()}
+            for row in out.get("alerts", []):
+                if row.get("state") == "firing":
+                    self._enrich_alert(row)
         if ext:
             out["alerts"] = list(out.get("alerts", [])) + [
                 r for r in ext if r.get("state") != "inactive"

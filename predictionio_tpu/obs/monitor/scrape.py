@@ -54,6 +54,26 @@ def parse_targets(text: str) -> list[tuple[str, str]]:
     return out
 
 
+def parse_exemplar_lines(text: str) -> list[tuple[str, str, float, float]]:
+    """Parse the registry's ``# EXEMPLAR <family> <trace_id> <value>
+    <ts>`` comment lines → [(family, trace_id, value, ts)]. Plain
+    Prometheus parsers skip them as comments; the fleet scraper feeds
+    them into the Monitor's exemplar index so a firing alert can link
+    straight to the slowest traces anywhere in the fleet."""
+    out: list[tuple[str, str, float, float]] = []
+    for line in text.splitlines():
+        parts = line.strip().split()
+        if len(parts) != 6 or parts[0] != "#" or parts[1] != "EXEMPLAR":
+            continue
+        try:
+            out.append(
+                (parts[2], parts[3], float(parts[4]), float(parts[5]))
+            )
+        except ValueError:
+            continue
+    return out
+
+
 def parse_prometheus_text(text: str) -> list[tuple[str, dict, float]]:
     """Parse exposition-format samples → [(name, labels, value)].
 
@@ -169,8 +189,21 @@ class FleetScraper:
                     "scrape_samples_stored", {"instance": instance},
                     written, "gauge", now_t,
                 )
+                self._index_exemplars(body)
             results[instance] = up
         return results
+
+    def _index_exemplars(self, body: str) -> None:
+        """Feed scraped `# EXEMPLAR` lines to the process monitor's
+        index (late import: obs.monitor imports this module)."""
+        try:
+            from predictionio_tpu.obs.monitor import get_monitor
+
+            note = get_monitor().note_exemplar
+            for family, tid, value, ts in parse_exemplar_lines(body):
+                note(family, tid, value, ts)
+        except Exception:
+            log.debug("exemplar indexing failed", exc_info=True)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

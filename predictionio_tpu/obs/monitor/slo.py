@@ -30,6 +30,13 @@ Spec fields (JSON; `PIO_SLOS` holds a JSON array or ``@/path.json``):
   min_samples   requests the fast window must contain before the rule
                 is judged at all — the zero-traffic guard: an idle
                 route neither divides by zero nor flaps its alert
+  aggregate     fleet scope (ISSUE 16): judge the scraper's
+                `instance`-tagged series instead of this process's own.
+                "sum" pools bad/total across every instance; "mean"
+                averages the per-instance error fractions (a single
+                unhealthy replica shows up even when the pooled fleet
+                total still looks fine). Kind "up" + aggregate watches
+                every scrape target, so `instance` becomes optional.
 
 Error-rate sources (all counter series the sampler already records):
 
@@ -84,6 +91,7 @@ class SLOSpec:
     for_s: float = 0.0
     resolve_s: float = 0.0
     min_samples: int = 1
+    aggregate: Optional[str] = None
 
     def __post_init__(self):
         if not self.name:
@@ -105,9 +113,15 @@ class SLOSpec:
                 f"SLO {self.name!r}: fast window must not exceed the "
                 "slow window"
             )
-        if self.kind == "up" and not self.instance:
+        if self.aggregate not in (None, "sum", "mean"):
             raise ValueError(
-                f"SLO {self.name!r}: kind 'up' needs an 'instance'"
+                f"SLO {self.name!r}: aggregate must be 'sum' or 'mean', "
+                f"got {self.aggregate!r}"
+            )
+        if self.kind == "up" and not self.instance and not self.aggregate:
+            raise ValueError(
+                f"SLO {self.name!r}: kind 'up' needs an 'instance' "
+                "(or an 'aggregate' to watch every scrape target)"
             )
 
     @property
@@ -121,6 +135,7 @@ class SLOSpec:
                 "name", "kind", "objective", "server", "route", "tenant",
                 "instance", "threshold_ms", "window_s", "fast_window_s",
                 "burn_threshold", "for_s", "resolve_s", "min_samples",
+                "aggregate",
             ) if k in d
         }
         unknown = set(d) - set(known)
@@ -140,7 +155,8 @@ class SLOSpec:
             "min_samples": self.min_samples,
         }
         if self.kind == "up":
-            out["instance"] = self.instance
+            if self.instance:
+                out["instance"] = self.instance
         else:
             out["server"] = self.server
             if self.tenant:
@@ -149,6 +165,8 @@ class SLOSpec:
                 out["route"] = self.route
         if self.kind == "latency":
             out["threshold_ms"] = self.threshold_ms
+        if self.aggregate:
+            out["aggregate"] = self.aggregate
         return out
 
 
@@ -171,6 +189,247 @@ def load_slos(text: Optional[str] = None) -> list[SLOSpec]:
     except (OSError, ValueError, TypeError) as e:
         log.warning("ignoring malformed PIO_SLOS (%s)", e)
         return []
+
+
+def tenant_slo_presets(tenant_ids) -> list[SLOSpec]:
+    """Per-tenant SLO presets, auto-derived from the tenant records at
+    mux attach (PIO_TENANT_SLO_PRESETS): a 99% availability objective
+    plus a 95% sub-500ms latency objective per tenant, judged only once
+    the tenant shows real traffic (min_samples guards quiet tenants)."""
+    specs: list[SLOSpec] = []
+    for tid in sorted(set(tenant_ids)):
+        specs.append(SLOSpec(
+            name=f"tenant:{tid}:availability", kind="availability",
+            objective=0.99, tenant=str(tid), min_samples=10,
+        ))
+        specs.append(SLOSpec(
+            name=f"tenant:{tid}:latency", kind="latency",
+            objective=0.95, tenant=str(tid), threshold_ms=500.0,
+            min_samples=10,
+        ))
+    return specs
+
+
+# -- error-rate math ---------------------------------------------------------
+#
+# Module-level so the engine's per-evaluation path and the sampler-tick
+# recording pass (record_slo_ratios) share ONE implementation of the
+# raw-window math — two copies would drift on exactly the edge cases
+# (counter resets, window baselines, min_samples) that matter.
+
+
+def _availability_source(spec: SLOSpec):
+    """(series name, label match, is_bad predicate) for one spec."""
+    if spec.tenant:
+        def is_bad(lbls: dict) -> bool:
+            return lbls.get("outcome") == "error"
+
+        return "tenant_requests_total", {"tenant": spec.tenant}, is_bad
+
+    def is_bad(lbls: dict) -> bool:
+        try:
+            return int(lbls.get("status", "0")) >= 500
+        except ValueError:
+            return False
+
+    return (
+        "http_requests_total",
+        {"server": spec.server, "path": spec.route},
+        is_bad,
+    )
+
+
+def _pool_latency_fraction(
+    tsdb: TSDB, buckets: list, counts: list, threshold_s: float,
+    window_s: float, now: float,
+) -> tuple[Optional[float], float]:
+    """(bad fraction, total) over one pool of bucket/count series; the
+    smallest le ≥ threshold is the good-bucket (PromQL's conservative
+    rounding). None fraction = no traffic or no usable bucket."""
+    total = sum(tsdb.series_increase(s, window_s, now) for s in counts)
+    if total <= 0:
+        return None, 0.0
+    best_le: Optional[float] = None
+    by_le: dict[float, list] = {}
+    for s in buckets:
+        le_s = s.labels_dict().get("le", "")
+        try:
+            le = float("inf") if le_s == "+Inf" else float(le_s)
+        except ValueError:
+            continue
+        by_le.setdefault(le, []).append(s)
+        if le >= threshold_s and (best_le is None or le < best_le):
+            best_le = le
+    if best_le is None:
+        return None, total
+    good = sum(
+        tsdb.series_increase(s, window_s, now) for s in by_le[best_le]
+    )
+    return max(0.0, 1.0 - good / total), total
+
+
+def error_fraction(
+    tsdb: TSDB, spec: SLOSpec, window_s: float, now: float
+) -> tuple[Optional[float], float]:
+    """(bad/total over the window, total). total < min_samples →
+    (None, total): not enough traffic to judge — callers hold state
+    instead of flapping (and never divide by zero).
+
+    With `spec.aggregate` set, only series carrying an `instance`
+    label (the fleet scraper's stamp) are judged: "sum" pools
+    bad/total across the fleet, "mean" averages the per-instance
+    fractions (zero-traffic instances are skipped)."""
+    floor = max(1, spec.min_samples)
+    if spec.kind == "up":
+        match = {"instance": spec.instance} if spec.instance else None
+        if spec.aggregate == "mean":
+            per: dict[str, list[float]] = {}
+            for s in tsdb.matching("up", match):
+                inst = s.labels_dict().get("instance")
+                if inst is None:
+                    continue
+                per.setdefault(inst, []).extend(
+                    v for _t, v in tsdb.points(s, window_s, now)
+                )
+            n = float(sum(len(p) for p in per.values()))
+            fracs = [
+                1.0 - sum(p) / len(p) for p in per.values() if p
+            ]
+            if n < floor or not fracs:
+                return None, n
+            return sum(fracs) / len(fracs), n
+        pts: list[float] = []
+        for s in tsdb.matching("up", match):
+            if spec.aggregate and "instance" not in s.labels_dict():
+                continue
+            pts.extend(v for _t, v in tsdb.points(s, window_s, now))
+        if len(pts) < floor:
+            return None, float(len(pts))
+        return 1.0 - sum(pts) / len(pts), float(len(pts))
+    if spec.kind == "availability":
+        name, match, is_bad = _availability_source(spec)
+        series = tsdb.matching(name, match)
+        if spec.aggregate:
+            series = [
+                s for s in series if "instance" in s.labels_dict()
+            ]
+        if spec.aggregate == "mean":
+            per_tot: dict[str, float] = {}
+            per_bad: dict[str, float] = {}
+            for s in series:
+                inst = s.labels_dict()["instance"]
+                inc = tsdb.series_increase(s, window_s, now)
+                per_tot[inst] = per_tot.get(inst, 0.0) + inc
+                if is_bad(s.labels_dict()):
+                    per_bad[inst] = per_bad.get(inst, 0.0) + inc
+            grand = sum(per_tot.values())
+            fracs = [
+                per_bad.get(i, 0.0) / t
+                for i, t in per_tot.items() if t > 0
+            ]
+            if grand < floor or not fracs:
+                return None, grand
+            return sum(fracs) / len(fracs), grand
+        total = bad = 0.0
+        for s in series:
+            inc = tsdb.series_increase(s, window_s, now)
+            total += inc
+            if is_bad(s.labels_dict()):
+                bad += inc
+        if total < floor:
+            return None, total
+        return bad / total, total
+    # latency: good = requests under the threshold, via the sampled
+    # cumulative bucket counters
+    if spec.tenant:
+        name = "tenant_serve_seconds_bucket"
+        cname = "tenant_serve_seconds_count"
+        match = {"tenant": spec.tenant}
+    else:
+        name = "http_request_seconds_bucket"
+        cname = "http_request_seconds_count"
+        match = {"server": spec.server, "path": spec.route}
+    threshold_s = spec.threshold_ms / 1000.0
+    buckets = tsdb.matching(name, match)
+    counts = tsdb.matching(cname, match)
+    if spec.aggregate:
+        buckets = [s for s in buckets if "instance" in s.labels_dict()]
+        counts = [s for s in counts if "instance" in s.labels_dict()]
+    if spec.aggregate == "mean":
+        pools: dict[str, tuple[list, list]] = {}
+        for s in counts:
+            pools.setdefault(
+                s.labels_dict()["instance"], ([], [])
+            )[1].append(s)
+        for s in buckets:
+            inst = s.labels_dict()["instance"]
+            if inst in pools:
+                pools[inst][0].append(s)
+        grand = 0.0
+        fracs = []
+        for bs, cs in pools.values():
+            frac, total = _pool_latency_fraction(
+                tsdb, bs, cs, threshold_s, window_s, now
+            )
+            grand += total
+            if frac is not None:
+                fracs.append(frac)
+        if grand < floor or not fracs:
+            return None, grand
+        return sum(fracs) / len(fracs), grand
+    frac, total = _pool_latency_fraction(
+        tsdb, buckets, counts, threshold_s, window_s, now
+    )
+    if total < floor or frac is None:
+        return None, total
+    return frac, total
+
+
+# -- recorded ratios (ISSUE 16) ----------------------------------------------
+#
+# record_slo_ratios runs on the SAMPLER tick (MetricsSampler's
+# post_sample hook — no extra thread): one raw-window rescan per tick
+# stores `slo_error_ratio{slo,window}` and `slo_samples{slo,window}` as
+# first-class series, and the engine's burn_rate then reads one
+# precomputed point per window instead of rescanning every raw bucket
+# ring on every evaluation. Freshness-gated: a recorded point older
+# than `recorded_max_age_s` (sampler wedged, rules disabled) silently
+# falls back to the raw math, so recording can never make alerting
+# WRONG — only cheap.
+
+RECORDED_RATIO = "slo_error_ratio"
+RECORDED_SAMPLES = "slo_samples"
+
+
+def record_slo_ratios(
+    tsdb: TSDB, specs: list[SLOSpec], now: Optional[float] = None
+) -> int:
+    """One recording pass over every spec × (fast, slow) window.
+    Samples are always written (the engine needs 'quiet' to be
+    observable); the ratio only when there is enough traffic to judge.
+    Returns points written."""
+    now = time.time() if now is None else now
+    written = 0
+    for spec in specs:
+        for tag, window_s in (
+            ("fast", spec.fast_window_s), ("slow", spec.window_s)
+        ):
+            try:
+                frac, samples = error_fraction(tsdb, spec, window_s, now)
+            except Exception:
+                log.debug(
+                    "recording ratios for %s failed", spec.name,
+                    exc_info=True,
+                )
+                continue
+            labels = {"slo": spec.name, "window": tag}
+            if tsdb.add(RECORDED_SAMPLES, labels, samples, "gauge", now):
+                written += 1
+            if frac is not None and tsdb.add(
+                RECORDED_RATIO, labels, frac, "gauge", now
+            ):
+                written += 1
+    return written
 
 
 @dataclass
@@ -224,6 +483,10 @@ class SLOEngine:
                  on_transition=None):
         self.tsdb = tsdb
         self.interval_s = max(0.05, float(interval_s))
+        # recorded-ratio fast path (ISSUE 16): points no older than this
+        # are trusted over a raw rescan; 0 disables the fast path (the
+        # Monitor sets ~2 sampler intervals when recording is on)
+        self.recorded_max_age_s = 0.0
         # notification hook (ISSUE 9 satellite): called OUTSIDE the lock
         # as (status_dict, old_state, new_state) on every state change —
         # the Monitor wires the webhook/exec sinks through it
@@ -262,86 +525,55 @@ class SLOEngine:
     def _error_fraction(
         self, spec: SLOSpec, window_s: float, now: float
     ) -> tuple[Optional[float], float]:
-        """(bad/total over the window, total). total < min_samples →
-        (None, total): not enough traffic to judge — the caller holds
-        state instead of flapping (and never divides by zero)."""
-        if spec.kind == "up":
-            pts: list[float] = []
-            for s in self.tsdb.matching("up", {"instance": spec.instance}):
-                pts.extend(
-                    v for _t, v in self.tsdb.points(s, window_s, now)
-                )
-            if len(pts) < max(1, spec.min_samples):
-                return None, float(len(pts))
-            return 1.0 - sum(pts) / len(pts), float(len(pts))
-        if spec.kind == "availability":
-            if spec.tenant:
-                name, match = (
-                    "tenant_requests_total", {"tenant": spec.tenant}
-                )
+        """Raw-window math — see the module-level error_fraction (one
+        shared implementation with the recording pass)."""
+        return error_fraction(self.tsdb, spec, window_s, now)
 
-                def is_bad(lbls: dict) -> bool:
-                    return lbls.get("outcome") == "error"
-            else:
-                name, match = (
-                    "http_requests_total",
-                    {"server": spec.server, "path": spec.route},
-                )
-
-                def is_bad(lbls: dict) -> bool:
-                    try:
-                        return int(lbls.get("status", "0")) >= 500
-                    except ValueError:
-                        return False
-            total = bad = 0.0
-            for s in self.tsdb.matching(name, match):
-                inc = self.tsdb.series_increase(s, window_s, now)
-                total += inc
-                if is_bad(s.labels_dict()):
-                    bad += inc
-            if total < max(1, spec.min_samples):
-                return None, total
-            return bad / total, total
-        # latency: good = requests under the threshold, via the sampled
-        # cumulative bucket counters (the smallest le ≥ threshold is the
-        # conservative good-bucket — same rounding PromQL applies)
-        if spec.tenant:
-            name = "tenant_serve_seconds_bucket"
-            cname = "tenant_serve_seconds_count"
-            match: dict = {"tenant": spec.tenant}
-        else:
-            name = "http_request_seconds_bucket"
-            cname = "http_request_seconds_count"
-            match = {"server": spec.server, "path": spec.route}
-        total = self.tsdb.increase(cname, match, window_s, now)
-        if total < max(1, spec.min_samples):
-            return None, total
-        threshold_s = spec.threshold_ms / 1000.0
-        best_le: Optional[float] = None
-        series_by_le: dict[float, Any] = {}
-        for s in self.tsdb.matching(name, match):
-            le_s = s.labels_dict().get("le", "")
-            try:
-                le = float("inf") if le_s == "+Inf" else float(le_s)
-            except ValueError:
-                continue
-            series_by_le.setdefault(le, []).append(s)
-            if le >= threshold_s and (best_le is None or le < best_le):
-                best_le = le
-        if best_le is None:
-            return None, total
-        good = sum(
-            self.tsdb.series_increase(s, window_s, now)
-            for s in series_by_le[best_le]
-        )
-        return max(0.0, 1.0 - good / total), total
+    def _recorded_fraction(
+        self, spec: SLOSpec, window_tag: str, now: float
+    ) -> Optional[tuple[Optional[float], float]]:
+        """The recorded fast path: read the precomputed
+        slo_error_ratio/slo_samples point for this spec+window. None =
+        MISS (no point, or staler than recorded_max_age_s) — caller
+        falls back to the raw rescan. (None, samples) = a fresh HIT
+        that says 'not enough traffic to judge' — the hold-state
+        signal, same as the raw path's."""
+        if self.recorded_max_age_s <= 0:
+            return None
+        match = {"slo": spec.name, "window": window_tag}
+        spt = self.tsdb.latest_point(RECORDED_SAMPLES, match)
+        if spt is None or now - spt[0] > self.recorded_max_age_s:
+            return None
+        samples = spt[1]
+        if samples < max(1, spec.min_samples):
+            return None, samples
+        rpt = self.tsdb.latest_point(RECORDED_RATIO, match)
+        if rpt is None or now - rpt[0] > self.recorded_max_age_s:
+            return None
+        return rpt[1], samples
 
     def burn_rate(
         self, spec: SLOSpec, window_s: float, now: Optional[float] = None
     ) -> tuple[Optional[float], float]:
-        """(error_fraction / budget, samples) over the window."""
+        """(error_fraction / budget, samples) over the window — via the
+        recorded fast path when a fresh precomputed ratio exists,
+        rescanning the raw rings otherwise."""
         now = time.time() if now is None else now
-        frac, samples = self._error_fraction(spec, window_s, now)
+        tag = (
+            "fast" if window_s == spec.fast_window_s
+            else "slow" if window_s == spec.window_s
+            else None
+        )
+        frac: Optional[float] = None
+        samples = 0.0
+        hit = (
+            self._recorded_fraction(spec, tag, now)
+            if tag is not None else None
+        )
+        if hit is not None:
+            frac, samples = hit
+        else:
+            frac, samples = self._error_fraction(spec, window_s, now)
         if frac is None:
             return None, samples
         return frac / spec.budget, samples

@@ -33,6 +33,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict, deque
+from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional
 
 from predictionio_tpu.obs.registry import (
@@ -41,6 +42,7 @@ from predictionio_tpu.obs.registry import (
     HistogramFamily,
     MetricFamily,
 )
+from predictionio_tpu.utils.env import env_str
 
 LabelPairs = tuple[tuple[str, str], ...]
 
@@ -227,13 +229,21 @@ class TSDB:
 
     def latest(self, name: str, match: Optional[dict] = None
                ) -> Optional[float]:
-        best_t, best_v = None, None
+        pt = self.latest_point(name, match)
+        return None if pt is None else pt[1]
+
+    def latest_point(self, name: str, match: Optional[dict] = None
+                     ) -> Optional[tuple[float, float]]:
+        """Newest (t, value) across matching series — readers that need
+        FRESHNESS (the SLO engine's recorded-ratio fast path) check the
+        timestamp, not just the value."""
+        best: Optional[tuple[float, float]] = None
         for s in self.matching(name, match):
             with self._lock:
                 pt = s.points[-1] if s.points else None
-            if pt is not None and (best_t is None or pt[0] > best_t):
-                best_t, best_v = pt
-        return best_v
+            if pt is not None and (best is None or pt[0] > best[0]):
+                best = pt
+        return best
 
     def series_count(self) -> int:
         with self._lock:
@@ -271,6 +281,231 @@ class TSDB:
         with self._lock:
             self._series.clear()
             self.dropped_series = 0
+
+
+# -- recording rules (ISSUE 16) ----------------------------------------------
+#
+# Declarative DERIVED series, evaluated once per sampler tick and
+# stored as first-class points: a rate, an error ratio, or a bucket
+# quantile computed from the raw counter/histogram rings. Consumers
+# (the SLO engine, dashboard sparklines, `pio monitor`) then read one
+# precomputed point instead of rescanning hundreds of raw bucket
+# points per pass. Rules parse from PIO_RECORDING_RULES (a JSON array
+# or ``@/path.json``) — per-SLO ratio rules are auto-derived on top by
+# the Monitor (see slo.record_slo_ratios).
+
+RULE_KINDS = ("rate", "error_ratio", "quantile")
+
+
+def bucket_quantile(tsdb: TSDB, name: str, q: float,
+                    match: Optional[dict] = None,
+                    window_s: Optional[float] = None,
+                    now: Optional[float] = None) -> Optional[float]:
+    """histogram_quantile over raw cumulative ``<name>_bucket`` rings:
+    per-le increase across the window, then linear interpolation inside
+    the target bucket (None on zero traffic)."""
+    inc_by_le: dict[float, float] = {}
+    for s in tsdb.matching(name + "_bucket", match):
+        le_s = s.labels_dict().get("le", "")
+        try:
+            le = float("inf") if le_s == "+Inf" else float(le_s)
+        except ValueError:
+            continue
+        inc_by_le[le] = (
+            inc_by_le.get(le, 0.0)
+            + tsdb.series_increase(s, window_s, now)
+        )
+    if not inc_by_le:
+        return None
+    edges = sorted(inc_by_le)
+    total = inc_by_le.get(float("inf"), max(inc_by_le.values()))
+    if total <= 0:
+        return None
+    target = min(max(q, 0.0), 1.0) * total
+    prev_edge = 0.0
+    prev_cum = 0.0
+    for le in edges:
+        cum = inc_by_le[le]
+        if cum >= target:
+            if le == float("inf"):
+                # fell past the finite edges: the highest finite edge
+                # is the best bounded estimate (same as the registry)
+                finite = [e for e in edges if e != float("inf")]
+                return finite[-1] if finite else None
+            n = cum - prev_cum
+            frac = (target - prev_cum) / n if n > 0 else 0.0
+            return prev_edge + (le - prev_edge) * frac
+        prev_edge = 0.0 if le == float("inf") else le
+        prev_cum = cum
+    finite = [e for e in edges if e != float("inf")]
+    return finite[-1] if finite else None
+
+
+@dataclass(frozen=True)
+class RecordingRule:
+    """One derived-series rule.
+
+    record    output series name (stored as a gauge)
+    kind      "rate" | "error_ratio" | "quantile"
+    source    raw family name (base name — no _bucket/_total suffix
+              stripping is attempted; pass the counter name for rate/
+              error_ratio and the histogram base name for quantile)
+    match     label matcher on the source series
+    labels    labels stamped on the derived series
+    window_s  evaluation window (default 300)
+    q         quantile rules: the quantile (default 0.99)
+    bad_label error_ratio rules: which label marks badness
+    bad_min   numeric threshold: bad when int(label) >= bad_min
+    bad_values exact-match alternative to bad_min
+    """
+
+    record: str
+    kind: str
+    source: str
+    match: tuple = ()
+    labels: tuple = ()
+    window_s: float = 300.0
+    q: float = 0.99
+    bad_label: str = "status"
+    bad_min: Optional[float] = 500.0
+    bad_values: tuple = ()
+
+    def __post_init__(self):
+        if not self.record or not self.source:
+            raise ValueError("recording rule needs 'record' and 'source'")
+        if self.kind not in RULE_KINDS:
+            raise ValueError(
+                f"rule {self.record!r}: unknown kind {self.kind!r} "
+                f"(known: {', '.join(RULE_KINDS)})"
+            )
+        if self.window_s <= 0:
+            raise ValueError(f"rule {self.record!r}: window_s must be > 0")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RecordingRule":
+        known = {
+            k: d[k] for k in (
+                "record", "kind", "source", "match", "labels", "window_s",
+                "q", "bad_label", "bad_min", "bad_values",
+            ) if k in d
+        }
+        unknown = set(d) - set(known)
+        if unknown:
+            raise ValueError(
+                "recording rule has unknown field(s): "
+                + ", ".join(sorted(unknown))
+            )
+        for key in ("match", "labels"):
+            if key in known and isinstance(known[key], dict):
+                known[key] = tuple(sorted(
+                    (str(k), str(v)) for k, v in known[key].items()
+                ))
+        if "bad_values" in known:
+            known["bad_values"] = tuple(
+                str(v) for v in known["bad_values"]
+            )
+        return cls(**known)
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "record": self.record, "kind": self.kind,
+            "source": self.source, "window_s": self.window_s,
+            "match": dict(self.match), "labels": dict(self.labels),
+        }
+        if self.kind == "quantile":
+            out["q"] = self.q
+        if self.kind == "error_ratio":
+            out["bad_label"] = self.bad_label
+            if self.bad_values:
+                out["bad_values"] = list(self.bad_values)
+            else:
+                out["bad_min"] = self.bad_min
+        return out
+
+    def evaluate(self, tsdb: TSDB,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Compute this rule's current value (None on no traffic —
+        nothing is written for an empty window, so readers can tell
+        'quiet' from 'zero')."""
+        now = time.time() if now is None else now
+        match = dict(self.match) or None
+        if self.kind == "rate":
+            if not tsdb.matching(self.source, match):
+                return None
+            return tsdb.rate(self.source, match, self.window_s, now)
+        if self.kind == "quantile":
+            return bucket_quantile(
+                tsdb, self.source, self.q, match, self.window_s, now
+            )
+        # error_ratio
+        total = bad = 0.0
+        for s in tsdb.matching(self.source, match):
+            inc = tsdb.series_increase(s, self.window_s, now)
+            total += inc
+            lbl = s.labels_dict().get(self.bad_label, "")
+            if self.bad_values:
+                is_bad = lbl in self.bad_values
+            else:
+                try:
+                    is_bad = float(int(lbl)) >= float(self.bad_min or 0.0)
+                except (TypeError, ValueError):
+                    is_bad = False
+            if is_bad:
+                bad += inc
+        if total <= 0:
+            return None
+        return bad / total
+
+
+def load_recording_rules(
+    text: Optional[str] = None,
+) -> list[RecordingRule]:
+    """Parse ``PIO_RECORDING_RULES`` (or an explicit string): a JSON
+    array of rule objects, or ``@/path.json``. Malformed input logs
+    and yields [] — same grammar discipline as PIO_SLOS."""
+    import json as _json
+    import logging as _logging
+
+    raw = text if text is not None else env_str("PIO_RECORDING_RULES")
+    raw = (raw or "").strip()
+    if not raw:
+        return []
+    try:
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        data = _json.loads(raw)
+        if isinstance(data, dict):
+            data = [data]
+        return [RecordingRule.from_dict(d) for d in data]
+    except (OSError, ValueError, TypeError) as e:
+        _logging.getLogger(__name__).warning(
+            "ignoring malformed PIO_RECORDING_RULES (%s)", e
+        )
+        return []
+
+
+def evaluate_rules(tsdb: TSDB, rules: Iterable[RecordingRule],
+                   now: Optional[float] = None) -> int:
+    """One recording pass: evaluate every rule, store the results as
+    first-class gauge points. Returns points written."""
+    now = time.time() if now is None else now
+    written = 0
+    for rule in rules:
+        try:
+            value = rule.evaluate(tsdb, now)
+        except Exception:
+            import logging as _logging
+
+            _logging.getLogger(__name__).debug(
+                "recording rule %s failed", rule.record, exc_info=True,
+            )
+            continue
+        if value is None:
+            continue
+        if tsdb.add(rule.record, dict(rule.labels), value, "gauge", now):
+            written += 1
+    return written
 
 
 # -- snapshot persistence (ISSUE 15 satellite) -------------------------------
@@ -512,10 +747,15 @@ class MetricsSampler:
 
     def __init__(self, tsdb: TSDB,
                  provider: Callable[[], list[MetricFamily]],
-                 interval_s: float = 5.0):
+                 interval_s: float = 5.0,
+                 post_sample: Optional[Callable[[TSDB, float], None]] = None):
         self.tsdb = tsdb
         self.provider = provider
         self.interval_s = max(0.05, float(interval_s))
+        # runs on the sampler thread after each snapshot — recording
+        # rules piggyback here so derived series share the raw series'
+        # tick timestamps and no extra thread joins the leak budget
+        self.post_sample = post_sample
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -524,7 +764,14 @@ class MetricsSampler:
             families = self.provider()
         except Exception:
             return 0
-        return sample_families(self.tsdb, families, now=now)
+        now = time.time() if now is None else now
+        written = sample_families(self.tsdb, families, now=now)
+        if self.post_sample is not None:
+            try:
+                self.post_sample(self.tsdb, now)
+            except Exception:
+                pass  # derived series must never take down raw sampling
+        return written
 
     def start(self) -> None:
         if self._thread is not None:

@@ -20,7 +20,11 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Any, Callable, Iterable, Optional, Sequence
+
+from predictionio_tpu.obs.tracing import current_trace_id
+from predictionio_tpu.utils.env import env_int
 
 # latency seconds: spans sub-ms device dispatches to multi-second trains
 DEFAULT_LATENCY_BUCKETS: tuple[float, ...] = (
@@ -189,12 +193,21 @@ class HistogramFamily(MetricFamily):
         # latencies; count-valued histograms (batch_size) pass 1 so a
         # bucket of all-ones yields p50=1, not an impossible 0.5
         self.lower_bound = float(lower_bound)
+        # ISSUE 16 exemplars: the slowest N (trace-id, value) pairs ever
+        # observed on this family while a request trace was in scope —
+        # one slot per trace id, so a single pathological request cannot
+        # monopolize the reservoir. The bound is per FAMILY (not per
+        # label set): exemplars answer "which trace do I open for this
+        # alert", and one bounded list per family is enough for that.
+        self._exemplar_cap = env_int("PIO_TRACE_EXEMPLARS")
+        self._exemplars: dict[str, tuple[float, float]] = {}
 
     def _new_child(self) -> _Histogram:
         return _Histogram(len(self.buckets))
 
     def observe(self, value: float, **labels: Any) -> None:
         value = float(value)
+        tid = current_trace_id() if self._exemplar_cap > 0 else None
         with self._lock:
             child = self._child(self._values(**labels))
             i = 0
@@ -206,6 +219,31 @@ class HistogramFamily(MetricFamily):
             child.bucket_counts[i] += 1
             child.sum += value
             child.count += 1
+            if tid is not None:
+                self._note_exemplar_locked(tid, value)
+
+    def _note_exemplar_locked(self, tid: str, value: float) -> None:
+        prev = self._exemplars.get(tid)
+        if prev is not None:
+            if value > prev[0]:
+                self._exemplars[tid] = (value, time.time())
+            return
+        if len(self._exemplars) >= self._exemplar_cap:
+            floor_tid = min(self._exemplars, key=lambda t: self._exemplars[t])
+            if value <= self._exemplars[floor_tid][0]:
+                return
+            del self._exemplars[floor_tid]
+        self._exemplars[tid] = (value, time.time())
+
+    def exemplars(self) -> list[dict]:
+        """Retained exemplars, slowest first: [{trace_id, value, ts}]."""
+        with self._lock:
+            items = list(self._exemplars.items())
+        items.sort(key=lambda kv: kv[1][0], reverse=True)
+        return [
+            {"trace_id": tid, "value": val, "ts": ts}
+            for tid, (val, ts) in items
+        ]
 
     def _get(self, labels: dict) -> Optional[_Histogram]:
         return self._children.get(self._values(**labels))
@@ -406,6 +444,16 @@ def render_families(families: Iterable[MetricFamily]) -> str:
                     lines.append(
                         f"{fam.name}{ls} {_format_value(c.value)}"
                     )
+        if isinstance(fam, HistogramFamily):
+            # exemplars ride as comment lines (a scraper that doesn't
+            # understand them skips '#'; ours parses them back into the
+            # fleet exemplar index). Emitted outside the family lock —
+            # exemplars() takes it.
+            for ex in fam.exemplars():
+                lines.append(
+                    f"# EXEMPLAR {fam.name} {ex['trace_id']} "
+                    f"{repr(float(ex['value']))} {ex['ts']:.3f}"
+                )
     return "\n".join(lines) + "\n"
 
 

@@ -44,7 +44,7 @@ import random
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -149,6 +149,13 @@ class SpanRecorder:
         # that batch's traces regardless of the sample rate
         self._captures: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
         self._forced: dict[str, str] = {}  # trace_id -> capture_id  # guarded-by: _lock
+        # every completed span, pre-sampling, for the fleet trace
+        # collector (ISSUE 16): cross-process stitching needs the raw
+        # fragments — a fast replica-side attempt would never survive
+        # LOCAL tail sampling, yet it is exactly the child the
+        # assembled hedged trace must show. Bounded ring; the
+        # collector dedups on span_id across overlapping polls.
+        self._recent: deque[Span] = deque(maxlen=4096)  # guarded-by: _lock
 
     # -- recording ---------------------------------------------------------
     @contextmanager
@@ -207,6 +214,7 @@ class SpanRecorder:
             except Exception:
                 pass  # a metrics hiccup must never break the request
         with self._lock:
+            self._recent.append(sp)
             kept = self._traces.get(sp.trace_id)
             if kept is not None:
                 # trace already deemed interesting: merge late fragments
@@ -366,6 +374,16 @@ class SpanRecorder:
         }
 
     # -- reading -----------------------------------------------------------
+    def recent(self, since: float = 0.0) -> list[Span]:
+        """Raw completed spans (pre-sampling) whose END falls at or
+        after `since` — the `/debug/traces?spans=1` dump the fleet
+        trace collector polls for cross-process stitching."""
+        with self._lock:
+            spans = list(self._recent)
+        if since <= 0.0:
+            return spans
+        return [s for s in spans if s.start + s.duration >= since]
+
     def get_trace(self, trace_id: str) -> list[Span]:
         """Spans of a retained trace, start-ordered ([] if not retained)."""
         with self._lock:
@@ -467,6 +485,7 @@ class SpanRecorder:
             self._traces.clear()
             self._captures.clear()
             self._forced.clear()
+            self._recent.clear()
 
 
 _default_recorder: Optional[SpanRecorder] = None

@@ -30,7 +30,7 @@ from typing import Any, Optional
 from predictionio_tpu.tenancy.cache import ModelCache, ModelLoadError
 from predictionio_tpu.tenancy.quota import QuotaEnforcer, QuotaExceeded
 from predictionio_tpu.tenancy.tenants import Tenant, TenantStore
-from predictionio_tpu.utils.env import env_float
+from predictionio_tpu.utils.env import env_flag, env_float
 
 log = logging.getLogger(__name__)
 
@@ -374,6 +374,16 @@ class TenantMux:
         with self._lock:
             self._removed_pending |= set(self._tenants) - set(tenants)
             self._tenants = tenants
+        if env_flag("PIO_TENANT_SLO_PRESETS"):
+            # fleet SLO presets (ISSUE 16): every known tenant gets an
+            # auto-derived availability + latency objective; no-op when
+            # the tenant set is unchanged, and never fails the refresh
+            try:
+                from predictionio_tpu.obs.monitor import get_monitor
+
+                get_monitor().apply_tenant_presets(list(tenants))
+            except Exception:
+                log.debug("tenant SLO preset sync failed", exc_info=True)
         for t in tenants.values():
             self.quota.configure(t)
         self._cleanup_removed(abort_active=False)

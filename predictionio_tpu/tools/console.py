@@ -621,28 +621,62 @@ def _print_span_tree(spans: list[dict]) -> None:
         walk(r, 0)
 
 
+def _fleet_collector():
+    """This process's fleet trace collector, or a CLI failure when no
+    gateway/monitor in this process is running one."""
+    from predictionio_tpu.obs.monitor import get_monitor
+
+    col = get_monitor().collector
+    if col is None:
+        _fail(
+            "no fleet trace collector in this process — pass --url "
+            "pointing at a gateway started with PIO_TRACE_COLLECT=1"
+        )
+    return col
+
+
 def cmd_trace(args) -> int:
     """`pio trace list|show|export` — the retained (tail-sampled) traces
-    of a running server (--url http://host:port) or of this process."""
+    of a running server (--url http://host:port) or of this process.
+    With --fleet, the ASSEMBLED cross-process traces of the fleet
+    collector (gateway root + per-attempt children + replica-side
+    server spans stitched by request id) instead of one process's
+    local fragments."""
     import json as _json
 
     from predictionio_tpu.obs.spans import get_default_recorder
 
     url = getattr(args, "url", None)
+    fleet = getattr(args, "fleet", False)
     action = args.trace_action
     if action == "list":
         if url:
-            data = _fetch_debug_traces(url, f"limit={args.limit}")
-            summaries, cfg = data["traces"], data.get("sampling", {})
+            params = f"limit={args.limit}"
+            if fleet:
+                params = "fleet=1&" + params
+            data = _fetch_debug_traces(url, params)
+            summaries = data["traces"]
+            cfg = data.get("collector" if fleet else "sampling", {})
+        elif fleet:
+            col = _fleet_collector()
+            if col is None:
+                return 1
+            summaries, cfg = col.summaries(limit=args.limit), col.status()
         else:
             rec = get_default_recorder()
             summaries, cfg = rec.summaries(limit=args.limit), rec.config()
+        kind = "assembled fleet" if fleet else "retained"
         print(
-            f"[INFO] {len(summaries)} retained trace(s) "
-            f"(sampling: {cfg})"
+            f"[INFO] {len(summaries)} {kind} trace(s) "
+            f"({'collector' if fleet else 'sampling'}: {cfg})"
         )
         for s in summaries:
-            where = f" {s['server']}" if s.get("server") else ""
+            # fleet rows carry every server the trace crossed; local
+            # rows only ever saw one
+            servers = s.get("servers") or (
+                [s["server"]] if s.get("server") else []
+            )
+            where = f" {','.join(servers)}" if servers else ""
             path = f" {s['path']}" if s.get("path") else ""
             err = " ERROR" if s["error"] else ""
             print(
@@ -653,8 +687,16 @@ def cmd_trace(args) -> int:
         return 0
     if action == "show":
         if url:
-            data = _fetch_debug_traces(url, f"trace_id={args.trace_id}")
+            params = f"trace_id={args.trace_id}"
+            if fleet:
+                params = "fleet=1&" + params
+            data = _fetch_debug_traces(url, params)
             spans = data["spans"]
+        elif fleet:
+            col = _fleet_collector()
+            if col is None:
+                return 1
+            spans = col.get_trace(args.trace_id)
         else:
             spans = [
                 s.to_dict()
@@ -670,7 +712,14 @@ def cmd_trace(args) -> int:
         params = "format=perfetto"
         if args.trace_id:
             params = f"trace_id={args.trace_id}&" + params
+        if fleet:
+            params = "fleet=1&" + params
         export = _fetch_debug_traces(url, params)
+    elif fleet:
+        col = _fleet_collector()
+        if col is None:
+            return 1
+        export = col.perfetto_export(args.trace_id)
     else:
         export = get_default_recorder().perfetto_export(args.trace_id)
     if not export.get("traceEvents"):
@@ -880,10 +929,12 @@ def cmd_monitor(args) -> int:
     from predictionio_tpu.obs.monitor import (
         FleetScraper,
         SLOEngine,
+        TraceCollector,
         get_monitor,
         load_slos,
         parse_targets,
     )
+    from predictionio_tpu.utils.env import env_bool
 
     targets = parse_targets(
         args.targets or _env_str("PIO_MONITOR_TARGETS")
@@ -897,6 +948,14 @@ def cmd_monitor(args) -> int:
     scraper = FleetScraper(
         monitor.tsdb, targets, interval_s=args.interval
     )
+    # the trace collector rides the same targets: the monitor process
+    # assembles the fleet's cross-process traces too (PIO_TRACE_COLLECT)
+    collector = None
+    if env_bool("PIO_TRACE_COLLECT"):
+        collector = TraceCollector(
+            targets=list(targets), interval_s=args.interval
+        )
+        monitor.set_collector(collector)
     specs = load_slos(args.slos) if args.slos else load_slos()
     engine = None
     if specs:
@@ -909,6 +968,8 @@ def cmd_monitor(args) -> int:
     try:
         while True:
             ups = scraper.scrape_once()
+            if collector is not None:
+                collector.collect_once()
             if engine is not None:
                 engine.evaluate_once()
             stamp = _time.strftime("%H:%M:%S")
@@ -916,7 +977,11 @@ def cmd_monitor(args) -> int:
                 f"{inst}={'up' if ok else 'DOWN'}"
                 for inst, ok in sorted(ups.items())
             )
-            print(f"[INFO] {stamp} fleet: {fleet}")
+            traces = (
+                f"  traces={collector.status()['assembled']}"
+                if collector is not None else ""
+            )
+            print(f"[INFO] {stamp} fleet: {fleet}{traces}")
             if engine is not None:
                 for row in engine.payload()["slos"]:
                     fast = row["fast_burn"]
@@ -1831,10 +1896,15 @@ def build_parser() -> argparse.ArgumentParser:
     tl = tsub.add_parser("list", help="list retained trace summaries")
     tl.add_argument("--url", help="server base URL, e.g. http://127.0.0.1:8000")
     tl.add_argument("--limit", type=int, default=20)
+    tl.add_argument("--fleet", action="store_true",
+                    help="assembled cross-process traces (the fleet "
+                         "collector on a gateway/dashboard/monitor)")
     tl.set_defaults(func=cmd_trace)
     ts = tsub.add_parser("show", help="print one trace's span tree")
     ts.add_argument("trace_id")
     ts.add_argument("--url", help="server base URL")
+    ts.add_argument("--fleet", action="store_true",
+                    help="look the trace up in the fleet collector")
     ts.set_defaults(func=cmd_trace)
     te = tsub.add_parser(
         "export",
@@ -1843,6 +1913,8 @@ def build_parser() -> argparse.ArgumentParser:
     te.add_argument("trace_id", nargs="?", default=None,
                     help="one trace (default: all retained)")
     te.add_argument("--url", help="server base URL")
+    te.add_argument("--fleet", action="store_true",
+                    help="export assembled fleet traces")
     te.add_argument("--output", required=True)
     te.set_defaults(func=cmd_trace)
 

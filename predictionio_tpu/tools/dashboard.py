@@ -27,7 +27,10 @@ class _Handler(JsonHandler):
         path = self.path.split("?")[0].rstrip("/") or "/"
         try:
             if path == "/":
-                self._respond(200, self._index(), "text/html")
+                from urllib.parse import parse_qs, urlsplit
+
+                qs = parse_qs(urlsplit(self.path).query)
+                self._respond(200, self._index(qs), "text/html")
             elif path == "/metrics":
                 self._serve_metrics()
             elif path == "/alerts":
@@ -77,7 +80,7 @@ class _Handler(JsonHandler):
         except HttpError as e:
             self._respond(e.status, {"message": e.message})
 
-    def _index(self) -> str:
+    def _index(self, qs: Optional[dict] = None) -> str:
         instances = (
             self.server.storage.get_meta_data_evaluation_instances()
             .get_completed()
@@ -98,6 +101,8 @@ class _Handler(JsonHandler):
 </table>
 {self._alerts_html()}
 {self._fleet_html()}
+{self._traces_html()}
+{self._tsdb_html(qs or {})}
 {self._lifecycle_html()}
 {self._tenants_html()}
 {self._online_html()}
@@ -238,6 +243,95 @@ class _Handler(JsonHandler):
 {''.join(rows)}
 </table>"""
 
+    def _traces_html(self) -> str:
+        """Fleet traces panel (ISSUE 16): the collector's assembled
+        cross-process traces, slowest/most recent first — the waterfall
+        lives in `pio trace show --fleet`, this is the index."""
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        col = get_monitor().collector
+        if col is None:
+            return ""
+        rows = "".join(
+            f"<tr><td><code>{html.escape(s['trace_id'])}</code></td>"
+            f"<td>{html.escape(s['root'])}</td>"
+            f"<td>{html.escape(','.join(s.get('servers') or []))}</td>"
+            f"<td>{html.escape(s.get('path') or '')}</td>"
+            f"<td>{s['duration_ms']:.1f} ms</td>"
+            f"<td>{s['spans']}</td>"
+            f"<td>{html.escape(s['kept'])}"
+            f"{' <b>ERROR</b>' if s['error'] else ''}</td></tr>"
+            for s in col.summaries(limit=15)
+        )
+        st = col.status()
+        return f"""<h1>Fleet traces</h1>
+<p>{st['assembled']} assembled, {st['pending_fragments']} pending
+fragment(s), {st['polls']} poll(s)</p>
+<table border="1" cellpadding="4">
+<tr><th>Trace</th><th>Root</th><th>Servers</th><th>Path</th>
+<th>Duration</th><th>Spans</th><th>Kept</th></tr>
+{rows}
+</table>"""
+
+    def _tsdb_html(self, qs: dict) -> str:
+        """TSDB explorer panel (ISSUE 16): a query box rendering ANY
+        retained series — raw samples or recording-rule outputs — as a
+        sparkline, without pre-wiring a panel per metric. Query params:
+        ``?series=<name>`` plus optional ``match=k=v,k=v``."""
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        tsdb = get_monitor().tsdb
+        name = (qs.get("series") or [""])[0].strip()
+        match_raw = (qs.get("match") or [""])[0].strip()
+        form = f"""<form method="get" action="/">
+<input name="series" size="40" value="{html.escape(name)}"
+ placeholder="series name, e.g. slo_error_ratio">
+<input name="match" size="30" value="{html.escape(match_raw)}"
+ placeholder="label match, e.g. slo=availability">
+<input type="submit" value="Plot"></form>"""
+        if not name:
+            return (
+                f"<h1>TSDB explorer</h1>{form}"
+                f"<p>({tsdb.series_count()} series retained)</p>"
+            )
+        match = None
+        if match_raw:
+            match = dict(
+                p.split("=", 1) for p in match_raw.split(",") if "=" in p
+            )
+        series = tsdb.matching(name, match)
+        if not series:
+            return (
+                f"<h1>TSDB explorer</h1>{form}"
+                f"<p>(no series named <code>{html.escape(name)}</code>"
+                + (f" matching <code>{html.escape(match_raw)}</code>"
+                   if match_raw else "") + ")</p>"
+            )
+        rows = []
+        for s in series[:32]:
+            pts = tsdb.points(s)
+            vals = [v for _t, v in pts]
+            last = vals[-1] if vals else None
+            lbls = ",".join(f"{k}={v}" for k, v in sorted(s.labels))
+            rows.append(
+                f"<tr><td><code>{html.escape(lbls) or '-'}</code></td>"
+                f"<td>{html.escape(s.kind)}</td>"
+                f"<td>{len(pts)}</td>"
+                f"<td>{'-' if last is None else f'{last:g}'}</td>"
+                f"<td><code>{html.escape(self._sparkline(vals))}</code>"
+                f"</td></tr>"
+            )
+        extra = (
+            f"<p>(showing 32 of {len(series)} series)</p>"
+            if len(series) > 32 else ""
+        )
+        return f"""<h1>TSDB explorer</h1>{form}
+<table border="1" cellpadding="4">
+<tr><th>Labels</th><th>Kind</th><th>Points</th><th>Last</th>
+<th>History</th></tr>
+{''.join(rows)}
+</table>{extra}"""
+
     def _lifecycle_html(self) -> str:
         """Model-lifecycle panel (ISSUE 5): versions newest-first with
         rollout state; active canaries lead the table. Registry fields
@@ -343,6 +437,7 @@ class Dashboard(ServerProcess):
         )
         self.scrape_interval_s = scrape_interval_s
         self._scraper = None
+        self._collector = None
 
     def _make_server(self) -> _Server:
         return _Server((self.ip, self.port_config), self.storage)
@@ -350,28 +445,45 @@ class Dashboard(ServerProcess):
     def start(self) -> int:
         from predictionio_tpu.obs.monitor import (
             FleetScraper,
+            TraceCollector,
             enabled,
             get_monitor,
             parse_targets,
         )
-        from predictionio_tpu.utils.env import env_float
+        from predictionio_tpu.utils.env import env_bool, env_float
 
         port = super().start()
         targets = parse_targets(self.monitor_targets)
         if targets and enabled():
+            interval = (
+                self.scrape_interval_s
+                if self.scrape_interval_s is not None
+                else env_float("PIO_SCRAPE_INTERVAL_S", 10.0)
+            )
             self._scraper = FleetScraper(
-                get_monitor().tsdb, targets,
-                interval_s=(
-                    self.scrape_interval_s
-                    if self.scrape_interval_s is not None
-                    else env_float("PIO_SCRAPE_INTERVAL_S", 10.0)
-                ),
+                get_monitor().tsdb, targets, interval_s=interval,
             )
             self._scraper.start()
             self._server.fleet_scraper = self._scraper  # type: ignore
+            if env_bool("PIO_TRACE_COLLECT"):
+                # the dashboard doubles as the fleet's trace assembly
+                # point when no gateway runs one (PIO_TRACE_COLLECT=1)
+                self._collector = TraceCollector(
+                    targets=list(targets), interval_s=interval,
+                )
+                get_monitor().set_collector(self._collector)
+                self._collector.start()
         return port
 
     def stop(self) -> None:
+        if self._collector is not None:
+            from predictionio_tpu.obs.monitor import get_monitor
+
+            self._collector.stop()  # joins the collect thread
+            mon = get_monitor()
+            if mon.collector is self._collector:
+                mon.set_collector(None)
+            self._collector = None
         if self._scraper is not None:
             self._scraper.stop()  # joins the scrape thread
             self._scraper = None

@@ -161,6 +161,30 @@ _k("PIO_PEAK_HBM_BPS", "float", None,
 _k("PIO_PROFILE_CAPTURE_DIR", "path", "",
    "Directory enabling POST /debug/profile/capture jax.profiler dumps.")
 
+# -- fleet observability (ISSUE 16) -----------------------------------------
+_k("PIO_TRACE_COLLECT", "flag", "1",
+   "Fleet trace collector; 0 disables /debug/traces polling even when "
+   "scrape targets exist.")
+_k("PIO_TRACE_COLLECT_INTERVAL_S", "float", 2.0,
+   "Seconds between trace-collector /debug/traces polls.")
+_k("PIO_TRACE_COLLECT_HOLD_S", "float", 15.0,
+   "Seconds an orphan span fragment (no root seen yet) is held for "
+   "late stitching before it expires.")
+_k("PIO_TRACE_COLLECT_MAX", "int", 256,
+   "Assembled cross-process traces retained by the collector.")
+_k("PIO_TRACE_EXEMPLARS", "int", 4,
+   "Slowest (trace-id, value) exemplars retained per histogram family "
+   "(0 disables exemplar capture).")
+_k("PIO_RECORDING_RULES", "json", "",
+   "Recording rules: JSON array of rule objects, or @/path/to/rules "
+   "(auto-derived per-SLO rules are added on top).")
+_k("PIO_TENANT_SLO_PRESETS", "flag", "",
+   "Set 1 to auto-derive per-tenant availability/latency SLO presets "
+   "from tenant records at mux attach.")
+_k("PIO_WORKER_METRICS_URL", "str", "",
+   "Metrics URL a fleet worker advertises on its registry record so "
+   "`pio fleet status` can scrape per-worker device gauges.")
+
 # -- monitoring plane --------------------------------------------------------
 _k("PIO_TSDB", "flag", "1",
    "In-process monitoring plane; 0 disables sampler/TSDB/SLO engine.")

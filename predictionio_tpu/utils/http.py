@@ -190,6 +190,13 @@ class JsonHandler(BaseHTTPRequestHandler):
         }
         if route != real_path:
             attrs["route"] = route  # the metric label this request fed
+        # identity attrs the owning process declared (ISSUE 16): a
+        # replica sets {"replica": id} here so its server spans stay
+        # attributable after the collector stitches them into a fleet
+        # trace alongside other replicas' identically-named spans
+        extra = getattr(self.server, "span_attrs", None)
+        if extra:
+            attrs.update(extra)
         _obs_spans.get_default_recorder().record(
             _obs_spans.Span(
                 trace_id=self._trace_id,
@@ -225,6 +232,21 @@ class JsonHandler(BaseHTTPRequestHandler):
 
         qs = dict(parse_qsl(urlsplit(self.path).query))
         recorder = _obs_spans.get_default_recorder()
+        if qs.get("spans") in ("1", "true", "yes"):
+            # raw recent-span dump (pre-sampling) for the fleet trace
+            # collector: `?spans=1[&since=<epoch-s>]`
+            try:
+                since = float(qs.get("since", 0) or 0)
+            except ValueError:
+                since = 0.0
+            self._respond(200, {
+                "now": time.time(),
+                "spans": [s.to_dict() for s in recorder.recent(since)],
+            })
+            return
+        if qs.get("fleet") in ("1", "true", "yes"):
+            self._serve_fleet_traces(qs)
+            return
         capture_id = qs.get("capture")
         if capture_id:
             cap = recorder.capture_status(capture_id)
@@ -279,6 +301,46 @@ class JsonHandler(BaseHTTPRequestHandler):
         self._respond(200, {
             "traces": summaries,
             "sampling": recorder.config(),
+        })
+
+    def _serve_fleet_traces(self, qs: dict) -> None:
+        """`GET /debug/traces?fleet=1` — the ASSEMBLED cross-process
+        traces from this process's fleet trace collector (ISSUE 16):
+        summaries by default, `&trace_id=` for one stitched tree,
+        `&format=perfetto` for the Chrome trace-event export. 503 on
+        processes that don't run a collector (replicas, bare servers)."""
+        from predictionio_tpu.obs.monitor import get_monitor
+
+        collector = get_monitor().collector
+        if collector is None:
+            self._respond(503, {
+                "message": "no fleet trace collector runs in this "
+                           "process (gateways, dashboards and `pio "
+                           "monitor` own one)",
+            })
+            return
+        trace_id = qs.get("trace_id")
+        if qs.get("format") == "perfetto":
+            export = collector.perfetto_export(trace_id)
+            if trace_id and not export["traceEvents"]:
+                self._respond(404, {"message": f"no trace {trace_id}"})
+                return
+            self._respond(200, export)
+            return
+        if trace_id:
+            spans = collector.get_trace(trace_id)
+            if not spans:
+                self._respond(404, {"message": f"no trace {trace_id}"})
+                return
+            self._respond(200, {"trace_id": trace_id, "spans": spans})
+            return
+        try:
+            limit = int(qs.get("limit", "50"))
+        except ValueError:
+            limit = 50
+        self._respond(200, {
+            "traces": collector.summaries(limit=limit),
+            "collector": collector.status(),
         })
 
     def _serve_debug_tsdb(self) -> None:
@@ -443,8 +505,15 @@ class JsonHandler(BaseHTTPRequestHandler):
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
-        self.wfile.write(data)
+        # account BEFORE the body write: the moment the client sees the
+        # last byte it may issue a follow-up scrape, and the counter for
+        # THIS request must already be visible to it (recording after
+        # the write loses that race — observed as a missing
+        # http_requests_total child on single-vCPU hosts). The final
+        # body-write syscall falls outside the measured duration;
+        # headers are already on the wire by this point.
         self._record_request(status)
+        self.wfile.write(data)
 
 
 class ThreadedServer(ThreadingHTTPServer):

@@ -1410,6 +1410,16 @@ class QueryServer(ServerProcess):
         server.owner = self
         server.metrics = self.metrics
         server.metrics_label = "query"
+        # identity attrs for every server span this process emits
+        # (ISSUE 16): after the fleet collector stitches this replica's
+        # spans into a cross-process tree, "which engine answered" must
+        # survive without a lookup. ReplicaMember.start merges the
+        # replica id into this same dict.
+        inst = getattr(self.runtime, "instance", None)
+        if inst is not None:
+            server.span_attrs = {
+                "engine": f"{inst.engine_id}/{inst.engine_variant}",
+            }
         return server
 
     # -- reload (reference MasterActor ReloadServer, CreateServer.scala:337) --

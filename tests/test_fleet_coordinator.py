@@ -401,3 +401,77 @@ class TestEngineSerializationAcrossWorkers:
         reg.remove("w1")  # a peer's gc during our connectivity gap
         reg.heartbeat("w1", None, 0)
         assert [w.id for w in reg.list()] == ["w1"]
+
+
+class TestWorkerDeviceInfo:
+    """ISSUE 16: `pio fleet status` scrapes each live worker's /metrics
+    for device counters (PIO_WORKER_METRICS_URL advertised at
+    registration)."""
+
+    def _metrics_server(self, body: str):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def test_device_families_allowlisted_and_summed(self):
+        from predictionio_tpu.fleet.coordinator import worker_device_info
+
+        srv = self._metrics_server(
+            'jax_live_buffer_bytes{device="0"} 1000\n'
+            'jax_live_buffer_bytes{device="1"} 2345\n'
+            "jax_jit_compile_count 3\n"
+            "http_requests_total 999\n"  # not a device family
+        )
+        try:
+            info = worker_device_info(
+                f"http://127.0.0.1:{srv.server_port}/metrics"
+            )
+        finally:
+            srv.shutdown()
+        assert info == {
+            "jax_live_buffer_bytes": 3345.0,
+            "jax_jit_compile_count": 3.0,
+        }
+
+    def test_unreachable_worker_yields_none(self):
+        from predictionio_tpu.fleet.coordinator import worker_device_info
+
+        assert worker_device_info("http://127.0.0.1:1/metrics") is None
+
+    def test_fleet_status_attaches_device_info(self, storage, monkeypatch):
+        from predictionio_tpu.fleet.coordinator import FleetConfig, FleetMember
+
+        srv = self._metrics_server("jax_jit_compile_count 7\n")
+        monkeypatch.setenv(
+            "PIO_WORKER_METRICS_URL",
+            f"http://127.0.0.1:{srv.server_port}/metrics",
+        )
+        m = FleetMember(
+            storage, fleet_config=FleetConfig(heartbeat_interval_s=0.05)
+        )
+        m.start()
+        try:
+            rows = fleet_status(storage)["workers"]
+            assert rows[0]["device_info"] == {
+                "jax_jit_compile_count": 7.0
+            }
+            # probing suppressed on request (cheap status calls)
+            rows = fleet_status(storage, probe_devices=False)["workers"]
+            assert "device_info" not in rows[0]
+        finally:
+            m.stop()
+            srv.shutdown()

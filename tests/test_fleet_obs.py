@@ -1,0 +1,661 @@
+"""Fleet observability plane (ISSUE 16): cross-process trace assembly
+(stitching, orphan expiry, HTTP polling), recording-rule math vs the
+direct TSDB queries, fleet-aggregated SLOs over instance-tagged series
+(incl. the recorded fast path), exemplar retention bounds, and the
+Monitor's alert→trace enrichment."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from predictionio_tpu.obs import spans as _spans
+from predictionio_tpu.obs import tracing as _tracing
+from predictionio_tpu.obs.monitor import (
+    FleetScraper,
+    Monitor,
+    SLOEngine,
+    SLOSpec,
+    record_slo_ratios,
+    tenant_slo_presets,
+)
+from predictionio_tpu.obs.monitor.collector import TraceCollector
+from predictionio_tpu.obs.monitor.scrape import parse_exemplar_lines
+from predictionio_tpu.obs.monitor.slo import (
+    RECORDED_RATIO,
+    RECORDED_SAMPLES,
+    error_fraction,
+)
+from predictionio_tpu.obs.monitor.tsdb import (
+    TSDB,
+    MetricsSampler,
+    RecordingRule,
+    bucket_quantile,
+    evaluate_rules,
+    load_recording_rules,
+)
+from predictionio_tpu.obs.registry import MetricsRegistry, render_families
+
+T0 = 1_700_000_000.0
+
+
+def _span(tid, sid, name, parent=None, start=T0, dur=0.01, attrs=None,
+          error=False) -> dict:
+    return _spans.Span(
+        trace_id=tid, span_id=sid, name=name, parent_span_id=parent,
+        start=start, duration=dur, attrs=dict(attrs or {}), error=error,
+    ).to_dict()
+
+
+# ---------------------------------------------------------------------------
+# collector stitching
+# ---------------------------------------------------------------------------
+
+
+class TestCollectorStitching:
+    def _collector(self, **kw) -> TraceCollector:
+        base = dict(
+            recorder=_spans.SpanRecorder(), interval_s=1.0, hold_s=5.0,
+        )
+        base.update(kw)
+        return TraceCollector(**base)
+
+    def test_hedged_two_attempt_trace_assembles_one_tree(self):
+        """The acceptance shape: gateway root + primary/hedge attempt
+        children + a replica-side server span arriving as a SEPARATE
+        fragment stitch into one tree, kept for being hedged."""
+        col = self._collector()
+        tid = "a" * 16
+        for sp in (
+            _span(tid, "root", "gateway.request",
+                  attrs={"server": "gateway", "path": "/queries.json"},
+                  dur=0.2),
+            _span(tid, "att1", "gateway.attempt", parent="root",
+                  attrs={"kind": "primary", "replica": "r0",
+                         "outcome": "200"}),
+            _span(tid, "att2", "gateway.attempt", parent="root",
+                  attrs={"kind": "hedge", "replica": "r1",
+                         "outcome": "200"}),
+        ):
+            col._ingest(sp, T0)
+        # the replica fragment lands on a LATER poll (cross-process)
+        col._ingest(
+            _span(tid, "srv1", "server.request", parent="att1",
+                  attrs={"server": "query", "replica": "r0"}),
+            T0 + 1,
+        )
+        col._settle(T0 + 1)
+        assert col.status()["assembled"] == 1
+        spans = col.get_trace(tid)
+        assert len(spans) == 4
+        by_id = {s["span_id"]: s for s in spans}
+        assert by_id["att1"]["parent_span_id"] == "root"
+        assert by_id["att2"]["parent_span_id"] == "root"
+        assert by_id["srv1"]["parent_span_id"] == "att1"
+        (row,) = col.summaries()
+        assert row["kept"] == "hedged"
+        assert set(row["servers"]) == {"gateway", "query"}
+        assert row["spans"] == 4
+        # perfetto export carries every span of the stitched tree
+        export = col.perfetto_export(tid)
+        names = [
+            e["name"] for e in export["traceEvents"] if e["ph"] == "X"
+        ]
+        assert names.count("gateway.attempt") == 2
+
+    def test_orphan_fragment_held_then_expired(self):
+        """A fragment whose root never arrives (its process died before
+        dumping) is held for hold_s, then dropped and counted — the
+        fragment store cannot grow without bound."""
+        col = self._collector(hold_s=5.0)
+        col._ingest(
+            _span("b" * 16, "child", "server.request", parent="gone",
+                  error=True),
+            T0,
+        )
+        col._settle(T0)
+        st = col.status()
+        assert st["pending_fragments"] == 1
+        assert st["assembled"] == 0
+        col._settle(T0 + 4.9)  # still inside the hold window
+        assert col.status()["pending_fragments"] == 1
+        col._settle(T0 + 5.1)
+        st = col.status()
+        assert st["pending_fragments"] == 0
+        assert st["assembled"] == 0
+        assert st["expired_orphans"] == 1
+
+    def test_orphan_resolves_when_root_arrives_late(self):
+        """Cross-process skew: the replica fragment is polled BEFORE
+        the gateway fragment. The held orphan must join the trace when
+        its root shows up within the hold window."""
+        col = self._collector()
+        tid = "c" * 16
+        col._ingest(
+            _span(tid, "srv", "server.request", parent="att"), T0
+        )
+        col._settle(T0)
+        assert col.status()["pending_fragments"] == 1
+        col._ingest(
+            _span(tid, "root", "gateway.request", error=True, attrs={
+                "server": "gateway",
+            }),
+            T0 + 2,
+        )
+        col._ingest(
+            _span(tid, "att", "gateway.attempt", parent="root"), T0 + 2
+        )
+        col._settle(T0 + 2)
+        assert col.status()["pending_fragments"] == 0
+        assert len(col.get_trace(tid)) == 3
+
+    def test_span_dedup_absorbs_poll_overlap(self):
+        """Cursors deliberately re-cover one interval per poll; the
+        span-id dedup must make the overlap free."""
+        col = self._collector()
+        tid = "d" * 16
+        root = _span(tid, "root", "gateway.request", error=True)
+        col._ingest(root, T0)
+        col._ingest(dict(root), T0 + 1)  # same span, next poll
+        col._settle(T0 + 1)
+        assert len(col.get_trace(tid)) == 1
+
+    def test_boring_trace_not_kept(self):
+        """Tail sampling: a fast, error-free, unhedged trace is not
+        worth fleet retention."""
+        col = self._collector(slow_ms=1000.0)
+        tid = "e" * 16
+        col._ingest(_span(tid, "root", "gateway.request", dur=0.001), T0)
+        col._ingest(
+            _span(tid, "att", "gateway.attempt", parent="root",
+                  attrs={"kind": "primary"}, dur=0.001),
+            T0,
+        )
+        col._settle(T0 + 10)  # past hold: fragment either kept or gone
+        st = col.status()
+        assert st["assembled"] == 0
+        assert st["pending_fragments"] == 0
+
+    def test_http_polling_stitches_remote_fragments(self, fresh_storage):
+        """The wire path: fragments recorded in a server process come
+        back through `GET /debug/traces?spans=1&since=` and assemble."""
+        from predictionio_tpu.data.api.server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        srv = EventServer(
+            fresh_storage,
+            EventServerConfig(ip="127.0.0.1", port=0, wal_dir=None),
+        )
+        port = srv.start()
+        tid = "f" * 16
+        # the server process's recorder is this process's default
+        # recorder (same process in-test); the collector gets a PRIVATE
+        # recorder so the only road to these spans is HTTP
+        rec = _spans.get_default_recorder()
+        rec.record(_spans.Span(
+            trace_id=tid, span_id="root", name="gateway.request",
+            parent_span_id=None, start=time.time(), duration=0.2,
+            attrs={"server": "gateway"}, error=True,
+        ), finalize=False)
+        rec.record(_spans.Span(
+            trace_id=tid, span_id="att", name="gateway.attempt",
+            parent_span_id="root", start=time.time(), duration=0.1,
+            attrs={"kind": "failover"},
+        ), finalize=False)
+        col = self._collector(
+            targets=[("ev", f"http://127.0.0.1:{port}")],
+        )
+        try:
+            ingested = col.collect_once()
+        finally:
+            srv.stop()
+        assert ingested >= 2
+        assert col.status()["polls"] == 1
+        assert col.status()["poll_errors"] == 0
+        assert len(col.get_trace(tid)) == 2
+
+    def test_assembled_store_bounded(self):
+        """max_traces is a hard cap: the oldest assembled trace falls
+        off when one more arrives."""
+        col = self._collector(max_traces=2)
+        for i in range(3):
+            tid = f"t{i}" + "0" * 14
+            col._ingest(
+                _span(tid, f"r{i}", "gateway.request", error=True,
+                      start=T0 + i),
+                T0 + i,
+            )
+            col._settle(T0 + i)
+        assert col.status()["assembled"] == 2
+        assert col.get_trace("t0" + "0" * 14) == []
+
+
+# ---------------------------------------------------------------------------
+# recording rules
+# ---------------------------------------------------------------------------
+
+
+def _feed_counter(db, name, labels, pairs):
+    for t, v in pairs:
+        db.add(name, labels, v, "counter", t)
+
+
+class TestRecordingRules:
+    def test_rate_rule_matches_direct_tsdb_rate(self):
+        db = TSDB()
+        _feed_counter(
+            db, "http_requests_total", {"server": "q", "status": "200"},
+            [(T0, 0.0), (T0 + 60, 120.0)],
+        )
+        rule = RecordingRule(
+            record="q:rate1m", kind="rate",
+            source="http_requests_total", window_s=60.0,
+        )
+        got = rule.evaluate(db, now=T0 + 60)
+        want = db.rate("http_requests_total", None, 60.0, T0 + 60)
+        assert got == pytest.approx(want) == pytest.approx(2.0)
+
+    def test_error_ratio_rule_matches_hand_math(self):
+        db = TSDB()
+        _feed_counter(
+            db, "http_requests_total", {"server": "q", "status": "200"},
+            [(T0, 0.0), (T0 + 30, 80.0)],
+        )
+        _feed_counter(
+            db, "http_requests_total", {"server": "q", "status": "500"},
+            [(T0, 0.0), (T0 + 30, 20.0)],
+        )
+        rule = RecordingRule(
+            record="q:err", kind="error_ratio",
+            source="http_requests_total", window_s=60.0,
+        )
+        assert rule.evaluate(db, now=T0 + 30) == pytest.approx(0.2)
+        # bad_values variant: exact label match instead of numeric >=
+        rule2 = RecordingRule(
+            record="q:err2", kind="error_ratio",
+            source="http_requests_total", window_s=60.0,
+            bad_values=("200",),
+        )
+        assert rule2.evaluate(db, now=T0 + 30) == pytest.approx(0.8)
+
+    def test_quantile_rule_interpolates_buckets(self):
+        db = TSDB()
+        # 10 obs <= 0.1, 10 more in (0.1, 0.5]: p50 = 0.1, p75 = 0.3
+        for le, cum in (("0.1", 10.0), ("0.5", 20.0), ("+Inf", 20.0)):
+            _feed_counter(
+                db, "http_request_seconds_bucket", {"le": le},
+                [(T0, 0.0), (T0 + 30, cum)],
+            )
+        assert bucket_quantile(
+            db, "http_request_seconds", 0.5, None, 60.0, T0 + 30
+        ) == pytest.approx(0.1)
+        assert bucket_quantile(
+            db, "http_request_seconds", 0.75, None, 60.0, T0 + 30
+        ) == pytest.approx(0.3)
+        rule = RecordingRule(
+            record="q:p75", kind="quantile",
+            source="http_request_seconds", q=0.75, window_s=60.0,
+        )
+        assert rule.evaluate(db, now=T0 + 30) == pytest.approx(0.3)
+
+    def test_quiet_window_writes_nothing(self):
+        """None results (zero traffic) must NOT be stored — readers
+        distinguish 'quiet' from 'zero'."""
+        db = TSDB()
+        rule = RecordingRule(
+            record="q:err", kind="error_ratio",
+            source="http_requests_total", window_s=60.0,
+        )
+        assert evaluate_rules(db, [rule], now=T0) == 0
+        assert db.matching("q:err") == []
+
+    def test_evaluate_rules_stores_first_class_series(self):
+        db = TSDB()
+        _feed_counter(
+            db, "c_total", {"status": "500"},
+            [(T0, 0.0), (T0 + 30, 5.0)],
+        )
+        _feed_counter(
+            db, "c_total", {"status": "200"},
+            [(T0, 0.0), (T0 + 30, 15.0)],
+        )
+        rule = RecordingRule(
+            record="c:err", kind="error_ratio", source="c_total",
+            window_s=60.0, labels=(("job", "q"),),
+        )
+        assert evaluate_rules(db, [rule], now=T0 + 30) == 1
+        assert db.latest("c:err", {"job": "q"}) == pytest.approx(0.25)
+
+    def test_rules_ride_the_sampler_tick(self):
+        """post_sample runs after raw sampling on the SAME tick, and a
+        raising hook never takes down raw sampling."""
+        reg = MetricsRegistry()
+        reg.counter("ticks_total", "t").inc(3.0)
+        db = TSDB()
+        calls = []
+
+        def hook(tsdb, now):
+            calls.append(now)
+            raise RuntimeError("derived series must not kill sampling")
+
+        sampler = MetricsSampler(
+            db, reg.families, interval_s=60.0, post_sample=hook
+        )
+        written = sampler.sample_once(now=T0)
+        assert written > 0
+        assert calls == [T0]
+        assert db.latest("ticks_total") == 3.0
+
+    def test_load_rules_json_and_malformed(self, tmp_path):
+        rules = load_recording_rules(json.dumps([{
+            "record": "a:rate", "kind": "rate", "source": "a_total",
+            "window_s": 30, "match": {"server": "q"},
+        }]))
+        assert len(rules) == 1
+        assert rules[0].match == (("server", "q"),)
+        # @file indirection
+        p = tmp_path / "rules.json"
+        p.write_text(json.dumps([{
+            "record": "b:p99", "kind": "quantile", "source": "b",
+        }]))
+        assert len(load_recording_rules(f"@{p}")) == 1
+        # malformed input degrades to [] (never takes the plane down)
+        assert load_recording_rules("[{\"record\": ") == []
+        assert load_recording_rules("") == []
+        with pytest.raises(ValueError):
+            RecordingRule(record="x", kind="nope", source="y")
+        with pytest.raises(ValueError):
+            RecordingRule.from_dict({
+                "record": "x", "kind": "rate", "source": "y",
+                "bogus_field": 1,
+            })
+
+
+# ---------------------------------------------------------------------------
+# fleet-scoped SLOs
+# ---------------------------------------------------------------------------
+
+
+def _fleet_spec(**kw) -> SLOSpec:
+    base = dict(
+        name="fleet-avail", kind="availability", objective=0.99,
+        server="query", route="/queries.json", aggregate="sum",
+        fast_window_s=10.0, window_s=40.0, burn_threshold=1.0,
+        min_samples=1, for_s=0.0,
+    )
+    base.update(kw)
+    return SLOSpec(**base)
+
+
+def _feed_instance(db, instance, t, ok, err):
+    for status, v in (("200", ok), ("500", err)):
+        db.add(
+            "http_requests_total",
+            {"server": "query", "path": "/queries.json",
+             "status": status, "instance": instance},
+            v, "counter", t,
+        )
+
+
+class _StubMetrics(BaseHTTPRequestHandler):
+    """A stub replica: /metrics exposing counters the test mutates."""
+
+    counters = {}
+
+    def do_GET(self):
+        lines = []
+        for (status,), v in sorted(self.counters.items()):
+            lines.append(
+                'http_requests_total{server="query",'
+                f'path="/queries.json",status="{status}"}} {v}'
+            )
+        body = ("\n".join(lines) + "\n").encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+class TestFleetSLO:
+    def test_aggregate_sum_pools_the_fleet(self):
+        db = TSDB()
+        _feed_instance(db, "r0", T0, 0.0, 0.0)
+        _feed_instance(db, "r1", T0, 0.0, 0.0)
+        _feed_instance(db, "r0", T0 + 5, 90.0, 10.0)
+        _feed_instance(db, "r1", T0 + 5, 100.0, 0.0)
+        # a process-LOCAL series without the instance tag must be
+        # excluded from fleet judgment
+        db.add(
+            "http_requests_total",
+            {"server": "query", "path": "/queries.json", "status": "500"},
+            1000.0, "counter", T0 + 5,
+        )
+        frac, n = error_fraction(db, _fleet_spec(), 10.0, T0 + 5)
+        assert frac == pytest.approx(10.0 / 200.0)
+        assert n == pytest.approx(200.0)
+
+    def test_aggregate_mean_averages_per_instance(self):
+        db = TSDB()
+        _feed_instance(db, "r0", T0, 0.0, 0.0)
+        _feed_instance(db, "r1", T0, 0.0, 0.0)
+        _feed_instance(db, "r0", T0 + 5, 50.0, 50.0)   # 0.5 locally
+        _feed_instance(db, "r1", T0 + 5, 1000.0, 0.0)  # 0.0 locally
+        spec = _fleet_spec(aggregate="mean")
+        frac, _n = error_fraction(db, spec, 10.0, T0 + 5)
+        # mean of per-instance fractions — the busy healthy replica
+        # must NOT dilute the small broken one (sum would give ~0.045)
+        assert frac == pytest.approx(0.25)
+
+    def test_up_kind_aggregate_watches_whole_fleet(self):
+        db = TSDB()
+        db.add("up", {"instance": "r0"}, 1.0, "gauge", T0)
+        db.add("up", {"instance": "r1"}, 0.0, "gauge", T0)
+        spec = _fleet_spec(kind="up", aggregate="mean", objective=0.9)
+        frac, n = error_fraction(db, spec, 10.0, T0)
+        assert frac == pytest.approx(0.5)
+        assert n == 2.0
+
+    def test_fleet_slo_fires_across_two_stub_replicas(self):
+        """The satellite: scrape two stub replica processes' /metrics,
+        aggregate, and fire on the pooled error budget."""
+
+        class _A(_StubMetrics):
+            counters = {("200",): 0.0, ("500",): 0.0}
+
+        class _B(_StubMetrics):
+            counters = {("200",): 0.0, ("500",): 0.0}
+
+        servers = []
+        for cls in (_A, _B):
+            s = ThreadingHTTPServer(("127.0.0.1", 0), cls)
+            threading.Thread(target=s.serve_forever, daemon=True).start()
+            servers.append(s)
+        try:
+            db = TSDB()
+            scraper = FleetScraper(db, [
+                ("r0", f"http://127.0.0.1:{servers[0].server_port}"),
+                ("r1", f"http://127.0.0.1:{servers[1].server_port}"),
+            ], interval_s=60.0)
+            assert scraper.scrape_once(now=T0) == {"r0": True, "r1": True}
+            # induced error window: r0 starts failing hard
+            _A.counters = {("200",): 10.0, ("500",): 90.0}
+            _B.counters = {("200",): 100.0, ("500",): 0.0}
+            scraper.scrape_once(now=T0 + 5)
+            spec = _fleet_spec()
+            engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+            burn, n = engine.burn_rate(spec, 10.0, now=T0 + 5)
+            # 90 bad / 200 total over a 0.01 budget
+            assert burn == pytest.approx(45.0)
+            engine.evaluate_once(now=T0 + 5)
+            engine.evaluate_once(now=T0 + 6)
+            assert engine.status("fleet-avail").state == "firing"
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_recorded_fast_path_feeds_burn_rate(self):
+        """With a fresh recorded ratio and NO raw series at all, the
+        burn must come from the recorded point — proof the engine read
+        the precomputed series instead of rescanning."""
+        db = TSDB()
+        spec = _fleet_spec()
+        labels = {"slo": spec.name, "window": "fast"}
+        db.add(RECORDED_RATIO, labels, 0.05, "gauge", T0)
+        db.add(RECORDED_SAMPLES, labels, 500.0, "gauge", T0)
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        engine.recorded_max_age_s = 30.0
+        burn, n = engine.burn_rate(spec, spec.fast_window_s, now=T0 + 5)
+        assert burn == pytest.approx(0.05 / spec.budget)
+        assert n == 500.0
+        # raw fallback still works when disabled
+        engine.recorded_max_age_s = 0.0
+        assert engine.burn_rate(spec, spec.fast_window_s, now=T0 + 5) \
+            == (None, 0.0)
+
+    def test_stale_recorded_point_falls_back_to_raw(self):
+        """Freshness gate: a wedged sampler's old recorded point must
+        not freeze alerting — the raw rescan takes over."""
+        db = TSDB()
+        spec = _fleet_spec()
+        db.add(RECORDED_RATIO, {"slo": spec.name, "window": "fast"},
+               0.5, "gauge", T0 - 500)
+        db.add(RECORDED_SAMPLES, {"slo": spec.name, "window": "fast"},
+               100.0, "gauge", T0 - 500)
+        _feed_instance(db, "r0", T0 - 5, 0.0, 0.0)
+        _feed_instance(db, "r0", T0, 100.0, 0.0)
+        engine = SLOEngine(db, [spec], registry=MetricsRegistry())
+        engine.recorded_max_age_s = 30.0
+        burn, n = engine.burn_rate(spec, spec.fast_window_s, now=T0)
+        assert burn == pytest.approx(0.0)  # raw says healthy
+        assert n == pytest.approx(100.0)
+
+    def test_record_slo_ratios_writes_ratio_and_samples(self):
+        db = TSDB()
+        spec = _fleet_spec()
+        _feed_instance(db, "r0", T0 - 5, 0.0, 0.0)
+        _feed_instance(db, "r0", T0, 96.0, 4.0)
+        written = record_slo_ratios(db, [spec], now=T0)
+        assert written == 4  # (ratio + samples) × (fast, slow)
+        assert db.latest(
+            RECORDED_RATIO, {"slo": spec.name, "window": "fast"}
+        ) == pytest.approx(0.04)
+        # quiet spec: samples written (observable quiet), no ratio
+        quiet = _fleet_spec(name="quiet", route="/other.json")
+        assert record_slo_ratios(db, [quiet], now=T0) == 2
+        assert db.latest(
+            RECORDED_RATIO, {"slo": "quiet", "window": "fast"}
+        ) is None
+        assert db.latest(
+            RECORDED_SAMPLES, {"slo": "quiet", "window": "fast"}
+        ) == 0.0
+
+    def test_tenant_presets_derived_and_spec_roundtrip(self):
+        presets = tenant_slo_presets(["acme", "beta"])
+        names = [p.name for p in presets]
+        assert names == [
+            "tenant:acme:availability", "tenant:acme:latency",
+            "tenant:beta:availability", "tenant:beta:latency",
+        ]
+        for p in presets:
+            # presets must survive the to_dict/from_dict wire format
+            assert SLOSpec.from_dict(p.to_dict()) == p
+        # aggregate survives the round trip too
+        spec = _fleet_spec()
+        assert SLOSpec.from_dict(spec.to_dict()).aggregate == "sum"
+        with pytest.raises(ValueError):
+            _fleet_spec(aggregate="median")
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+
+class TestExemplars:
+    def _observe(self, fam, tid, value):
+        tok = _tracing.set_trace_id(tid)
+        try:
+            fam.observe(value, path="/q")
+        finally:
+            _tracing.reset_trace_id(tok)
+
+    def test_retention_bounded_keep_slowest(self, monkeypatch):
+        monkeypatch.setenv("PIO_TRACE_EXEMPLARS", "3")
+        reg = MetricsRegistry()
+        fam = reg.histogram(
+            "t_seconds", "t", buckets=(0.1, 1.0), labelnames=("path",)
+        )
+        for i, v in enumerate((0.5, 0.1, 0.9, 0.3, 2.0)):
+            self._observe(fam, f"tid{i}", v)
+        ex = fam.exemplars()
+        assert len(ex) == 3
+        assert [e["value"] for e in ex] == [2.0, 0.9, 0.5]
+        # a faster value than the floor is not admitted
+        self._observe(fam, "tid-fast", 0.01)
+        assert len(fam.exemplars()) == 3
+        # same trace id keeps only its own max (one slot per trace)
+        self._observe(fam, "tid4", 5.0)
+        self._observe(fam, "tid4", 0.2)
+        ex = fam.exemplars()
+        assert [e["trace_id"] for e in ex].count("tid4") == 1
+        assert ex[0]["value"] == 5.0
+
+    def test_untraced_observations_record_no_exemplar(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram("u_seconds", "u", buckets=(1.0,))
+        fam.observe(0.5)  # no ambient trace id
+        assert fam.exemplars() == []
+
+    def test_exposition_roundtrip(self, monkeypatch):
+        monkeypatch.setenv("PIO_TRACE_EXEMPLARS", "4")
+        reg = MetricsRegistry()
+        fam = reg.histogram(
+            "r_seconds", "r", buckets=(1.0,), labelnames=("path",)
+        )
+        self._observe(fam, "tidA", 0.25)
+        text = render_families([fam])
+        assert "# EXEMPLAR r_seconds tidA" in text
+        parsed = parse_exemplar_lines(text)
+        assert parsed == [("r_seconds", "tidA", 0.25, pytest.approx(
+            parsed[0][3]
+        ))]
+        # plain exposition parsing still works on the same text (the
+        # exemplar comments are invisible to a vanilla scraper)
+        from predictionio_tpu.obs.monitor.scrape import (
+            parse_prometheus_text,
+        )
+        names = {n for n, _l, _v in parse_prometheus_text(text)}
+        assert "r_seconds_count" in names
+
+    def test_monitor_index_bounded_and_merged(self):
+        monitor = Monitor()
+        cap = monitor._exemplar_cap
+        for i in range(cap + 10):
+            monitor.note_exemplar("f_seconds", f"t{i}", float(i), ts=T0)
+        ex = monitor.exemplars("f_seconds", limit=cap + 10)
+        assert len(ex) == cap
+        # keep-slowest: the earliest (fastest) entries were evicted
+        assert ex[0]["value"] == float(cap + 9)
+
+    def test_alert_enrichment_links_exemplars_and_traces(self):
+        """A firing alert payload carries exemplar trace ids and the
+        slowest assembled fleet traces — the alert→trace loop."""
+        monitor = Monitor()
+        monitor.note_exemplar("http_request_seconds", "tid-slow", 1.5,
+                              ts=T0)
+        col = TraceCollector(recorder=_spans.SpanRecorder())
+        col._ingest(_span("g" * 16, "root", "gateway.request",
+                          error=True, dur=0.4), T0)
+        col._settle(T0)
+        monitor.set_collector(col)
+        row = {"slo": "fleet-avail", "state": "firing"}
+        monitor._enrich_alert(row)
+        assert row["exemplars"][0]["trace_id"] == "tid-slow"
+        assert row["fleet_traces"][0]["trace_id"] == "g" * 16

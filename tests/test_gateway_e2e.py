@@ -324,3 +324,160 @@ def test_stale_heartbeat_ejection_and_recovery(gateway_fleet):
             break
         time.sleep(0.2)
     assert readmitted, "thawed replica was never re-admitted"
+
+
+def test_fleet_observability_chaos(tmp_path, monkeypatch):
+    """ISSUE 16 acceptance: with tracing and the trace collector
+    attached, one replica forced slow (hedges fire) and one SIGKILLed
+    mid-hammer. The collector assembles cross-process traces (gateway
+    root + attempt children + replica-side server spans), `pio trace
+    show --fleet` renders one, the fleet-aggregated availability SLO
+    fires, and the firing alert links exemplar trace ids."""
+    from predictionio_tpu.obs.monitor import SLOSpec
+    from predictionio_tpu.tools import console
+
+    monkeypatch.setenv("PIO_TRACE_COLLECT", "1")
+    storage = _sqlite_storage(tmp_path)
+    procs, ports = {}, {}
+    # r1 answers every 3rd query in 400 ms — over the 60 ms hedge
+    # trigger, so hedged (two-attempt) traces exist from the start
+    for rid, slow in (("r0", 0), ("r1", 3), ("r2", 0)):
+        ports[rid] = _free_port()
+        procs[rid] = _spawn_replica(
+            tmp_path, rid, ports[rid], slow_every=slow
+        )
+    mon = get_monitor()
+    old_slo_iv = mon.slo_interval_s
+    old_sample_iv = mon.sampler_interval_s
+    mon.slo_interval_s = 0.5
+    mon.sampler_interval_s = 0.25
+    # fleet-scoped SLO over the scraper's up{instance} series: one dead
+    # replica of three (fraction 1/3) blows a 0.1 error budget
+    mon.set_slos([SLOSpec(
+        name="fleet-up", kind="up", aggregate="mean", objective=0.9,
+        fast_window_s=3.0, window_s=6.0, burn_threshold=1.0,
+        min_samples=1, for_s=0.0, resolve_s=300.0,
+    )])
+    gw = GatewayServer(storage, GatewayConfig(
+        ip="127.0.0.1", port=0, sync_interval_s=0.15,
+        replica_stale_after_s=1.5, scrape=True, scrape_interval_s=0.4,
+        hedge=True, hedge_min_ms=60.0,
+        breaker_threshold=2, breaker_cooldown_s=0.5,
+    ))
+    gport = gw.start()
+    hammer = _Hammer(gport, clients=16)
+    try:
+        _wait_routable(gw, 3)
+        col = get_monitor().collector
+        assert col is not None, (
+            "PIO_TRACE_COLLECT=1 + scrape must start a collector"
+        )
+        hammer.start()
+
+        def _assembled_cross_process():
+            """(trace_id, spans) of a trace with a rooted gateway-side
+            tree: a gateway.request span, >=2 attempt children, and a
+            replica-side server span parented under an attempt."""
+            for row in col.summaries(limit=50):
+                spans = col.get_trace(row["trace_id"])
+                if not any(
+                    not s.get("parent_span_id") for s in spans
+                ):
+                    continue
+                gw_spans = {
+                    s["span_id"] for s in spans
+                    if s["name"] == "gateway.request"
+                }
+                attempts = [
+                    s for s in spans if s["name"] == "gateway.attempt"
+                    and s.get("parent_span_id") in gw_spans
+                ]
+                attempt_ids = {s["span_id"] for s in attempts}
+                server_spans = [
+                    s for s in spans if s["name"] == "server.request"
+                    and s.get("parent_span_id") in attempt_ids
+                    and (s.get("attrs") or {}).get("replica")
+                ]
+                if len(attempts) >= 2 and server_spans:
+                    return row["trace_id"], spans
+            return None
+
+        deadline = time.time() + 40
+        found = None
+        while time.time() < deadline and found is None:
+            found = _assembled_cross_process()
+            if found is None:
+                time.sleep(0.3)
+        assert found, (
+            "no cross-process trace assembled; status="
+            f"{col.status()} summaries={col.summaries(limit=5)}"
+        )
+        tid, _spans_found = found
+        # the operator path renders the same assembled trace
+        assert console.main(["trace", "show", tid, "--fleet"]) == 0
+        assert console.main(["trace", "list", "--fleet"]) == 0
+
+        # chaos: SIGKILL a healthy replica mid-hammer
+        procs["r0"].send_signal(signal.SIGKILL)
+        procs["r0"].wait(timeout=10)
+
+        # a failover/errored attempt against the dead replica shows up
+        # in an assembled trace (error-kept), naming the dead replica
+        def _failed_attempt_visible():
+            for row in col.summaries(limit=80):
+                for s in col.get_trace(row["trace_id"]):
+                    if (
+                        s["name"] == "gateway.attempt" and s.get("error")
+                        and (s.get("attrs") or {}).get("replica") == "r0"
+                    ):
+                        return True
+            return False
+
+        deadline = time.time() + 30
+        failed_seen = False
+        while time.time() < deadline and not failed_seen:
+            failed_seen = _failed_attempt_visible()
+            if not failed_seen:
+                time.sleep(0.3)
+        assert failed_seen, (
+            "killed replica's failed attempt never appeared in an "
+            f"assembled trace; status={col.status()}"
+        )
+
+        # the fleet-aggregated SLO fires, and the firing row carries
+        # exemplar trace ids plus the slowest assembled fleet traces
+        deadline = time.time() + 45
+        fired = None
+        while time.time() < deadline and fired is None:
+            payload = mon.alerts_payload()
+            for row in payload.get("alerts", []):
+                if row.get("slo") == "fleet-up" and (
+                    row.get("state") == "firing"
+                ):
+                    fired = row
+                    break
+            if fired is None:
+                time.sleep(0.5)
+        assert fired, (
+            "fleet-up SLO never fired after replica kill; "
+            f"payload={mon.alerts_payload()}"
+        )
+        assert fired.get("exemplars"), (
+            f"firing alert carried no exemplars: {fired}"
+        )
+        assert fired["exemplars"][0].get("trace_id")
+        assert fired.get("fleet_traces"), (
+            f"firing alert carried no fleet traces: {fired}"
+        )
+    finally:
+        hammer.stop()
+        gw.stop()
+        mon.set_slos([])
+        mon.slo_interval_s = old_slo_iv
+        mon.sampler_interval_s = old_sample_iv
+        for proc in procs.values():
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except Exception:
+                pass
