@@ -64,6 +64,7 @@ from predictionio_tpu.data.storage.registry import (
 from predictionio_tpu.deploy.registry import LifecycleRecordStore
 from predictionio_tpu.obs import get_default_registry
 from predictionio_tpu.resilience.retry import RetryPolicy
+from predictionio_tpu.utils.env import env_str
 
 log = logging.getLogger(__name__)
 
@@ -621,7 +622,73 @@ class TrainScheduler:
                     job.id, job.generation + 1,
                 )
                 self._jobs_counter.inc(outcome="unwedged")
+        # orphaned push spools (ISSUE 17): a kill -9'd worker never ran
+        # its exit flush — its durably-spooled telemetry batches are
+        # still sitting under log_dir. Ship them now so the dead job's
+        # spans / stage metrics / devprof land without a single poll.
+        try:
+            self.ship_orphan_spools()
+        except Exception:
+            log.debug("orphan spool sweep failed", exc_info=True)
         return requeued
+
+    # -- push-telemetry spool handling (ISSUE 17) --------------------------
+    def _push_spool_dir(
+        self, job_id: str, env: dict[str, str]
+    ) -> Optional[str]:
+        """Per-job spool dir for the worker's TelemetryShipper, or None
+        when push shipping isn't configured. An operator-pinned
+        PIO_PUSH_SPOOL is respected (shared spool — the workers own it,
+        the supervisor stays out)."""
+        if not env_str("PIO_PUSH_URL", env=env).strip():
+            return None
+        if env_str("PIO_PUSH_SPOOL", env=env).strip():
+            return None
+        return os.path.join(self._log_dir, f"{job_id}.spool")
+
+    def _ship_spool_residue(self, spool_dir: str, url: str) -> int:
+        """Best-effort ship of everything left in `spool_dir`, removing
+        the dir once empty. Never raises — a dead ingest endpoint keeps
+        the files for the next sweep."""
+        from predictionio_tpu.obs.monitor import push as _push
+
+        if not url:
+            return 0
+        try:
+            shipped = _push.ship_spool(spool_dir, url)
+        except Exception:
+            log.debug("spool ship failed: %s", spool_dir, exc_info=True)
+            return 0
+        try:
+            os.rmdir(spool_dir)  # only succeeds once fully drained
+        except OSError:
+            pass
+        return shipped
+
+    def ship_orphan_spools(self) -> int:
+        """Ship every `<log_dir>/<job>.spool` left by a dead worker
+        (skipping jobs whose child is still alive under THIS scheduler —
+        a live worker ships its own spool). Returns batches shipped."""
+        env = dict(os.environ, **self.config.child_env)
+        url = env_str("PIO_PUSH_URL", env=env).strip()
+        if not url:
+            return 0
+        try:
+            entries = sorted(os.listdir(self._log_dir))
+        except OSError:
+            return 0
+        shipped = 0
+        for entry in entries:
+            if not entry.endswith(".spool"):
+                continue
+            with self._child_lock:
+                live = entry[: -len(".spool")] in self._children
+            if live:
+                continue
+            shipped += self._ship_spool_residue(
+                os.path.join(self._log_dir, entry), url
+            )
+        return shipped
 
     # -- main loop --------------------------------------------------------
     def _loop(self) -> None:
@@ -809,6 +876,14 @@ class TrainScheduler:
         self, job: TrainJob, spec_path: str, result_path: str, log_path: str
     ) -> None:
         env = dict(os.environ, **self.config.child_env)
+        # push telemetry (ISSUE 17): give each worker its OWN spool dir
+        # under log_dir (unless the operator pinned one) so a kill -9'd
+        # child's unsent batches survive as files THIS supervisor can
+        # ship — see the post-exit residue ship below and
+        # ship_orphan_spools()
+        spool_dir = self._push_spool_dir(job.id, env)
+        if spool_dir is not None:
+            env["PIO_PUSH_SPOOL"] = spool_dir
         timeout_s = job.timeout_s or self.config.default_timeout_s
         deadline = time.monotonic() + timeout_s
         timed_out = False
@@ -898,6 +973,14 @@ class TrainScheduler:
                 self._children.pop(job.id, None)
         if self._abandon:
             return  # crashed worker: the record keeps its stale heartbeat
+        if spool_dir is not None:
+            # the worker's exit flush usually leaves the spool empty; a
+            # SIGKILLed / OOM-killed child cannot flush, so whatever
+            # batches it durably spooled ship from HERE (best-effort,
+            # zero polls of the dead process)
+            self._ship_spool_residue(
+                spool_dir, env_str("PIO_PUSH_URL", env=env)
+            )
         if not self.queue.is_owner(job):
             # fenced between the last heartbeat and child exit: the
             # thief's record wins, our outcome is dropped (the retrain

@@ -45,6 +45,27 @@ def main(argv: list[str]) -> int:
         spec = json.load(f)
     storage = Storage(storage_config_from_json(spec["storage"]))
 
+    # push telemetry (ISSUE 17): this process usually dies before any
+    # scraper gets a chance to poll it, so its train spans / stage
+    # metrics / devprof report ship OUT instead — spooled durably every
+    # interval, flushed on exit (atexit covers clean exits AND the
+    # uncaught-exception path; kill -9 leaves the spool for the
+    # supervisor to ship). No-op unless PIO_PUSH_URL/PIO_PUSH_SPOOL set.
+    shipper = None
+    try:
+        from predictionio_tpu.obs.monitor.push import TelemetryShipper
+
+        shipper = TelemetryShipper.from_env(job_id=spec.get("job_id"))
+        if shipper is not None:
+            shipper.start()
+            import atexit
+
+            atexit.register(shipper.stop)
+    except Exception:
+        logging.getLogger(__name__).debug(
+            "telemetry shipper unavailable", exc_info=True
+        )
+
     # retried-job adoption (ISSUE 9 satellite): if a previous attempt of
     # THIS job already trained and registered a version — and only the
     # result receipt / bookkeeping was lost — adopt it instead of paying

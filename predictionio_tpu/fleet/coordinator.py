@@ -188,6 +188,7 @@ class FleetMember:
         # so every claim doesn't pay a worker-record fold
         self._peer_cache: tuple[float, int] = (0.0, 0)
         self._peer_lock = threading.Lock()
+        self._shipper = None  # push telemetry (ISSUE 17), armed in start()
 
     # -- liveness ----------------------------------------------------------
     def live_peer_count(self) -> int:
@@ -251,10 +252,30 @@ class FleetMember:
             daemon=True,
         )
         self._hb_thread.start()
+        # push telemetry (ISSUE 17): fleet workers are often behind NAT
+        # or firewalls where the monitor can't scrape them — ship this
+        # process's series/spans out instead. No-op unless PIO_PUSH_URL
+        # or PIO_PUSH_SPOOL is set.
+        try:
+            from predictionio_tpu.obs.monitor.push import TelemetryShipper
+
+            self._shipper = TelemetryShipper.from_env(
+                instance=f"fleet-{self.worker_id}"
+            )
+            if self._shipper is not None:
+                self._shipper.start()
+        except Exception:
+            log.debug("telemetry shipper unavailable", exc_info=True)
         self.scheduler.resume_orphans()
         self.scheduler.start()
 
     def stop(self, kill_child: bool = False) -> None:
+        if self._shipper is not None:
+            try:
+                self._shipper.stop()  # joins + final flush
+            except Exception:
+                log.debug("telemetry shipper stop failed", exc_info=True)
+            self._shipper = None
         self.scheduler.stop(kill_child=kill_child)
         self._stop.set()
         if self._hb_thread is not None:

@@ -18,6 +18,8 @@ Knobs (read when the monitor is created; mutable attributes after):
   PIO_MONITOR_TARGETS    fleet scrape targets (dashboard / pio monitor)
   PIO_RECORDING_RULES    derived-series recording rules (ISSUE 16)
   PIO_TENANT_SLO_PRESETS auto-derive per-tenant SLOs at mux attach
+  PIO_PUSH_*             push-telemetry shipper/ingest (ISSUE 17 —
+                         see obs.monitor.push)
 """
 
 from __future__ import annotations
@@ -136,11 +138,24 @@ class Monitor:
         # (gateways, dashboards, `pio monitor`) — registered via
         # set_collector; its lifecycle stays with its owner
         self.collector: Optional[TraceCollector] = None
-        # scraped exemplar index (ISSUE 16): family → trace id →
-        # (value, ts), fed by the fleet scraper's `# EXEMPLAR` lines;
-        # merged with the local registries' exemplars on read
-        self._exemplars: dict[str, dict[str, tuple[float, float]]] = {}
+        # scraped exemplar index (ISSUE 16, per-route in ISSUE 17):
+        # family → observing label set → trace id → (value, ts), fed by
+        # the fleet scraper's `# EXEMPLAR` lines; merged with the local
+        # registries' exemplars on read. The bound is per (family,
+        # label set) — a slow /metrics route can no longer evict the
+        # /queries.json evidence an alert actually needs.
+        self._exemplars: dict[
+            str, dict[tuple, dict[str, tuple[float, float]]]
+        ] = {}
         self._exemplar_cap = max(16, 4 * env_int("PIO_TRACE_EXEMPLARS"))
+        # push-telemetry bookkeeping (ISSUE 17): last receipt wall time
+        # and latest devprof report per pushed instance. The sampler
+        # tick re-derives telemetry_last_push_age_seconds from
+        # _push_last so the series AGES between pushes — a worker gone
+        # silent trips a threshold alert exactly like up{instance}==0.
+        self._push_last: dict[str, float] = {}
+        self.push_reports: "dict[str, dict]" = {}
+        self._push_reports_cap = 64
         # push sinks (ISSUE 9 satellite): webhook/exec fired on
         # pending→firing (and resolve) transitions — SLO alerts AND the
         # externally-raised ones below
@@ -251,8 +266,15 @@ class Monitor:
             evaluate_rules(tsdb, self.recording_rules, now)
         with self._lock:
             specs = self._slo_union_locked()
+            push_last = dict(self._push_last)
         if specs:
             record_slo_ratios(tsdb, specs, now)
+        for instance, last in push_last.items():
+            tsdb.add(
+                "telemetry_last_push_age_seconds",
+                {"instance": instance},
+                max(0.0, now - last), "gauge", now,
+            )
 
     # -- SLOs --------------------------------------------------------------
     def _slo_union_locked(self) -> list[SLOSpec]:
@@ -296,15 +318,21 @@ class Monitor:
         self.collector = collector
 
     def note_exemplar(self, family: str, trace_id: str, value: float,
-                      ts: Optional[float] = None) -> None:
-        """Index one scraped exemplar: bounded per family, one slot per
-        trace id, evicting the fastest when full — the index always
-        holds the slowest traces seen."""
+                      ts: Optional[float] = None,
+                      labels: Optional[dict] = None) -> None:
+        """Index one scraped exemplar: bounded per (family, observing
+        label set), one slot per trace id, evicting the fastest when
+        full — each route/verb keeps its own slowest traces."""
         import time as _time
 
         ts = _time.time() if ts is None else float(ts)
+        lkey = tuple(sorted(
+            (str(k), str(v)) for k, v in (labels or {}).items()
+        ))
         with self._lock:
-            d = self._exemplars.setdefault(family, {})
+            d = self._exemplars.setdefault(family, {}).setdefault(
+                lkey, {}
+            )
             prev = d.get(trace_id)
             if prev is not None:
                 if value > prev[0]:
@@ -318,27 +346,41 @@ class Monitor:
             d[trace_id] = (value, ts)
 
     def exemplars(self, family: Optional[str] = None,
-                  limit: int = 8) -> list[dict]:
+                  limit: int = 8,
+                  labels: Optional[dict] = None) -> list[dict]:
         """Slowest-first exemplars across the scraped fleet index AND
-        the local registries' histogram families, deduped by trace id."""
+        the local registries' histogram families, deduped by trace id.
+        `labels` filters to label sets containing those pairs (e.g.
+        ``{"route": "/queries.json"}`` — the per-route view)."""
         from predictionio_tpu.obs.registry import HistogramFamily
 
+        want = None if not labels else set(
+            (str(k), str(v)) for k, v in labels.items()
+        )
         rows: list[dict] = []
         with self._lock:
-            for fam, d in self._exemplars.items():
+            for fam, by_lkey in self._exemplars.items():
                 if family and fam != family:
                     continue
-                rows.extend(
-                    {"family": fam, "trace_id": tid,
-                     "value": v, "ts": ts}
-                    for tid, (v, ts) in d.items()
-                )
+                for lkey, d in by_lkey.items():
+                    if want is not None and not want <= set(lkey):
+                        continue
+                    rows.extend(
+                        {"family": fam, "trace_id": tid,
+                         "value": v, "ts": ts, "labels": dict(lkey)}
+                        for tid, (v, ts) in d.items()
+                    )
         for f in self._families():
             if isinstance(f, HistogramFamily) and (
                 not family or f.name == family
             ):
-                rows.extend({"family": f.name, **ex}
-                            for ex in f.exemplars())
+                for ex in f.exemplars():
+                    ex_labels = ex.get("labels") or {}
+                    if want is not None and not want <= set(
+                        (str(k), str(v)) for k, v in ex_labels.items()
+                    ):
+                        continue
+                    rows.append({"family": f.name, **ex})
         rows.sort(key=lambda r: r["value"], reverse=True)
         seen: set[str] = set()
         out: list[dict] = []
@@ -350,6 +392,48 @@ class Monitor:
             if len(out) >= max(1, limit):
                 break
         return out
+
+    # -- push telemetry (ISSUE 17) -----------------------------------------
+    def note_push(self, instance: str, sampled_at: float,
+                  devprof: Optional[dict] = None,
+                  now: Optional[float] = None) -> None:
+        """Bookkeeping for one ingested push: freshness (the sampler
+        re-derives telemetry_last_push_age_seconds from this) and the
+        instance's latest devprof report. Writes an immediate age≈0
+        point so the series exists even before the next sampler tick —
+        `pio tsdb` right after a push must already see it."""
+        import time as _time
+
+        now = _time.time() if now is None else now
+        with self._lock:
+            self._push_last[instance] = now
+            if devprof is not None:
+                self.push_reports[instance] = devprof
+                while len(self.push_reports) > self._push_reports_cap:
+                    self.push_reports.pop(
+                        next(iter(self.push_reports))
+                    )
+        self.tsdb.add(
+            "telemetry_last_push_age_seconds", {"instance": instance},
+            max(0.0, now - float(sampled_at)), "gauge", now,
+        )
+
+    def push_status(self) -> list[dict]:
+        """Per-instance push freshness for dashboards/CLI."""
+        import time as _time
+
+        now = _time.time()
+        with self._lock:
+            rows = [
+                {
+                    "instance": instance,
+                    "age_s": round(max(0.0, now - last), 3),
+                    "devprof": instance in self.push_reports,
+                }
+                for instance, last in self._push_last.items()
+            ]
+        rows.sort(key=lambda r: r["instance"])
+        return rows
 
     def _enrich_alert(self, payload: dict) -> dict:
         """Attach evidence to a firing alert: the slowest exemplar
@@ -501,6 +585,26 @@ class Monitor:
         `q=`) for points/aggregates."""
         if not enabled():
             return {"enabled": False, "series": []}
+        expr_s = qs.get("expr")
+        if expr_s:
+            from predictionio_tpu.obs.monitor.expr import (
+                ExprError,
+                evaluate_rows,
+            )
+
+            try:
+                window_s = (
+                    float(qs["window_s"]) if "window_s" in qs else 300.0
+                )
+            except ValueError:
+                window_s = 300.0
+            try:
+                rows = evaluate_rows(
+                    self.tsdb, expr_s, default_window_s=window_s
+                )
+            except ExprError as e:
+                return {"enabled": True, "expr": expr_s, "error": str(e)}
+            return {"enabled": True, "expr": expr_s, "result": rows}
         name = qs.get("name")
         if not name:
             try:

@@ -97,6 +97,7 @@ class TraceCollector:
         self._polls = 0
         self._poll_errors = 0
         self._expired_orphans = 0
+        self._pushed_spans = 0  # spans arrived via POST /telemetry/push  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -135,6 +136,51 @@ class TraceCollector:
                 float(payload.get("now", now)) - self.interval_s
             )
         self._settle(now)
+        return ingested
+
+    def ingest_spans(self, spans: list, now: Optional[float] = None) -> int:
+        """Pushed span batches (POST /telemetry/push): ingest out-of-band
+        spans from a process that died before any poll could reach it.
+        Rooted pushed traces are promoted immediately with reason
+        "pushed" even when boring — the process is gone, so "wait for
+        the poll loop to decide" would just expire them; a train trace
+        that cost a subprocess its whole life is worth one slot. Unrooted
+        fragments keep the normal hold_s grace for a late root."""
+        now = time.time() if now is None else now
+        ingested = 0
+        tids: set = set()
+        for sp in spans or []:
+            if not isinstance(sp, dict):
+                continue
+            n = self._ingest(sp, now)
+            ingested += n
+            if n and sp.get("trace_id"):
+                tids.add(sp["trace_id"])
+        if not ingested:
+            return 0
+        with self._lock:
+            self._pushed_spans += ingested
+            for tid in tids:
+                frag = self._frags.get(tid)
+                if frag is None:
+                    continue
+                spans_by_id = frag["spans"]
+                rooted = any(
+                    not s.get("parent_span_id")
+                    for s in spans_by_id.values()
+                )
+                if not rooted:
+                    continue
+                del self._frags[tid]
+                self._traces[tid] = {
+                    "spans": spans_by_id,
+                    "reason": (
+                        self._keep_reason(spans_by_id.values()) or "pushed"
+                    ),
+                    "assembled_at": now,
+                }
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
         return ingested
 
     def _ingest(self, sp: dict, now: float) -> int:
@@ -339,6 +385,7 @@ class TraceCollector:
             "polls": self._polls,
             "poll_errors": self._poll_errors,
             "expired_orphans": self._expired_orphans,
+            "pushed_spans": self._pushed_spans,
         }
 
     # -- lifecycle ---------------------------------------------------------

@@ -54,20 +54,38 @@ def parse_targets(text: str) -> list[tuple[str, str]]:
     return out
 
 
-def parse_exemplar_lines(text: str) -> list[tuple[str, str, float, float]]:
+def parse_exemplar_lines(
+    text: str,
+) -> list[tuple[str, str, float, float, dict]]:
     """Parse the registry's ``# EXEMPLAR <family> <trace_id> <value>
-    <ts>`` comment lines → [(family, trace_id, value, ts)]. Plain
-    Prometheus parsers skip them as comments; the fleet scraper feeds
-    them into the Monitor's exemplar index so a firing alert can link
-    straight to the slowest traces anywhere in the fleet."""
-    out: list[tuple[str, str, float, float]] = []
+    <ts> [<labels-json>]`` comment lines →
+    [(family, trace_id, value, ts, labels)]. The trailing compact-JSON
+    token is the observing label set (ISSUE 17 per-route indexing);
+    legacy 6-token lines parse with empty labels. JSON label values may
+    contain spaces, so the line is split at most 6 times and the
+    remainder JSON-decoded. Plain Prometheus parsers skip all of it as
+    comments; the fleet scraper feeds these into the Monitor's exemplar
+    index so a firing alert can link straight to the slowest traces
+    anywhere in the fleet."""
+    import json as _json
+
+    out: list[tuple[str, str, float, float, dict]] = []
     for line in text.splitlines():
-        parts = line.strip().split()
-        if len(parts) != 6 or parts[0] != "#" or parts[1] != "EXEMPLAR":
+        parts = line.strip().split(None, 6)
+        if len(parts) < 6 or parts[0] != "#" or parts[1] != "EXEMPLAR":
             continue
+        labels: dict = {}
+        if len(parts) == 7:
+            try:
+                decoded = _json.loads(parts[6])
+                if isinstance(decoded, dict):
+                    labels = {str(k): str(v) for k, v in decoded.items()}
+            except ValueError:
+                continue
         try:
             out.append(
-                (parts[2], parts[3], float(parts[4]), float(parts[5]))
+                (parts[2], parts[3], float(parts[4]), float(parts[5]),
+                 labels)
             )
         except ValueError:
             continue
@@ -138,20 +156,49 @@ class FleetScraper:
     thread_name = "fleet-scraper"
 
     def __init__(self, tsdb: TSDB, targets: list[tuple[str, str]],
-                 interval_s: float = 10.0, timeout_s: float = 5.0):
+                 interval_s: float = 10.0, timeout_s: float = 5.0,
+                 backoff_max_s: Optional[float] = None):
+        from predictionio_tpu.utils.env import env_float
+
         self.tsdb = tsdb
         self.targets = list(targets)
         self.interval_s = max(0.05, float(interval_s))
         self.timeout_s = float(timeout_s)
+        # ISSUE 17 satellite: a down target is NOT re-polled every
+        # interval — each consecutive failure doubles the wait (capped),
+        # so a dead replica doesn't eat a connect timeout per tick. The
+        # up{instance}=0 point still lands every logical tick below, so
+        # alerting freshness is unaffected by the backoff.
+        self.backoff_max_s = float(
+            backoff_max_s if backoff_max_s is not None
+            else env_float("PIO_SCRAPE_BACKOFF_MAX_S")
+        )
+        self._fails: dict[str, int] = {}       # consecutive failures
+        self._not_before: dict[str, float] = {}  # next attempt (epoch s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def backoff_remaining(self, instance: str,
+                          now: Optional[float] = None) -> float:
+        """Seconds until the next real attempt at `instance` (0 when it
+        is due — or healthy)."""
+        now = time.time() if now is None else now
+        return max(0.0, self._not_before.get(instance, 0.0) - now)
+
     # -- one pass ----------------------------------------------------------
     def scrape_once(self, now: Optional[float] = None) -> dict[str, bool]:
-        """Scrape every target once; returns {instance: up}."""
+        """Scrape every target once; returns {instance: up}. Targets
+        inside their failure backoff are skipped (no HTTP), but still
+        write up=0 for the tick."""
         results: dict[str, bool] = {}
         for instance, base in self.targets:
             now_t = time.time() if now is None else now
+            if now_t < self._not_before.get(instance, 0.0):
+                self.tsdb.add(
+                    "up", {"instance": instance}, 0.0, "gauge", now_t,
+                )
+                results[instance] = False
+                continue
             t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(
@@ -164,6 +211,15 @@ class FleetScraper:
                 up = False
                 log.debug("scrape of %s (%s) failed: %s", instance, base, e)
             dur = time.perf_counter() - t0
+            if up:
+                self._fails.pop(instance, None)
+                self._not_before.pop(instance, None)
+            else:
+                n = self._fails.get(instance, 0) + 1
+                self._fails[instance] = n
+                self._not_before[instance] = now_t + min(
+                    self.interval_s * (2.0 ** n), self.backoff_max_s
+                )
             self.tsdb.add(
                 "up", {"instance": instance}, 1.0 if up else 0.0,
                 "gauge", now_t,
@@ -200,8 +256,10 @@ class FleetScraper:
             from predictionio_tpu.obs.monitor import get_monitor
 
             note = get_monitor().note_exemplar
-            for family, tid, value, ts in parse_exemplar_lines(body):
-                note(family, tid, value, ts)
+            for family, tid, value, ts, labels in parse_exemplar_lines(
+                body
+            ):
+                note(family, tid, value, ts, labels=labels)
         except Exception:
             log.debug("exemplar indexing failed", exc_info=True)
 
@@ -238,10 +296,14 @@ class FleetScraper:
             match = {"instance": instance}
             up = self.tsdb.latest("up", match)
             dur = self.tsdb.latest("scrape_duration_seconds", match)
-            out.append({
+            row = {
                 "instance": instance,
                 "url": base,
                 "up": None if up is None else bool(up),
                 "scrape_seconds": dur,
-            })
+            }
+            backoff = self.backoff_remaining(instance)
+            if backoff > 0:
+                row["backoff_s"] = round(backoff, 1)
+            out.append(row)
         return out

@@ -66,7 +66,7 @@ from predictionio_tpu.obs.registry import MetricsRegistry
 log = logging.getLogger(__name__)
 from predictionio_tpu.utils.env import env_str
 
-KINDS = ("availability", "latency", "up")
+KINDS = ("availability", "latency", "up", "expr")
 
 # alert states
 INACTIVE = "inactive"
@@ -92,6 +92,12 @@ class SLOSpec:
     resolve_s: float = 0.0
     min_samples: int = 1
     aggregate: Optional[str] = None
+    # kind "expr" (ISSUE 17): the error fraction IS this series-algebra
+    # expression (obs.monitor.expr), evaluated per window with $window
+    # substituted (e.g. "sum(increase(errs[$window])) /
+    # sum(increase(reqs[$window]))"). min_samples does not apply — an
+    # expression with no data holds state exactly like no-traffic.
+    expr: Optional[str] = None
 
     def __post_init__(self):
         if not self.name:
@@ -101,6 +107,16 @@ class SLOSpec:
                 f"SLO {self.name!r}: unknown kind {self.kind!r} "
                 f"(known: {', '.join(KINDS)})"
             )
+        if self.kind == "expr":
+            if not self.expr:
+                raise ValueError(
+                    f"SLO {self.name!r}: kind 'expr' needs an 'expr'"
+                )
+            # parse eagerly (with a dummy window) so a typo fails at
+            # spec-load time, not silently on every evaluation
+            from predictionio_tpu.obs.monitor.expr import parse
+
+            parse(self.expr.replace("$window", "300s"))
         if not 0.0 < self.objective < 1.0:
             raise ValueError(
                 f"SLO {self.name!r}: objective must be in (0, 1), got "
@@ -135,7 +151,7 @@ class SLOSpec:
                 "name", "kind", "objective", "server", "route", "tenant",
                 "instance", "threshold_ms", "window_s", "fast_window_s",
                 "burn_threshold", "for_s", "resolve_s", "min_samples",
-                "aggregate",
+                "aggregate", "expr",
             ) if k in d
         }
         unknown = set(d) - set(known)
@@ -157,6 +173,8 @@ class SLOSpec:
         if self.kind == "up":
             if self.instance:
                 out["instance"] = self.instance
+        elif self.kind == "expr":
+            out["expr"] = self.expr
         else:
             out["server"] = self.server
             if self.tenant:
@@ -280,6 +298,29 @@ def error_fraction(
     bad/total across the fleet, "mean" averages the per-instance
     fractions (zero-traffic instances are skipped)."""
     floor = max(1, spec.min_samples)
+    if spec.kind == "expr":
+        from predictionio_tpu.obs.monitor import expr as _expr
+
+        text = (spec.expr or "").replace("$window", f"{window_s:g}s")
+        try:
+            val = _expr.evaluate(
+                tsdb, text, now, default_window_s=window_s
+            )
+        except _expr.ExprError:
+            return None, 0.0
+        if val is None:
+            return None, 0.0
+        if isinstance(val, list):
+            if not val:
+                return None, 0.0
+            # a vector result averages across label sets — the scalar
+            # shape burn_rate needs; write the expression with sum()/
+            # ratios if a different pooling is wanted
+            val = sum(v for _l, v in val) / len(val)
+        # it IS an error fraction by contract: clamp to the unit range
+        # so a mis-scaled expression can't produce a negative budget
+        frac = min(max(float(val), 0.0), 1.0)
+        return frac, float(floor)
     if spec.kind == "up":
         match = {"instance": spec.instance} if spec.instance else None
         if spec.aggregate == "mean":

@@ -112,7 +112,14 @@ class TSDB:
     def add(self, name: str, labels: Optional[dict], value: float,
             kind: str = "gauge", t: Optional[float] = None) -> bool:
         """Append one point; returns False when the series would exceed
-        the cardinality cap (dropped + counted, never raises)."""
+        the cardinality cap (dropped + counted, never raises).
+
+        Points are kept in TIME order even when they arrive out of
+        order — a snapshot restored after live sampling already began,
+        or a pushed spool payload backfilling a dead worker's history.
+        `increase()`/`rate()` walk the ring in sequence assuming
+        monotone timestamps; an interleaved restore used to read a
+        counter reset where none happened and double-count the window."""
         key = (name, _label_key(labels))
         now = time.time() if t is None else t
         with self._lock:
@@ -124,7 +131,18 @@ class TSDB:
                 series = self._series[key] = Series(
                     name, key[1], kind, self.capacity
                 )
-            series.points.append((now, float(value)))
+            pts = series.points
+            if pts and now < pts[-1][0]:
+                # out-of-order arrival (rare): rebuild with the point in
+                # its time slot; the deque maxlen still drops oldest
+                ordered = list(pts)
+                idx = len(ordered)
+                while idx > 0 and ordered[idx - 1][0] > now:
+                    idx -= 1
+                ordered.insert(idx, (now, float(value)))
+                series.points = deque(ordered, maxlen=self.capacity)
+            else:
+                pts.append((now, float(value)))
         return True
 
     # -- reading -----------------------------------------------------------
@@ -294,7 +312,7 @@ class TSDB:
 # or ``@/path.json``) — per-SLO ratio rules are auto-derived on top by
 # the Monitor (see slo.record_slo_ratios).
 
-RULE_KINDS = ("rate", "error_ratio", "quantile")
+RULE_KINDS = ("rate", "error_ratio", "quantile", "expr")
 
 
 def bucket_quantile(tsdb: TSDB, name: str, q: float,
@@ -346,10 +364,14 @@ class RecordingRule:
     """One derived-series rule.
 
     record    output series name (stored as a gauge)
-    kind      "rate" | "error_ratio" | "quantile"
+    kind      "rate" | "error_ratio" | "quantile" | "expr"
     source    raw family name (base name — no _bucket/_total suffix
               stripping is attempted; pass the counter name for rate/
-              error_ratio and the histogram base name for quantile)
+              error_ratio and the histogram base name for quantile;
+              unused by expr rules)
+    expr      expr rules: a series-algebra expression (obs.monitor.expr)
+              — may evaluate to a VECTOR, writing one point per label
+              set with the expression's labels merged under `labels`
     match     label matcher on the source series
     labels    labels stamped on the derived series
     window_s  evaluation window (default 300)
@@ -361,7 +383,8 @@ class RecordingRule:
 
     record: str
     kind: str
-    source: str
+    source: str = ""
+    expr: str = ""
     match: tuple = ()
     labels: tuple = ()
     window_s: float = 300.0
@@ -371,13 +394,23 @@ class RecordingRule:
     bad_values: tuple = ()
 
     def __post_init__(self):
-        if not self.record or not self.source:
-            raise ValueError("recording rule needs 'record' and 'source'")
         if self.kind not in RULE_KINDS:
             raise ValueError(
                 f"rule {self.record!r}: unknown kind {self.kind!r} "
                 f"(known: {', '.join(RULE_KINDS)})"
             )
+        if self.kind == "expr":
+            if not self.record or not self.expr:
+                raise ValueError(
+                    "expr recording rule needs 'record' and 'expr'"
+                )
+            # parse eagerly: a typo fails at load time (logged by
+            # load_recording_rules), not silently every sampler tick
+            from predictionio_tpu.obs.monitor.expr import parse
+
+            parse(self.expr)
+        elif not self.record or not self.source:
+            raise ValueError("recording rule needs 'record' and 'source'")
         if self.window_s <= 0:
             raise ValueError(f"rule {self.record!r}: window_s must be > 0")
 
@@ -385,8 +418,8 @@ class RecordingRule:
     def from_dict(cls, d: dict) -> "RecordingRule":
         known = {
             k: d[k] for k in (
-                "record", "kind", "source", "match", "labels", "window_s",
-                "q", "bad_label", "bad_min", "bad_values",
+                "record", "kind", "source", "expr", "match", "labels",
+                "window_s", "q", "bad_label", "bad_min", "bad_values",
             ) if k in d
         }
         unknown = set(d) - set(known)
@@ -412,6 +445,10 @@ class RecordingRule:
             "source": self.source, "window_s": self.window_s,
             "match": dict(self.match), "labels": dict(self.labels),
         }
+        if self.kind == "expr":
+            out["expr"] = self.expr
+            out.pop("source")
+            out.pop("match")
         if self.kind == "quantile":
             out["q"] = self.q
         if self.kind == "error_ratio":
@@ -428,6 +465,9 @@ class RecordingRule:
         nothing is written for an empty window, so readers can tell
         'quiet' from 'zero')."""
         now = time.time() if now is None else now
+        if self.kind == "expr":
+            rows = self.evaluate_vector(tsdb, now)
+            return rows[0][1] if len(rows) == 1 else None
         match = dict(self.match) or None
         if self.kind == "rate":
             if not tsdb.matching(self.source, match):
@@ -455,6 +495,34 @@ class RecordingRule:
         if total <= 0:
             return None
         return bad / total
+
+    def evaluate_vector(
+        self, tsdb: TSDB, now: Optional[float] = None
+    ) -> list[tuple[dict, float]]:
+        """Evaluate to [(labels, value), ...] — expr rules may produce a
+        whole vector (one point per label set, e.g. `sum by (instance)`);
+        the fixed kinds produce at most one sample under the rule's
+        static labels. Empty list on no traffic."""
+        now = time.time() if now is None else now
+        if self.kind != "expr":
+            value = self.evaluate(tsdb, now)
+            if value is None:
+                return []
+            return [(dict(self.labels), value)]
+        from predictionio_tpu.obs.monitor import expr as _expr
+
+        val = _expr.evaluate(tsdb, self.expr, now,
+                             default_window_s=self.window_s)
+        if val is None:
+            return []
+        if isinstance(val, float):
+            return [(dict(self.labels), val)]
+        return [
+            # rule labels win on collision: the operator's stamp is the
+            # contract consumers match on
+            ({**dict(labels), **dict(self.labels)}, v)
+            for labels, v in val
+        ]
 
 
 def load_recording_rules(
@@ -493,7 +561,7 @@ def evaluate_rules(tsdb: TSDB, rules: Iterable[RecordingRule],
     written = 0
     for rule in rules:
         try:
-            value = rule.evaluate(tsdb, now)
+            rows = rule.evaluate_vector(tsdb, now)
         except Exception:
             import logging as _logging
 
@@ -501,10 +569,9 @@ def evaluate_rules(tsdb: TSDB, rules: Iterable[RecordingRule],
                 "recording rule %s failed", rule.record, exc_info=True,
             )
             continue
-        if value is None:
-            continue
-        if tsdb.add(rule.record, dict(rule.labels), value, "gauge", now):
-            written += 1
+        for labels, value in rows:
+            if tsdb.add(rule.record, labels, value, "gauge", now):
+                written += 1
     return written
 
 

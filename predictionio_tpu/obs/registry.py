@@ -193,14 +193,17 @@ class HistogramFamily(MetricFamily):
         # latencies; count-valued histograms (batch_size) pass 1 so a
         # bucket of all-ones yields p50=1, not an impossible 0.5
         self.lower_bound = float(lower_bound)
-        # ISSUE 16 exemplars: the slowest N (trace-id, value) pairs ever
-        # observed on this family while a request trace was in scope —
-        # one slot per trace id, so a single pathological request cannot
-        # monopolize the reservoir. The bound is per FAMILY (not per
-        # label set): exemplars answer "which trace do I open for this
-        # alert", and one bounded list per family is enough for that.
+        # ISSUE 16 exemplars, ISSUE 17 per-route indexing: the slowest N
+        # (trace-id, value) pairs observed PER LABEL SET while a request
+        # trace was in scope — one slot per trace id, so a single
+        # pathological request cannot monopolize a reservoir. Keying by
+        # label set (route/verb/tenant...) means "which trace do I open
+        # for the /queries.json alert" no longer competes with a slow
+        # /metrics scrape for the same bounded list.
         self._exemplar_cap = env_int("PIO_TRACE_EXEMPLARS")
-        self._exemplars: dict[str, tuple[float, float]] = {}
+        self._exemplars: dict[
+            tuple[str, ...], dict[str, tuple[float, float]]
+        ] = {}
 
     def _new_child(self) -> _Histogram:
         return _Histogram(len(self.buckets))
@@ -209,7 +212,8 @@ class HistogramFamily(MetricFamily):
         value = float(value)
         tid = current_trace_id() if self._exemplar_cap > 0 else None
         with self._lock:
-            child = self._child(self._values(**labels))
+            lv = self._values(**labels)
+            child = self._child(lv)
             i = 0
             for i, edge in enumerate(self.buckets):
                 if value <= edge:
@@ -220,29 +224,40 @@ class HistogramFamily(MetricFamily):
             child.sum += value
             child.count += 1
             if tid is not None:
-                self._note_exemplar_locked(tid, value)
+                self._note_exemplar_locked(lv, tid, value)
 
-    def _note_exemplar_locked(self, tid: str, value: float) -> None:
-        prev = self._exemplars.get(tid)
+    def _note_exemplar_locked(self, lv: tuple[str, ...], tid: str,
+                              value: float) -> None:
+        d = self._exemplars.setdefault(lv, {})
+        prev = d.get(tid)
         if prev is not None:
             if value > prev[0]:
-                self._exemplars[tid] = (value, time.time())
+                d[tid] = (value, time.time())
             return
-        if len(self._exemplars) >= self._exemplar_cap:
-            floor_tid = min(self._exemplars, key=lambda t: self._exemplars[t])
-            if value <= self._exemplars[floor_tid][0]:
+        if len(d) >= self._exemplar_cap:
+            floor_tid = min(d, key=lambda t: d[t])
+            if value <= d[floor_tid][0]:
                 return
-            del self._exemplars[floor_tid]
-        self._exemplars[tid] = (value, time.time())
+            del d[floor_tid]
+        d[tid] = (value, time.time())
 
     def exemplars(self) -> list[dict]:
-        """Retained exemplars, slowest first: [{trace_id, value, ts}]."""
+        """Retained exemplars, slowest first:
+        [{trace_id, value, ts, labels}] — `labels` is the observing
+        label set (route/verb/...), per-set bounded."""
         with self._lock:
-            items = list(self._exemplars.items())
-        items.sort(key=lambda kv: kv[1][0], reverse=True)
+            items = [
+                (lv, tid, val, ts)
+                for lv, d in self._exemplars.items()
+                for tid, (val, ts) in d.items()
+            ]
+        items.sort(key=lambda row: row[2], reverse=True)
         return [
-            {"trace_id": tid, "value": val, "ts": ts}
-            for tid, (val, ts) in items
+            {
+                "trace_id": tid, "value": val, "ts": ts,
+                "labels": dict(zip(self.labelnames, lv)),
+            }
+            for lv, tid, val, ts in items
         ]
 
     def _get(self, labels: dict) -> Optional[_Histogram]:
@@ -448,12 +463,23 @@ def render_families(families: Iterable[MetricFamily]) -> str:
             # exemplars ride as comment lines (a scraper that doesn't
             # understand them skips '#'; ours parses them back into the
             # fleet exemplar index). Emitted outside the family lock —
-            # exemplars() takes it.
+            # exemplars() takes it. The trailing token is the observing
+            # label set as compact JSON (ISSUE 17 per-route indexing);
+            # it is omitted for label-less families, which keeps the
+            # 6-token legacy format parseable both ways.
+            import json as _json
+
             for ex in fam.exemplars():
-                lines.append(
+                line = (
                     f"# EXEMPLAR {fam.name} {ex['trace_id']} "
                     f"{repr(float(ex['value']))} {ex['ts']:.3f}"
                 )
+                if ex.get("labels"):
+                    line += " " + _json.dumps(
+                        ex["labels"], separators=(",", ":"),
+                        sort_keys=True,
+                    )
+                lines.append(line)
     return "\n".join(lines) + "\n"
 
 

@@ -956,6 +956,16 @@ def cmd_monitor(args) -> int:
             targets=list(targets), interval_s=args.interval
         )
         monitor.set_collector(collector)
+    exprs = list(getattr(args, "expr", None) or [])
+    if exprs:
+        # parse eagerly so a typo fails before the first scrape pass
+        from predictionio_tpu.obs.monitor.expr import ExprError, parse
+
+        for e in exprs:
+            try:
+                parse(e)
+            except ExprError as exc:
+                return _fail(f"bad --expr {e!r}: {exc}")
     specs = load_slos(args.slos) if args.slos else load_slos()
     engine = None
     if specs:
@@ -982,6 +992,30 @@ def cmd_monitor(args) -> int:
                 if collector is not None else ""
             )
             print(f"[INFO] {stamp} fleet: {fleet}{traces}")
+            for e in exprs:
+                # evaluated per pass over the freshly-scraped TSDB
+                from predictionio_tpu.obs.monitor.expr import (
+                    ExprError,
+                    evaluate_rows,
+                )
+
+                try:
+                    rows = evaluate_rows(monitor.tsdb, e)
+                except ExprError as exc:
+                    print(f"[WARN]   expr {e}: {exc}")
+                    continue
+                if not rows:
+                    print(f"[INFO]   expr {e} = (no data)")
+                    continue
+                for row in rows:
+                    lbls = ",".join(
+                        f"{k}={v}"
+                        for k, v in sorted(row["labels"].items())
+                    )
+                    where = f"{{{lbls}}}" if lbls else ""
+                    print(
+                        f"[INFO]   expr {e}{where} = {row['value']:g}"
+                    )
             if engine is not None:
                 for row in engine.payload()["slos"]:
                     fast = row["fast_burn"]
@@ -1120,6 +1154,8 @@ def cmd_tsdb(args) -> int:
 
     url = getattr(args, "url", None)
     qs: dict = {}
+    if getattr(args, "expr", None):
+        qs["expr"] = args.expr
     if args.name:
         qs["name"] = args.name
     if args.labels:
@@ -1140,6 +1176,21 @@ def cmd_tsdb(args) -> int:
         payload = get_monitor().tsdb_payload(qs)
     if not payload.get("enabled", True):
         print("[INFO] monitoring disabled (PIO_TSDB=0)")
+        return 0
+    if "expr" in payload:
+        # series-algebra evaluation (ISSUE 17)
+        if "error" in payload:
+            return _fail(f"expression error: {payload['error']}")
+        rows = payload.get("result") or []
+        print(f"[INFO] {payload['expr']}")
+        if not rows:
+            print("[INFO]   (no data)")
+            return 0
+        for row in rows:
+            labels = ",".join(
+                f"{k}={v}" for k, v in sorted(row["labels"].items())
+            )
+            print(f"[INFO]   {{{labels}}} = {row['value']:g}")
         return 0
     if "value" in payload:
         print(
@@ -1988,6 +2039,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--slos", default=None,
         help="SLO specs: JSON array or @/path.json (default: PIO_SLOS)",
     )
+    s.add_argument(
+        "--expr", action="append", default=None, metavar="EXPR",
+        help="series-algebra expression to evaluate and print each "
+             "pass (repeatable)",
+    )
     s.set_defaults(func=cmd_monitor)
 
     s = sub.add_parser(
@@ -2035,7 +2091,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     dsub = s.add_subparsers(dest="tsdb_action", required=True)
     dq = dsub.add_parser(
-        "query", help="list series, or one series' points/aggregates"
+        "query", help="list series, or one series' points/aggregates, "
+                      "or evaluate a series-algebra expression"
+    )
+    dq.add_argument(
+        "expr", nargs="?", default=None,
+        help="expression to evaluate, e.g. "
+             "'sum by (instance) (rate(errors_total[5m]))' "
+             "(omit for the series listing / --name forms)",
     )
     dq.add_argument("--name", default=None,
                     help="series name (omit to list all)")

@@ -75,6 +75,8 @@ class _Handler(JsonHandler):
         try:
             if path == "/debug/faults":
                 self._serve_debug_faults_set()
+            elif path == "/telemetry/push":
+                self._serve_telemetry_push()
             else:
                 raise HttpError(404, "Not Found")
         except HttpError as e:
@@ -283,12 +285,53 @@ fragment(s), {st['polls']} poll(s)</p>
         tsdb = get_monitor().tsdb
         name = (qs.get("series") or [""])[0].strip()
         match_raw = (qs.get("match") or [""])[0].strip()
+        expr_s = (qs.get("expr") or [""])[0].strip()
         form = f"""<form method="get" action="/">
 <input name="series" size="40" value="{html.escape(name)}"
  placeholder="series name, e.g. slo_error_ratio">
 <input name="match" size="30" value="{html.escape(match_raw)}"
  placeholder="label match, e.g. slo=availability">
-<input type="submit" value="Plot"></form>"""
+<input type="submit" value="Plot"></form>
+<form method="get" action="/">
+<input name="expr" size="72" value="{html.escape(expr_s)}"
+ placeholder="expression, e.g. sum by (instance) (rate(errors_total[5m]))">
+<input type="submit" value="Eval"></form>"""
+        if expr_s:
+            # series-algebra evaluation (ISSUE 17): same engine that
+            # backs expr recording rules and `pio tsdb query`
+            from predictionio_tpu.obs.monitor.expr import (
+                ExprError,
+                evaluate_rows,
+            )
+
+            try:
+                rows_v = evaluate_rows(tsdb, expr_s)
+            except ExprError as e:
+                return (
+                    f"<h1>TSDB explorer</h1>{form}"
+                    f"<p>expression error: <code>{html.escape(str(e))}"
+                    f"</code></p>"
+                )
+            if not rows_v:
+                return (
+                    f"<h1>TSDB explorer</h1>{form}"
+                    "<p>(expression matched no data)</p>"
+                )
+            body = "".join(
+                "<tr><td><code>"
+                + (html.escape(
+                    ",".join(f"{k}={v}" for k, v in sorted(
+                        r["labels"].items()
+                    ))
+                ) or "-")
+                + f"</code></td><td>{r['value']:g}</td></tr>"
+                for r in rows_v
+            )
+            return f"""<h1>TSDB explorer</h1>{form}
+<table border="1" cellpadding="4">
+<tr><th>Labels</th><th>Value</th></tr>
+{body}
+</table>"""
         if not name:
             return (
                 f"<h1>TSDB explorer</h1>{form}"
@@ -450,10 +493,18 @@ class Dashboard(ServerProcess):
             get_monitor,
             parse_targets,
         )
-        from predictionio_tpu.utils.env import env_bool, env_float
+        from predictionio_tpu.utils.env import env_bool, env_flag, env_float
 
         port = super().start()
         targets = parse_targets(self.monitor_targets)
+        if env_flag("PIO_PUSH_INGEST") and enabled() and targets == []:
+            # pure push-ingest sink (ISSUE 17): spans arriving on
+            # POST /telemetry/push need a collector to land in, but with
+            # no scrape targets there is nothing to poll — mount one
+            # WITHOUT starting its poll thread (zero polls, assembles
+            # pushed traces only)
+            self._collector = TraceCollector(targets=[], interval_s=3600)
+            get_monitor().set_collector(self._collector)
         if targets and enabled():
             interval = (
                 self.scrape_interval_s
@@ -473,6 +524,11 @@ class Dashboard(ServerProcess):
                 )
                 get_monitor().set_collector(self._collector)
                 self._collector.start()
+            elif env_flag("PIO_PUSH_INGEST"):
+                # scraping but not polling traces: pushed spans still
+                # need a sink (unstarted — ingest only, zero polls)
+                self._collector = TraceCollector(targets=[], interval_s=3600)
+                get_monitor().set_collector(self._collector)
         return port
 
     def stop(self) -> None:

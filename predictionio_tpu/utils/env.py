@@ -185,6 +185,29 @@ _k("PIO_WORKER_METRICS_URL", "str", "",
    "Metrics URL a fleet worker advertises on its registry record so "
    "`pio fleet status` can scrape per-worker device gauges.")
 
+# -- push telemetry (ISSUE 17) ----------------------------------------------
+_k("PIO_PUSH_URL", "str", "",
+   "Base URL of a push-telemetry ingest (POST /telemetry/push); set in "
+   "ephemeral processes (train workers, fleet workers) to ship spooled "
+   "metrics/spans/devprof. Empty disables shipping.")
+_k("PIO_PUSH_SPOOL", "path", "",
+   "Local fsync'd spool directory for the telemetry shipper; the train "
+   "scheduler defaults each child to <log_dir>/<job>.spool so orphaned "
+   "spools of killed workers are shipped by the supervisor.")
+_k("PIO_PUSH_INGEST", "flag", "",
+   "Set 1 to enable the guarded POST /telemetry/push ingest endpoint "
+   "on this server (dashboard/monitor).")
+_k("PIO_PUSH_INTERVAL_S", "float", 10.0,
+   "Seconds between telemetry-shipper spool+ship passes.")
+_k("PIO_PUSH_DEADLINE_S", "float", 5.0,
+   "Wall-clock budget (s) one telemetry ship pass may spend retrying.")
+_k("PIO_PUSH_SPOOL_MAX_BYTES", "int", 8 * 1024 * 1024,
+   "Telemetry spool directory size bound; oldest spool files drop "
+   "first.")
+_k("PIO_SCRAPE_BACKOFF_MAX_S", "float", 60.0,
+   "Cap (s) on the fleet scraper's exponential backoff for down "
+   "targets (up{instance}=0 still records every tick).")
+
 # -- monitoring plane --------------------------------------------------------
 _k("PIO_TSDB", "flag", "1",
    "In-process monitoring plane; 0 disables sampler/TSDB/SLO engine.")

@@ -476,6 +476,30 @@ class JsonHandler(BaseHTTPRequestHandler):
             _faults.install(spec)
         self._respond(200, {"faults": _faults.specs()})
 
+    def _serve_telemetry_push(self) -> None:
+        """POST /telemetry/push — ingest a pushed telemetry payload from
+        an ephemeral process (ISSUE 17). Guarded like /debug/faults: 403
+        unless the operator set PIO_PUSH_INGEST=1 on this server, so an
+        internet-facing query server can't be fed fabricated series.
+        Body is the :mod:`obs.monitor.push` payload (v1: series + spans
+        + optional devprof report); lands in the process monitor's TSDB
+        tagged ``instance``/``job_id`` and in its trace collector."""
+        from predictionio_tpu.obs.monitor import push as _push
+        from predictionio_tpu.utils.env import env_flag as _env_flag
+
+        if not _env_flag("PIO_PUSH_INGEST"):
+            self._respond(403, {
+                "message": "telemetry push ingest is disabled: set "
+                           "PIO_PUSH_INGEST=1 on this server to enable it"
+            })
+            return
+        body = self._json_body()
+        try:
+            result = _push.ingest(body)
+        except _push.PushError as e:
+            raise HttpError(400, str(e))
+        self._respond(200, result)
+
     def _drain_body(self) -> None:
         length = int(self.headers.get("Content-Length") or 0)
         self._raw_body = self.rfile.read(length) if length else b""

@@ -1075,6 +1075,19 @@ class _BatchDispatcher:
                 self.max_window_s,
                 getattr(self, "last_batch_sec", 0.0) * 1.2,
             )
+            # continuous mode's backstop exists ONLY for a wedged
+            # in-flight batch (device hang, in-flight accounting leak):
+            # closing early never serves anyone sooner — the bucket
+            # just parks at the semaphore while later arrivals fragment
+            # into a second device round-trip. Before the FIRST batch
+            # retires there is no last_batch_sec measurement, so give
+            # an unmeasured flight several windows before declaring it
+            # wedged; shed_dead and the clients' own deadlines still
+            # bound how long any held query can suffer.
+            wedge_deadline = _t.monotonic() + max(
+                10.0 * self.max_window_s,
+                getattr(self, "last_batch_sec", 0.0) * 1.2,
+            )
             while len(batch) < self.max_batch:
                 skip = self._admission_skip(batch)
                 try:
@@ -1105,14 +1118,19 @@ class _BatchDispatcher:
                             ),
                         )
                     try:
-                        batch.append(self._queue.get(timeout=patience))
+                        # the admission cap still applies: a capped
+                        # tenant's overflow waits for the next bucket
+                        # even when the pipeline just went idle
+                        batch.append(
+                            self._queue.get(timeout=patience, skip=skip)
+                        )
                         continue
                     except _q.Empty:
                         break
                 if self.batching == "continuous":
                     if self._retired != retired_mark:
                         break  # a bucket retired — dispatch onto the slot
-                    if _t.monotonic() >= hard_deadline:
+                    if _t.monotonic() >= wedge_deadline:
                         break  # wedged in-flight batch: don't hold queries
                     try:
                         batch.append(

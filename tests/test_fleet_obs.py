@@ -623,9 +623,14 @@ class TestExemplars:
         text = render_families([fam])
         assert "# EXEMPLAR r_seconds tidA" in text
         parsed = parse_exemplar_lines(text)
+        # ISSUE 17: lines now carry the observing label set as a
+        # trailing compact-JSON token
         assert parsed == [("r_seconds", "tidA", 0.25, pytest.approx(
             parsed[0][3]
-        ))]
+        ), {"path": "/q"})]
+        # legacy 6-token lines (no labels json) still parse
+        legacy = parse_exemplar_lines("# EXEMPLAR r_seconds tidB 0.5 1.0")
+        assert legacy == [("r_seconds", "tidB", 0.5, 1.0, {})]
         # plain exposition parsing still works on the same text (the
         # exemplar comments are invisible to a vanilla scraper)
         from predictionio_tpu.obs.monitor.scrape import (

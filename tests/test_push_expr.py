@@ -1,0 +1,918 @@
+"""Push telemetry + series algebra (ISSUE 17): the expression engine
+(selectors, arithmetic with label matching, range functions, grouped
+aggregation), expression recording rules vs hand-computed references,
+the increase()-across-snapshot-restore regression, scraper failure
+backoff, per-label-set exemplar indexing, the TelemetryShipper spool →
+guarded ingest path, and the chaos e2e: a train worker whose telemetry
+lands with zero polls — including a kill -9'd worker whose orphaned
+spool the supervisor ships."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.deploy.scheduler import (
+    JobQueue,
+    SchedulerConfig,
+    TrainScheduler,
+)
+from predictionio_tpu.obs import spans as _spans
+from predictionio_tpu.obs import tracing as _tracing
+from predictionio_tpu.obs.monitor import Monitor
+from predictionio_tpu.obs.monitor.collector import TraceCollector
+from predictionio_tpu.obs.monitor import expr as expr_mod
+from predictionio_tpu.obs.monitor.expr import (
+    ExprError,
+    evaluate,
+    evaluate_rows,
+    parse,
+)
+from predictionio_tpu.obs.monitor import push as push_mod
+from predictionio_tpu.obs.monitor.push import (
+    PUSH_ROUTE,
+    PushError,
+    TelemetryShipper,
+    build_payload,
+    ingest,
+    ship_spool,
+    spool_payload,
+)
+from predictionio_tpu.obs.monitor.scrape import (
+    FleetScraper,
+    parse_exemplar_lines,
+)
+from predictionio_tpu.obs.monitor.tsdb import (
+    TSDB,
+    RecordingRule,
+    evaluate_rules,
+    load_snapshot,
+    save_snapshot,
+)
+from predictionio_tpu.obs.registry import MetricsRegistry, render_families
+from predictionio_tpu.utils.http import HttpError, JsonHandler, ThreadedServer
+
+T0 = 1_700_000_000.0
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_DIR = os.path.dirname(TESTS_DIR)
+
+
+def _wait_for(predicate, timeout=60.0, interval=0.1, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _counter_walk(db, name, labels, values, step=10.0, start=T0):
+    """Write a counter series one point per `step` seconds."""
+    for i, v in enumerate(values):
+        db.add(name, labels, float(v), "counter", start + i * step)
+
+
+# ---------------------------------------------------------------------------
+# the expression engine
+# ---------------------------------------------------------------------------
+
+
+class TestExprEngine:
+    def _db(self) -> TSDB:
+        db = TSDB()
+        db.add("mem_bytes", {"instance": "a"}, 100.0, "gauge", T0)
+        db.add("mem_bytes", {"instance": "b"}, 300.0, "gauge", T0)
+        return db
+
+    def test_scalar_arithmetic_and_precedence(self):
+        db = TSDB()
+        assert evaluate(db, "1 + 2 * 3", now=T0) == 7.0
+        assert evaluate(db, "(1 + 2) * 3", now=T0) == 9.0
+        assert evaluate(db, "-2 + 10", now=T0) == 8.0
+        assert evaluate(db, "7 / 2", now=T0) == 3.5
+
+    def test_selector_returns_latest_per_series(self):
+        db = self._db()
+        db.add("mem_bytes", {"instance": "a"}, 150.0, "gauge", T0 + 5)
+        rows = evaluate_rows(db, "mem_bytes", now=T0 + 10)
+        assert rows == [
+            {"labels": {"instance": "a"}, "value": 150.0},
+            {"labels": {"instance": "b"}, "value": 300.0},
+        ]
+
+    def test_selector_label_match(self):
+        db = self._db()
+        rows = evaluate_rows(db, 'mem_bytes{instance="b"}', now=T0 + 1)
+        assert rows == [{"labels": {"instance": "b"}, "value": 300.0}]
+
+    def test_vector_scalar_op(self):
+        db = self._db()
+        rows = evaluate_rows(db, "mem_bytes / 100", now=T0 + 1)
+        assert [r["value"] for r in rows] == [1.0, 3.0]
+        # labels survive scalar ops
+        assert rows[0]["labels"] == {"instance": "a"}
+
+    def test_vector_vector_exact_label_matching(self):
+        db = TSDB()
+        db.add("errs", {"i": "a"}, 2.0, "gauge", T0)
+        db.add("errs", {"i": "b"}, 5.0, "gauge", T0)
+        db.add("reqs", {"i": "a"}, 10.0, "gauge", T0)
+        db.add("reqs", {"i": "b"}, 50.0, "gauge", T0)
+        # unmatched series on either side simply drop out
+        db.add("reqs", {"i": "c"}, 9.0, "gauge", T0)
+        rows = evaluate_rows(db, "errs / reqs", now=T0 + 1)
+        assert rows == [
+            {"labels": {"i": "a"}, "value": 0.2},
+            {"labels": {"i": "b"}, "value": 0.1},
+        ]
+
+    def test_division_by_zero_drops_sample(self):
+        db = TSDB()
+        db.add("errs", {"i": "a"}, 2.0, "gauge", T0)
+        db.add("reqs", {"i": "a"}, 0.0, "gauge", T0)
+        assert evaluate_rows(db, "errs / reqs", now=T0 + 1) == []
+
+    def test_rate_and_increase(self):
+        db = TSDB()
+        _counter_walk(db, "c_total", {"i": "a"}, [0, 30, 60, 90])
+        now = T0 + 30
+        # 90 over the full 100s window → rate = increase / window
+        inc = evaluate(db, 'increase(c_total[100s])', now=now)
+        assert inc == [((("i", "a"),), pytest.approx(90.0))]
+        rate = evaluate(db, 'rate(c_total[100s])', now=now)
+        assert rate == [((("i", "a"),), pytest.approx(0.9))]
+
+    def test_increase_is_counter_reset_aware(self):
+        db = TSDB()
+        _counter_walk(db, "c_total", {}, [100, 110, 5, 8])
+        # 10 + (reset: 5) + 3 = 18
+        val = evaluate(db, "increase(c_total[100s])", now=T0 + 30)
+        assert val == [((), pytest.approx(18.0))]
+
+    def test_quantile_over_time(self):
+        db = TSDB()
+        for i, v in enumerate([1.0, 2.0, 3.0, 4.0]):
+            db.add("lat", {"i": "a"}, v, "gauge", T0 + i)
+        val = evaluate(
+            db, "quantile_over_time(0.5, lat[60s])", now=T0 + 10
+        )
+        assert val == [((("i", "a"),), pytest.approx(2.5))]
+
+    def test_sum_by_groups_labels(self):
+        db = TSDB()
+        db.add("reqs", {"i": "a", "route": "/q"}, 1.0, "gauge", T0)
+        db.add("reqs", {"i": "a", "route": "/m"}, 2.0, "gauge", T0)
+        db.add("reqs", {"i": "b", "route": "/q"}, 4.0, "gauge", T0)
+        rows = evaluate_rows(db, "sum by (i) (reqs)", now=T0 + 1)
+        assert rows == [
+            {"labels": {"i": "a"}, "value": 3.0},
+            {"labels": {"i": "b"}, "value": 4.0},
+        ]
+        rows = evaluate_rows(db, "max by (route) (reqs)", now=T0 + 1)
+        assert rows == [
+            {"labels": {"route": "/m"}, "value": 2.0},
+            {"labels": {"route": "/q"}, "value": 4.0},
+        ]
+
+    def test_bare_aggregation_is_scalar(self):
+        db = self._db()
+        assert evaluate(db, "sum(mem_bytes)", now=T0 + 1) == 400.0
+        assert evaluate(db, "mean(mem_bytes)", now=T0 + 1) == 200.0
+        assert evaluate(db, "max(mem_bytes)", now=T0 + 1) == 300.0
+
+    def test_no_data_is_none_and_empty_rows(self):
+        db = TSDB()
+        assert evaluate(db, "nothing_here", now=T0) in (None, [])
+        assert evaluate_rows(db, "nothing_here", now=T0) == []
+
+    @pytest.mark.parametrize("bad", [
+        "", "   ", "sum by (", "rate(x[abc])", "a +", "1 ** 2",
+        'x{i="a"', "quantile_over_time(x[1m])",
+    ])
+    def test_parse_errors(self, bad):
+        with pytest.raises(ExprError):
+            parse(bad)
+
+    def test_parse_cache_returns_same_ast(self):
+        assert parse("sum(up)") is parse("sum(up)")
+
+
+# ---------------------------------------------------------------------------
+# expression recording rules (vs a hand-computed reference)
+# ---------------------------------------------------------------------------
+
+
+class TestExprRecordingRule:
+    def _ratio_db(self) -> TSDB:
+        db = TSDB()
+        # per-instance counters walked over 110s, one point / 10s
+        _counter_walk(db, "errors_total", {"instance": "a", "route": "/q"},
+                      [i * 2 for i in range(12)])
+        _counter_walk(db, "errors_total", {"instance": "b", "route": "/q"},
+                      [i * 1 for i in range(12)])
+        _counter_walk(db, "requests_total", {"instance": "a", "route": "/q"},
+                      [i * 10 for i in range(12)])
+        _counter_walk(db, "requests_total", {"instance": "b", "route": "/q"},
+                      [i * 20 for i in range(12)])
+        return db
+
+    EXPR = (
+        "sum by (instance) (increase(errors_total[2m]))"
+        " / sum by (instance) (increase(requests_total[2m]))"
+    )
+
+    def test_cross_family_error_ratio_matches_hand_computed(self):
+        db = self._ratio_db()
+        now = T0 + 120
+        rows = evaluate_rows(db, self.EXPR, now=now)
+        # hand-computed: instance a grows 2 errors / 10 reqs per 10s
+        # (22/110), instance b 1 error / 20 reqs (11/220) — ratios
+        # exactly 0.2 and 0.05
+        assert rows == [
+            {"labels": {"instance": "a"},
+             "value": pytest.approx(0.2, abs=1e-12)},
+            {"labels": {"instance": "b"},
+             "value": pytest.approx(0.05, abs=1e-12)},
+        ]
+
+    def test_expr_rule_records_one_gauge_per_row(self):
+        db = self._ratio_db()
+        now = T0 + 120
+        rule = RecordingRule(
+            record="fleet:error_ratio", kind="expr", expr=self.EXPR,
+        )
+        expected = {
+            r["labels"]["instance"]: r["value"]
+            for r in evaluate_rows(db, self.EXPR, now=now)
+        }
+        assert evaluate_rules(db, [rule], now=now) == 2
+        for inst, want in expected.items():
+            series = db.matching("fleet:error_ratio", {"instance": inst})
+            assert len(series) == 1
+            t, v = series[0].points[-1]
+            assert t == now and v == pytest.approx(want)
+
+    def test_expr_rule_static_labels_win_on_collision(self):
+        db = TSDB()
+        db.add("up", {"instance": "a"}, 1.0, "gauge", T0)
+        rule = RecordingRule(
+            record="fleet:up", kind="expr", expr="up",
+            labels={"instance": "fleet", "tier": "gold"},
+        )
+        assert evaluate_rules(db, [rule], now=T0 + 1) == 1
+        s = db.matching("fleet:up", {"tier": "gold"})
+        assert len(s) == 1
+        assert s[0].labels_dict() == {"instance": "fleet", "tier": "gold"}
+
+    def test_expr_rule_validates_at_construction(self):
+        with pytest.raises(ValueError):
+            RecordingRule(record="r", kind="expr", expr="sum by (")
+        with pytest.raises(ValueError):
+            RecordingRule(record="r", kind="expr", expr="")
+
+    def test_expr_rule_roundtrips_to_dict(self):
+        rule = RecordingRule(
+            record="fleet:error_ratio", kind="expr", expr=self.EXPR,
+        )
+        d = rule.to_dict()
+        assert d["kind"] == "expr" and d["expr"] == self.EXPR
+        clone = RecordingRule.from_dict(d)
+        assert clone.expr == rule.expr
+
+
+# ---------------------------------------------------------------------------
+# increase() across a snapshot restore (the satellite-3 regression)
+# ---------------------------------------------------------------------------
+
+
+class TestIncreaseAcrossSnapshotRestore:
+    def test_restore_after_live_points_keeps_time_order(self, tmp_path):
+        now = T0
+        old = TSDB()
+        old.add("jobs_total", {}, 100.0, "counter", now - 60)
+        old.add("jobs_total", {}, 110.0, "counter", now - 50)
+        path = str(tmp_path / "tsdb.snap")
+        save_snapshot(old, path)
+
+        live = TSDB()
+        # the process restarts, samples twice (counter reset to zero),
+        # and only THEN the periodic restore loads yesterday's ring
+        live.add("jobs_total", {}, 5.0, "counter", now - 10)
+        live.add("jobs_total", {}, 8.0, "counter", now - 5)
+        assert load_snapshot(live, path) > 0
+
+        (series,) = live.matching("jobs_total")
+        # ring must be in time order after the interleaved restore
+        ts = [t for t, _ in series.points]
+        assert ts == sorted(ts)
+        # 10 (old segment) + 5 (reset) + 3 (live segment) — the broken
+        # append-at-end ordering used to read 105 here
+        got = live.series_increase(series, window_s=120, now=now)
+        assert got == pytest.approx(18.0)
+        assert evaluate(live, "increase(jobs_total[120s])", now=now) == [
+            ((), pytest.approx(18.0))
+        ]
+
+    def test_out_of_order_add_single_series(self):
+        db = TSDB()
+        db.add("g", {}, 2.0, "gauge", T0 + 10)
+        db.add("g", {}, 1.0, "gauge", T0)       # late arrival
+        db.add("g", {}, 3.0, "gauge", T0 + 20)
+        (s,) = db.matching("g")
+        assert [v for _, v in s.points] == [1.0, 2.0, 3.0]
+
+
+# ---------------------------------------------------------------------------
+# scraper failure backoff
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestScraperBackoff:
+    def _dead_target(self):
+        return ("dead", f"http://127.0.0.1:{_free_port()}")
+
+    def _points(self, db, name, instance):
+        out = []
+        for s in db.matching(name, {"instance": instance}):
+            out.extend(s.points)
+        return out
+
+    def test_backoff_skips_http_but_still_writes_up(self):
+        db = TSDB()
+        sc = FleetScraper(db, [self._dead_target()], interval_s=5.0,
+                          backoff_max_s=60.0)
+        assert sc.scrape_once(now=T0) == {"dead": False}
+        # first real attempt wrote up=0 AND a scrape duration
+        assert len(self._points(db, "up", "dead")) == 1
+        assert len(self._points(db, "scrape_duration_seconds", "dead")) == 1
+        assert sc.backoff_remaining("dead", now=T0) == pytest.approx(10.0)
+
+        # inside the backoff window: no HTTP attempt (no new duration
+        # point) but up=0 still lands for the tick — alert freshness
+        assert sc.scrape_once(now=T0 + 5) == {"dead": False}
+        assert len(self._points(db, "up", "dead")) == 2
+        assert len(self._points(db, "scrape_duration_seconds", "dead")) == 1
+
+        # past the window: a real attempt again, backoff doubles
+        assert sc.scrape_once(now=T0 + 11) == {"dead": False}
+        assert len(self._points(db, "scrape_duration_seconds", "dead")) == 2
+        assert sc.backoff_remaining("dead", now=T0 + 11) == pytest.approx(
+            20.0
+        )
+
+    def test_backoff_is_capped(self):
+        db = TSDB()
+        sc = FleetScraper(db, [self._dead_target()], interval_s=5.0,
+                          backoff_max_s=12.0)
+        now = T0
+        for _ in range(5):
+            sc.scrape_once(now=now)
+            now += sc.backoff_remaining("dead", now=now) + 0.001
+        assert sc.backoff_remaining("dead", now=now - 0.001) <= 12.0
+
+    def test_recovery_clears_backoff(self):
+        class _OkMetrics(JsonHandler):
+            def do_GET(self):
+                self._drain_body()
+                self._respond(200, "ok_total 1\n",
+                              content_type="text/plain")
+
+        srv = ThreadedServer(("127.0.0.1", 0), _OkMetrics)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            port = srv.server_address[1]
+            db = TSDB()
+            sc = FleetScraper(
+                db, [("flaky", f"http://127.0.0.1:{port}")],
+                interval_s=5.0,
+            )
+            # force a backed-off state by hand, past its window
+            sc._fails["flaky"] = 3
+            sc._not_before["flaky"] = T0 - 1
+            assert sc.scrape_once() == {"flaky": True}
+            assert sc.backoff_remaining("flaky") == 0.0
+            assert sc._fails.get("flaky") is None
+            up = db.matching("up", {"instance": "flaky"})[0]
+            assert up.points[-1][1] == 1.0
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# per-label-set exemplar indexing
+# ---------------------------------------------------------------------------
+
+
+class TestPerRouteExemplars:
+    def _observe(self, fam, tid, value, **labels):
+        tok = _tracing.set_trace_id(tid)
+        try:
+            fam.observe(value, **labels)
+        finally:
+            _tracing.reset_trace_id(tok)
+
+    def test_render_parse_roundtrip_with_labels(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram("r_seconds", "latency", ["path"])
+        self._observe(fam, "tidQ", 0.25, path="/q")
+        self._observe(fam, "tidM", 0.50, path="/m")
+        text = render_families(reg.families())
+        parsed = sorted(parse_exemplar_lines(text))
+        assert [(p[0], p[1], p[2], p[4]) for p in parsed] == [
+            ("r_seconds", "tidM", 0.50, {"path": "/m"}),
+            ("r_seconds", "tidQ", 0.25, {"path": "/q"}),
+        ]
+
+    def test_labelless_family_renders_legacy_six_token_line(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram("plain_seconds", "latency", [])
+        self._observe(fam, "tidA", 0.1)
+        line = [
+            ln for ln in render_families(reg.families()).splitlines()
+            if ln.startswith("# EXEMPLAR")
+        ][0]
+        assert len(line.split()) == 6
+        assert parse_exemplar_lines(line) == [
+            ("plain_seconds", "tidA", 0.1,
+             pytest.approx(parse_exemplar_lines(line)[0][3]), {}),
+        ]
+
+    def test_each_label_set_keeps_its_own_slowest(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram("r_seconds", "latency", ["path"])
+        cap = fam._exemplar_cap
+        # flood /metrics with slow observations; /q's one trace must
+        # survive — the reservoirs no longer compete
+        self._observe(fam, "tidQ", 0.001, path="/q")
+        for i in range(cap + 4):
+            self._observe(fam, f"m{i}", 10.0 + i, path="/metrics")
+        exs = fam.exemplars()
+        by_path = {}
+        for ex in exs:
+            by_path.setdefault(ex["labels"]["path"], []).append(ex)
+        assert len(by_path["/metrics"]) == cap
+        assert [e["trace_id"] for e in by_path["/q"]] == ["tidQ"]
+
+    def test_monitor_index_filters_by_labels(self):
+        mon = Monitor()
+        mon.note_exemplar("r_seconds", "tidQ", 0.3,
+                          labels={"path": "/q"})
+        mon.note_exemplar("r_seconds", "tidM", 0.9,
+                          labels={"path": "/m"})
+        got = mon.exemplars(family="r_seconds", labels={"path": "/q"})
+        assert [e["trace_id"] for e in got] == ["tidQ"]
+        assert got[0]["labels"] == {"path": "/q"}
+        # unfiltered: slowest first across label sets
+        all_rows = mon.exemplars(family="r_seconds")
+        assert [e["trace_id"] for e in all_rows] == ["tidM", "tidQ"]
+
+    def test_monitor_index_bounded_per_label_set(self):
+        mon = Monitor()
+        cap = mon._exemplar_cap
+        for i in range(cap + 5):
+            mon.note_exemplar("r_seconds", f"t{i}", float(i),
+                              labels={"path": "/m"})
+        mon.note_exemplar("r_seconds", "tQ", 0.0, labels={"path": "/q"})
+        rows = mon.exemplars(family="r_seconds", limit=cap * 3)
+        by_path = {}
+        for r in rows:
+            by_path.setdefault(r["labels"]["path"], []).append(r)
+        assert len(by_path["/m"]) == cap
+        # the fastest were evicted, the slowest retained
+        assert min(r["value"] for r in by_path["/m"]) == 5.0
+        assert [r["trace_id"] for r in by_path["/q"]] == ["tQ"]
+
+
+# ---------------------------------------------------------------------------
+# push: payloads, spool durability, guarded ingest
+# ---------------------------------------------------------------------------
+
+
+class _IngestHandler(JsonHandler):
+    """Test ingest endpoint landing pushes in `server.monitor` (a
+    dedicated Monitor — the guard itself is covered separately)."""
+
+    def do_POST(self):
+        self._drain_body()
+        try:
+            if self.path.split("?")[0] == PUSH_ROUTE:
+                try:
+                    result = ingest(
+                        self._json_body(), monitor=self.server.monitor
+                    )
+                except PushError as e:
+                    raise HttpError(400, str(e))
+                self._respond(200, result)
+            else:
+                raise HttpError(404, "Not Found")
+        except HttpError as e:
+            self._respond(e.status, {"message": e.message})
+
+
+def _start_ingest_server(port=0):
+    srv = ThreadedServer(("127.0.0.1", port), _IngestHandler)
+    srv.monitor = Monitor()
+    srv.monitor.set_collector(TraceCollector(targets=[], interval_s=3600))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+class TestPushIngest:
+    def test_ingest_tags_series_and_backfills_sampled_at(self):
+        mon = Monitor()
+        payload = {
+            "v": 1, "instance": "w1", "job_id": "j9",
+            "sampled_at": T0,
+            "series": [
+                {"name": "train_runs_total", "labels": {"status": "ok"},
+                 "value": 3.0, "kind": "counter"},
+            ],
+            "spans": [],
+        }
+        out = ingest(payload, monitor=mon, now=T0 + 30)
+        assert out["ok"] and out["series_written"] == 1
+        (s,) = mon.tsdb.matching("train_runs_total")
+        assert s.labels_dict() == {
+            "status": "ok", "instance": "w1", "job_id": "j9",
+        }
+        # the point lands at its SAMPLED time, not arrival time
+        assert s.points[-1] == (T0, 3.0)
+        # freshness bookkeeping: the age series exists immediately
+        (age,) = mon.tsdb.matching(
+            "telemetry_last_push_age_seconds", {"instance": "w1"}
+        )
+        assert age.points[-1][1] == pytest.approx(30.0)
+        assert [r["instance"] for r in mon.push_status()] == ["w1"]
+
+    def test_ingest_clamps_future_clocks(self):
+        mon = Monitor()
+        ingest({"v": 1, "instance": "w", "sampled_at": T0 + 9999,
+                "series": [{"name": "g", "value": 1.0}], "spans": []},
+               monitor=mon, now=T0)
+        (s,) = mon.tsdb.matching("g")
+        assert s.points[-1][0] <= T0 + 1.0
+
+    def test_ingest_rejects_malformed(self):
+        mon = Monitor()
+        for bad in (None, [], {"v": 99},
+                    {"v": 1, "series": "nope", "spans": []}):
+            with pytest.raises(PushError):
+                ingest(bad, monitor=mon)
+
+    def test_ingest_spans_reach_collector_with_zero_polls(self):
+        mon = Monitor()
+        col = TraceCollector(targets=[], interval_s=3600)
+        mon.set_collector(col)
+        spans = [
+            _spans.Span(trace_id="t1", span_id="s1", name="train",
+                        parent_span_id=None, start=T0,
+                        duration=1.0).to_dict(),
+            _spans.Span(trace_id="t1", span_id="s2", name="train.read",
+                        parent_span_id="s1", start=T0,
+                        duration=0.5).to_dict(),
+        ]
+        out = ingest({"v": 1, "instance": "w", "sampled_at": T0,
+                      "series": [], "spans": spans}, monitor=mon, now=T0)
+        assert out["spans_ingested"] == 2
+        st = col.status()
+        assert st["pushed_spans"] == 2 and st["polls"] == 0
+        assert st["assembled"] >= 1
+
+
+class TestTelemetryShipper:
+    def test_spool_files_are_durable_and_ordered(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        sh = TelemetryShipper(spool, url="", instance="w1", job_id="j1",
+                              interval_s=9.0, recorder=_spans.SpanRecorder())
+        assert sh.spool_once(now=T0) is not None
+        assert sh.spool_once(now=T0 + 1) is not None
+        names = sorted(os.listdir(spool))
+        assert len(names) == 2 and names == sorted(names)
+        with open(os.path.join(spool, names[0])) as f:
+            payload = json.load(f)
+        assert payload["v"] == 1
+        assert payload["instance"] == "w1" and payload["job_id"] == "j1"
+        assert isinstance(payload["series"], list)
+        # lexical order == chronological order (the ship order)
+        assert names[0].split("-")[0] <= names[1].split("-")[0]
+
+    def test_ship_spool_delivers_and_drains(self, tmp_path):
+        srv, base = _start_ingest_server()
+        try:
+            spool = str(tmp_path / "spool")
+            reg = MetricsRegistry()
+            reg.counter("pushed_total", "t", []).inc(7)
+            sh = TelemetryShipper(
+                spool, url=base, instance="w2", job_id="j2",
+                interval_s=9.0, registries=[reg],
+                recorder=_spans.SpanRecorder(),
+            )
+            sh.spool_once(now=T0)
+            assert sh.ship() == 1
+            assert os.listdir(spool) == []
+            (s,) = srv.monitor.tsdb.matching(
+                "pushed_total", {"instance": "w2"}
+            )
+            assert s.points[-1][1] == 7.0
+            assert s.labels_dict()["job_id"] == "j2"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_ship_spool_keeps_files_when_receiver_down(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        sh = TelemetryShipper(
+            spool, url=f"http://127.0.0.1:{_free_port()}",
+            instance="w3", interval_s=9.0,
+            recorder=_spans.SpanRecorder(),
+        )
+        sh.spool_once(now=T0)
+        assert sh.ship(deadline_s=0.5) == 0
+        assert len(os.listdir(spool)) == 1  # durable for the supervisor
+
+    def test_ship_spool_unlinks_poison_files(self, tmp_path):
+        srv, base = _start_ingest_server()
+        try:
+            spool = str(tmp_path / "spool")
+            os.makedirs(spool)
+            with open(os.path.join(spool, "000-bad.json"), "w") as f:
+                f.write("{not json")
+            marker = build_payload("poison-test", now=T0)
+            spool_payload(spool, marker, seq=1)
+            assert ship_spool(spool, base) == 1
+            assert os.listdir(spool) == []
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_missing_spool_dir_ships_zero(self, tmp_path):
+        assert ship_spool(str(tmp_path / "nope"), "http://x") == 0
+
+    def test_start_stop_joins_thread_and_flushes(self, tmp_path):
+        srv, base = _start_ingest_server()
+        try:
+            sh = TelemetryShipper(
+                str(tmp_path / "spool"), url=base, instance="w4",
+                interval_s=30.0, recorder=_spans.SpanRecorder(),
+            )
+            sh.start()
+            sh.stop()
+            assert not any(
+                t.name == TelemetryShipper.thread_name
+                for t in threading.enumerate()
+            )
+            # the final flush shipped at least the exit snapshot
+            assert sh.shipped >= 1
+            assert srv.monitor.push_status()[0]["instance"] == "w4"
+            sh.stop()  # idempotent
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_from_env_disabled_without_knobs(self, monkeypatch):
+        monkeypatch.delenv("PIO_PUSH_URL", raising=False)
+        monkeypatch.delenv("PIO_PUSH_SPOOL", raising=False)
+        assert TelemetryShipper.from_env() is None
+
+    def test_from_env_configured(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PIO_PUSH_URL", "http://127.0.0.1:1")
+        monkeypatch.setenv("PIO_PUSH_SPOOL", str(tmp_path / "sp"))
+        sh = TelemetryShipper.from_env(job_id="j7")
+        assert sh is not None
+        assert sh.url == "http://127.0.0.1:1" and sh.job_id == "j7"
+
+    def test_spool_trim_bounds_disk(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        sh = TelemetryShipper(
+            spool, url="", instance="w5", interval_s=9.0,
+            spool_max_bytes=4096, recorder=_spans.SpanRecorder(),
+        )
+        for i in range(50):
+            sh.spool_once(now=T0 + i)
+        total = sum(
+            os.path.getsize(os.path.join(spool, n))
+            for n in os.listdir(spool)
+        )
+        assert total <= 4096
+
+
+class TestGuardedIngestEndpoint:
+    """The production handler: 403 unless PIO_PUSH_INGEST=1."""
+
+    class _Handler(JsonHandler):
+        def do_POST(self):
+            self._drain_body()
+            try:
+                if self.path.split("?")[0] == PUSH_ROUTE:
+                    self._serve_telemetry_push()
+                else:
+                    raise HttpError(404, "Not Found")
+            except HttpError as e:
+                self._respond(e.status, {"message": e.message})
+
+    def _post(self, base, payload):
+        req = urllib.request.Request(
+            base + PUSH_ROUTE, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    @pytest.fixture()
+    def server(self):
+        srv = ThreadedServer(("127.0.0.1", 0), self._Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+        srv.shutdown()
+        srv.server_close()
+
+    def test_403_when_disabled(self, server, monkeypatch):
+        monkeypatch.delenv("PIO_PUSH_INGEST", raising=False)
+        status, body = self._post(server, build_payload("w", now=T0))
+        assert status == 403
+        assert "PIO_PUSH_INGEST" in body["message"]
+
+    def test_200_when_enabled_and_400_on_garbage(
+        self, server, monkeypatch
+    ):
+        monkeypatch.setenv("PIO_PUSH_INGEST", "1")
+        status, body = self._post(
+            server, build_payload("guard-test", now=T0)
+        )
+        assert status == 200 and body["ok"] is True
+        assert body["instance"] == "guard-test"
+        status, body = self._post(server, {"v": 99})
+        assert status == 400
+        assert "version" in body["message"]
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: telemetry from train workers with ZERO polls
+# ---------------------------------------------------------------------------
+
+
+VARIANT = {
+    "id": "pushlc",
+    "engineFactory": "sample_engine.Engine0Factory",
+    "datasource": {"params": {"id": 1}},
+    "preparator": {"params": {"id": 2}},
+    "algorithms": [{"name": "algo0", "params": {"id": 3}}],
+    "serving": {},
+}
+
+SLOW_VARIANT = {
+    "id": "pushslow",
+    "engineFactory": "sample_engine.SlowEngineFactory",
+    "datasource": {"params": {"id": 1, "sleep_s": 30.0}},
+    "preparator": {"params": {"id": 2}},
+    "algorithms": [{"name": "", "params": {"id": 3}}],
+}
+
+
+def _scheduler_config(tmp_path, push_url, **kw) -> SchedulerConfig:
+    cfg = SchedulerConfig(
+        poll_interval_s=0.1,
+        heartbeat_interval_s=0.2,
+        stale_after_s=1.0,
+        log_dir=str(tmp_path / "job-logs"),
+        child_env={
+            "PYTHONPATH": os.pathsep.join([REPO_DIR, TESTS_DIR]),
+            "JAX_PLATFORMS": "cpu",
+            "PIO_PUSH_URL": push_url,
+            "PIO_PUSH_INTERVAL_S": "0.2",
+        },
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+class TestTrainWorkerPushE2E:
+    def test_worker_exit_before_any_scrape_lands_telemetry(
+        self, fresh_storage, tmp_path
+    ):
+        """No scraper anywhere: the worker's train.* spans, stage series
+        and devprof land purely via push (its own shipper plus the
+        supervisor's residue pass)."""
+        srv, base = _start_ingest_server()
+        try:
+            q = JobQueue(fresh_storage)
+            job = q.submit(VARIANT)
+            sched = TrainScheduler(
+                fresh_storage, _scheduler_config(tmp_path, base)
+            )
+            assert sched.run_pending_once() == 1
+            assert q.get(job.id).status == "completed"
+
+            mon = srv.monitor
+            # stage series arrived tagged with the worker identity
+            stage = mon.tsdb.matching(
+                "train_stage_seconds_count", {"job_id": job.id}
+            )
+            assert stage, "no train stage series pushed"
+            stages = {s.labels_dict()["stage"] for s in stage}
+            assert {"read", "prepare", "train"} <= stages
+            instance = stage[0].labels_dict()["instance"]
+            # freshness series + push_status row for the dead worker
+            assert mon.tsdb.matching(
+                "telemetry_last_push_age_seconds", {"instance": instance}
+            )
+            assert any(
+                r["instance"] == instance for r in mon.push_status()
+            )
+            # spans assembled by the collector with ZERO polls
+            st = mon.collector.status()
+            assert st["polls"] == 0 and st["pushed_spans"] > 0
+            rows = mon.collector.summaries()
+            train_rows = [r for r in rows if r["root"] == "train"]
+            assert train_rows and train_rows[0]["kept"] == "pushed"
+            assert train_rows[0]["spans"] >= 4  # root + DASE stages
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_sigkilled_worker_spool_shipped_by_supervisor(
+        self, fresh_storage, tmp_path
+    ):
+        """kill -9 mid-train: the worker never flushes; its durable
+        spool is shipped by the next scheduler's orphan sweep — and the
+        receiver never polled anything."""
+        # reserve a port with NO listener: the worker's own ship
+        # attempts all fail, so batches stay durably spooled
+        port = _free_port()
+        base = f"http://127.0.0.1:{port}"
+        q = JobQueue(fresh_storage)
+        job = q.submit(SLOW_VARIANT, max_attempts=1)
+        cfg = _scheduler_config(tmp_path, base)
+        sched1 = TrainScheduler(fresh_storage, cfg)
+        sched1.start()
+        spool_dir = os.path.join(
+            str(tmp_path / "job-logs"), f"{job.id}.spool"
+        )
+        try:
+            _wait_for(
+                lambda: q.get(job.id).status == "running",
+                timeout=30, what="job to start",
+            )
+            _wait_for(
+                lambda: os.path.isdir(spool_dir) and os.listdir(spool_dir),
+                timeout=30, what="worker to spool telemetry",
+            )
+        finally:
+            sched1.stop(kill_child=True)  # SIGKILL, no exit flush
+        assert os.listdir(spool_dir), "expected an orphaned spool"
+
+        # the receiver comes up AFTER the worker died
+        srv, _ = _start_ingest_server(port=port)
+        try:
+            sched2 = TrainScheduler(fresh_storage, cfg)
+            assert sched2.ship_orphan_spools() >= 1
+            assert not os.path.exists(spool_dir)  # drained + removed
+
+            mon = srv.monitor
+            assert mon.tsdb.matching(
+                "telemetry_last_push_age_seconds"
+            ), "no pushed series from the dead worker"
+            assert mon.push_status(), "ingest saw no instance"
+            assert mon.collector.status()["polls"] == 0
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_supervisor_skips_live_worker_spools(
+        self, fresh_storage, tmp_path, monkeypatch
+    ):
+        """The orphan sweep must not steal a LIVE worker's spool."""
+        sched = TrainScheduler(
+            fresh_storage,
+            _scheduler_config(tmp_path, "http://127.0.0.1:1"),
+        )
+        os.makedirs(sched._log_dir, exist_ok=True)
+        live = os.path.join(sched._log_dir, "livejob.spool")
+        os.makedirs(live)
+        with open(os.path.join(live, "000-1-0001.json"), "w") as f:
+            json.dump(build_payload("w", now=T0), f)
+        with sched._child_lock:
+            sched._children["livejob"] = object()
+        try:
+            assert sched.ship_orphan_spools() == 0
+            assert os.listdir(live)
+        finally:
+            with sched._child_lock:
+                sched._children.pop("livejob", None)
