@@ -3019,6 +3019,114 @@ def bench_push_telemetry():
     return out
 
 
+def bench_durable_tsdb():
+    """ISSUE 18 (BENCH_r12): the durable long-horizon TSDB tier.
+
+    - WAL flush throughput (points/s through add + fsync'd flush_once),
+    - replay latency: a cold DurableTSDB reconstructing its ring from
+      WAL + sealed blocks,
+    - one forced compaction pass (raw → 5m → 1h) over ~3 days of data,
+    - the acceptance query: increase() over a 3-day window answered
+      from the downsampled tiers — p50 must be far under 100ms,
+    - downsample agreement: the same in-retention window answered from
+      raw blocks vs 5m buckets (relative error within the documented
+      edge-bucket bound).
+    """
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.obs.monitor.compact import Compactor
+    from predictionio_tpu.obs.monitor.durable import DurableTSDB
+
+    out: dict = {}
+    tmp = tempfile.mkdtemp(prefix="bench-dtsdb-")
+    try:
+        db = DurableTSDB(
+            os.path.join(tmp, "tsdb"), capacity=720,
+            flush_interval_s=9999.0, seal_age_s=9999.0,
+        )
+        now = time.time()
+        start = now - 3 * 86400
+        step = 120.0 if SMALL else 60.0
+        series = 2 if SMALL else 4
+        t0 = time.perf_counter()
+        n_pts = 0
+        for i in range(series):
+            v = 0.0
+            t = start
+            while t <= now:
+                v += 5.0
+                db.add("bench_reqs_total", {"inst": f"r{i}"}, v,
+                       "counter", t)
+                t += step
+                n_pts += 1
+        db.flush_once(seal=True)
+        wall = time.perf_counter() - t0
+        out["tsdb_durable_flush_points_per_s"] = round(n_pts / wall)
+        db.stop()
+
+        # cold replay: the restart path every monitor pays on attach
+        t0 = time.perf_counter()
+        db = DurableTSDB(
+            os.path.join(tmp, "tsdb"), capacity=720,
+            flush_interval_s=9999.0, seal_age_s=9999.0,
+        )
+        out["tsdb_durable_replay_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 3
+        )
+        assert db.replayed_points > 0
+
+        comp = Compactor(db, interval_s=9999.0)
+        t0 = time.perf_counter()
+        comp.run_once(now=now, force=True)
+        out["tsdb_durable_compact_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 3
+        )
+
+        # downsample agreement BEFORE measuring the 3-day query (a
+        # second retention pass may prune rolled-up raw blocks): the
+        # same 4h window from raw points vs 5m buckets
+        key = ("bench_reqs_total", (("inst", "r0"),))
+        window = 4 * 3600.0
+        raw_inc, _ = db._disk_increase(
+            key, now - window, now, window, tier="raw"
+        )
+        ds_inc, _ = db._disk_increase(
+            key, now - window, now, window, tier="5m"
+        )
+        out["tsdb_durable_downsample_rel_err"] = round(
+            abs(ds_inc - raw_inc) / max(raw_inc, 1e-9), 6
+        )
+
+        s = db.matching("bench_reqs_total", {"inst": "r0"})[0]
+        iters = 20 if SMALL else 50
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            inc = db.series_increase(s, 3 * 86400.0, now)
+            times.append(time.perf_counter() - t0)
+        assert inc > 0
+        out["tsdb_durable_query_3d_p50_ms"] = round(
+            float(np.percentile(times, 50)) * 1e3, 4
+        )
+        tiers = db.durable_stats()["tiers"]
+        out["tsdb_durable_disk_bytes"] = sum(
+            st["bytes"] for st in tiers.values()
+        )
+        out["tsdb_durable_blocks"] = {
+            t: st["blocks"] for t, st in tiers.items()
+        }
+        db.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    out["host_cpus"] = os.cpu_count()
+    out["note"] = (
+        "3 days of counters through WAL flush + seal + forced raw→5m→1h "
+        "compaction; the 3-day increase() answers from the 1h tier"
+    )
+    return out
+
+
 def main():
     rows, cols, vals = make_data()
     tpu = bench_tpu(rows, cols, vals)
@@ -3321,5 +3429,10 @@ if __name__ == "__main__":
         # shipper attach tax on serving p99, spool→queryable latency,
         # and series-algebra eval cost
         print(json.dumps(bench_push_telemetry()))
+    elif "--durable-tsdb" in _sys.argv:
+        # focused ISSUE-18 emission (BENCH_r12): the durable TSDB tier
+        # — WAL throughput, cold replay, compaction, and the 3-day
+        # downsampled query
+        print(json.dumps(bench_durable_tsdb()))
     else:
         main()

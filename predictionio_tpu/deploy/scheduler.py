@@ -884,6 +884,14 @@ class TrainScheduler:
         spool_dir = self._push_spool_dir(job.id, env)
         if spool_dir is not None:
             env["PIO_PUSH_SPOOL"] = spool_dir
+        # push auth (ISSUE 18): hand the worker the shared push secret
+        # explicitly — its shipper mints the per-instance wire token
+        # (HMAC(secret, instance)) from it, so the receiver 403s any
+        # pusher that can't prove it, and a captured token can't write
+        # series under another instance's label
+        push_secret = env_str("PIO_PUSH_TOKEN", env=env).strip()
+        if push_secret:
+            env["PIO_PUSH_TOKEN"] = push_secret
         timeout_s = job.timeout_s or self.config.default_timeout_s
         deadline = time.monotonic() + timeout_s
         timed_out = False

@@ -20,6 +20,11 @@ Knobs (read when the monitor is created; mutable attributes after):
   PIO_TENANT_SLO_PRESETS auto-derive per-tenant SLOs at mux attach
   PIO_PUSH_*             push-telemetry shipper/ingest (ISSUE 17 —
                          see obs.monitor.push)
+  PIO_TSDB_DIR           durable on-disk tier: WAL + sealed blocks +
+                         5m/1h downsampled tiers, replayed on start
+                         (ISSUE 18 — see obs.monitor.durable/compact;
+                         PIO_TSDB_{FLUSH_S,SEAL_*,COMPACT_S,
+                         RETENTION_*} tune it)
 """
 
 from __future__ import annotations
@@ -100,14 +105,35 @@ class Monitor:
     def __init__(self):
         self.sampler_interval_s = env_float("PIO_TSDB_INTERVAL_S", 5.0)
         self.slo_interval_s = env_float("PIO_SLO_INTERVAL_S", 15.0)
-        self.tsdb = TSDB(
-            capacity=int(env_float("PIO_TSDB_POINTS", 720)),
-            max_series=int(env_float("PIO_TSDB_MAX_SERIES", 4096)),
-        )
+        # durable tier (ISSUE 18): with a directory configured, the
+        # rings are backed by a WAL + sealed-block disk store and the
+        # constructor REPLAYS the durable tail — a restarted process
+        # alerts on pre-restart burn instead of starting amnesiac. The
+        # durable tier supersedes the JSON snapshot (PIO_TSDB_SNAPSHOT).
+        self.durable_dir = env_path("PIO_TSDB_DIR") or None
+        if self.durable_dir and enabled():
+            from predictionio_tpu.obs.monitor.durable import DurableTSDB
+
+            self.tsdb: TSDB = DurableTSDB(
+                self.durable_dir,
+                capacity=int(env_float("PIO_TSDB_POINTS", 720)),
+                max_series=int(env_float("PIO_TSDB_MAX_SERIES", 4096)),
+                flush_interval_s=env_float("PIO_TSDB_FLUSH_S", 2.0),
+                seal_points=env_int("PIO_TSDB_SEAL_POINTS", 50000),
+                seal_age_s=env_float("PIO_TSDB_SEAL_AGE_S", 300.0),
+            )
+        else:
+            self.tsdb = TSDB(
+                capacity=int(env_float("PIO_TSDB_POINTS", 720)),
+                max_series=int(env_float("PIO_TSDB_MAX_SERIES", 4096)),
+            )
         # snapshot persistence (ISSUE 15 satellite): with a path
         # configured, history survives restarts — reload here, persist
         # periodically (and on last detach) below
-        self.snapshot_path = env_path("PIO_TSDB_SNAPSHOT") or None
+        self.snapshot_path = (
+            None if self.durable_dir
+            else env_path("PIO_TSDB_SNAPSHOT") or None
+        )
         self.snapshot_interval_s = env_float(
             "PIO_TSDB_SNAPSHOT_INTERVAL_S", 60.0
         )
@@ -126,6 +152,7 @@ class Monitor:
         self._sampler: Optional[MetricsSampler] = None
         self._engine: Optional[SLOEngine] = None
         self._snapshotter: Optional[SnapshotWriter] = None
+        self._compactor: Optional[Any] = None
         self._slos: list[SLOSpec] = load_slos()
         # per-tenant presets (ISSUE 16): auto-derived at mux attach,
         # kept apart from the operator's _slos — an operator spec with
@@ -206,6 +233,8 @@ class Monitor:
         if token is None:
             return
         stop_sampler = stop_engine = stop_snapshotter = None
+        stop_compactor = None
+        stop_flusher = False
         with self._lock:
             self._attached = [
                 row for row in self._attached if row[0] != token
@@ -216,6 +245,8 @@ class Monitor:
                 stop_snapshotter, self._snapshotter = (
                     self._snapshotter, None
                 )
+                stop_compactor, self._compactor = self._compactor, None
+                stop_flusher = self.durable_dir is not None
         # join OUTSIDE the lock: the threads' loops call back into us
         if stop_engine is not None:
             stop_engine.stop()
@@ -223,6 +254,10 @@ class Monitor:
             stop_sampler.stop()
         if stop_snapshotter is not None:
             stop_snapshotter.stop()  # also writes the final snapshot
+        if stop_compactor is not None:
+            stop_compactor.stop()
+        if stop_flusher and hasattr(self.tsdb, "flush_once"):
+            self.tsdb.stop()  # final WAL drain + fsync
         if stop_engine is not None or stop_sampler is not None:
             # last detach also joins in-flight alert deliveries — a
             # notification thread must not outlive the plane (ISSUE 12)
@@ -257,6 +292,22 @@ class Monitor:
                     interval_s=self.snapshot_interval_s,
                 )
                 self._snapshotter.start()
+            if self._compactor is None and self.durable_dir and hasattr(
+                self.tsdb, "flush_once"
+            ):
+                from predictionio_tpu.obs.monitor.compact import Compactor
+
+                self.tsdb.start()  # the tsdb-wal flusher
+                self._compactor = Compactor(
+                    self.tsdb,
+                    interval_s=env_float("PIO_TSDB_COMPACT_S", 30.0),
+                    retention={
+                        "raw": env_float("PIO_TSDB_RETENTION_RAW"),
+                        "5m": env_float("PIO_TSDB_RETENTION_5M"),
+                        "1h": env_float("PIO_TSDB_RETENTION_1H"),
+                    },
+                )
+                self._compactor.start()
 
     def _post_sample(self, tsdb: TSDB, now: float) -> None:
         """Recording pass, on the sampler thread right after each raw

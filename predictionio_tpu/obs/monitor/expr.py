@@ -16,6 +16,15 @@ expression language evaluated directly against the ring buffers:
                            ``mean|avg|max|min|count``, bare ``sum(...)``
 - binary arithmetic        ``+ - * /`` with exact-label-set matching
                            between vectors and broadcast against scalars
+- offset modifier          ``rate(http_requests_total[5m] offset 1h)``
+                           shifts a selector's evaluation time into the
+                           past, so binary ops can compare the same
+                           window across two points in time (now vs an
+                           hour ago). With a durable tier attached the
+                           shifted window reads through to disk; an
+                           offset range is computed from the selected
+                           tier's points (reset-aware, bucket-`last`
+                           granularity on downsampled tiers).
 
 Values are *vectors* — lists of ``(labels, value)`` samples — or plain
 scalars. Division by zero drops the sample (a ratio with no denominator
@@ -38,6 +47,7 @@ from typing import Any, Optional, Union
 from predictionio_tpu.obs.monitor.tsdb import (
     TSDB,
     LabelPairs,
+    increase_of,
     quantile_of,
 )
 
@@ -118,13 +128,15 @@ class _Number(_Node):
 
 
 class _Selector(_Node):
-    """``name{k="v",...}`` with an optional ``[window]`` range suffix."""
+    """``name{k="v",...}`` with an optional ``[window]`` range suffix
+    and an optional ``offset <duration>`` modifier (ISSUE 18)."""
 
     def __init__(self, name: str, match: dict[str, str],
-                 window_s: Optional[float]):
+                 window_s: Optional[float], offset_s: float = 0.0):
         self.name = name
         self.match = match
         self.window_s = window_s
+        self.offset_s = offset_s
 
     def eval(self, ctx: "_Ctx") -> Optional[Value]:
         if self.window_s is not None:
@@ -134,10 +146,42 @@ class _Selector(_Node):
             )
         out: Vector = []
         for s in ctx.tsdb.matching(self.name, self.match or None):
-            pts = ctx.tsdb.points(s)
+            if self.offset_s <= 0:
+                pts = ctx.tsdb.points(s)
+                if pts:
+                    out.append((s.labels, pts[-1][1]))
+                continue
+            # shifted instant: the last sample at or before now-offset,
+            # looked up within one default window of it
+            shifted = ctx.now - self.offset_s
+            pts = [
+                (t, v) for t, v in ctx.tsdb.points(
+                    s, ctx.default_window_s + self.offset_s, ctx.now
+                ) if t <= shifted
+            ]
             if pts:
                 out.append((s.labels, pts[-1][1]))
         return out
+
+
+def _offset_window(ctx: "_Ctx", s: Any, window_s: float,
+                   offset_s: float) -> tuple[Optional[tuple[float, float]],
+                                             list[tuple[float, float]]]:
+    """(baseline, points) for the shifted window
+    [now-offset-window, now-offset]: the in-window samples plus the
+    last sample before the window (searched one extra window back) —
+    the reset-aware seed `series_increase` would use."""
+    shifted = ctx.now - offset_s
+    cutoff = shifted - window_s
+    pts = ctx.tsdb.points(s, 2.0 * window_s + offset_s, ctx.now)
+    windowed = [(t, v) for t, v in pts if cutoff <= t <= shifted]
+    baseline = None
+    for t, v in pts:
+        if t < cutoff:
+            baseline = (t, v)
+        else:
+            break
+    return baseline, windowed
 
 
 class _RangeFn(_Node):
@@ -148,17 +192,26 @@ class _RangeFn(_Node):
 
     def eval(self, ctx: "_Ctx") -> Optional[Value]:
         window = self.sel.window_s or ctx.default_window_s
+        offset = self.sel.offset_s
         out: Vector = []
         for s in ctx.tsdb.matching(self.sel.name, self.sel.match or None):
             if self.fn == "quantile_over_time":
-                vals = [
-                    v for _t, v in ctx.tsdb.points(s, window, ctx.now)
-                ]
+                if offset > 0:
+                    _base, win = _offset_window(ctx, s, window, offset)
+                    vals = [v for _t, v in win]
+                else:
+                    vals = [
+                        v for _t, v in ctx.tsdb.points(s, window, ctx.now)
+                    ]
                 qv = quantile_of(vals, self.q if self.q is not None else 0.99)
                 if qv is not None:
                     out.append((s.labels, qv))
                 continue
-            inc = ctx.tsdb.series_increase(s, window, ctx.now)
+            if offset > 0:
+                base, win = _offset_window(ctx, s, window, offset)
+                inc = increase_of(([base] if base is not None else []) + win)
+            else:
+                inc = ctx.tsdb.series_increase(s, window, ctx.now)
             if self.fn == "rate":
                 inc = inc / window if window > 0 else 0.0
             out.append((s.labels, inc))
@@ -428,7 +481,26 @@ class _Parser:
             while (t := self._next())[1] != "]":
                 parts.append(t[1])
             window_s = _parse_duration("".join(parts))
-        return _Selector(name, match, window_s)
+        offset_s = 0.0
+        tok = self._peek()
+        if tok is not None and tok == ("ident", "offset"):
+            self._next()
+            t = self._next()
+            if t[0] != "num":
+                raise ExprError(
+                    f"offset needs a duration (e.g. offset 1h), got "
+                    f"{t[1]!r}"
+                )
+            dur = t[1]
+            unit = self._peek()
+            if unit is not None and unit[0] == "ident" \
+                    and unit[1] in _DURATION_UNITS:
+                self._next()
+                dur += unit[1]
+            offset_s = _parse_duration(dur)
+            if offset_s < 0:
+                raise ExprError("offset must be >= 0")
+        return _Selector(name, match, window_s, offset_s)
 
 
 def _parse_duration(text: str) -> float:

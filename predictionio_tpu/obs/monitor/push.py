@@ -32,6 +32,8 @@ for telemetry.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import logging
 import os
@@ -55,12 +57,15 @@ from predictionio_tpu.utils.env import (
 log = logging.getLogger(__name__)
 
 __all__ = [
+    "PushAuthError",
     "PushError",
     "TelemetryShipper",
     "build_payload",
     "ingest",
+    "issue_push_token",
     "ship_spool",
     "spool_payload",
+    "verify_push_token",
 ]
 
 PAYLOAD_VERSION = 1
@@ -68,9 +73,44 @@ PAYLOAD_VERSION = 1
 #: the ingest route, relative to the push base URL
 PUSH_ROUTE = "/telemetry/push"
 
+#: header carrying the per-instance push token (ISSUE 18)
+TOKEN_HEADER = "X-PIO-Push-Token"
+
 
 class PushError(ValueError):
     """A malformed push payload (ingest side → HTTP 400)."""
+
+
+class PushAuthError(PushError):
+    """A missing/invalid push token (ingest side → HTTP 403)."""
+
+
+# -- per-instance push auth (ISSUE 18) ---------------------------------------
+#
+# PIO_PUSH_TOKEN is a shared secret between the receiver and the
+# processes allowed to push. The wire token is HMAC-SHA256(secret,
+# instance) — bound to the payload's `instance` label, so a token
+# captured from instance A cannot be replayed to write series labeled
+# instance B, and a sender without the secret cannot fabricate series
+# at all. The TrainScheduler passes the secret to its workers via the
+# injected child env; the shipper derives the wire token itself.
+
+
+def issue_push_token(instance: str, secret: str) -> str:
+    """The wire token authorizing pushes labeled `instance`."""
+    return hmac.new(
+        secret.encode(), str(instance).encode(), hashlib.sha256
+    ).hexdigest()
+
+
+def verify_push_token(instance: str, token: Optional[str],
+                      secret: str) -> bool:
+    """Constant-time check of a presented wire token."""
+    if not token:
+        return False
+    return hmac.compare_digest(
+        issue_push_token(instance, secret), str(token)
+    )
 
 
 # -- payload construction (the ephemeral process side) -----------------------
@@ -197,10 +237,11 @@ def trim_spool(spool_dir: str, max_bytes: int) -> int:
     return dropped
 
 
-def _post(url: str, data: bytes, timeout_s: float) -> None:
+def _post(url: str, data: bytes, timeout_s: float,
+          headers: Optional[dict[str, str]] = None) -> None:
     req = urllib.request.Request(
         url, data=data, method="POST",
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
     with urllib.request.urlopen(req, timeout=timeout_s) as r:
         r.read()
@@ -226,23 +267,32 @@ def ship_spool(
     endpoint = url if url.endswith(PUSH_ROUTE) else url + PUSH_ROUTE
     retry = retry or RetryPolicy(max_attempts=4, base_delay=0.05)
     deadline = time.monotonic() + max(0.1, float(deadline_s))
+    secret = env_str("PIO_PUSH_TOKEN")
     shipped = 0
     for path in _spool_files(spool_dir):
         try:
             with open(path, "rb") as f:
                 data = f.read()
-            json.loads(data)  # poison guard: never retry an unparsable file
+            # poison guard: never retry an unparsable file (and the
+            # orphan sweep ships spools from MANY instances — the
+            # token must be derived per file, from its own label)
+            parsed = json.loads(data)
         except (OSError, ValueError):
             try:
                 os.unlink(path)
             except OSError:
                 pass
             continue
+        headers = None
+        if secret and isinstance(parsed, dict):
+            headers = {TOKEN_HEADER: issue_push_token(
+                str(parsed.get("instance") or "") or "(unknown)", secret
+            )}
         if time.monotonic() >= deadline:
             break
         try:
             retry.call(
-                lambda _a: _post(endpoint, data, timeout_s),
+                lambda _a: _post(endpoint, data, timeout_s, headers),
                 retry_on=(OSError, urllib.error.URLError),
                 deadline=deadline,
             )
@@ -409,13 +459,53 @@ class TelemetryShipper:
 # -- the ingest side ---------------------------------------------------------
 
 
+# per-instance span token buckets: instance → (tokens, last_refill_ts)
+_span_buckets: dict[str, tuple[float, float]] = {}  # guarded-by: _span_lock
+_span_lock = threading.Lock()
+_dropped_family = None  # guarded-by: _span_lock (lazy, import-cheap)
+
+
+def _dropped_counter():
+    global _dropped_family
+    if _dropped_family is None:
+        from predictionio_tpu.obs.registry import get_default_registry
+
+        _dropped_family = get_default_registry().counter(
+            "telemetry_push_dropped_total",
+            "Pushed telemetry discarded at ingest, by kind",
+            ("kind",),  # label-bound: literal ingest drop kinds
+        )
+    return _dropped_family
+
+
+def _admit_spans(instance: str, n: int, now: float) -> int:
+    """Token-bucket admission for pushed spans: how many of `n` this
+    instance may ingest right now (PIO_PUSH_SPAN_RATE refill/s, burst
+    PIO_PUSH_SPAN_BURST). Rate <= 0 disables the limiter."""
+    rate = env_float("PIO_PUSH_SPAN_RATE")
+    if rate <= 0 or n <= 0:
+        return n
+    burst = max(1.0, env_float("PIO_PUSH_SPAN_BURST"))
+    with _span_lock:
+        tokens, last = _span_buckets.get(instance, (burst, now))
+        tokens = min(burst, tokens + max(0.0, now - last) * rate)
+        allowed = int(min(float(n), tokens))
+        _span_buckets[instance] = (tokens - allowed, now)
+        if len(_span_buckets) > 4096:  # shed: idle instances refill anyway
+            _span_buckets.pop(next(iter(_span_buckets)))
+    return allowed
+
+
 def ingest(payload: Any, monitor: Any = None,
-           now: Optional[float] = None) -> dict:
+           now: Optional[float] = None,
+           token: Optional[str] = None) -> dict:
     """Land one pushed payload in the process monitor: series into the
     TSDB (tagged instance/job_id, at their *sampled* timestamps), spans
     into the TraceCollector, devprof report + freshness bookkeeping
     onto the Monitor. Raises :class:`PushError` on malformed input
-    (the HTTP handler maps it to 400)."""
+    (the HTTP handler maps it to 400) and :class:`PushAuthError` when
+    PIO_PUSH_TOKEN is set on this receiver and `token` is not the
+    HMAC for the payload's `instance` (→ 403)."""
     from predictionio_tpu.obs.monitor import get_monitor
 
     if not isinstance(payload, dict):
@@ -431,6 +521,12 @@ def ingest(payload: Any, monitor: Any = None,
     monitor = monitor if monitor is not None else get_monitor()
     now = time.time() if now is None else now
     instance = str(payload.get("instance") or "") or "(unknown)"
+    secret = env_str("PIO_PUSH_TOKEN")
+    if secret and not verify_push_token(instance, token, secret):
+        raise PushAuthError(
+            f"push token missing or not valid for instance "
+            f"{instance!r}"
+        )
     job_id = payload.get("job_id")
     extra: dict[str, str] = {"instance": instance}
     if job_id:
@@ -459,9 +555,15 @@ def ingest(payload: Any, monitor: Any = None,
         ):
             written += 1
     ingested = 0
+    dropped_spans = 0
     collector = monitor.collector
     if collector is not None and spans:
-        ingested = collector.ingest_spans(spans, now)
+        allowed = _admit_spans(instance, len(spans), now)
+        dropped_spans = len(spans) - allowed
+        if dropped_spans:
+            _dropped_counter().inc(dropped_spans, kind="span")
+        if allowed:
+            ingested = collector.ingest_spans(spans[:allowed], now)
     devprof = payload.get("devprof")
     monitor.note_push(
         instance,
@@ -474,4 +576,5 @@ def ingest(payload: Any, monitor: Any = None,
         "instance": instance,
         "series_written": written,
         "spans_ingested": ingested,
+        "spans_dropped": dropped_spans,
     }

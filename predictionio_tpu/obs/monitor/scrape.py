@@ -19,6 +19,13 @@ Targets parse from ``PIO_MONITOR_TARGETS`` (or a CLI/constructor arg):
 ``instance=url`` pairs, comma-separated —
 ``query=http://host:8000,event=http://host:7070``. A bare url gets its
 ``host:port`` as the instance name.
+
+With a durable tier attached (``PIO_TSDB_DIR``, ISSUE 18) scraped
+series write through :class:`~.durable.DurableTSDB` like every other
+writer — fleet history, including ``up``, survives a monitor restart
+and ages through the 5m/1h downsampled tiers, so multi-window
+burn-rate SLOs over scraped fleet metrics keep working across
+restarts with no scraper-side changes.
 """
 
 from __future__ import annotations

@@ -30,6 +30,15 @@ Spec fields (JSON; `PIO_SLOS` holds a JSON array or ``@/path.json``):
   min_samples   requests the fast window must contain before the rule
                 is judged at all — the zero-traffic guard: an idle
                 route neither divides by zero nor flaps its alert
+  extra_pairs   additional (fast, slow) burn-rate window pairs judged
+                alongside the primary one (ISSUE 18): a list of
+                {"fast_window_s", "window_s", "burn_threshold"}
+                objects, e.g. the SRE-workbook 30m/6h@6 and 6h/3d@1
+                ladder. A breach on ANY pair (both its windows over
+                its threshold) trips the alert; long windows answer
+                from the durable disk tier when PIO_TSDB_DIR is set,
+                so a restarted process still alerts on pre-restart
+                burn
   aggregate     fleet scope (ISSUE 16): judge the scraper's
                 `instance`-tagged series instead of this process's own.
                 "sum" pools bad/total across every instance; "mean"
@@ -98,8 +107,47 @@ class SLOSpec:
     # sum(increase(reqs[$window]))"). min_samples does not apply — an
     # expression with no data holds state exactly like no-traffic.
     expr: Optional[str] = None
+    # multi-window ladder (ISSUE 18): extra (fast_s, slow_s, threshold)
+    # triples judged alongside the primary pair; canonicalized by
+    # __post_init__ from dicts/sequences
+    extra_pairs: tuple = ()
 
     def __post_init__(self):
+        if self.extra_pairs:
+            norm = []
+            for p in self.extra_pairs:
+                if isinstance(p, dict):
+                    unknown = set(p) - {
+                        "fast_window_s", "window_s", "burn_threshold"
+                    }
+                    if unknown:
+                        raise ValueError(
+                            f"SLO {self.name!r}: extra_pairs entry has "
+                            f"unknown field(s): {', '.join(sorted(unknown))}"
+                        )
+                    fast = float(p.get("fast_window_s", 0.0))
+                    slow = float(p.get("window_s", 0.0))
+                    thr = float(p.get("burn_threshold", 1.0))
+                else:
+                    seq = tuple(p)
+                    if len(seq) != 3:
+                        raise ValueError(
+                            f"SLO {self.name!r}: extra_pairs entries are "
+                            "(fast_window_s, window_s, burn_threshold)"
+                        )
+                    fast, slow, thr = (float(x) for x in seq)
+                if fast <= 0 or slow <= 0 or thr <= 0:
+                    raise ValueError(
+                        f"SLO {self.name!r}: extra pair windows and "
+                        "threshold must be > 0"
+                    )
+                if fast > slow:
+                    raise ValueError(
+                        f"SLO {self.name!r}: extra pair fast window must "
+                        "not exceed its slow window"
+                    )
+                norm.append((fast, slow, thr))
+            object.__setattr__(self, "extra_pairs", tuple(norm))
         if not self.name:
             raise ValueError("SLO spec needs a name")
         if self.kind not in KINDS:
@@ -144,6 +192,13 @@ class SLOSpec:
     def budget(self) -> float:
         return 1.0 - self.objective
 
+    @property
+    def burn_pairs(self) -> tuple[tuple[float, float, float], ...]:
+        """Every (fast_s, slow_s, threshold) pair, primary first."""
+        return (
+            (self.fast_window_s, self.window_s, self.burn_threshold),
+        ) + self.extra_pairs
+
     @classmethod
     def from_dict(cls, d: dict) -> "SLOSpec":
         known = {
@@ -151,7 +206,7 @@ class SLOSpec:
                 "name", "kind", "objective", "server", "route", "tenant",
                 "instance", "threshold_ms", "window_s", "fast_window_s",
                 "burn_threshold", "for_s", "resolve_s", "min_samples",
-                "aggregate", "expr",
+                "aggregate", "expr", "extra_pairs",
             ) if k in d
         }
         unknown = set(d) - set(known)
@@ -185,6 +240,11 @@ class SLOSpec:
             out["threshold_ms"] = self.threshold_ms
         if self.aggregate:
             out["aggregate"] = self.aggregate
+        if self.extra_pairs:
+            out["extra_pairs"] = [
+                {"fast_window_s": f, "window_s": w, "burn_threshold": t}
+                for f, w, t in self.extra_pairs
+            ]
         return out
 
 
@@ -487,6 +547,9 @@ class AlertStatus:
     fast_samples: float = 0.0
     last_eval: Optional[float] = None
     transitions: int = 0
+    # per-pair burn numbers of the last evaluation (ISSUE 18):
+    # primary-first, same order as spec.burn_pairs
+    pair_burns: list = field(default_factory=list)
     # (t, fast_burn) ring for the dashboard sparkline
     history: deque = field(default_factory=lambda: deque(maxlen=120))
 
@@ -508,6 +571,7 @@ class AlertStatus:
             "error_budget": round(self.spec.budget, 6),
             "transitions": self.transitions,
             "last_eval": self.last_eval,
+            "pairs": [dict(p) for p in self.pair_burns],
             "spec": self.spec.to_dict(),
         }
 
@@ -627,16 +691,41 @@ class SLOEngine:
         transitions: list[tuple[dict, str, str]] = []
         for st in statuses:
             spec = st.spec
-            fast, fast_n = self.burn_rate(spec, spec.fast_window_s, now)
-            slow, _ = self.burn_rate(spec, spec.window_s, now)
+            # every pair evaluates (primary first); a breach on ANY
+            # complete pair trips — the 6h/3d ladder pairs read through
+            # the durable disk tier when one is configured
+            pair_rows: list[dict] = []
+            for fast_w, slow_w, thr in spec.burn_pairs:
+                p_fast, p_n = self.burn_rate(spec, fast_w, now)
+                p_slow, _ = self.burn_rate(spec, slow_w, now)
+                pair_rows.append({
+                    "fast_window_s": fast_w, "window_s": slow_w,
+                    "burn_threshold": thr,
+                    "fast_burn": (
+                        None if p_fast is None else round(p_fast, 4)
+                    ),
+                    "slow_burn": (
+                        None if p_slow is None else round(p_slow, 4)
+                    ),
+                    "fast_samples": p_n,
+                })
+            fast = pair_rows[0]["fast_burn"]
+            slow = pair_rows[0]["slow_burn"]
+            fast_n = pair_rows[0]["fast_samples"]
+            complete = [
+                r for r in pair_rows
+                if r["fast_burn"] is not None
+                and r["slow_burn"] is not None
+            ]
             with self._lock:
                 st.fast_burn, st.slow_burn = fast, slow
                 st.fast_samples = fast_n
+                st.pair_burns = pair_rows
                 st.last_eval = now
                 st.history.append(
                     (round(now, 3), None if fast is None else fast)
                 )
-                if fast is None or slow is None:
+                if not complete:
                     # zero-traffic window: hold state (no flap), freeze
                     # the resolve streak — silence is not health
                     st.clear_since = None if st.state == FIRING else (
@@ -644,9 +733,10 @@ class SLOEngine:
                     )
                     self._export_locked(st)
                     continue
-                breach = (
-                    fast >= spec.burn_threshold
-                    and slow >= spec.burn_threshold
+                breach = any(
+                    r["fast_burn"] >= r["burn_threshold"]
+                    and r["slow_burn"] >= r["burn_threshold"]
+                    for r in complete
                 )
                 old_state = st.state
                 self._step_locked(st, breach, now)

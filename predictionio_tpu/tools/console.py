@@ -1208,6 +1208,26 @@ def cmd_tsdb(args) -> int:
             f"{payload.get('dropped_series', 0)} dropped at the "
             "cardinality cap)"
         )
+        durable = payload.get("durable")
+        if durable:
+            # durable tier summary (ISSUE 18)
+            wal = durable.get("wal", {})
+            print(
+                f"[INFO] durable tier at {durable.get('dir')}: "
+                f"{wal.get('segments', 0)} wal segment(s), "
+                f"{wal.get('pending', 0)} pending pts, replayed "
+                f"{durable.get('replayed_points', 0)} pts at attach"
+            )
+            for tier, st in (durable.get("tiers") or {}).items():
+                span = (
+                    f"{st['max_t'] - st['min_t']:.0f}s span"
+                    if st.get("min_t") is not None else "empty"
+                )
+                print(
+                    f"[INFO]   tier {tier}: {st.get('blocks', 0)} "
+                    f"block(s), {st.get('series', 0)} series, "
+                    f"{st.get('bytes', 0)} bytes, {span}"
+                )
         for s in series:
             labels = ",".join(f"{k}={v}" for k, v in s["labels"].items())
             where = f"{s['name']}{{{labels}}}" if labels else s["name"]

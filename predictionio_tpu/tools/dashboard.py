@@ -333,9 +333,37 @@ fragment(s), {st['polls']} poll(s)</p>
 {body}
 </table>"""
         if not name:
+            durable = ""
+            if hasattr(tsdb, "durable_stats"):
+                # durable tier panel (ISSUE 18): block counts + spans
+                # per retention tier, so an operator can see how far
+                # back queries can reach past the in-memory ring
+                ds = tsdb.durable_stats()
+                rows = "".join(
+                    f"<tr><td>{html.escape(t)}</td>"
+                    f"<td>{st['blocks']}</td><td>{st['series']}</td>"
+                    f"<td>{st['bytes']}</td>"
+                    + (
+                        f"<td>{st['max_t'] - st['min_t']:.0f}s</td>"
+                        if st["min_t"] is not None else "<td>-</td>"
+                    )
+                    + "</tr>"
+                    for t, st in ds.get("tiers", {}).items()
+                )
+                durable = f"""<h2>Durable tiers</h2>
+<p><code>{html.escape(str(ds.get('dir')))}</code> —
+wal {ds['wal']['segments']} segment(s), {ds['wal']['pending']} pending;
+replayed {ds.get('replayed_points', 0)} pts /
+{ds.get('replayed_series', 0)} series at attach</p>
+<table border="1" cellpadding="4">
+<tr><th>Tier</th><th>Blocks</th><th>Series</th><th>Bytes</th>
+<th>Span</th></tr>
+{rows}
+</table>"""
             return (
                 f"<h1>TSDB explorer</h1>{form}"
                 f"<p>({tsdb.series_count()} series retained)</p>"
+                f"{durable}"
             )
         match = None
         if match_raw:

@@ -207,6 +207,17 @@ _k("PIO_PUSH_SPOOL_MAX_BYTES", "int", 8 * 1024 * 1024,
 _k("PIO_SCRAPE_BACKOFF_MAX_S", "float", 60.0,
    "Cap (s) on the fleet scraper's exponential backoff for down "
    "targets (up{instance}=0 still records every tick).")
+_k("PIO_PUSH_TOKEN", "str", "",
+   "Shared secret for per-instance push-ingest auth: shippers send "
+   "X-PIO-Push-Token = HMAC-SHA256(secret, instance) and the ingest "
+   "rejects payloads whose token does not match their instance label. "
+   "Empty disables auth.")
+_k("PIO_PUSH_SPAN_RATE", "float", 50.0,
+   "Per-instance pushed-span admission budget (spans/s token bucket) "
+   "at the telemetry ingest; overflow is dropped and counted in "
+   "telemetry_push_dropped_total{kind=span}.")
+_k("PIO_PUSH_SPAN_BURST", "float", 200.0,
+   "Burst capacity (spans) of the per-instance pushed-span bucket.")
 
 # -- monitoring plane --------------------------------------------------------
 _k("PIO_TSDB", "flag", "1",
@@ -230,6 +241,26 @@ _k("PIO_TSDB_SNAPSHOT", "path", "",
    "Path persisting the TSDB rings across restarts (empty = off).")
 _k("PIO_TSDB_SNAPSHOT_INTERVAL_S", "float", 60.0,
    "Seconds between TSDB snapshot writes.")
+_k("PIO_TSDB_DIR", "path", "",
+   "Directory of the durable on-disk TSDB tier (fsync'd WAL + sealed "
+   "columnar blocks + 5m/1h downsampled tiers). Empty keeps history "
+   "memory-only; set, it supersedes PIO_TSDB_SNAPSHOT.")
+_k("PIO_TSDB_FLUSH_S", "float", 2.0,
+   "Seconds between durable-TSDB WAL flush+fsync passes.")
+_k("PIO_TSDB_SEAL_POINTS", "int", 50000,
+   "Points in the active WAL segment that trigger sealing it into an "
+   "immutable columnar block.")
+_k("PIO_TSDB_SEAL_AGE_S", "float", 300.0,
+   "Age (s) of a non-empty active WAL segment that triggers sealing.")
+_k("PIO_TSDB_COMPACT_S", "float", 30.0,
+   "Seconds between durable-TSDB compactor passes (downsampling + "
+   "per-tier retention).")
+_k("PIO_TSDB_RETENTION_RAW", "float", 6 * 3600.0,
+   "Retention (s) of raw-resolution durable blocks.")
+_k("PIO_TSDB_RETENTION_5M", "float", 3 * 86400.0,
+   "Retention (s) of the 5-minute downsampled tier.")
+_k("PIO_TSDB_RETENTION_1H", "float", 14 * 86400.0,
+   "Retention (s) of the 1-hour downsampled tier.")
 _k("PIO_ALERT_WEBHOOK", "str", "",
    "URL POSTed one JSON alert per SLO/external alert transition.")
 _k("PIO_ALERT_EXEC", "str", "",

@@ -495,7 +495,11 @@ class JsonHandler(BaseHTTPRequestHandler):
             return
         body = self._json_body()
         try:
-            result = _push.ingest(body)
+            result = _push.ingest(
+                body, token=self.headers.get(_push.TOKEN_HEADER)
+            )
+        except _push.PushAuthError as e:
+            raise HttpError(403, str(e))
         except _push.PushError as e:
             raise HttpError(400, str(e))
         self._respond(200, result)

@@ -763,6 +763,277 @@ class TestGuardedIngestEndpoint:
 
 
 # ---------------------------------------------------------------------------
+# per-instance push auth (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class TestPushTokenAuth:
+    SECRET = "test-push-secret"
+
+    def test_issue_verify_roundtrip(self):
+        tok = push_mod.issue_push_token("w1", self.SECRET)
+        assert push_mod.verify_push_token("w1", tok, self.SECRET)
+        # bound to the instance: w1's token is useless for w2
+        assert not push_mod.verify_push_token("w2", tok, self.SECRET)
+        assert not push_mod.verify_push_token("w1", tok, "other-secret")
+        assert not push_mod.verify_push_token("w1", None, self.SECRET)
+        assert not push_mod.verify_push_token("w1", "", self.SECRET)
+
+    def test_ingest_requires_matching_token(self, monkeypatch):
+        monkeypatch.setenv("PIO_PUSH_TOKEN", self.SECRET)
+        mon = Monitor()
+        payload = {"v": 1, "instance": "w1", "sampled_at": T0,
+                   "series": [{"name": "g", "value": 1.0}], "spans": []}
+        with pytest.raises(push_mod.PushAuthError):
+            ingest(dict(payload), monitor=mon, now=T0)
+        # a token for ANOTHER instance must not let w1's label be
+        # spoofed (nor vice versa)
+        other = push_mod.issue_push_token("w2", self.SECRET)
+        with pytest.raises(push_mod.PushAuthError):
+            ingest(dict(payload), monitor=mon, now=T0, token=other)
+        good = push_mod.issue_push_token("w1", self.SECRET)
+        out = ingest(dict(payload), monitor=mon, now=T0, token=good)
+        assert out["ok"] and out["series_written"] == 1
+
+    def test_ingest_open_when_secret_unset(self, monkeypatch):
+        monkeypatch.delenv("PIO_PUSH_TOKEN", raising=False)
+        mon = Monitor()
+        out = ingest({"v": 1, "instance": "w1", "sampled_at": T0,
+                      "series": [], "spans": []}, monitor=mon, now=T0)
+        assert out["ok"]
+
+    def test_http_endpoint_enforces_header(self, monkeypatch):
+        monkeypatch.setenv("PIO_PUSH_INGEST", "1")
+        monkeypatch.setenv("PIO_PUSH_TOKEN", self.SECRET)
+        srv = ThreadedServer(
+            ("127.0.0.1", 0), TestGuardedIngestEndpoint._Handler
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            base = f"http://127.0.0.1:{srv.server_address[1]}"
+            payload = json.dumps(build_payload("w1", now=T0)).encode()
+
+            def post(headers):
+                req = urllib.request.Request(
+                    base + PUSH_ROUTE, data=payload, method="POST",
+                    headers={"Content-Type": "application/json",
+                             **headers},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    return e.code
+
+            assert post({}) == 403
+            assert post({push_mod.TOKEN_HEADER: "bogus"}) == 403
+            good = push_mod.issue_push_token("w1", self.SECRET)
+            assert post({push_mod.TOKEN_HEADER: good}) == 200
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_ship_spool_sends_per_file_token(self, tmp_path,
+                                             monkeypatch):
+        """The orphan sweep ships spools from many instances — each
+        request must carry the token for ITS OWN payload's instance."""
+        monkeypatch.setenv("PIO_PUSH_TOKEN", self.SECRET)
+        seen: list[tuple] = []
+
+        class _Capture(JsonHandler):
+            def do_POST(self):
+                self._drain_body()
+                body = json.loads(self._body().decode())
+                seen.append((
+                    body["instance"],
+                    self.headers.get(push_mod.TOKEN_HEADER),
+                ))
+                self._respond(200, {"ok": True})
+
+        srv = ThreadedServer(("127.0.0.1", 0), _Capture)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            spool = str(tmp_path / "spool")
+            spool_payload(spool, {"v": 1, "instance": "wA",
+                                  "sampled_at": T0, "series": [],
+                                  "spans": []}, 1)
+            spool_payload(spool, {"v": 1, "instance": "wB",
+                                  "sampled_at": T0 + 1, "series": [],
+                                  "spans": []}, 2)
+            url = f"http://127.0.0.1:{srv.server_address[1]}"
+            assert ship_spool(spool, url, deadline_s=10.0) == 2
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        assert dict(seen) == {
+            "wA": push_mod.issue_push_token("wA", self.SECRET),
+            "wB": push_mod.issue_push_token("wB", self.SECRET),
+        }
+
+
+# ---------------------------------------------------------------------------
+# pushed-span rate limiting (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def _span_rows(n, prefix="s"):
+    return [
+        _spans.Span(trace_id="t", span_id=f"{prefix}{i}", name="x",
+                    parent_span_id=None, start=T0,
+                    duration=0.1).to_dict()
+        for i in range(n)
+    ]
+
+
+class TestPushSpanRateLimit:
+    @pytest.fixture(autouse=True)
+    def _fresh_buckets(self):
+        push_mod._span_buckets.clear()
+        yield
+        push_mod._span_buckets.clear()
+
+    def _mon(self):
+        mon = Monitor()
+        mon.set_collector(TraceCollector(targets=[], interval_s=3600))
+        return mon
+
+    def test_burst_caps_one_push(self, monkeypatch):
+        monkeypatch.setenv("PIO_PUSH_SPAN_RATE", "0.0001")
+        monkeypatch.setenv("PIO_PUSH_SPAN_BURST", "3")
+        mon = self._mon()
+        out = ingest({"v": 1, "instance": "w", "sampled_at": T0,
+                      "series": [], "spans": _span_rows(10)},
+                     monitor=mon, now=T0)
+        assert out["spans_ingested"] == 3
+        assert out["spans_dropped"] == 7
+        # bucket drained: the next push within the window loses all
+        out2 = ingest({"v": 1, "instance": "w", "sampled_at": T0,
+                       "series": [], "spans": _span_rows(4, "z")},
+                      monitor=mon, now=T0 + 1)
+        assert out2["spans_ingested"] == 0 and out2["spans_dropped"] == 4
+
+    def test_bucket_refills_and_is_per_instance(self, monkeypatch):
+        monkeypatch.setenv("PIO_PUSH_SPAN_RATE", "1.0")
+        monkeypatch.setenv("PIO_PUSH_SPAN_BURST", "2")
+        mon = self._mon()
+
+        def push(instance, n, now, prefix):
+            return ingest(
+                {"v": 1, "instance": instance, "sampled_at": now,
+                 "series": [], "spans": _span_rows(n, prefix)},
+                monitor=mon, now=now,
+            )
+
+        assert push("a", 2, T0, "a")["spans_ingested"] == 2
+        assert push("a", 2, T0, "b")["spans_ingested"] == 0
+        # instance b has its own bucket
+        assert push("b", 2, T0, "c")["spans_ingested"] == 2
+        # 1 token/s: two seconds later instance a may send two more
+        assert push("a", 2, T0 + 2, "d")["spans_ingested"] == 2
+
+    def test_drop_counter_exported(self, monkeypatch):
+        monkeypatch.setenv("PIO_PUSH_SPAN_RATE", "0.0001")
+        monkeypatch.setenv("PIO_PUSH_SPAN_BURST", "1")
+        mon = self._mon()
+        ingest({"v": 1, "instance": "w", "sampled_at": T0,
+                "series": [], "spans": _span_rows(5)},
+               monitor=mon, now=T0)
+        fam = push_mod._dropped_counter()
+        text = render_families([fam])
+        assert 'telemetry_push_dropped_total{kind="span"}' in text
+
+    def test_disabled_when_rate_nonpositive(self, monkeypatch):
+        monkeypatch.setenv("PIO_PUSH_SPAN_RATE", "0")
+        mon = self._mon()
+        out = ingest({"v": 1, "instance": "w", "sampled_at": T0,
+                      "series": [], "spans": _span_rows(50)},
+                     monitor=mon, now=T0)
+        assert out["spans_ingested"] == 50 and out["spans_dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the offset modifier (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class TestExprOffset:
+    def _db(self):
+        """1/s for the last hour, 2/s the hour before — rate() vs
+        rate(offset 1h) must see different slopes."""
+        db = TSDB(capacity=2048)
+        now = T0 + 7200
+        t, v = T0, 0.0
+        while t <= now:
+            v += 2.0 if t < T0 + 3600 else 1.0
+            db.add("reqs", {}, v * 10.0, "counter", t)
+            t += 10.0
+        return db, now
+
+    def test_offset_shifts_range_window(self):
+        db, now = self._db()
+        (r_now,) = evaluate(db, "rate(reqs[30m])", now)
+        (r_old,) = evaluate(db, "rate(reqs[30m] offset 1h)", now)
+        assert r_now[1] == pytest.approx(1.0, rel=0.02)
+        assert r_old[1] == pytest.approx(2.0, rel=0.02)
+
+    def test_binary_op_across_two_windows(self):
+        db, now = self._db()
+        (row,) = evaluate(
+            db, "rate(reqs[30m]) / rate(reqs[30m] offset 1h)", now
+        )
+        assert row[1] == pytest.approx(0.5, rel=0.03)
+
+    def test_instant_selector_offset(self):
+        db, now = self._db()
+        (cur,) = evaluate(db, "reqs", now)
+        (old,) = evaluate(db, "reqs offset 30m", now)
+        # 1/s * 10.0 scale * 1800s of travel between the two instants
+        assert cur[1] - old[1] == pytest.approx(1800.0, abs=20.0)
+
+    def test_offset_increase_is_reset_aware(self):
+        db = TSDB(capacity=2048)
+        now = T0 + 7200
+        t, v = T0, 0.0
+        while t <= now:
+            if abs(t - (T0 + 1800)) < 5:
+                v = 0.0  # the counted process restarted 90m ago
+            v += 1.0
+            db.add("c", {}, v, "counter", t)
+            t += 10.0
+        (row,) = evaluate(db, "increase(c[30m] offset 80m)", now)
+        # the straddled reset must not produce a negative or zero
+        # increase — post-reset accumulation counts
+        assert row[1] == pytest.approx(180.0, abs=15.0)
+
+    def test_offset_parses_units_and_defaults_seconds(self):
+        for text in ("rate(x[5m] offset 1h)", "rate(x[5m] offset 300)",
+                     "x offset 90s", "increase(x[1h] offset 2d)"):
+            parse(text)
+
+    def test_offset_syntax_errors(self):
+        for bad in ("rate(x[5m] offset)", "x offset y",
+                    "rate(x[5m] offset offset 1h)"):
+            with pytest.raises(ExprError):
+                parse(bad)
+
+    def test_quantile_over_time_offset(self):
+        db = TSDB(capacity=2048)
+        now = T0 + 3600
+        for i in range(360):
+            t = T0 + i * 10.0
+            # old half: values ~100, recent half: values ~1
+            db.add("lat", {}, 100.0 if t < T0 + 1800 else 1.0,
+                   "gauge", t)
+        (recent,) = evaluate(db, "quantile_over_time(0.5, lat[20m])",
+                             now)
+        (old,) = evaluate(
+            db, "quantile_over_time(0.5, lat[20m] offset 40m)", now
+        )
+        assert recent[1] == pytest.approx(1.0)
+        assert old[1] == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
 # chaos e2e: telemetry from train workers with ZERO polls
 # ---------------------------------------------------------------------------
 
