@@ -3127,6 +3127,165 @@ def bench_durable_tsdb():
     return out
 
 
+def bench_replication():
+    """ISSUE 19 (BENCH_r13): the replicated event store.
+
+    - acked ingest: insert_batch against a primary whose commit hook
+      ships each WAL frame synchronously to one HTTP follower at
+      min_acks=1 (every batch blocks on the follower's fsync + ack),
+    - cold catch-up: ship throughput for a fresh replica pulling the
+      sealed segments + WAL tail from scratch over the daemon RPC,
+    - the acceptance ratio ship/ingest — must stay >= 0.5 or a cold
+      follower can never catch a sustained ingest,
+    - promotion-to-first-serve p50: elect_and_promote through the CAS
+      election records to the first accepted write on the winner.
+    """
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.data.api.storage_server import StorageServer
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.data.storage.replication import (
+        ReplicationConfig, SegmentShipper, elect_and_promote,
+    )
+    from predictionio_tpu.data.storage.segmentfs import (
+        SegmentFSEventStore,
+    )
+    from predictionio_tpu.deploy.registry import LifecycleRecordStore
+    from predictionio_tpu.obs.registry import MetricsRegistry
+
+    app = 1
+    out: dict = {}
+    tmp = tempfile.mkdtemp(prefix="bench-repl-")
+    daemons = []
+
+    def _follower(name):
+        storage = Storage(StorageConfig(
+            sources={
+                "REP": SourceConfig("REP", "segmentfs-replica", {
+                    "PATH": os.path.join(tmp, name),
+                    "SEAL_INTERVAL_S": "3600",
+                }),
+                "M": SourceConfig("M", "memory", {}),
+            },
+            repositories={
+                "METADATA": "M", "EVENTDATA": "REP", "MODELDATA": "M",
+            },
+        ))
+        daemon = StorageServer(storage, host="127.0.0.1", port=0).start()
+        daemons.append(daemon)
+        replica = storage.get_events()
+        replica.init_app(app)
+        return daemon, replica
+
+    def _events(lo, hi):
+        return [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{k}",
+                target_entity_type="item",
+                target_entity_id=f"i{k % 97}",
+                properties={"rating": float(k % 5 + 1)},
+            )
+            for k in range(lo, hi)
+        ]
+
+    try:
+        primary = SegmentFSEventStore({
+            "PATH": os.path.join(tmp, "primary"),
+            "SEAL_INTERVAL_S": "3600", "SEAL_AGE_S": "3600",
+            "SEAL_EVENTS": "2000",
+            "METRICS_REGISTRY": MetricsRegistry(),
+        })
+        primary.init_app(app)
+
+        # acked ingest: the commit hook blocks each batch on the live
+        # follower's WAL-frame ack (min_acks=1) — this is the write
+        # path a production primary pays
+        daemon_a, _replica_a = _follower("replica-a")
+        shipper = SegmentShipper(
+            primary,
+            ReplicationConfig(
+                followers=(f"127.0.0.1:{daemon_a.port}",),
+                min_acks=1, ship_interval_s=9999.0, timeout_s=10.0,
+            ),
+            epoch=1, metrics=MetricsRegistry(),
+        )
+        n = 2_000 if SMALL else 8_000
+        batch = 64
+        evs = _events(0, n)
+        t0 = time.perf_counter()
+        for i in range(0, n, batch):
+            primary.insert_batch(evs[i:i + batch], app)
+        ingest_wall = time.perf_counter() - t0
+        out["replication_ingest_eps"] = round(n / ingest_wall)
+        primary.seal(app)
+        shipper.pass_once()
+
+        # cold catch-up: a fresh replica pulls every sealed segment +
+        # the WAL tail from scratch through the daemon transport
+        daemon_b, replica_b = _follower("replica-b")
+        catchup = SegmentShipper(
+            primary,
+            ReplicationConfig(
+                followers=(f"127.0.0.1:{daemon_b.port}",),
+                timeout_s=10.0,
+            ),
+            epoch=1, metrics=MetricsRegistry(),
+        )
+        t0 = time.perf_counter()
+        while len(replica_b.find_since(app, 0)) < n:
+            catchup.pass_once()
+        ship_wall = time.perf_counter() - t0
+        out["replication_ship_eps"] = round(n / ship_wall)
+        out["replication_ship_vs_ingest"] = round(
+            out["replication_ship_eps"]
+            / max(out["replication_ingest_eps"], 1), 2
+        )
+        assert replica_b.replication_lag(app)["lag"] == 0
+
+        # promotion-to-first-serve: fenced CAS election through the
+        # record store, then the first accepted write on the winner
+        records = LifecycleRecordStore(Storage(StorageConfig(
+            sources={"M": SourceConfig("M", "memory", {})},
+            repositories={
+                "METADATA": "M", "EVENTDATA": "M", "MODELDATA": "M",
+            },
+        )))
+        rounds = 7 if SMALL else 15
+        times = []
+        for i in range(rounds):
+            t0 = time.perf_counter()
+            epoch = elect_and_promote(
+                records, replica_b, f"bench-replica-{i}",
+                group=f"bench-events-primary-{i}",
+            )
+            replica_b.insert_batch(_events(n + i, n + i + 1), app)
+            times.append(time.perf_counter() - t0)
+            assert epoch is not None
+        out["replication_promotion_p50_ms"] = round(
+            float(np.percentile(times, 50)) * 1e3, 3
+        )
+        out["replication_events"] = n
+        shipper.stop()
+        catchup.stop()
+        primary.close()
+    finally:
+        for daemon in daemons:
+            daemon.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+    out["host_cpus"] = os.cpu_count()
+    out["note"] = (
+        "one HTTP follower, min_acks=1 on the ingest loop (each batch "
+        "blocks on the follower ack); catch-up ships sealed segments + "
+        "WAL tail to a cold replica; promotion p50 spans CAS claim, "
+        "promote(), and the first accepted write"
+    )
+    return out
+
+
 def main():
     rows, cols, vals = make_data()
     tpu = bench_tpu(rows, cols, vals)
@@ -3434,5 +3593,10 @@ if __name__ == "__main__":
         # — WAL throughput, cold replay, compaction, and the 3-day
         # downsampled query
         print(json.dumps(bench_durable_tsdb()))
+    elif "--replication" in _sys.argv:
+        # focused ISSUE-19 emission (BENCH_r13): the replicated event
+        # store — acked ingest under min_acks=1, cold-follower
+        # catch-up throughput, and promotion-to-first-serve
+        print(json.dumps(bench_replication()))
     else:
         main()

@@ -75,6 +75,18 @@ _DAO_TABLE: dict[str, tuple[str, frozenset[str]]] = {
         "get_model_data_models",
         frozenset({"insert", "get", "delete"}),
     ),
+    # event-store replication (ISSUE 19): the shipper's RPC surface on a
+    # follower daemon whose events store is a ReplicaEventStore. Routed
+    # through the same getter as "events" — the replica IS the store.
+    "replication": (
+        "get_events",
+        frozenset({
+            "replication_status", "replication_lag", "wait_for_revision",
+            "replication_apply_wal", "replication_apply_tombstones",
+            "replication_segment_manifest", "replication_segment_file",
+            "replication_commit_segment", "promote",
+        }),
+    ),
 }
 
 
@@ -332,10 +344,33 @@ def main(argv: Optional[list[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     server = StorageServer(host=args.host, port=args.port,
                            auth_key=args.auth_key)
+    # primary-side replication (ISSUE 19): with PIO_REPL_FOLLOWERS set,
+    # this daemon ships its event store to the follower daemons — and
+    # with PIO_REPL_MIN_ACKS > 0 inserts ack through them
+    shipper = None
+    from predictionio_tpu.data.storage.replication import (
+        ReplicationConfig,
+        SegmentShipper,
+    )
+    from predictionio_tpu.utils.env import env_int
+
+    repl_cfg = ReplicationConfig.from_env(auth_key=args.auth_key)
+    if repl_cfg.followers:
+        shipper = SegmentShipper(
+            server.storage.get_events(), repl_cfg,
+            epoch=env_int("PIO_REPL_EPOCH"),
+        )
+        shipper.start()
+        log.info(
+            "replication shipper started: %d follower(s), min_acks=%d",
+            len(repl_cfg.followers), repl_cfg.min_acks,
+        )
     log.info("storage server listening on %s:%d", args.host, server.port)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
+        if shipper is not None:
+            shipper.stop()
         server.shutdown()
     return 0
 

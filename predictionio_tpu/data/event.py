@@ -165,8 +165,9 @@ class EventValidation:
     # framework-internal entities allowed under the reserved pio_ prefix:
     # feedback predictions (pio_pr), the model-lifecycle records (ISSUE
     # 5), the tenancy/rollout-state records (ISSUE 6), the online
-    # consumer's durable cursor records (ISSUE 9), and the fleet's
-    # job-claim bids + worker heartbeats (ISSUE 10) — all living in the
+    # consumer's durable cursor records (ISSUE 9), the fleet's
+    # job-claim bids + worker heartbeats (ISSUE 10), and the replicated
+    # event store's CAS election records (ISSUE 19) — all living in the
     # reserved LIFECYCLE_APP_ID namespace
     BUILTIN_ENTITY_TYPES = frozenset(
         {
@@ -175,6 +176,8 @@ class EventValidation:
             "pio_job_claim", "pio_fleet_worker",
             # serving-replica presence records (ISSUE 15)
             "pio_query_replica",
+            # replication primary-election records (ISSUE 19)
+            "pio_election", "pio_election_bid",
         }
     )
 

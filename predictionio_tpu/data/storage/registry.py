@@ -54,6 +54,11 @@ BACKENDS: dict[str, tuple[str, str]] = {
     # immutable column segments, the zero-copy train-loader source
     # (ISSUE 13) — EVENTDATA only, pair it with a SQL/doc metadata source
     "segmentfs": ("predictionio_tpu.data.storage.segmentfs", "SegmentFS"),
+    # segmentfs follower: a read-only replica fed by a primary's
+    # SegmentShipper over the storage-daemon transport; promotable
+    # through fenced election (ISSUE 19) — EVENTDATA only
+    "segmentfs-replica": ("predictionio_tpu.data.storage.replication",
+                          "Replica"),
 }
 
 # DAO logical names → class suffix

@@ -412,6 +412,32 @@ class RemoteEventStore(_RemoteDao, base.EventStore):
     def data_signature(self, app_id: int, channel_id: Optional[int] = None) -> str:
         return self._call("data_signature", app_id, channel_id)
 
+    # -- replication passthrough (ISSUE 19): observe a follower daemon's
+    # -- replica state without speaking the replication DAO by hand
+    def replication_status(self) -> dict:
+        return self._client.call("replication", "replication_status")
+
+    def replication_lag(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> dict:
+        return self._client.call(
+            "replication", "replication_lag", app_id, channel_id
+        )
+
+    def wait_for_revision(
+        self,
+        app_id: int,
+        revision: int,
+        timeout_s: float = 5.0,
+        channel_id: Optional[int] = None,
+    ) -> bool:
+        """Read-your-writes against a follower daemon: block (server
+        side) until its watermark reaches `revision`."""
+        return self._client.call(
+            "replication", "wait_for_revision", app_id, revision,
+            timeout_s, channel_id,
+        )
+
     def find_entities_batch(
         self,
         app_id,

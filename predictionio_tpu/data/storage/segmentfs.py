@@ -79,6 +79,7 @@ from __future__ import annotations
 
 import bisect
 import datetime as _dt
+import hashlib
 import json
 import logging
 import os
@@ -390,6 +391,25 @@ def _first_seen(values: Sequence) -> tuple[list, np.ndarray]:
     return vocab, codes
 
 
+def segment_content_hash(seg_dir: str) -> str:
+    """Content address of a segment directory: sha256 over every data
+    file's (name, sha256(bytes)), sorted by name, footer.json excluded
+    (it HOLDS the hash). Replication verifies a shipped segment against
+    this before publishing; segments sealed before the field existed
+    hash identically because the computation never reads the footer."""
+    acc = hashlib.sha256()
+    for fname in sorted(os.listdir(seg_dir)):
+        if fname == "footer.json" or fname.startswith("."):
+            continue
+        h = hashlib.sha256()
+        with open(os.path.join(seg_dir, fname), "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        acc.update(fname.encode())
+        acc.update(h.digest())
+    return acc.hexdigest()
+
+
 def _write_segment(
     ns_dir: str, rows: Sequence[Sequence], revs: Sequence[int]
 ) -> str:
@@ -462,11 +482,13 @@ def _write_segment(
         )
     bloom, n_bits = _bloom_build(entity_ids)
     times_arr = cols["time_ms"]
+    content_hash = segment_content_hash(tmp)
     with open(os.path.join(tmp, "footer.json"), "w") as f:
         json.dump(
             {
                 "min_rev": min_rev,
                 "max_rev": max_rev,
+                "content_hash": content_hash,
                 "n_rows": len(rows),
                 "event_names": event_names,
                 "entity_types": entity_types,
@@ -766,6 +788,11 @@ class SegmentFSEventStore(base.EventStore):
         self.segments_scanned = 0  # target-posting prune introspection
         self._stop = threading.Event()
         self._sealer: Optional[threading.Thread] = None
+        # replication seam: when set (SegmentShipper with MIN_ACKS>0),
+        # called under the store lock after the WAL append + state
+        # update with (app_id, channel_id, first_rev, rows, head); a
+        # raise propagates to the caller so "acked ⇒ replicated"
+        self._commit_hook = None
 
     # -- cross-process writer guard ---------------------------------------
     def _acquire_writer_lock(self):
@@ -963,6 +990,16 @@ class SegmentFSEventStore(base.EventStore):
                 ns._tail_append(row, first + i)
             if was_empty:
                 ns.tail_since = time.monotonic()
+            hook = self._commit_hook
+            if hook is not None:
+                # sync replication: still under the store lock so frames
+                # reach followers in revision order. On a raise the rows
+                # stay durable LOCALLY (WAL is already fsync'd) and the
+                # background ship pass re-sends them — same at-least-once
+                # class as a batch whose fsync raised after the write —
+                # but the caller sees the failure, so an ACK always
+                # means the frame reached MIN_ACKS followers.
+                hook(app_id, channel_id, first, rows, ns.next_rev - 1)
             return [row[_ROW_ID] for row in rows]
 
     def delete(
@@ -1317,6 +1354,103 @@ class SegmentFSEventStore(base.EventStore):
                 "max_revision": ns.next_rev - 1,
                 "tombstones": len(ns.tombstones),
             }
+
+    # -- replication seam --------------------------------------------------
+    def set_commit_hook(self, hook) -> None:
+        """Install (or clear, with None) the synchronous replication
+        commit hook. See insert_batch for the calling contract."""
+        with self._lock:
+            self._commit_hook = hook
+
+    def ship_namespaces(self) -> list[tuple[int, Optional[int]]]:
+        """Every (app_id, channel_id) this store holds — loaded ones
+        plus on-disk directories not opened yet (the shipper must see
+        namespaces it never wrote to in this process)."""
+        with self._lock:
+            keys = set(self._ns)
+        try:
+            names = os.listdir(self.base)
+        except FileNotFoundError:
+            names = []
+        for n in names:
+            if not n.startswith("app_"):
+                continue
+            parts = n.split("_")
+            try:
+                app = int(parts[1])
+                ch = int(parts[2]) if len(parts) > 2 else None
+            except (IndexError, ValueError):
+                continue
+            keys.add((app, ch))
+        return sorted(keys, key=lambda k: (k[0], k[1] is not None, k[1] or 0))
+
+    def ship_state(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> dict[str, Any]:
+        """Shipper-side snapshot of one namespace: watermark, sealed
+        segment names with ranges, and the tombstone op counter."""
+        with self._lock:
+            ns = self._namespace(app_id, channel_id)
+            return {
+                "watermark": ns.next_rev - 1,
+                "tail_floor": ns.tail_base - 1,
+                "segments": {
+                    os.path.basename(s.path): [s.min_rev, s.max_rev]
+                    for s in ns.segments
+                },
+                "tombstone_ops": ns.delete_ops,
+            }
+
+    def ship_tail_after(
+        self,
+        app_id: int,
+        channel_id: Optional[int],
+        after_rev: int,
+        limit: int,
+    ) -> dict[str, Any]:
+        """Live unsealed rows with revision > after_rev, revision order,
+        at most `limit`. `floor` is the last sealed revision — when it
+        exceeds after_rev the follower is missing sealed rows that only
+        segment shipping can provide, so the caller must sync segments
+        first. Row lists are append-only after publication (supersede
+        nulls the slot instead of mutating), so handing references out
+        for serialization is safe."""
+        with self._lock:
+            ns = self._namespace(app_id, channel_id)
+            revs: list[int] = []
+            rows: list[list] = []
+            for rev, row in ns.live_tail():
+                if rev <= after_rev:
+                    continue
+                revs.append(rev)
+                rows.append(row)
+                if len(revs) >= limit:
+                    break
+            return {
+                "revs": revs,
+                "rows": rows,
+                "head": ns.next_rev - 1,
+                "floor": ns.tail_base - 1,
+            }
+
+    def ship_segment_path(
+        self, app_id: int, channel_id: Optional[int], name: str
+    ) -> Optional[str]:
+        """Path of a registered sealed segment by name, or None if it
+        was compacted away (the next pass ships the merged segment)."""
+        with self._lock:
+            ns = self._namespace(app_id, channel_id)
+            for seg in ns.segments:
+                if os.path.basename(seg.path) == name:
+                    return seg.path
+        return None
+
+    def ship_tombstones(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> tuple[dict[str, int], int]:
+        with self._lock:
+            ns = self._namespace(app_id, channel_id)
+            return dict(ns.tombstones), ns.delete_ops
 
     # -- columnar fast path ------------------------------------------------
     @staticmethod

@@ -1,0 +1,158 @@
+"""CAS leader election on the lifecycle record layer (ISSUE 19).
+
+The train scheduler's claim protocol (PR 10, deploy/scheduler.py) showed
+that an append-only event fold gives a correct compare-and-swap without
+any backend growing a CAS primitive: every candidate appends a BID
+record carrying (generation, claim_token), and the winner is the FIRST
+bid of that generation in the record layer's total event order — an
+order every reader computes identically once the bids are visible. This
+module lifts that protocol out of the scheduler into a reusable
+`CasElection` so replicated-store failover (data/storage/replication.py)
+elects its primary with the same fencing:
+
+- the **generation** is monotone and never reused (each claim bids
+  generation = settled + 1), so it doubles as the replication *epoch*
+  stamped into shipped WAL frames — a zombie primary still holding the
+  old generation produces frames every follower rejects;
+- the **claim_token** makes a candidate's own bid distinguishable from
+  another candidate's bid for the same generation, so losing a race is
+  detected locally, not by side effect.
+
+No jax anywhere on this path — elections run inside storage daemons.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+ELECTION_ENTITY = "pio_election"
+ELECTION_BID_ENTITY = "pio_election_bid"
+
+
+@dataclass(frozen=True)
+class ElectionState:
+    """Settled view of one election group."""
+
+    leader: Optional[str]
+    generation: int
+    claim_token: Optional[str]
+    claimed_at: float
+
+
+class CasElection:
+    """Fenced leader election for one named group.
+
+    Usage::
+
+        el = CasElection(records, group="events-primary")
+        gen = el.claim("replica-a1b2", settle_s=0.2)
+        if gen is not None:
+            ...   # this candidate is leader at generation/epoch `gen`
+
+    `claim` returns the won generation (the new epoch) or None when
+    another candidate won the race or the settled generation moved on
+    while we were bidding. Claims are *advisory* leadership — fencing is
+    the consumer's job: stamp the generation into every side effect and
+    reject effects carrying an older one.
+    """
+
+    def __init__(
+        self,
+        records,
+        group: str,
+        entity: str = ELECTION_ENTITY,
+        bid_entity: str = ELECTION_BID_ENTITY,
+    ):
+        self._records = records
+        self.group = group
+        self._entity = entity
+        self._bid_entity = bid_entity
+
+    # -- reads -------------------------------------------------------------
+    def state(self) -> ElectionState:
+        d = self._records.fold(self._entity, self.group).get(self.group, {})
+        return ElectionState(
+            leader=d.get("leader"),
+            generation=int(d.get("generation", 0)),
+            claim_token=d.get("claim_token"),
+            claimed_at=float(d.get("claimed_at", 0.0)),
+        )
+
+    # -- claim -------------------------------------------------------------
+    def claim(
+        self,
+        candidate: str,
+        settle_s: float = 0.0,
+        generation: Optional[int] = None,
+    ) -> Optional[int]:
+        """Bid for leadership. Returns the won generation or None.
+
+        The bid generation defaults to settled + 1; passing an explicit
+        `generation` lets a coordinator drive a specific epoch bump. The
+        optional settle window gives racing candidates time to land
+        their bids before resolution — resolution itself needs no
+        window for correctness (the total order is deterministic), the
+        window only reduces the chance a *later-visible* earlier bid
+        flips the outcome between a winner's check and its announce."""
+        cur = self.state()
+        gen = int(generation) if generation is not None else cur.generation + 1
+        if gen <= cur.generation:
+            return None
+        token = uuid.uuid4().hex
+        self._records.append(
+            self._bid_entity, self.group,
+            {
+                "generation": gen,
+                "claim_token": token,
+                "candidate": candidate,
+                "bid_at": time.time(),
+            },
+        )
+        if settle_s > 0:
+            time.sleep(settle_s)
+        winner = self._winning_bid(gen)
+        if winner is None or winner.get("claim_token") != token:
+            return None
+        # the settled record may have moved past our generation while we
+        # slept (another group of candidates ran a later election) — a
+        # stale announce would roll the epoch BACK, so re-check first
+        if self.state().generation >= gen:
+            return None
+        self._records.append(
+            self._entity, self.group,
+            {
+                "leader": candidate,
+                "generation": gen,
+                "claim_token": token,
+                "claimed_at": time.time(),
+            },
+        )
+        return gen
+
+    def _winning_bid(self, generation: int) -> Optional[dict]:
+        """First bid of `generation` in the record layer's total event
+        order — the same resolution rule as the scheduler's job claims."""
+        for ev in self._records.events(self._bid_entity, self.group):
+            props = ev.properties.to_dict()
+            if int(props.get("generation", -1)) == generation:
+                return props
+        return None
+
+    # -- hygiene -----------------------------------------------------------
+    def gc_bids(self) -> int:
+        """Delete bids whose generation is at or below the settled one
+        (they can never win again); keeps the bid record O(contenders)."""
+        settled = self.state().generation
+        removed = 0
+        for ev in self._records.events(self._bid_entity, self.group):
+            props = ev.properties.to_dict()
+            if int(props.get("generation", 0)) <= settled and ev.event_id:
+                self._records.discard(ev.event_id)
+                removed += 1
+        return removed

@@ -74,6 +74,9 @@ BLOCK_SUFFIX = ".blk"
 BLOCK_MAGIC = b"PTSB1\x00"
 BLOCK_TAIL = b"PTSE1\x00"
 WAL_SUFFIX = ".log"
+#: replay checkpoint cursor (ISSUE 19 satellite): ring snapshot + WAL
+#: high-water mark, so attach parses only the bytes past the mark
+CKPT_NAME = "ckpt.json"
 
 #: a stitch tier must offer at least this many buckets per window
 MIN_BUCKETS_PER_WINDOW = 4
@@ -412,12 +415,17 @@ class DurableTSDB(TSDB):
     def __init__(self, directory: str, capacity: int = 720,
                  max_series: int = 4096, flush_interval_s: float = 2.0,
                  seal_points: int = 50000, seal_age_s: float = 300.0,
-                 replay: bool = True):
+                 replay: bool = True, ckpt_points: Optional[int] = None):
         super().__init__(capacity, max_series)
         self.dir = directory
         self.flush_interval_s = max(0.05, float(flush_interval_s))
         self.seal_points = max(1, int(seal_points))
         self.seal_age_s = max(0.1, float(seal_age_s))
+        if ckpt_points is None:
+            from predictionio_tpu.utils.env import env_int
+
+            ckpt_points = env_int("PIO_TSDB_CKPT_POINTS")
+        self.ckpt_points = max(0, int(ckpt_points))
         self.wal_dir = os.path.join(directory, "wal")
         os.makedirs(self.wal_dir, exist_ok=True)
         self.tiers: dict[str, TierIndex] = {
@@ -431,6 +439,9 @@ class DurableTSDB(TSDB):
         self._wal_points = 0  # guarded-by: _dlock
         self._wal_opened_at = 0.0  # guarded-by: _dlock
         self.wal_flushed_points = 0  # guarded-by: _dlock
+        self._ckpt_flushed = 0  # points flushed since last ckpt, guarded-by: _dlock
+        self.ckpt_written = 0
+        self.ckpt_seeded_points = 0
         self.replayed_points = 0
         self.replayed_series = 0
         self._stop = threading.Event()
@@ -499,11 +510,13 @@ class DurableTSDB(TSDB):
                     pass
 
     @staticmethod
-    def _read_wal_segment(path: str
+    def _read_wal_segment(path: str, offset: int = 0
                           ) -> list[tuple[float, str, LabelPairs, str, float]]:
         points = []
         try:
             with open(path, "rb") as f:
+                if offset:
+                    f.seek(offset)
                 for line in f:
                     try:
                         rec = json.loads(line)
@@ -547,6 +560,11 @@ class DurableTSDB(TSDB):
                 os.fsync(self._wal_f.fileno())
                 self._wal_points += len(batch)
                 self.wal_flushed_points += len(batch)
+                self._ckpt_flushed += len(batch)
+            want_ckpt = (
+                self.ckpt_points > 0
+                and self._ckpt_flushed >= self.ckpt_points
+            )
             want_seal = seal is True or (
                 seal is None
                 and self._wal_points > 0
@@ -558,9 +576,82 @@ class DurableTSDB(TSDB):
                 self._wal_f = None
                 self._wal_points = 0
                 self._wal_seq += 1
+        if want_ckpt:
+            self._write_checkpoint()
         if seal is not False and self._seal_closed_segments():
             self.tiers["raw"].invalidate()
         return len(batch)
+
+    # -- replay checkpoint cursor (ISSUE 19 satellite) -----------------------
+
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.wal_dir, CKPT_NAME)
+
+    def checkpoint_once(self) -> dict:
+        """Flush pending points, then persist a replay cursor: the full
+        ring snapshot plus the WAL (segment seq, byte offset) high-water
+        mark it covers. The next attach seeds the rings from the
+        snapshot and parses only WAL bytes past the mark instead of the
+        whole unsealed tail. Returns the written cursor's position."""
+        self.flush_once(seal=False)
+        return self._write_checkpoint()
+
+    def _write_checkpoint(self) -> dict:
+        # position FIRST, snapshot second: a point racing in between is
+        # in both the snapshot and the post-mark WAL bytes — replay sees
+        # it twice, a harmless identical-sample dup (delta 0 for
+        # counters). The opposite order could LOSE the point.
+        with self._dlock:
+            seq = self._wal_seq
+            if self._wal_f is not None:
+                off = self._wal_f.tell()
+            else:
+                # stop() closes the active file without bumping seq —
+                # cover what is already on disk instead of re-reading it
+                try:
+                    off = os.path.getsize(
+                        os.path.join(
+                            self.wal_dir, f"w-{seq:08d}{WAL_SUFFIX}"
+                        )
+                    )
+                except OSError:
+                    off = 0
+            self._ckpt_flushed = 0
+        series_out = []
+        with self._lock:
+            rows = [
+                (s.name, s.labels, s.kind, list(s.points))
+                for s in self._series.values()
+            ]
+        for name, labels, kind, pts in rows:
+            if not pts:
+                continue
+            series_out.append({
+                "n": name, "l": [list(p) for p in labels], "k": kind,
+                "pts": [[t, v] for t, v in pts],
+            })
+        doc = {"v": 1, "seq": seq, "off": off, "t": time.time(),
+               "series": series_out}
+        tmp = self._ckpt_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ckpt_path())
+        _fsync_dir(self.wal_dir)
+        self.ckpt_written += 1
+        return {"seq": seq, "off": off}
+
+    def _load_checkpoint(self) -> Optional[dict]:
+        try:
+            with open(self._ckpt_path()) as f:
+                doc = json.load(f)
+            if doc.get("v") != 1:
+                return None
+            int(doc["seq"]); int(doc["off"])
+            return doc
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
 
     def _seal_closed_segments(self) -> int:
         """Convert every non-active WAL segment into a raw block, then
@@ -645,13 +736,40 @@ class DurableTSDB(TSDB):
     # -- replay --------------------------------------------------------------
 
     def _replay(self, max_blocks: int = 64) -> None:
-        """Reload the durable tail (WAL segments + newest raw blocks)
-        into the memory rings — at most `capacity` newest points per
-        series, added oldest-first via the time-ordered insert path."""
+        """Reload the durable tail into the memory rings — at most
+        `capacity` newest points per series, added oldest-first via the
+        time-ordered insert path. With a checkpoint cursor present
+        (ISSUE 19 satellite) the rings seed from its snapshot and only
+        WAL bytes past the (seq, offset) high-water mark are parsed;
+        without one, every WAL segment is read in full."""
         per: dict[tuple[str, LabelPairs], list[tuple[float, float]]] = {}
         kinds: dict[tuple[str, LabelPairs], str] = {}
-        for _seq, path in self._wal_segments():
-            for t, n, lbls, k, v in self._read_wal_segment(path):
+        ck = self._load_checkpoint()
+        ck_seq, ck_off = -1, 0
+        # newest snapshotted stamp per series: the block-backfill filter
+        # (a block may hold a pre-mark segment whose points the snapshot
+        # already carries)
+        ck_last: dict[tuple[str, LabelPairs], float] = {}
+        if ck is not None:
+            ck_seq, ck_off = int(ck["seq"]), int(ck["off"])
+            for s in ck.get("series", ()):
+                try:
+                    key = (str(s["n"]),
+                           tuple((str(k), str(v)) for k, v in s["l"]))
+                    pts = [(float(t), float(v)) for t, v in s["pts"]]
+                except (ValueError, KeyError, TypeError):
+                    continue
+                if not pts:
+                    continue
+                per[key] = pts
+                ck_last[key] = pts[-1][0]
+                kinds[key] = str(s.get("k", "gauge"))
+                self.ckpt_seeded_points += len(pts)
+        for seq, path in self._wal_segments():
+            if seq < ck_seq:
+                continue  # fully covered by the snapshot
+            off = ck_off if seq == ck_seq else 0
+            for t, n, lbls, k, v in self._read_wal_segment(path, off):
                 key = (n, lbls)
                 per.setdefault(key, []).append((t, v))
                 kinds.setdefault(key, k)
@@ -659,13 +777,21 @@ class DurableTSDB(TSDB):
         for b in sorted(raw_blocks, key=lambda b: -b.max_t)[:max_blocks]:
             for key, entry in b.series.items():
                 have = per.get(key)
-                if have is not None and len(have) >= self.capacity:
+                full = have is not None and len(have) >= self.capacity
+                if full and (
+                    key not in ck_last or b.max_t <= ck_last[key]
+                ):
                     continue
                 got = b.read_series(key)
                 if got is None:
                     continue
                 ts, cols = got
-                per.setdefault(key, []).extend(zip(ts, cols["v"]))
+                pts = zip(ts, cols["v"])
+                if key in ck_last:
+                    # only what the snapshot has not seen (a segment
+                    # sealed after the ckpt holds post-snapshot points)
+                    pts = ((t, v) for t, v in pts if t > ck_last[key])
+                per.setdefault(key, []).extend(pts)
                 kinds.setdefault(key, entry.get("k", "gauge"))
         for key, pts in per.items():
             pts.sort()
@@ -898,6 +1024,7 @@ class DurableTSDB(TSDB):
                 "pending": len(self._pending),
                 "active_points": self._wal_points,
                 "flushed_points": self.wal_flushed_points,
+                "ckpt_pending_points": self._ckpt_flushed,
             }
         return {
             "dir": self.dir,
@@ -905,6 +1032,8 @@ class DurableTSDB(TSDB):
             "tiers": {name: self.tiers[name].stats() for name in TIER_ORDER},
             "replayed_points": self.replayed_points,
             "replayed_series": self.replayed_series,
+            "ckpt_written": self.ckpt_written,
+            "ckpt_seeded_points": self.ckpt_seeded_points,
         }
 
     def summary(self, limit: int = 0) -> dict[str, Any]:

@@ -288,6 +288,31 @@ def tenant_slo_presets(tenant_ids) -> list[SLOSpec]:
     return specs
 
 
+def replication_slo_presets(
+    max_lag_revisions: Optional[int] = None,
+) -> list[SLOSpec]:
+    """Replication-lag SLO preset (ISSUE 19): an expression objective on
+    the `replication_lag_revisions` gauge the SegmentShipper maintains
+    per follower namespace. The error fraction is p99 lag over the
+    window as a fraction of the `PIO_REPL_MAX_LAG_REVISIONS` budget, so
+    with objective 0.5 and burn_threshold 2.0 the alert fires exactly
+    when sustained lag reaches the configured ceiling (fraction ≥ 1.0 ⇔
+    burn ≥ 2.0 × the 0.5 budget) on both the fast and slow windows."""
+    if max_lag_revisions is None:
+        from predictionio_tpu.utils.env import env_int
+
+        max_lag_revisions = env_int("PIO_REPL_MAX_LAG_REVISIONS")
+    budget = max(1, int(max_lag_revisions))
+    return [SLOSpec(
+        name="replication:lag", kind="expr",
+        objective=0.5, burn_threshold=2.0,
+        expr=(
+            "max(quantile_over_time(0.99, "
+            f"replication_lag_revisions[$window])) / {budget}"
+        ),
+    )]
+
+
 # -- error-rate math ---------------------------------------------------------
 #
 # Module-level so the engine's per-evaluation path and the sampler-tick

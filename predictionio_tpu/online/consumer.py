@@ -54,10 +54,24 @@ class OnlineConsumerConfig:
     drift_threshold: float = field(
         default_factory=lambda: env_float("PIO_ONLINE_DRIFT_THRESHOLD", 1.0)
     )
+    # drift-pause auto-resume (ISSUE 19 satellite): a drift pause that
+    # has seen a retrain waits this long, then optimistically resumes —
+    # the next fold re-probes drift against the rebased baseline and
+    # re-pauses if still breaching. 0 keeps the original behavior
+    # (resume immediately on retrain).
+    drift_cooldown_s: float = field(
+        default_factory=lambda: env_float("PIO_ONLINE_DRIFT_COOLDOWN_S", 0.0)
+    )
     # compact the cursor record fold every N persisted ticks (single
     # writer → the quiescence guard is unnecessary; min_age_s=0)
     compact_every: int = 64
     name: Optional[str] = None  # cursor record id override
+    # one-shot cursor migration (ISSUE 19 satellite): when this consumer
+    # has NO persisted record under its own cursor_id, adopt the record
+    # at this legacy id (the pre-replica-scoping name) and re-persist it
+    # under the new id with a `migrated_from` marker. Restarts find the
+    # new record and never consult the legacy id again.
+    migrate_from: Optional[str] = None
     # a consumer with NO persisted cursor starts from the stream head by
     # default (everything before it is already in the trained model);
     # True skips history and tails from the store's current revision —
@@ -147,6 +161,9 @@ class OnlineConsumer:
         self._thread: Optional[threading.Thread] = None
         self._paused: Optional[str] = None  # guarded-by: _lock
         self._drift_paused = False  # auto-clears on retrain  # guarded-by: _lock
+        # drift cool-down: monotonic stamp of the retrain observed while
+        # drift-paused; cleared on resume or a fresh pause
+        self._retrain_seen_at: Optional[float] = None
         self._last_runtime: Any = None
         self._ticks_persisted = 0
         self._last_error: Optional[str] = None
@@ -159,6 +176,25 @@ class OnlineConsumer:
         rec = self._records.fold(CURSOR_ENTITY, self.cursor_id).get(
             self.cursor_id
         ) or {}
+        self.migrated_from: Optional[str] = rec.get("migrated_from") or None
+        adopt_legacy = False
+        if (
+            not rec
+            and self.config.migrate_from
+            and self.config.migrate_from != self.cursor_id
+        ):
+            legacy = self._records.fold(
+                CURSOR_ENTITY, self.config.migrate_from
+            ).get(self.config.migrate_from) or {}
+            if legacy:
+                rec = legacy
+                adopt_legacy = True
+                self.migrated_from = self.config.migrate_from
+                log.info(
+                    "adopting legacy online cursor %s as %s (one-shot "
+                    "migration to replica-scoped naming)",
+                    self.config.migrate_from, self.cursor_id,
+                )
         self.cursor: dict[str, int] = {
             k: int(v) for k, v in (rec.get("cursor") or {}).items()
         }
@@ -199,6 +235,22 @@ class OnlineConsumer:
             }
             or None
         )
+
+        if adopt_legacy:
+            # persist immediately under the new id: the migration is
+            # one-shot BECAUSE the next restart finds this record and
+            # never consults the legacy id again (which leaves the
+            # legacy record intact for any replica yet to migrate)
+            self._records.append(CURSOR_ENTITY, self.cursor_id, {
+                "cursor": dict(self.cursor),
+                **self.counters,
+                "scope": getattr(self.host, "scope", "server"),
+                "app_id": self.app_id,
+                "baseline_instance": self._baseline_instance,
+                "baseline_cursor": dict(self._baseline_cursor or {}),
+                "migrated_from": self.config.migrate_from,
+                "updated_at": time.time(),
+            })
 
         self.metrics = metrics or get_default_registry()
         self._consumed_ctr = self.metrics.counter(
@@ -288,6 +340,7 @@ class OnlineConsumer:
         with self._lock:
             self._paused = reason
             self._drift_paused = by_drift
+            self._retrain_seen_at = None
         self._paused_gauge.set(1.0, scope=self.cursor_id)
         log.warning("online fold-in paused: %s", reason)
 
@@ -305,6 +358,7 @@ class OnlineConsumer:
         with self._lock:
             self._paused = None
             self._drift_paused = False
+            self._retrain_seen_at = None
         self._paused_gauge.set(0.0, scope=self.cursor_id)
         try:
             from predictionio_tpu.obs.monitor import get_monitor
@@ -369,11 +423,40 @@ class OnlineConsumer:
                 self._baseline_cursor = dict(self.cursor)
             self._last_runtime = runtime
             if self._paused is not None and self._drift_paused:
-                log.info(
-                    "retrain detected while drift-paused: rebasing and "
-                    "resuming fold-in (%s)", self.cursor_id,
-                )
-                self.resume()
+                if self.config.drift_cooldown_s > 0:
+                    # cool-down mode (ISSUE 19 satellite): the retrain
+                    # rebased the baseline above; stay paused for the
+                    # cool-down, then re-probe drift once below
+                    log.info(
+                        "retrain detected while drift-paused: rebased; "
+                        "re-probing drift after %.1fs cool-down (%s)",
+                        self.config.drift_cooldown_s, self.cursor_id,
+                    )
+                    with self._lock:
+                        self._retrain_seen_at = time.monotonic()
+                else:
+                    log.info(
+                        "retrain detected while drift-paused: rebasing "
+                        "and resuming fold-in (%s)", self.cursor_id,
+                    )
+                    self.resume()
+        if (
+            self._paused is not None
+            and self._drift_paused
+            and self._retrain_seen_at is not None
+            and time.monotonic() - self._retrain_seen_at
+            >= self.config.drift_cooldown_s
+        ):
+            # cool-down elapsed after a completed retrain: optimistic
+            # resume — the next fold IS the drift re-probe (it checks
+            # against the rebased baseline and re-pauses if still
+            # breaching), so a clean stream resumes and a still-drifting
+            # one pauses again within one tick
+            log.info(
+                "drift cool-down elapsed: re-probing and resuming "
+                "fold-in (%s)", self.cursor_id,
+            )
+            self.resume()
         if self._paused is not None:
             return {"paused": self._paused}
 
@@ -566,6 +649,9 @@ class OnlineConsumer:
             "counters": dict(self.counters),
             "drift": round(self.guard.last_drift, 4),
             "drift_threshold": self.guard.threshold,
+            "drift_cooldown_s": self.config.drift_cooldown_s,
+            "cooling_down": self._retrain_seen_at is not None,
+            "migrated_from": self.migrated_from,
             "tick_s": self.config.tick_s,
             "last_error": self._last_error,
         }

@@ -130,6 +130,31 @@ _k("PIO_ONLINE_TICK_S", "float", 0.5,
    "Seconds between online fold-in consumer ticks.")
 _k("PIO_ONLINE_DRIFT_THRESHOLD", "float", 1.0,
    "Score-drift score that pauses fold-in and raises the alert.")
+_k("PIO_ONLINE_DRIFT_COOLDOWN_S", "float", 0.0,
+   "Cool-down (s) after a completed retrain before a drift-paused "
+   "consumer re-probes drift once and auto-resumes if clean; 0 keeps "
+   "the immediate-resume-on-retrain behaviour.")
+
+# -- event-store replication -------------------------------------------------
+_k("PIO_REPL_FOLLOWERS", "str", "",
+   "Comma-separated host:port follower storage daemons the primary's "
+   "SegmentShipper streams segments and the WAL tail to. Empty "
+   "disables replication.")
+_k("PIO_REPL_MIN_ACKS", "int", 0,
+   "Synchronous-replication floor: insert_batch acks only after this "
+   "many followers applied the WAL frame (0 = async shipping only).")
+_k("PIO_REPL_SHIP_INTERVAL_S", "float", 0.25,
+   "Seconds between background SegmentShipper passes (segment sync + "
+   "WAL-tail catch-up + tombstone sync).")
+_k("PIO_REPL_WAL_BATCH", "int", 512,
+   "Max live-tail rows per replication WAL frame on catch-up passes.")
+_k("PIO_REPL_MAX_LAG_REVISIONS", "int", 1000,
+   "Replication-lag budget (revisions) used by the replication_lag "
+   "SLO preset.")
+_k("PIO_REPL_EPOCH", "int", 1,
+   "Replication epoch a primary storage daemon stamps into shipped "
+   "frames at boot. Normally 1 for the original primary; a promoted "
+   "follower's epoch comes from the election generation instead.")
 
 # -- fleet -------------------------------------------------------------------
 _k("PIO_FLEET_COORDINATOR", "str", "",
@@ -255,6 +280,10 @@ _k("PIO_TSDB_SEAL_AGE_S", "float", 300.0,
 _k("PIO_TSDB_COMPACT_S", "float", 30.0,
    "Seconds between durable-TSDB compactor passes (downsampling + "
    "per-tier retention).")
+_k("PIO_TSDB_CKPT_POINTS", "int", 50000,
+   "Flushed WAL points between durable-TSDB replay-checkpoint writes; "
+   "attach replays only WAL bytes past the checkpoint (0 disables "
+   "checkpointing).")
 _k("PIO_TSDB_RETENTION_RAW", "float", 6 * 3600.0,
    "Retention (s) of raw-resolution durable blocks.")
 _k("PIO_TSDB_RETENTION_5M", "float", 3 * 86400.0,

@@ -1613,6 +1613,13 @@ class QueryServer(ServerProcess):
                         f"online/{app_id}/server"
                         f"@{self.replica.replica_id}"
                     ),
+                    # one-shot adoption of the pre-replica-scoped record
+                    # (ISSUE 19 satellite): a server upgraded in place
+                    # resumes exactly where its un-scoped cursor stood
+                    migrate_from=(
+                        config.migrate_from
+                        or f"online/{app_id}/server"
+                    ),
                 )
             consumer = OnlineConsumer(
                 self.storage, ServerApplyHost(self), app_id,

@@ -476,3 +476,89 @@ class TestMonitorWiring:
         assert "durable tier at" in out
         assert "tier raw" in out
         m.tsdb.stop()
+
+
+# ---------------------------------------------------------------------------
+# WAL replay checkpoint cursor (ISSUE 19 satellite): a fat unsealed tail
+# must not be re-parsed from byte 0 on every attach
+# ---------------------------------------------------------------------------
+
+
+class TestReplayCheckpoint:
+    def test_replay_skips_pre_checkpoint_wal_bytes(self, tmp_path):
+        """Fat-tail regression: after a checkpoint, reopening reads the
+        active WAL segment from the cursor's byte offset — the thousands
+        of pre-checkpoint lines are seeded from the snapshot, not
+        re-parsed."""
+        db = _mk(tmp_path)
+        _walk(db, "fat", {"h": "a"}, T0, T0 + 30_000, 10.0, 1.0)  # 3001 pts
+        db.flush_once(seal=False)
+        cur = db.checkpoint_once()
+        assert cur["off"] > 0
+        last = _walk(db, "fat", {"h": "a"}, T0 + 30_010, T0 + 30_500,
+                     10.0, 1.0, v0=3001.0)
+        db.stop()
+
+        reads = []
+        real = DurableTSDB._read_wal_segment
+
+        def spy(path, offset=0):
+            reads.append((os.path.basename(path), offset))
+            return real(path, offset)
+
+        DurableTSDB._read_wal_segment = staticmethod(spy)
+        try:
+            db2 = _mk(tmp_path)
+        finally:
+            DurableTSDB._read_wal_segment = staticmethod(real)
+        # every replay read of the checkpointed segment started at the
+        # cursor offset — no read from byte 0
+        seg = f"w-{cur['seq']:08d}.log"
+        seg_reads = [off for name, off in reads if name == seg]
+        assert seg_reads and all(off == cur["off"] for off in seg_reads)
+        assert db2.ckpt_seeded_points > 0
+        # and nothing was lost past the mark: the post-checkpoint walk
+        # is all there
+        pts = db2.matching("fat", {"h": "a"})[0].points
+        assert pts[-1][1] == pytest.approx(last)
+        stats = db2.durable_stats()
+        assert stats["ckpt_seeded_points"] == db2.ckpt_seeded_points
+        db2.stop()
+
+    def test_checkpoint_replay_matches_full_replay(self, tmp_path):
+        """Seeding from the snapshot + post-mark bytes must reconstruct
+        exactly the rings a full WAL re-read builds."""
+        import shutil
+
+        db = _mk(tmp_path)
+        for h in ("a", "b"):
+            _walk(db, "m", {"h": h}, T0, T0 + 12_000, 10.0, 1.0)
+        db.flush_once(seal=False)
+        db.checkpoint_once()
+        for h in ("a", "b"):
+            _walk(db, "m", {"h": h}, T0 + 12_010, T0 + 12_300, 10.0, 1.0,
+                  v0=1201.0)
+        db.stop()
+        shutil.copytree(str(tmp_path / "tsdb"), str(tmp_path / "full"))
+        os.remove(str(tmp_path / "full" / "wal" / "ckpt.json"))
+
+        with_ckpt = _mk(tmp_path)
+        no_ckpt = DurableTSDB(str(tmp_path / "full"), capacity=720,
+                              flush_interval_s=9999.0, seal_age_s=9999.0)
+        assert with_ckpt.ckpt_seeded_points > 0
+        assert no_ckpt.ckpt_seeded_points == 0
+        for h in ("a", "b"):
+            assert list(with_ckpt.matching("m", {"h": h})[0].points) == \
+                list(no_ckpt.matching("m", {"h": h})[0].points)
+        with_ckpt.stop()
+        no_ckpt.stop()
+
+    def test_periodic_checkpoint_rides_flush(self, tmp_path):
+        db = _mk(tmp_path, ckpt_points=100)
+        _walk(db, "c", {}, T0, T0 + 2_500, 10.0, 1.0)  # 251 points
+        db.flush_once(seal=False)
+        assert db.ckpt_written >= 1
+        stats = db.durable_stats()
+        assert stats["wal"]["ckpt_pending_points"] < 100
+        assert stats["ckpt_written"] == db.ckpt_written
+        db.stop()

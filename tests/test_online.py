@@ -386,6 +386,44 @@ class TestCursorResume:
         assert c2.counters["events_consumed"] == 5
         assert c2.tick() == {"idle": "no new events"}
 
+    def test_replica_scoped_cursor_migrates_once(self):
+        """ISSUE 19 satellite: a consumer given a per-replica cursor
+        name adopts the legacy un-scoped record exactly once — no
+        re-consumption on the rename, and later movement of the legacy
+        record never leaks into the scoped one."""
+        from predictionio_tpu.online import (
+            OnlineConsumer,
+            OnlineConsumerConfig,
+        )
+
+        storage = _mem_storage()
+        store = storage.get_events()
+        store.insert_batch([_ev(u=f"u{k}") for k in range(5)], 1)
+        legacy = OnlineConsumer(
+            storage, _StubHost(), 1, OnlineConsumerConfig(tick_s=9),
+        )
+        legacy.tick()
+        assert legacy.cursor == {"0": 5}
+        scoped_cfg = OnlineConsumerConfig(
+            tick_s=9, name="online/1/replica-a",
+            migrate_from=legacy.cursor_id,
+        )
+        scoped = OnlineConsumer(storage, _StubHost(), 1, scoped_cfg)
+        # adopted, not restarted from zero
+        assert scoped.cursor == {"0": 5}
+        assert scoped.counters["events_consumed"] == 5
+        assert scoped.migrated_from == legacy.cursor_id
+        assert scoped.tick() == {"idle": "no new events"}
+        # one-shot: the scoped record exists now, so a restart reads IT
+        # even when the legacy record has moved on meanwhile
+        store.insert_batch([_ev(u="x1"), _ev(u="x2")], 1)
+        legacy.tick()  # legacy cursor moves to 7 independently
+        scoped2 = OnlineConsumer(storage, _StubHost(), 1, scoped_cfg)
+        assert scoped2.cursor == {"0": 5}  # own record, not legacy's 7
+        assert scoped2.migrated_from == legacy.cursor_id
+        assert scoped2.tick()["consumed"] == 2
+        assert scoped2.status()["migrated_from"] == legacy.cursor_id
+
     def test_from_latest_skips_history(self):
         from predictionio_tpu.online import (
             OnlineConsumer,

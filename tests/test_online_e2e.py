@@ -422,6 +422,49 @@ class TestDriftGuard:
         srv.reload()
         assert consumer.tick() == {"paused": "operator hold"}
 
+    def test_drift_cooldown_delays_resume_after_retrain(self, served):
+        """ISSUE 19 satellite: with PIO_ONLINE_DRIFT_COOLDOWN_S (here
+        via config) a drift-paused consumer does NOT resume the moment a
+        retrain lands — it waits out the cool-down, then the next tick
+        re-probes drift by folding and stays resumed when clean."""
+        storage, srv, port, app_id = served
+        consumer = srv.attach_online(
+            app_id,
+            OnlineConsumerConfig(
+                tick_s=60, from_latest=True, drift_threshold=0.5,
+                drift_cooldown_s=0.4,
+            ),
+        )
+        consumer.stop()
+        storage.get_events().insert_batch(
+            [e for u in range(8) for e in _rate(f"u{u}", ["i2"], 3.0)],
+            app_id,
+        )
+        faults.install(faults.FaultSpec("online.fold", "corrupt", 1.0))
+        assert "paused" in consumer.tick()
+        faults.clear()
+        run_train(storage, VARIANT)
+        srv.reload()
+        # the retrain alone no longer resumes: this tick sees the new
+        # runtime, rebases, and starts the cool-down clock
+        out = consumer.tick()
+        assert "paused" in out
+        assert consumer.status()["cooling_down"] is True
+        assert consumer.paused is not None
+        # ... and once the cool-down expires, the next tick resumes and
+        # the fold itself is the drift re-probe
+        time.sleep(0.45)
+        out = consumer.tick()
+        assert consumer.paused is None
+        assert out.get("folded") == 8
+        assert consumer.status()["cooling_down"] is False
+        # an OPERATOR pause never auto-resumes, cool-down or not
+        consumer.pause("operator hold")
+        run_train(storage, VARIANT)
+        srv.reload()
+        time.sleep(0.45)
+        assert consumer.tick() == {"paused": "operator hold"}
+
     def test_error_fault_fails_tick_without_cursor_advance(self, served):
         storage, srv, port, app_id = served
         consumer = srv.attach_online(

@@ -1,0 +1,587 @@
+"""Replicated event store units (ISSUE 19): frame protocol idempotence
+and gap handling, torn-frame-at-the-epoch-boundary recovery, resumable
+hash-verified segment shipping across a follower restart, epoch fencing
+and fenced promotion, the CAS election, and the replica read routing
+fold-in consumers use."""
+
+import glob
+import json
+import os
+import threading
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import StorageError
+from predictionio_tpu.data.storage.replication import (
+    FollowerLink,
+    ReplicaEventStore,
+    ReplicaReadStorage,
+    ReplicationConfig,
+    SegmentShipper,
+    elect_and_promote,
+)
+from predictionio_tpu.data.storage.segmentfs import SegmentFSEventStore
+from predictionio_tpu.fleet.election import CasElection
+from predictionio_tpu.obs.registry import MetricsRegistry
+
+APP = 7
+
+
+def _mem_storage():
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    return Storage(StorageConfig(
+        sources={"M": SourceConfig("M", "memory", {})},
+        repositories={
+            "METADATA": "M", "EVENTDATA": "M", "MODELDATA": "M",
+        },
+    ))
+
+
+def _row(k):
+    """A valid segmentfs event row (the shape ship_tail_after emits):
+    [id, event, etype, eid, ttype, tid, props, t_ms, tags, prid, ct_ms]"""
+    return [
+        f"e{k}", "rate", "user", f"u{k}", "item", "i1",
+        {"rating": 1.0}, 0, None, None, 0,
+    ]
+
+
+def _ev(k, u=None):
+    return Event(
+        event="rate", entity_type="user", entity_id=u or f"u{k}",
+        target_entity_type="item", target_entity_id=f"i{k % 5}",
+        properties={"rating": float(k % 5 + 1)},
+    )
+
+
+def _store_cfg(tmp, name, **over):
+    cfg = {
+        "PATH": str(tmp / name),
+        # seals are driven explicitly in these tests
+        "SEAL_INTERVAL_S": "3600", "SEAL_AGE_S": "3600",
+        "SEAL_EVENTS": "1000000",
+        "METRICS_REGISTRY": MetricsRegistry(),
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _primary(tmp, **over):
+    s = SegmentFSEventStore(_store_cfg(tmp, "primary", **over))
+    s.init_app(APP)
+    return s
+
+
+def _replica(tmp, **over):
+    r = ReplicaEventStore(_store_cfg(tmp, "replica", **over))
+    r.init_app(APP)
+    return r
+
+
+class _DirectLink:
+    """FollowerLink stand-in calling a replica in-process (same method
+    surface the daemon's `replication` DAO exposes), with an optional
+    call log so tests can assert what a resumed ship re-sent."""
+
+    def __init__(self, replica, name="direct:0"):
+        self.replica = replica
+        self.name = name
+        self.lock = threading.Lock()
+        self.calls = []
+
+    def call(self, method, *args, **kwargs):
+        self.calls.append(method)
+        return getattr(self.replica, method)(*args, **kwargs)
+
+
+def _shipper(primary, replica, epoch=1, **over):
+    cfg = ReplicationConfig(followers=("direct:0",), **over)
+    sh = SegmentShipper(
+        primary, cfg, epoch=epoch, metrics=MetricsRegistry()
+    )
+    sh.links = [_DirectLink(replica)]
+    return sh
+
+
+def _revs(store, app=APP):
+    return [e.revision for e in store.find_since(app, 0)]
+
+
+# ---------------------------------------------------------------------------
+# frame protocol
+# ---------------------------------------------------------------------------
+
+
+class TestFrameProtocol:
+    def test_ship_and_apply_parity(self, tmp_path):
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        primary.insert_batch([_ev(k) for k in range(120)], APP)
+        primary.seal(APP)
+        primary.insert_batch([_ev(k, u=f"t{k}") for k in range(8)], APP)
+        sh = _shipper(primary, replica)
+        sh.pass_once()
+        assert replica.latest_revision(APP) == primary.latest_revision(APP)
+        assert _revs(replica) == _revs(primary)
+        assert (
+            replica.data_signature(APP) == primary.data_signature(APP)
+        )
+        lag = replica.replication_lag(APP)
+        assert lag["lag"] == 0 and lag["role"] == "replica"
+        # a second pass is a no-op, not a re-apply
+        before = _revs(replica)
+        sh.pass_once()
+        assert _revs(replica) == before
+
+    def test_duplicate_frame_is_idempotent(self, tmp_path):
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        primary.insert_batch([_ev(k) for k in range(6)], APP)
+        t = primary.ship_tail_after(APP, None, 0, 100)
+        frame = (APP, None, 1, 0, list(t["revs"]),
+                 json.loads(json.dumps(t["rows"], default=str)), t["head"])
+        r1 = replica.replication_apply_wal(*frame)
+        r2 = replica.replication_apply_wal(*frame)  # retried RPC
+        assert r1["watermark"] == r2["watermark"] == 6
+        assert _revs(replica) == [1, 2, 3, 4, 5, 6]
+
+    def test_gap_frame_answers_watermark_and_applies_nothing(
+        self, tmp_path
+    ):
+        replica = _replica(tmp_path)
+        resp = replica.replication_apply_wal(
+            APP, None, 1, 5, [6, 7], [["x"] * 11, ["y"] * 11], 7
+        )
+        assert resp == {"gap": True, "watermark": 0, "epoch": 1}
+        assert replica.latest_revision(APP) == 0
+
+    def test_torn_wal_frame_at_epoch_boundary(self, tmp_path):
+        """Satellite: a frame torn mid-ship at an epoch bump. The
+        follower's WAL carries a torn line (crash mid-fsync), recovery
+        skips it, and the resumed stream — now at the NEW epoch —
+        neither skips nor duplicates a revision."""
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        primary.insert_batch([_ev(k) for k in range(10)], APP)
+        sh1 = _shipper(primary, replica, epoch=1)
+        sh1.pass_once()
+        assert replica.latest_revision(APP) == 10
+        # the primary keeps writing; the ship of revs 11..16 tears:
+        # the follower crashed mid-append, leaving a torn WAL line
+        primary.insert_batch([_ev(k, u=f"p{k}") for k in range(6)], APP)
+        wal = sorted(glob.glob(
+            os.path.join(replica.base, f"app_{APP}", "wal-*.jsonl")
+        ))[-1]
+        with open(wal, "a") as f:
+            f.write('[11,[["torn-row-never-com')  # no newline, no close
+        replica.close()
+        replica2 = ReplicaEventStore(_store_cfg(tmp_path, "replica"))
+        # recovery skipped the torn record: watermark is still 10
+        assert replica2.latest_revision(APP) == 10
+        # failover happened meanwhile: the resumed stream runs at epoch 2
+        sh2 = _shipper(primary, replica2, epoch=2)
+        sh2.pass_once()
+        assert replica2.epoch == 2
+        assert _revs(replica2) == list(range(1, 17))  # no skip, no dup
+        assert _revs(replica2) == _revs(primary)
+
+    def test_out_of_order_frame_after_gap_backfills(self, tmp_path):
+        """A gap answer makes the shipper backfill from the follower's
+        watermark — delivered through the commit-hook path itself."""
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        sh = _shipper(primary, replica, min_acks=1)
+        primary.set_commit_hook(sh._commit_hook)
+        # first batch reaches the follower through the hook
+        primary.insert_batch([_ev(k) for k in range(3)], APP)
+        assert replica.latest_revision(APP) == 3
+        # follower loses its state (fresh directory = lost frames)
+        sh.links[0].replica = _replica(
+            tmp_path, PATH=str(tmp_path / "replica-b")
+        )
+        primary.insert_batch([_ev(k, u=f"b{k}") for k in range(3)], APP)
+        # the gap response triggered a backfill from watermark 0
+        assert _revs(sh.links[0].replica) == [1, 2, 3, 4, 5, 6]
+
+    def test_min_acks_failure_raises_but_keeps_rows_durable(
+        self, tmp_path
+    ):
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        sh = _shipper(primary, replica, min_acks=1)
+
+        class _DownLink:
+            name = "down:0"
+
+            def call(self, *a, **k):
+                raise OSError("connection refused")
+
+        sh.links = [_DownLink()]
+        primary.set_commit_hook(sh._commit_hook)
+        with pytest.raises(StorageError, match="ack floor"):
+            primary.insert_batch([_ev(1)], APP)
+        # the rows are durable locally and re-ship once the follower is
+        # back — the documented failure contract
+        assert primary.latest_revision(APP) == 1
+        sh.links = [_DirectLink(replica)]
+        sh.pass_once()
+        assert _revs(replica) == [1]
+
+
+# ---------------------------------------------------------------------------
+# segment shipping
+# ---------------------------------------------------------------------------
+
+
+class TestSegmentShip:
+    def test_ship_resumes_after_follower_restart(self, tmp_path):
+        """Satellite: staged files survive a follower restart (the
+        `repl-` staging dir is NOT seal garbage) and the resumed ship
+        skips them instead of re-sending."""
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        primary.insert_batch([_ev(k) for k in range(50)], APP)
+        primary.seal(APP)
+        name = list(primary.ship_state(APP, None)["segments"])[0]
+        seg_path = primary.ship_segment_path(APP, None, name)
+        fnames = sorted(
+            n for n in os.listdir(seg_path) if not n.startswith(".")
+        )
+        assert len(fnames) > 2
+        # ship only the first two files, then "crash" the follower
+        import hashlib
+        for fname in fnames[:2]:
+            with open(os.path.join(seg_path, fname), "rb") as f:
+                data = f.read()
+            replica.replication_segment_file(
+                APP, None, 1, name, fname, data,
+                hashlib.sha256(data).hexdigest(),
+            )
+        replica.close()
+        replica2 = ReplicaEventStore(_store_cfg(tmp_path, "replica"))
+        man = replica2.replication_segment_manifest(APP, None, name)
+        assert sorted(man["staged"]) == fnames[:2]  # staging survived
+        sh = _shipper(primary, replica2)
+        link = sh.links[0]
+        sh._ship_segment(link, APP, None, name)
+        # resumed ship sent only the files that were missing
+        sent = link.calls.count("replication_segment_file")
+        assert sent == len(fnames) - 2
+        assert replica2.replication_segment_manifest(
+            APP, None, name
+        )["published"]
+        assert _revs(replica2) == list(range(1, 51))
+
+    def test_commit_rejects_corrupted_staged_file(self, tmp_path):
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        primary.insert_batch([_ev(k) for k in range(30)], APP)
+        primary.seal(APP)
+        name = list(primary.ship_state(APP, None)["segments"])[0]
+        sh = _shipper(primary, replica)
+        link = sh.links[0]
+        sh._ship_segment(link, APP, None, name)
+        assert replica.replication_segment_manifest(
+            APP, None, name
+        )["published"]
+        # a second segment, corrupted in staging before commit
+        primary.insert_batch([_ev(k, u=f"c{k}") for k in range(30)], APP)
+        primary.seal(APP)
+        name2 = [
+            n for n in primary.ship_state(APP, None)["segments"]
+            if n != name
+        ][0]
+        seg2 = primary.ship_segment_path(APP, None, name2)
+        import hashlib
+        files = {}
+        for fname in sorted(os.listdir(seg2)):
+            if fname.startswith("."):
+                continue
+            with open(os.path.join(seg2, fname), "rb") as f:
+                data = f.read()
+            files[fname] = hashlib.sha256(data).hexdigest()
+            replica.replication_segment_file(
+                APP, None, 1, name2, fname, data, files[fname]
+            )
+        ns_dir = os.path.join(replica.base, f"app_{APP}")
+        staged = os.path.join(ns_dir, f"repl-{name2}")
+        victim = sorted(
+            n for n in os.listdir(staged) if n != "footer.json"
+        )[0]
+        with open(os.path.join(staged, victim), "r+b") as f:
+            f.write(b"\x00garbage\x00")
+        with open(os.path.join(seg2, "footer.json")) as f:
+            chash = json.load(f)["content_hash"]
+        with pytest.raises(StorageError, match="re-ship|hash"):
+            replica.replication_commit_segment(
+                APP, None, 1, name2, files, chash
+            )
+        # nothing published; a clean re-ship succeeds
+        assert not replica.replication_segment_manifest(
+            APP, None, name2
+        )["published"]
+        sh._ship_segment(link, APP, None, name2)
+        assert replica.replication_segment_manifest(
+            APP, None, name2
+        )["published"]
+        assert _revs(replica) == list(range(1, 61))
+
+    def test_replica_survives_restart_with_sealed_and_tail(self, tmp_path):
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        primary.insert_batch([_ev(k) for k in range(40)], APP)
+        primary.seal(APP)
+        primary.insert_batch([_ev(k, u=f"t{k}") for k in range(5)], APP)
+        sh = _shipper(primary, replica)
+        sh.pass_once()
+        assert replica.latest_revision(APP) == 45
+        replica.close()
+        replica2 = ReplicaEventStore(_store_cfg(tmp_path, "replica"))
+        assert _revs(replica2) == list(range(1, 46))
+
+    def test_tombstones_replicate(self, tmp_path):
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        primary.insert_batch([_ev(k) for k in range(10)], APP)
+        victim = primary.find_since(APP, 0)[3]
+        sh = _shipper(primary, replica)
+        sh.pass_once()
+        primary.delete(victim.event_id, APP)
+        sh.pass_once()
+        ids = [e.event_id for e in replica.find_since(APP, 0)]
+        assert victim.event_id not in ids
+        assert len(ids) == 9
+
+
+# ---------------------------------------------------------------------------
+# fencing, promotion, election
+# ---------------------------------------------------------------------------
+
+
+class TestFencingAndPromotion:
+    def test_stale_epoch_is_fenced_and_newer_adopted_durably(
+        self, tmp_path
+    ):
+        replica = _replica(tmp_path)
+        replica.replication_apply_wal(APP, None, 3, 0, [1], [_row(1)], 1)
+        assert replica.epoch == 3
+        with pytest.raises(StorageError, match="fenced"):
+            replica.replication_apply_wal(
+                APP, None, 2, 1, [2], [["x"] * 11], 2
+            )
+        replica.close()
+        replica2 = ReplicaEventStore(_store_cfg(tmp_path, "replica"))
+        assert replica2.epoch == 3  # adoption survived restart
+
+    def test_replica_is_read_only_until_promoted(self, tmp_path):
+        replica = _replica(tmp_path)
+        with pytest.raises(StorageError, match="read-only"):
+            replica.insert_batch([_ev(1)], APP)
+        with pytest.raises(StorageError, match="read-only"):
+            replica.delete_batch(["nope"], APP)
+        replica.promote(5)
+        replica.insert_batch([_ev(1)], APP)
+        assert replica.latest_revision(APP) == 1
+        # a promoted store rejects replication frames
+        with pytest.raises(StorageError, match="promoted"):
+            replica.replication_apply_wal(
+                APP, None, 6, 1, [2], [["x"] * 11], 2
+            )
+
+    def test_stale_promotion_raises_and_role_survives_restart(
+        self, tmp_path
+    ):
+        replica = _replica(tmp_path)
+        replica.replication_apply_wal(APP, None, 4, 0, [1], [_row(1)], 1)
+        with pytest.raises(StorageError, match="stale promotion"):
+            replica.promote(4)  # a zombie's claim at the observed epoch
+        replica.promote(5)
+        replica.close()
+        replica2 = ReplicaEventStore(_store_cfg(tmp_path, "replica"))
+        assert replica2.role == "primary" and replica2.epoch == 5
+        replica2.insert_batch([_ev(2)], APP)
+
+    def test_cas_election_first_bid_wins(self):
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+        
+
+        records = LifecycleRecordStore(_mem_storage())
+        el_a = CasElection(records, "events-primary")
+        el_b = CasElection(records, "events-primary")
+        assert el_a.claim("node-a") == 1
+        assert el_a.state().leader == "node-a"
+        # a bid already landed for generation 2 — the late bidder loses
+        records.append(
+            "pio_election_bid", "events-primary",
+            {"generation": 2, "claim_token": "other", "candidate": "x",
+             "bid_at": 0.0},
+        )
+        assert el_b.claim("node-b") is None
+        assert el_b.claim("node-b", generation=3) == 3
+        assert el_b.state() == el_a.state()
+        assert el_a.state().generation == 3
+        assert records.events("pio_election_bid", "events-primary")
+        el_a.gc_bids()
+        assert not records.events("pio_election_bid", "events-primary")
+
+    def test_elect_and_promote_catch_up_gate(self, tmp_path):
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+        
+
+        primary = _primary(tmp_path)
+        ahead = _replica(tmp_path)
+        behind = ReplicaEventStore(
+            _store_cfg(tmp_path, "replica-behind")
+        )
+        behind.init_app(APP)
+        primary.insert_batch([_ev(k) for k in range(8)], APP)
+        _shipper(primary, ahead).pass_once()
+        t = primary.ship_tail_after(APP, None, 0, 4)
+        behind.replication_apply_wal(
+            APP, None, 1, 0, list(t["revs"][:4]),
+            json.loads(json.dumps(t["rows"][:4], default=str)), 4,
+        )
+        assert ahead.latest_revision(APP) == 8
+        assert behind.latest_revision(APP) == 4
+        records = LifecycleRecordStore(_mem_storage())
+        # the lagging follower withdraws: a reachable peer is ahead
+        assert elect_and_promote(
+            records, behind, "behind", peers=[_DirectLink(ahead)]
+        ) is None
+        assert behind.role == "replica"
+        # the caught-up follower wins and its epoch out-numbers the
+        # primary's frame epoch even though no election minted epoch 1
+        gen = elect_and_promote(
+            records, ahead, "ahead", peers=[_DirectLink(behind)]
+        )
+        assert gen == 2
+        assert ahead.role == "primary" and ahead.epoch == 2
+        # the promoted store serves writes immediately
+        ahead.insert_batch([_ev(99, u="post-failover")], APP)
+        assert ahead.latest_revision(APP) == 9
+
+
+# ---------------------------------------------------------------------------
+# read-side: lag, read-your-writes, consumer routing
+# ---------------------------------------------------------------------------
+
+
+class TestReadSide:
+    def test_lag_watermark_and_wait_for_revision(self, tmp_path):
+        primary = _primary(tmp_path)
+        replica = _replica(tmp_path)
+        primary.insert_batch([_ev(k) for k in range(5)], APP)
+        t = primary.ship_tail_after(APP, None, 0, 3)
+        replica.replication_apply_wal(
+            APP, None, 1, 0, list(t["revs"][:3]),
+            json.loads(json.dumps(t["rows"][:3], default=str)), 5,
+        )
+        lag = replica.replication_lag(APP)
+        assert lag == {
+            "watermark": 3, "head": 5, "lag": 2, "epoch": 1,
+            "role": "replica",
+        }
+        assert replica.wait_for_revision(APP, 3, timeout_s=0.1)
+        assert not replica.wait_for_revision(APP, 5, timeout_s=0.1)
+        _shipper(primary, replica).pass_once()
+        assert replica.wait_for_revision(APP, 5, timeout_s=0.1)
+        assert replica.replication_lag(APP)["lag"] == 0
+
+    def test_replica_read_storage_routes_reads_not_writes(self, tmp_path):
+        
+
+        control = _mem_storage()
+        control.get_events().init_app(APP)
+        control.get_events().init_app(APP + 1)
+        replica = _replica(tmp_path)
+        replica.replication_apply_wal(
+            APP, None, 1, 0, [1, 2, 3], [_row(k) for k in range(3)], 3
+        )
+        view = ReplicaReadStorage(control, replica, [APP])
+        ev = view.get_events()
+        # replicated app reads hit the replica
+        assert [e.revision for e in ev.find_since(APP, 0)] == [1, 2, 3]
+        assert ev.latest_revision(APP) == 3
+        # writes go to control (the replica would raise read-only)
+        ev.insert_batch([_ev(1)], APP + 1)
+        assert ev.latest_revision(APP + 1) == 1
+        assert control.get_events().latest_revision(APP + 1) == 1
+        # non-replicated app reads hit control
+        assert [e.revision for e in ev.find_since(APP + 1, 0)] == [1]
+        # single revision stream (replica revisions ARE primary
+        # revisions), and lifecycle/meta DAOs pass through to control
+        assert [k for k, _s, _sh in ev.revision_streams()] == ["0"]
+        assert view.get_meta_data_apps() is control.get_meta_data_apps()
+        assert ev.replication_lag(APP)["watermark"] == 3
+
+
+# ---------------------------------------------------------------------------
+# real daemon transport
+# ---------------------------------------------------------------------------
+
+
+class TestRemoteTransport:
+    def test_ship_over_storage_daemon(self, tmp_path):
+        from predictionio_tpu.data.api.storage_server import StorageServer
+        from predictionio_tpu.data.storage.registry import (
+            SourceConfig,
+            Storage,
+            StorageConfig,
+        )
+
+        follower_storage = Storage(StorageConfig(
+            sources={
+                "REP": SourceConfig("REP", "segmentfs-replica", {
+                    "PATH": str(tmp_path / "replica"),
+                    "SEAL_INTERVAL_S": "3600",
+                }),
+                "M": SourceConfig("M", "memory", {}),
+            },
+            repositories={
+                "METADATA": "M", "EVENTDATA": "REP", "MODELDATA": "M",
+            },
+        ))
+        daemon = StorageServer(
+            follower_storage, host="127.0.0.1", port=0
+        ).start()
+        try:
+            replica = follower_storage.get_events()
+            assert isinstance(replica, ReplicaEventStore)
+            replica.init_app(APP)
+            primary = _primary(tmp_path)
+            primary.insert_batch([_ev(k) for k in range(60)], APP)
+            primary.seal(APP)
+            primary.insert_batch(
+                [_ev(k, u=f"t{k}") for k in range(4)], APP
+            )
+            cfg = ReplicationConfig(
+                followers=(f"127.0.0.1:{daemon.port}",), timeout_s=10.0
+            )
+            sh = SegmentShipper(
+                primary, cfg, epoch=1, metrics=MetricsRegistry()
+            )
+            assert isinstance(sh.links[0], FollowerLink)
+            sh.pass_once()
+            assert _revs(replica) == _revs(primary)
+            # the remote client surface consumers use
+            from predictionio_tpu.data.storage.remote import (
+                RemoteEventStore,
+            )
+
+            remote = RemoteEventStore({
+                "HOST": "127.0.0.1", "PORT": str(daemon.port),
+            })
+            lag = remote.replication_lag(APP)
+            assert lag["lag"] == 0 and lag["watermark"] == 64
+            assert remote.wait_for_revision(APP, 64, timeout_s=1.0)
+            status = remote.replication_status()
+            assert status["role"] == "replica"
+            assert str(APP) in status["namespaces"]
+        finally:
+            daemon.shutdown()
