@@ -3286,6 +3286,206 @@ def bench_replication():
     return out
 
 
+def bench_eval_fleet():
+    """ISSUE 20 (BENCH_r14): fleet-scale evaluation & auto-tuning.
+
+    - fleet fan-out vs sequential: the same grid-compatible param space
+      through `pio eval run` machinery (EvalDriver fan-out → per-fold
+      shard jobs on a 2-worker fleet → durable partial records → fold)
+      against the sequential MetricEvaluator on identical splits; the
+      ratio must stay > 1 (fan-out beats one process) or the fleet is
+      pure overhead,
+    - grid-kernel grouping: batch_eval over the compatible group (ONE
+      train_grid program per fold) vs the solo per-point path, plus the
+      one-program assertion (every prediction stamped with the full
+      group size — the compile-cache evidence that N points shared one
+      device program),
+    - records-fold overhead: a full `pio eval status` recompute (job
+      states + per-point partial fold) on the finished run.
+
+    The engine's train cost is a calibrated sleep (sample_engine grid
+    engine): the bench measures ORCHESTRATION — fan-out, claim, shard,
+    record, fold — not kernel arithmetic, which BENCH_r01..r08 cover.
+    """
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    tests_dir = os.path.join(repo_dir, "tests")
+    if tests_dir not in _sys.path:
+        _sys.path.insert(0, tests_dir)
+    import sample_engine
+    from predictionio_tpu.controller.evaluation import MetricEvaluator
+    from predictionio_tpu.core.base import RuntimeContext, WorkflowParams
+    from predictionio_tpu.data.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.deploy.scheduler import SchedulerConfig
+    from predictionio_tpu.evalfleet import (
+        EvalDriver, EvalDriverConfig, EvalSpec, expand_points,
+    )
+    from predictionio_tpu.evalfleet.specs import ParamAxis
+    from predictionio_tpu.fleet.coordinator import FleetConfig, FleetMember
+
+    folds = 2 if SMALL else 4
+    points = 6 if SMALL else 8
+    train_cost_s = 0.4 if SMALL else 1.0
+    weights = [round(0.05 + 0.08 * i, 3) for i in range(points)]
+
+    def _variant(cost):
+        return {
+            "id": "bench-grid",
+            "engineFactory": "sample_engine.GridEngineFactory",
+            "datasource": {"params": {"folds": folds, "queries": 8}},
+            "preparator": {"params": {"id": 1}},
+            "algorithms": [{
+                "name": "grid",
+                "params": {"weight": 0.0, "train_cost_s": cost},
+            }],
+            "serving": {},
+        }
+
+    spec = EvalSpec(
+        variant=_variant(train_cost_s),
+        axes=[ParamAxis("algorithms.0.params.weight", weights)],
+        metric={"class": "sample_engine.GridScore"},
+        folds=folds,
+    )
+    out: dict = {}
+    tmp = tempfile.mkdtemp(prefix="bench-evalfleet-")
+    members = []
+    try:
+        storage = Storage(StorageConfig(
+            sources={
+                "SQL": SourceConfig(
+                    "SQL", "sqlite", {"PATH": os.path.join(tmp, "pio.db")}
+                ),
+                "FS": SourceConfig("FS", "localfs", {"PATH": tmp}),
+            },
+            repositories={
+                "METADATA": "SQL", "EVENTDATA": "SQL", "MODELDATA": "FS",
+            },
+        ))
+        engine = sample_engine.GridEngineFactory().apply()
+        ctx = RuntimeContext(storage=storage, mesh=None, mode="eval")
+
+        # sequential reference: the single-process MetricEvaluator over
+        # the same splits (grid-batched, folds x one train_grid program)
+        eps = [engine.params_from_variant_json(p)
+               for p in expand_points(spec)]
+        t0 = time.perf_counter()
+        eval_data = engine.batch_eval(ctx, eps)
+        seq_result = MetricEvaluator(sample_engine.GridScore()).evaluate(
+            ctx, None, eval_data, WorkflowParams()
+        )
+        seq_wall = time.perf_counter() - t0
+        # one-program evidence: every prediction of every point carries
+        # the FULL group size — N points shared one compiled program per
+        # fold (a per-point fallback would stamp 1)
+        sizes = {
+            p.grid_size
+            for _ep, data in eval_data
+            for _info, qpas in data
+            for _q, p, _a in qpas
+        }
+        out["evalfleet_grid_one_program"] = int(sizes == {len(eps)})
+
+        # grid-group speedup: the compatible group as one train_grid
+        # program vs the solo per-point path, on one fold, at a lighter
+        # calibrated cost so the A/B stays bench-sized
+        cheap = [
+            engine.params_from_variant_json(p)
+            for p in expand_points(EvalSpec(
+                variant=_variant(0.15),
+                axes=[ParamAxis("algorithms.0.params.weight", weights)],
+                metric={"class": "sample_engine.GridScore"},
+                folds=folds,
+            ))
+        ]
+        t0 = time.perf_counter()
+        engine.batch_eval(ctx, cheap, fold_indices=[0])
+        grid_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for ep in cheap:
+            engine.eval(ctx, ep, fold_indices=[0])
+        solo_wall = time.perf_counter() - t0
+        out["evalfleet_grid_group_speedup"] = round(
+            solo_wall / max(grid_wall, 1e-9), 2
+        )
+
+        # the fleet: 2 workers x 2 slots CAS-claiming per-fold shards
+        for i in range(2):
+            member = FleetMember(
+                storage,
+                scheduler_config=SchedulerConfig(
+                    poll_interval_s=0.05,
+                    heartbeat_interval_s=0.2,
+                    stale_after_s=10.0,
+                    max_concurrent=2,
+                    log_dir=os.path.join(tmp, f"w{i}-logs"),
+                    child_env={
+                        "PYTHONPATH": os.pathsep.join(
+                            [repo_dir, tests_dir]
+                        ),
+                        "JAX_PLATFORMS": "cpu",
+                    },
+                ),
+                fleet_config=FleetConfig(
+                    heartbeat_interval_s=0.2, adaptive_settle=False
+                ),
+            )
+            member.start()
+            members.append(member)
+        driver = EvalDriver(
+            storage, EvalDriverConfig(poll_interval_s=0.1)
+        )
+        t0 = time.perf_counter()
+        run = driver.submit(spec)
+        run = driver.wait(run.id, timeout_s=600)
+        fleet_wall = time.perf_counter() - t0
+        assert run.status == "completed", run.last_error
+        assert run.winner_index == seq_result.best_index
+        fleet_scores = driver.scores(run)
+        for got, ref in zip(fleet_scores, seq_result.engine_params_scores):
+            assert abs(got["score"] - ref.score) < 1e-5
+
+        out["evalfleet_fleet_wall_s"] = round(fleet_wall, 3)
+        out["evalfleet_sequential_wall_s"] = round(seq_wall, 3)
+        out["evalfleet_fleet_vs_sequential"] = round(
+            seq_wall / max(fleet_wall, 1e-9), 2
+        )
+
+        # records-fold overhead: one full status recompute (durable
+        # records + job states folded into the live view)
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            driver.status(run.id)
+            times.append(time.perf_counter() - t0)
+        out["evalfleet_records_fold_ms"] = round(
+            float(np.percentile(times, 50)) * 1e3, 3
+        )
+        out["evalfleet_points"] = points
+        out["evalfleet_folds"] = folds
+        out["evalfleet_shards"] = len(run.shards)
+        out["evalfleet_workers"] = len(members)
+    finally:
+        for member in members:
+            member.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    out["host_cpus"] = os.cpu_count()
+    out["note"] = (
+        f"{points}-point grid x {folds} folds, calibrated "
+        f"{train_cost_s}s train program; fleet = 2 workers x 2 slots on "
+        "shared sqlite, per-fold shard jobs, durable partial records; "
+        "sequential = in-process MetricEvaluator on identical splits; "
+        "group speedup = one train_grid program vs per-point training "
+        "at 0.15s cost on one fold"
+    )
+    return out
+
+
 def main():
     rows, cols, vals = make_data()
     tpu = bench_tpu(rows, cols, vals)
@@ -3598,5 +3798,10 @@ if __name__ == "__main__":
         # store — acked ingest under min_acks=1, cold-follower
         # catch-up throughput, and promotion-to-first-serve
         print(json.dumps(bench_replication()))
+    elif "--eval" in _sys.argv:
+        # focused ISSUE-20 emission (BENCH_r14): fleet evaluation —
+        # fan-out vs sequential MetricEvaluator, grid-group one-program
+        # speedup, and the records-fold status overhead
+        print(json.dumps(bench_eval_fleet()))
     else:
         main()

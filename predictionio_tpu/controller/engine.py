@@ -55,6 +55,23 @@ class EngineParams:
         return replace(self, **kw)
 
 
+def _select_folds(eval_sets, fold_indices: Optional[Sequence[int]]):
+    """Restrict eval sets to the requested fold indices (fleet eval
+    shards, ISSUE 20); identity when unset. Out-of-range folds are a
+    spec error, not a silent empty evaluation."""
+    if fold_indices is None:
+        return eval_sets
+    sets = list(eval_sets)
+    want = sorted({int(i) for i in fold_indices})
+    bad = [i for i in want if i < 0 or i >= len(sets)]
+    if bad:
+        raise ValueError(
+            f"fold_indices {bad} out of range: datasource yields "
+            f"{len(sets)} eval set(s)"
+        )
+    return [sets[i] for i in want]
+
+
 def _as_classmap(cm: ClassMap) -> Mapping[str, type]:
     if isinstance(cm, Mapping):
         return cm
@@ -290,12 +307,13 @@ class Engine(BaseEngine):
         self,
         ctx: RuntimeContext,
         engine_params: EngineParams,
+        fold_indices: Optional[Sequence[int]] = None,
     ) -> list[Any]:
         data_source = self.make_data_source(engine_params)
         preparator = self.make_preparator(engine_params)
         algorithms = self.make_algorithms(engine_params)
         serving = self.make_serving(engine_params)
-        eval_sets = data_source.read_eval(ctx)
+        eval_sets = _select_folds(data_source.read_eval(ctx), fold_indices)
         results = []
         for td, ei, qa in eval_sets:
             pd = preparator.prepare(ctx, td)
@@ -323,11 +341,12 @@ class Engine(BaseEngine):
         self,
         ctx: RuntimeContext,
         engine_params_list,
+        fold_indices: Optional[Sequence[int]] = None,
     ):
         eps = list(engine_params_list)
         if self._grid_batchable(ctx, eps):
-            return self._batch_eval_grid(ctx, eps)
-        return super().batch_eval(ctx, eps)
+            return self._batch_eval_grid(ctx, eps, fold_indices=fold_indices)
+        return super().batch_eval(ctx, eps, fold_indices=fold_indices)
 
     def _grid_batchable(self, ctx: RuntimeContext, eps: list) -> bool:
         """True when the grid varies ONLY in a single algorithm's
@@ -359,14 +378,21 @@ class Engine(BaseEngine):
         key0 = shared_key(eps[0])
         return all(shared_key(ep) == key0 for ep in eps[1:])
 
-    def _batch_eval_grid(self, ctx: RuntimeContext, eps: list):
+    def _batch_eval_grid(
+        self,
+        ctx: RuntimeContext,
+        eps: list,
+        fold_indices: Optional[Sequence[int]] = None,
+    ):
         ep0 = eps[0]
         data_source = self.make_data_source(ep0)
         preparator = self.make_preparator(ep0)
         serving = self.make_serving(ep0)
         algos = [self.make_algorithms(ep)[0] for ep in eps]
         params_list = [ep.algorithm_params_list[0][1] for ep in eps]
-        eval_sets = list(data_source.read_eval(ctx))  # may be a generator
+        eval_sets = _select_folds(
+            list(data_source.read_eval(ctx)), fold_indices  # may be a generator
+        )
         per_ep: list[list] = [[] for _ in eps]
         for td, ei, qa in eval_sets:
             pd = preparator.prepare(ctx, td)

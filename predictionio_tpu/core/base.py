@@ -276,11 +276,20 @@ class BaseEngine(Generic[EI, Q, P, A]):
         self,
         ctx: RuntimeContext,
         engine_params_list: Sequence[Any],
+        fold_indices: Optional[Sequence[int]] = None,
     ) -> list[tuple[Any, list[tuple[EI, list[tuple[Q, P, A]]]]]]:
         """Default: map `eval` over the params grid (reference
         BaseEngine.batchEval:81). FastEvalEngine overrides with prefix
-        memoization."""
-        return [(ep, self.eval(ctx, ep)) for ep in engine_params_list]
+        memoization. `fold_indices` restricts the evaluation to a subset
+        of the datasource's eval sets (fleet eval shards, ISSUE 20) —
+        only forwarded when set, so eval() overrides without the
+        parameter keep working on the full-run path."""
+        if fold_indices is None:
+            return [(ep, self.eval(ctx, ep)) for ep in engine_params_list]
+        return [
+            (ep, self.eval(ctx, ep, fold_indices=fold_indices))
+            for ep in engine_params_list
+        ]
 
     def params_from_variant_json(self, variant: dict) -> Any:
         raise NotImplementedError

@@ -178,6 +178,9 @@ class EventValidation:
             "pio_query_replica",
             # replication primary-election records (ISSUE 19)
             "pio_election", "pio_election_bid",
+            # fleet evaluation & tuning records (ISSUE 20)
+            "pio_eval_run", "pio_eval_result", "pio_retrain_preset",
+            "pio_settle_probe",
         }
     )
 

@@ -375,6 +375,28 @@ class RolloutController:
             self.server.complete_rollout(self, promote=False)
             raise
         self.st.state = "canary"
+        # offline prior (ISSUE 20): when both candidate and live carry
+        # lineage-linked eval-run scores and the candidate's OFFLINE
+        # metric is worse, stretch the bake window — the online verdict
+        # gets more evidence before promoting a model the fleet eval
+        # already ranked below live. Never blocking, never a veto.
+        try:
+            from predictionio_tpu.evalfleet.tuning import (
+                offline_prior_multiplier,
+            )
+
+            live = self.registry.live_version(
+                self.st.version.engine_id, self.st.version.engine_variant
+            )
+            mult, why = offline_prior_multiplier(
+                self.server.storage, self.st.version.engine_id,
+                self.st.version.id, live.id if live is not None else None,
+            )
+            if mult > 1.0:
+                self.config.bake_s *= mult
+                log.info("%s; bake now %.0fs", why, self.config.bake_s)
+        except Exception:
+            log.debug("offline prior unavailable", exc_info=True)
         now_wall = time.time()
         if (
             resume_started_wall is not None

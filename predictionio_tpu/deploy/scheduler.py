@@ -82,6 +82,13 @@ JOB_STATUSES = ("queued", "running", "completed", "failed")
 EXIT_TRAIN_FAILED = 3
 EXIT_INFRA_FAILED = 4
 
+# job kind → subprocess entry module (ISSUE 20): eval shards ride the
+# same queue/claim/heartbeat machinery but run a different workload
+WORKER_MODULES = {
+    "train": "predictionio_tpu.deploy.worker",
+    "eval": "predictionio_tpu.evalfleet.worker",
+}
+
 
 def storage_config_to_json(config: StorageConfig) -> dict:
     """StorageConfig → JSON round-trip so the train subprocess opens the
@@ -141,6 +148,11 @@ class TrainJob:
     # owner's claim and fences its heartbeats/terminal writes
     generation: int = 0
     claim_token: Optional[str] = None
+    # job kind (ISSUE 20): "train" jobs keep the per-engine serialization
+    # and spawn deploy/worker; "eval" shards parallelize freely and spawn
+    # evalfleet/worker. `tenant` scopes periodic-retrain preset lookups.
+    kind: str = "train"
+    tenant: Optional[str] = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -156,6 +168,7 @@ class TrainJob:
             "log_path": self.log_path, "worker_id": self.worker_id,
             "generation": self.generation,
             "claim_token": self.claim_token,
+            "kind": self.kind, "tenant": self.tenant,
         }
 
     @staticmethod
@@ -169,7 +182,7 @@ class TrainJob:
             "finished_at", "heartbeat_at", "attempt", "max_attempts",
             "timeout_s", "period_s", "last_error", "instance_id",
             "model_version", "log_path", "worker_id", "generation",
-            "claim_token",
+            "claim_token", "kind", "tenant",
         ):
             if d.get(k) is not None:
                 setattr(job, k, d[k])
@@ -194,10 +207,16 @@ class JobQueue:
         max_attempts: int = 3,
         not_before: float = 0.0,
         attempt: int = 0,
+        kind: str = "train",
+        tenant: Optional[str] = None,
     ) -> TrainJob:
         for key in ("id", "engineFactory"):
             if key not in variant:
                 raise ValueError(f"engine variant is missing {key!r}")
+        if kind not in WORKER_MODULES:
+            raise ValueError(
+                f"unknown job kind {kind!r} (known: {sorted(WORKER_MODULES)})"
+            )
 
         # validate numerics AT SUBMIT: a string timeout_s stored raw
         # would 201 now and wedge the job at claim time (TypeError mid-
@@ -223,6 +242,8 @@ class JobQueue:
             period_s=_num("period_s", period_s),
             max_attempts=max(1, int(max_attempts)),
             attempt=attempt,
+            kind=kind,
+            tenant=tenant,
         )
         self._store.append(JOB_ENTITY, job.id, job.to_dict())
         return job
@@ -724,17 +745,22 @@ class TrainScheduler:
         per-engine serialization allow it. Claims are capped at
         max_concurrent so a burst of submissions doesn't pile jobs into
         a `running`-but-not-started limbo behind the pool queue."""
+        # eval shards (ISSUE 20) skip the per-engine serialization — the
+        # whole point of the fan-out is same-engine shards in parallel
+        engine_key = job.engine_id if job.kind == "train" else None
         with self._claim_lock:
             if (
                 len(self._running_ids) >= max(
                     1, int(self.config.max_concurrent)
                 )
                 or job.id in self._running_ids
-                or job.engine_id in self._running_engines
+                or (engine_key is not None
+                    and engine_key in self._running_engines)
             ):
                 return False
             self._running_ids.add(job.id)
-            self._running_engines.add(job.engine_id)
+            if engine_key is not None:
+                self._running_engines.add(engine_key)
 
         def run() -> None:
             try:
@@ -747,7 +773,8 @@ class TrainScheduler:
             finally:
                 with self._claim_lock:
                     self._running_ids.discard(job.id)
-                    self._running_engines.discard(job.engine_id)
+                    if engine_key is not None:
+                        self._running_engines.discard(engine_key)
 
         pool = self._pool
         if pool is None:
@@ -759,7 +786,8 @@ class TrainScheduler:
         except RuntimeError:  # pool already shut down (stop raced)
             with self._claim_lock:
                 self._running_ids.discard(job.id)
-                self._running_engines.discard(job.engine_id)
+                if engine_key is not None:
+                    self._running_engines.discard(engine_key)
             return False
         return True
 
@@ -782,8 +810,9 @@ class TrainScheduler:
         # seniority check below still closes the claim/claim race this
         # read can't see.
         try:
-            if any(
+            if job.kind == "train" and any(
                 j.engine_id == job.engine_id and j.id != job.id
+                and j.kind == "train"
                 for j in self.queue.list(status="running")
             ):
                 return  # re-polled next cycle; nothing written
@@ -827,7 +856,8 @@ class TrainScheduler:
             rivals = [
                 j for j in self.queue.list(status="running")
                 if j.engine_id == job.engine_id and j.id != job.id
-            ]
+                and j.kind == "train"
+            ] if job.kind == "train" else []
         except Exception:
             rivals = []  # storage blip: the in-process guard still holds
         if rivals:
@@ -901,9 +931,11 @@ class TrainScheduler:
                     f"--- attempt {job.attempt} ({_now_iso()}) ---\n".encode()
                 )
                 logf.flush()
+                worker_module = WORKER_MODULES.get(
+                    job.kind, WORKER_MODULES["train"]
+                )
                 child = subprocess.Popen(
-                    [sys.executable, "-m", "predictionio_tpu.deploy.worker",
-                     spec_path],
+                    [sys.executable, "-m", worker_module, spec_path],
                     stdout=logf, stderr=subprocess.STDOUT, env=env,
                 )
             with self._child_lock:
@@ -1018,6 +1050,7 @@ class TrainScheduler:
                 last_error=None, claim_token=None,
             )
             self._jobs_counter.inc(outcome="completed")
+            self._link_eval_run(job, result)
             self._schedule_next_period(job)
         elif rc == EXIT_TRAIN_FAILED:
             # deterministic failure: retrying reproduces it — fail fast
@@ -1063,17 +1096,52 @@ class TrainScheduler:
             job.id, error, job.attempt, job.max_attempts, backoff,
         )
 
+    def _link_eval_run(self, job: TrainJob, result: dict) -> None:
+        """Lineage stamp (ISSUE 20): a completed retrain whose variant
+        carries an `evalRun` marker (the tuning loop's preset merge put
+        it there) links the trained ModelVersion back onto the eval run
+        — the winning params now point at the model they produced.
+        Best-effort: lineage must never fail a completed train."""
+        run_id = (job.variant or {}).get("evalRun")
+        version = result.get("model_version")
+        if not run_id or not version:
+            return
+        try:
+            from predictionio_tpu.evalfleet.records import EvalRecordStore
+
+            EvalRecordStore(self.storage).link_model_version(
+                run_id, version, job_id=job.id
+            )
+            log.info("job %s: linked model version %s to eval run %s",
+                     job.id, version, run_id)
+        except Exception:
+            log.debug("eval-run lineage stamp failed", exc_info=True)
+
     def _schedule_next_period(self, job: TrainJob) -> None:
         """Cron-style periodic retrain: a finished periodic job enqueues
         its next run (fixed-delay schedule — the next run starts
         `period_s` after this one ENDED, so a slow train can't stack)."""
         if not job.period_s:
             return
+        variant = job.variant
+        if job.kind == "train":
+            # tuning loop (ISSUE 20): overlay the parked eval winner (the
+            # job's tenant-scoped preset wins over the global one) so the
+            # NEXT scheduled retrain trains the winning params
+            try:
+                from predictionio_tpu.evalfleet.tuning import apply_preset
+
+                variant = apply_preset(
+                    self.storage, variant, job.engine_id, tenant=job.tenant
+                )
+            except Exception:
+                log.debug("retrain preset lookup failed", exc_info=True)
         nxt = self.queue.submit(
-            job.variant, engine_id=job.engine_id,
+            variant, engine_id=job.engine_id,
             timeout_s=job.timeout_s, period_s=job.period_s,
             max_attempts=job.max_attempts,
             not_before=time.time() + job.period_s,
+            kind=job.kind, tenant=job.tenant,
         )
         log.info(
             "periodic retrain: job %s scheduled %.0fs after %s finished",

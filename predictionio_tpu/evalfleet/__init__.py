@@ -1,0 +1,56 @@
+"""Fleet-scale evaluation & auto-tuning (ISSUE 20) — the paper's E2
+layer as a fleet workload, not a script.
+
+- specs:   declarative param-space DSL + metric specs + combinable partials
+- records: durable EvalRun/EvalResult family (exactly-once by record shape)
+- driver:  shard fan-out on the JobQueue, live status, straggler re-dispatch
+- worker:  the eval-shard subprocess entry (spawned by the scheduler)
+- tuning:  winner → retrain preset → periodic retrain; canary offline prior
+
+Import-leak contract: importing this package must not import jax — the
+driver/records layers run on coordinator hosts (CI enforces this).
+"""
+
+from predictionio_tpu.evalfleet.driver import EvalDriver, EvalDriverConfig
+from predictionio_tpu.evalfleet.records import EvalRecordStore, EvalRun
+from predictionio_tpu.evalfleet.specs import (
+    EvalSpec,
+    HeldOutRMSE,
+    MAPAtK,
+    NDCGAtK,
+    ParamAxis,
+    PrecisionAtK,
+    expand_points,
+    group_points,
+    resolve_metric,
+)
+from predictionio_tpu.evalfleet.tuning import (
+    PresetStore,
+    RetrainPreset,
+    apply_preset,
+    offline_prior_multiplier,
+    park_winner,
+    tune,
+)
+
+__all__ = [
+    "EvalDriver",
+    "EvalDriverConfig",
+    "EvalRecordStore",
+    "EvalRun",
+    "EvalSpec",
+    "HeldOutRMSE",
+    "MAPAtK",
+    "NDCGAtK",
+    "ParamAxis",
+    "PrecisionAtK",
+    "PresetStore",
+    "RetrainPreset",
+    "apply_preset",
+    "expand_points",
+    "group_points",
+    "offline_prior_multiplier",
+    "park_winner",
+    "resolve_metric",
+    "tune",
+]

@@ -1,0 +1,125 @@
+"""Eval-shard subprocess entry (`python -m predictionio_tpu.evalfleet.worker`).
+
+The scheduler spawns this module for `kind="eval"` jobs exactly as it
+spawns deploy/worker for train jobs: same spec file (storage wiring +
+variant + result path), same exit-code retry contract. The variant
+carries an `evalShard` payload (written by evalfleet/driver.py): which
+points of which run, which fold, which metrics.
+
+In here the shard is plain: build the engine, materialize one
+EngineParams per point, run the grid through `Engine.batch_eval` (the
+grid-compatible group trains as ONE device program per fold via
+train_grid), reduce each point's (Q,P,A) tuples to combinable metric
+partials, and write them to the durable EvalResult records.
+
+Crash-safety is free: result entity ids are deterministic and fold
+fields idempotent (evalfleet/records.py), so a kill -9 here just means
+the re-claimed shard rewrites the same fields.
+
+Exit codes (the scheduler's retry contract):
+- 0                  — partials recorded
+- EXIT_TRAIN_FAILED  — the eval itself raised (deterministic fail-fast)
+- anything else      — infra trouble; the scheduler re-queues with backoff
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import traceback
+
+
+def main(argv: list[str]) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    if len(argv) != 2:
+        print("usage: python -m predictionio_tpu.evalfleet.worker <spec.json>",
+              file=sys.stderr)
+        return 2
+    from predictionio_tpu.controller.engine import resolve_engine
+    from predictionio_tpu.controller.params import load_symbol
+    from predictionio_tpu.core.base import RuntimeContext, WorkflowParams
+    from predictionio_tpu.data.storage.base import StorageError
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.deploy.scheduler import (
+        EXIT_INFRA_FAILED,
+        EXIT_TRAIN_FAILED,
+        storage_config_from_json,
+    )
+    from predictionio_tpu.evalfleet.records import EvalRecordStore
+    from predictionio_tpu.evalfleet.specs import metric_partial, resolve_metric
+
+    with open(argv[1]) as f:
+        spec = json.load(f)
+    try:
+        storage = Storage(storage_config_from_json(spec["storage"]))
+    except Exception:
+        traceback.print_exc()
+        return EXIT_INFRA_FAILED
+
+    variant = spec["variant"]
+    shard = variant.get("evalShard")
+    if not shard:
+        print("spec variant carries no evalShard payload", file=sys.stderr)
+        return EXIT_TRAIN_FAILED
+
+    try:
+        engine = resolve_engine(load_symbol(variant["engineFactory"]))
+        base = {k: v for k, v in variant.items() if k != "evalShard"}
+        eps = []
+        for frag in shard["points"]:
+            eps.append(engine.params_from_variant_json({**base, **frag}))
+        fold = shard.get("fold")
+        ctx = RuntimeContext(storage=storage, mesh=None, mode="eval",
+                             workflow_params=WorkflowParams())
+        eval_data = engine.batch_eval(
+            ctx, eps,
+            fold_indices=None if fold is None else [int(fold)],
+        )
+        primary = resolve_metric(shard["metric"])
+        others = [resolve_metric(m) for m in shard.get("other_metrics", [])]
+    except StorageError:
+        traceback.print_exc()
+        return EXIT_INFRA_FAILED
+    except Exception:
+        traceback.print_exc()
+        return EXIT_TRAIN_FAILED
+
+    run_id = shard["run_id"]
+    try:
+        records = EvalRecordStore(storage)
+        for idx, (point_index, (_ep, data)) in enumerate(
+            zip(shard["point_indices"], eval_data)
+        ):
+            payload = {
+                "primary": metric_partial(primary, ctx, data),
+                "others": [
+                    {"header": m.header(), **metric_partial(m, ctx, data)}
+                    for m in others
+                ],
+                "job_id": spec.get("job_id"),
+            }
+            records.record_partial(
+                run_id, point_index, fold, payload,
+                params=shard["points"][idx],
+            )
+    except StorageError:
+        traceback.print_exc()
+        return EXIT_INFRA_FAILED
+    except Exception:
+        traceback.print_exc()
+        return EXIT_TRAIN_FAILED
+
+    with open(spec["result_path"], "w") as f:
+        json.dump({"run_id": run_id, "points": len(eps),
+                   "fold": fold}, f)
+    print(f"eval shard done: run {run_id}, {len(eps)} point(s), "
+          f"fold {'all' if fold is None else fold}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -41,7 +41,7 @@ from predictionio_tpu.deploy.scheduler import (
     TrainScheduler,
 )
 from predictionio_tpu.fleet.distributed import DistributedConfig
-from predictionio_tpu.utils.env import env_str
+from predictionio_tpu.utils.env import env_float, env_str
 
 log = logging.getLogger(__name__)
 
@@ -107,6 +107,46 @@ class FleetConfig:
     distributed: DistributedConfig = field(
         default_factory=DistributedConfig
     )
+    # adapt the CAS claim settle window from measured storage
+    # write-visibility skew at start() (ISSUE 20); PIO_CAS_SETTLE_S
+    # pins it instead when set
+    adaptive_settle: bool = True
+
+
+# safety factor on the measured same-process visibility latency: cross-
+# worker skew (other host's clock + commit pipeline) is what the settle
+# window really waits out, and we can only probe our own round trip
+SETTLE_SKEW_FACTOR = 4.0
+
+
+def measure_write_visibility_skew(
+    storage: Storage, probes: int = 3, timeout_s: float = 2.0
+) -> float:
+    """Worst observed append→visible latency of the record store,
+    measured with throwaway probe records (purged afterwards). This is
+    the floor of the skew a CAS claimant must out-wait before reading
+    the bid order; the settle window derives from it instead of a
+    guessed constant."""
+    store = LifecycleRecordStore(storage)
+    entity = f"probe-{uuid.uuid4().hex[:8]}"
+    worst = 0.0
+    try:
+        for i in range(max(1, probes)):
+            t0 = time.monotonic()
+            store.append("pio_settle_probe", entity, {"i": i})
+            while True:
+                if len(store.events("pio_settle_probe", entity)) > i:
+                    break
+                if time.monotonic() - t0 >= timeout_s:
+                    break
+                time.sleep(0.001)
+            worst = max(worst, time.monotonic() - t0)
+    finally:
+        try:
+            store.purge("pio_settle_probe", entity)
+        except Exception:
+            log.debug("settle probe cleanup failed", exc_info=True)
+    return worst
 
 
 class WorkerRegistry:
@@ -266,8 +306,45 @@ class FleetMember:
                 self._shipper.start()
         except Exception:
             log.debug("telemetry shipper unavailable", exc_info=True)
+        self._adapt_claim_settle()
         self.scheduler.resume_orphans()
         self.scheduler.start()
+
+    def _adapt_claim_settle(self) -> None:
+        """Derive the CAS claim settle window from MEASURED storage
+        write-visibility skew instead of a fixed constant (ISSUE 20):
+        eval fan-out multiplies concurrent claims, and a settle window
+        tuned for sqlite-on-localhost is wrong for a remote store. An
+        operator-pinned PIO_CAS_SETTLE_S wins; failures keep the
+        configured default (adaptation must never block a start)."""
+        pinned = env_str("PIO_CAS_SETTLE_S").strip()
+        if pinned:
+            try:
+                self.scheduler.config.claim_settle_s = float(pinned)
+                log.info("claim settle pinned: %.3fs (PIO_CAS_SETTLE_S)",
+                         self.scheduler.config.claim_settle_s)
+            except ValueError:
+                log.warning("PIO_CAS_SETTLE_S=%r is not a number; keeping "
+                            "%.3fs", pinned,
+                            self.scheduler.config.claim_settle_s)
+            return
+        if not self.config.adaptive_settle:
+            return
+        try:
+            skew = measure_write_visibility_skew(self.storage)
+        except Exception:
+            log.debug("settle skew probe failed; keeping %.3fs",
+                      self.scheduler.config.claim_settle_s, exc_info=True)
+            return
+        lo = env_float("PIO_CAS_SETTLE_MIN_S")
+        hi = env_float("PIO_CAS_SETTLE_MAX_S")
+        settle = min(max(SETTLE_SKEW_FACTOR * skew, lo), max(lo, hi))
+        log.info(
+            "claim settle adapted: measured visibility skew %.4fs -> "
+            "settle %.3fs (was %.3fs)", skew, settle,
+            self.scheduler.config.claim_settle_s,
+        )
+        self.scheduler.config.claim_settle_s = settle
 
     def stop(self, kill_child: bool = False) -> None:
         if self._shipper is not None:

@@ -91,6 +91,8 @@ class _Handler(JsonHandler):
                 self._get_models(parts)
             elif parts[:1] == ["tenants"]:
                 self._get_tenants(parts)
+            elif parts[:1] == ["evals"]:
+                self._get_evals(parts)
             elif path == "/rollout":
                 self._get_rollout()
             elif path == "/online":
@@ -201,6 +203,30 @@ class _Handler(JsonHandler):
             self._respond(200, data, "text/plain")
         else:
             raise HttpError(404, "Not Found")
+
+    # -- evaluation records (ISSUE 20) -------------------------------------
+    def _get_evals(self, parts: list[str]) -> None:
+        store = self.server.eval_records
+        if len(parts) == 1:
+            q = self._query_params()
+            self._respond(200, [
+                r.to_dict() for r in store.list_runs(
+                    engine_id=q.get("engine"), status=q.get("status"),
+                    tenant=q.get("tenant"),
+                )
+            ])
+            return
+        if len(parts) == 2:
+            from predictionio_tpu.evalfleet.driver import EvalDriver
+
+            try:
+                self._respond(
+                    200, EvalDriver(self.storage).status(parts[1])
+                )
+            except KeyError:
+                raise HttpError(404, f"no eval run {parts[1]!r}")
+            return
+        raise HttpError(404, "Not Found")
 
     def _post_job(self) -> None:
         obj = self._json_body()
@@ -427,6 +453,9 @@ class _Server(ThreadedServer):
         self.model_registry = ModelRegistry(storage)
         self.job_queue = JobQueue(storage)
         self.tenant_store = TenantStore(storage)
+        from predictionio_tpu.evalfleet.records import EvalRecordStore
+
+        self.eval_records = EvalRecordStore(storage)
         self.metrics = server_registry()
         self.metrics_label = "admin"
 

@@ -21,6 +21,8 @@ Commands:
 from __future__ import annotations
 
 import argparse
+import json as _json
+import os
 import sys
 from typing import Optional
 
@@ -265,25 +267,166 @@ def cmd_deploy(args) -> int:
     )
 
 
-def cmd_eval(args) -> int:
+def _run_legacy_evaluation(target: str, params_generator) -> int:
     from predictionio_tpu.controller.evaluation import Evaluation
     from predictionio_tpu.controller.params import load_symbol
     from predictionio_tpu.workflow.evaluation import run_evaluation
 
-    evaluation = load_symbol(args.evaluation)
+    evaluation = load_symbol(target)
     if isinstance(evaluation, type):
         evaluation = evaluation()
     if not isinstance(evaluation, Evaluation):
-        return _fail(f"{args.evaluation} is not an Evaluation")
+        return _fail(f"{target} is not an Evaluation")
     params_list = None
-    if args.params_generator:
-        gen = load_symbol(args.params_generator)
+    if params_generator:
+        gen = load_symbol(params_generator)
         if isinstance(gen, type):
             gen = gen()
         params_list = list(gen.engine_params_list)
     inst, result = run_evaluation(_storage(), evaluation, params_list)
     print(f"[INFO] Evaluation {inst.status}: {result.to_one_liner()}")
     return 0 if inst.status == "EVALCOMPLETED" else 1
+
+
+def _local_fleet(storage, n: int) -> list:
+    """Spin n in-process FleetMembers so `pio eval run` / `pio tune`
+    work without a standing fleet (each member supervises real shard
+    subprocesses)."""
+    from predictionio_tpu.fleet.coordinator import FleetMember
+
+    members = [FleetMember(storage) for _ in range(max(1, n))]
+    for m in members:
+        m.start()
+    return members
+
+
+def _print_eval_run(run: dict, points: list) -> None:
+    print(f"run        {run['id']}")
+    print(f"engine     {run.get('engine_id')}"
+          + (f"  tenant {run['tenant']}" if run.get("tenant") else ""))
+    print(f"status     {run.get('status')}")
+    print(f"metric     {run.get('metric_header')}"
+          f" ({'higher' if run.get('higher_is_better', True) else 'lower'}"
+          f" is better)")
+    if run.get("winner_index") is not None:
+        print(f"winner     point {run['winner_index']}"
+              f"  score {run.get('winner_score')}")
+    if run.get("winner_model_version"):
+        print(f"lineage    model version {run['winner_model_version']}")
+    if points:
+        print(f"{'POINT':>5s}  {'DONE':4s}  {'SCORE':>12s}  PARAMS")
+        for p in points:
+            score = "-" if p["score"] is None else f"{p['score']:.6g}"
+            mark = "yes" if p["complete"] else f"{len(p['folds_done'])}f"
+            print(f"{p['point_index']:>5d}  {mark:4s}  {score:>12s}  "
+                  f"{_json.dumps(p.get('params') or {})[:80]}")
+
+
+def cmd_eval(args) -> int:
+    action = getattr(args, "eval_action", None)
+    if action == "run":
+        if not os.path.isfile(args.target):
+            return _run_legacy_evaluation(args.target, args.params_generator)
+        from predictionio_tpu.evalfleet.driver import EvalDriver
+        from predictionio_tpu.evalfleet.specs import EvalSpec
+
+        storage = _storage()
+        try:
+            spec = EvalSpec.load(args.target)
+        except (OSError, ValueError, KeyError) as e:
+            return _fail(f"bad eval spec: {e}")
+        driver = EvalDriver(storage)
+        members = (
+            _local_fleet(storage, args.local_workers)
+            if args.local_workers else []
+        )
+        try:
+            run = driver.submit(spec, tenant=args.tenant)
+            print(f"[INFO] Eval run {run.id}: {run.num_points} points, "
+                  f"{len(run.shards)} shard jobs queued.")
+            if args.no_wait:
+                return 0
+            run = driver.wait(run.id, timeout_s=args.timeout)
+        finally:
+            for m in members:
+                m.stop()
+        status = driver.status(run.id)
+        _print_eval_run(status["run"], status["points"])
+        return 0 if run.status == "completed" else 1
+
+    from predictionio_tpu.evalfleet.records import EvalRecordStore
+
+    store = EvalRecordStore(_storage())
+    if action == "list":
+        runs = store.list_runs(
+            engine_id=args.engine, status=args.status, tenant=args.tenant
+        )
+        print(f"{'RUN':24s} {'ENGINE':12s} {'STATUS':10s} {'POINTS':>6s} "
+              f"{'METRIC':14s} {'WINNER':>8s}")
+        for r in runs:
+            winner = "-" if r.winner_score is None else f"{r.winner_score:.4g}"
+            print(f"{r.id:24s} {r.engine_id:12s} {r.status:10s} "
+                  f"{r.num_points:>6d} {r.metric_header:14s} {winner:>8s}")
+        return 0
+    if action in ("show", "status"):
+        from predictionio_tpu.evalfleet.driver import EvalDriver
+
+        driver = EvalDriver(_storage())
+        try:
+            status = driver.status(args.run_id)
+        except KeyError as e:
+            return _fail(str(e))
+        _print_eval_run(status["run"], status["points"])
+        if action == "status":
+            print(f"progress   {status['points_done']}/"
+                  f"{status['points_total']} points")
+            for s in status["shards"]:
+                fold = "all" if s["fold"] is None else s["fold"]
+                print(f"  shard {s['job_id']}  group {s['group']} "
+                      f"fold {fold}  {s['status']}"
+                      + (f"  worker {s['worker_id']}"
+                         if s.get("worker_id") else ""))
+        return 0
+    if action == "gc":
+        from predictionio_tpu.utils.env import env_int
+
+        removed = store.gc(keep=args.keep if args.keep is not None
+                           else env_int("PIO_EVAL_RETENTION"))
+        removed += store.compact(min_age_s=0.0 if args.now else 60.0)
+        print(f"[INFO] Eval GC: {removed} events removed.")
+        return 0
+    return _fail(f"unknown eval action {action!r}")
+
+
+def cmd_tune(args) -> int:
+    from predictionio_tpu.evalfleet.specs import EvalSpec
+    from predictionio_tpu.evalfleet.tuning import tune
+
+    storage = _storage()
+    try:
+        spec = EvalSpec.load(args.spec)
+    except (OSError, ValueError, KeyError) as e:
+        return _fail(f"bad eval spec: {e}")
+    members = (
+        _local_fleet(storage, args.local_workers)
+        if args.local_workers else []
+    )
+    try:
+        run, preset = tune(
+            storage, spec, tenant=args.tenant, timeout_s=args.timeout
+        )
+    finally:
+        for m in members:
+            m.stop()
+    if preset is None:
+        return _fail(f"tune: run {run.id} ended {run.status} without a "
+                     f"winner")
+    scope = f"tenant {preset.tenant}" if preset.tenant else "global"
+    print(f"[INFO] Eval run {run.id} completed: winner point "
+          f"{run.winner_index} ({run.metric_header}={run.winner_score}).")
+    print(f"[INFO] Winner parked as {scope} retrain preset for engine "
+          f"{preset.engine_id} — the next periodic retrain trains it.")
+    return 0
 
 
 def cmd_eventserver(args) -> int:
@@ -1861,14 +2004,67 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.set_defaults(func=cmd_deploy)
 
-    # eval
-    s = sub.add_parser("eval", help="run an evaluation")
-    s.add_argument("evaluation", help="import path of an Evaluation")
-    s.add_argument(
-        "params_generator", nargs="?",
-        help="import path of an EngineParamsGenerator",
+    # eval: fleet-distributed spec runs + first-class records (ISSUE 20);
+    # `eval run <ImportPath>` keeps the legacy single-process Evaluation
+    s = sub.add_parser("eval", help="run/inspect evaluations")
+    esub = s.add_subparsers(dest="eval_action", required=True)
+    er = esub.add_parser(
+        "run",
+        help="run an EvalSpec JSON on the fleet, or a legacy Evaluation "
+             "import path single-process",
     )
-    s.set_defaults(func=cmd_eval)
+    er.add_argument(
+        "target",
+        help="EvalSpec JSON path (fleet mode) or Evaluation import path",
+    )
+    er.add_argument(
+        "params_generator", nargs="?",
+        help="import path of an EngineParamsGenerator (legacy mode)",
+    )
+    er.add_argument("--tenant", default=None,
+                    help="tenant scope recorded on the run")
+    er.add_argument("--local-workers", type=int, default=0,
+                    help="spin N in-process fleet members for the run")
+    er.add_argument("--timeout", type=float, default=None,
+                    help="max seconds to wait for convergence")
+    er.add_argument("--no-wait", action="store_true",
+                    help="submit the shards and return immediately")
+    er.set_defaults(func=cmd_eval)
+    el = esub.add_parser("list", help="list eval runs")
+    el.add_argument("--engine", default=None)
+    el.add_argument("--status", default=None,
+                    choices=["running", "completed", "failed"])
+    el.add_argument("--tenant", default=None)
+    el.set_defaults(func=cmd_eval)
+    eo = esub.add_parser("show", help="one run's record + point scores")
+    eo.add_argument("run_id")
+    eo.set_defaults(func=cmd_eval)
+    es = esub.add_parser(
+        "status", help="live fan-out view: shard jobs + partial folds"
+    )
+    es.add_argument("run_id")
+    es.set_defaults(func=cmd_eval)
+    eg = esub.add_parser("gc", help="purge old terminal eval runs")
+    eg.add_argument("--keep", type=int, default=None,
+                    help="terminal runs to keep (default PIO_EVAL_RETENTION)")
+    eg.add_argument("--now", action="store_true",
+                    help="compact without the quiescence age gate")
+    eg.set_defaults(func=cmd_eval)
+
+    # tune: run the space, park the winner on the retrain spec (ISSUE 20)
+    s = sub.add_parser(
+        "tune",
+        help="evaluate a param space and feed the winner into the "
+             "periodic-retrain spec",
+    )
+    s.add_argument("spec", help="EvalSpec JSON path")
+    s.add_argument("--tenant", default=None,
+                   help="park the winner on this tenant's retrain preset")
+    s.add_argument("--local-workers", type=int, default=0,
+                   help="spin N in-process fleet members for the run")
+    s.add_argument("--timeout", type=float, default=None,
+                   help="max seconds to wait for convergence")
+    s.set_defaults(func=cmd_tune)
 
     # eventserver
     s = sub.add_parser("eventserver", help="run the event ingestion server")

@@ -106,9 +106,43 @@ class _Handler(JsonHandler):
 {self._traces_html()}
 {self._tsdb_html(qs or {})}
 {self._lifecycle_html()}
+{self._evals_html()}
 {self._tenants_html()}
 {self._online_html()}
 </body></html>"""
+
+    # -- fleet evaluation (ISSUE 20) ---------------------------------------
+    def _evals_html(self) -> str:
+        """Fleet eval panel: EvalRun records newest-first — space size,
+        convergence, winner, and the lineage pointer to the ModelVersion
+        the winning params trained into."""
+        from predictionio_tpu.evalfleet.records import EvalRecordStore
+
+        try:
+            runs = EvalRecordStore(self.server.storage).list_runs()
+        except Exception:
+            return "<h1>Fleet evaluations</h1><p>(eval store unavailable)</p>"
+        if not runs:
+            return "<h1>Fleet evaluations</h1><p>(no eval runs recorded)</p>"
+        rows = "".join(
+            f"<tr><td>{html.escape(r.id)}</td>"
+            f"<td>{html.escape(r.engine_id)}</td>"
+            f"<td>{html.escape(r.tenant or '-')}</td>"
+            f"<td>{r.status}</td>"
+            f"<td>{r.num_points} pts / {r.num_groups} grp "
+            f"&times; {r.num_folds} folds</td>"
+            f"<td>{html.escape(r.metric_header)}</td>"
+            f"<td>{'-' if r.winner_score is None else f'{r.winner_score:.6g}'}"
+            f"{'' if r.winner_index is None else f' (p{r.winner_index})'}</td>"
+            f"<td>{html.escape(r.winner_model_version or '-')}</td></tr>"
+            for r in runs[:50]
+        )
+        return f"""<h1>Fleet evaluations</h1>
+<table border="1" cellpadding="4">
+<tr><th>Run</th><th>Engine</th><th>Tenant</th><th>Status</th>
+<th>Space</th><th>Metric</th><th>Winner</th><th>Model version</th></tr>
+{rows}
+</table>"""
 
     # -- online learning (ISSUE 9) -----------------------------------------
     def _online_html(self) -> str:

@@ -323,6 +323,31 @@ _k("PIO_TSAN", "flag", "",
 _k("PIO_TSAN_REPORT", "path", "",
    "Path the sanitizer writes its JSON findings report to at exit.")
 
+# -- fleet evaluation & auto-tuning (ISSUE 20) -------------------------------
+_k("PIO_EVAL_POLL_S", "float", 0.5,
+   "Eval-driver poll cadence (s): partial-result folds + re-dispatch.")
+_k("PIO_EVAL_SHARD_TIMEOUT_S", "float", 600.0,
+   "Wall-clock timeout (s) for one fleet eval shard job.")
+_k("PIO_EVAL_MAX_ATTEMPTS", "int", 3,
+   "Queue retry budget per eval shard job (infra failures).")
+_k("PIO_EVAL_REDISPATCH", "int", 2,
+   "Extra driver re-submissions per exhausted eval shard before the "
+   "run fails (straggler/poison insurance on top of queue retries).")
+_k("PIO_EVAL_RETENTION", "int", 20,
+   "Terminal EvalRun records (with results) the eval GC keeps.")
+_k("PIO_TUNE_PRIOR", "flag", "1",
+   "Set 0 to disable the canary offline prior from eval records.")
+_k("PIO_TUNE_STRICT_BAKE", "float", 2.0,
+   "Bake-window multiplier when the candidate's linked offline eval "
+   "score is worse than live's (<=1 disables).")
+_k("PIO_CAS_SETTLE_S", "str", "",
+   "Operator-pinned CAS claim settle window (s); empty = adapt from "
+   "measured storage write-visibility skew at fleet-member start.")
+_k("PIO_CAS_SETTLE_MIN_S", "float", 0.02,
+   "Floor (s) of the adaptive CAS claim settle window.")
+_k("PIO_CAS_SETTLE_MAX_S", "float", 2.0,
+   "Ceiling (s) of the adaptive CAS claim settle window.")
+
 # -- bench harness -----------------------------------------------------------
 _k("PIO_BENCH_SCALE", "enum", "",
    "Set small for the CI-sized bench shapes (100K-scale).")

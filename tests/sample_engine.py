@@ -274,3 +274,120 @@ class SlowEngineFactory(EngineFactory):
 class UnserializableEngineFactory(EngineFactory):
     def apply(self):
         return Engine(DataSource0, Preparator0, UnserializableAlgo, FirstServing)
+
+
+# -- fleet-eval grid engine (ISSUE 20) --------------------------------------
+# A jax-free engine with a real eval surface: configurable folds, a
+# train_grid hook that stamps how many points shared its device program,
+# and a deterministic score peaked at weight=0.37 so grid winners are
+# known in advance. Evalfleet chaos/parity tests and bench.py use it.
+
+
+@dataclass
+class GridDSP:
+    folds: int = 2
+    queries: int = 4
+    sleep_s: float = 0.0  # stall inside read_eval → kill lands mid-shard
+
+
+class GridDataSource(DataSource):
+    def __init__(self, params: GridDSP):
+        self.params = params
+
+    def read_training(self, ctx):
+        return TrainingData(id=0)
+
+    def read_eval(self, ctx):
+        if self.params.sleep_s:
+            import time
+
+            time.sleep(self.params.sleep_s)
+        return [
+            (
+                TrainingData(id=f),
+                EvalInfo(id=f),
+                [
+                    (Query(q=100 * f + i), Actual(q=100 * f + i))
+                    for i in range(self.params.queries)
+                ],
+            )
+            for f in range(self.params.folds)
+        ]
+
+
+@dataclass
+class GridAP:
+    weight: float = 0.0
+    # simulated device-program cost: train_grid pays it ONCE for the
+    # whole params group (one program), train() pays it per point —
+    # bench.py's grid-group speedup measures exactly this difference
+    train_cost_s: float = 0.0
+
+
+@dataclass
+class GridModel:
+    weight: float
+    td_id: int
+    grid_size: int = 1  # points trained in the same train_grid call
+
+
+@dataclass
+class GridPrediction:
+    q: int
+    score: float
+    grid_size: int
+
+
+class GridAlgo(Algorithm):
+    BEST_WEIGHT = 0.37
+
+    def __init__(self, params: GridAP):
+        self.params = params
+
+    @staticmethod
+    def _spend(cost_s: float) -> None:
+        if cost_s:
+            import time
+
+            time.sleep(cost_s)
+
+    def train(self, ctx, pd) -> GridModel:
+        self._spend(self.params.train_cost_s)
+        return GridModel(self.params.weight, pd.td_id, 1)
+
+    def train_grid(self, ctx, pd, params_list) -> list:
+        self._spend(max(p.train_cost_s for p in params_list))
+        return [
+            GridModel(p.weight, pd.td_id, len(params_list))
+            for p in params_list
+        ]
+
+    def predict(self, model: GridModel, query: Query) -> GridPrediction:
+        return GridPrediction(
+            q=query.q,
+            score=1.0 - abs(model.weight - self.BEST_WEIGHT),
+            grid_size=model.grid_size,
+        )
+
+
+class GridScore:
+    """AverageMetric over GridPrediction.score (declared lazily so
+    importing sample_engine needs no controller.metrics / numpy)."""
+
+    def __new__(cls):
+        from predictionio_tpu.controller.metrics import AverageMetric
+
+        class _GridScore(AverageMetric):
+            def header(self):
+                return "GridScore"
+
+            def calculate_one(self, q, p, a):
+                return p.score
+
+        return _GridScore()
+
+
+class GridEngineFactory(EngineFactory):
+    def apply(self):
+        return Engine(GridDataSource, Preparator0, {"grid": GridAlgo},
+                      FirstServing)

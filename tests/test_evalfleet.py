@@ -1,0 +1,843 @@
+"""Fleet-scale evaluation & auto-tuning tests (ISSUE 20): param-space
+DSL, combinable metric partials, durable EvalRun/EvalResult records with
+exactly-once convergence, the eval driver's fan-out/re-dispatch/finalize
+loop, chaos kill -9 of an eval worker mid-shard, grid-grouped fleet
+metrics matching the sequential MetricEvaluator to 1e-5, the tuning→
+retrain loop (preset park → periodic overlay → lineage stamp), the
+adaptive CAS settle window, and the canary offline prior."""
+
+import json
+import math
+import os
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.data.storage.registry import (
+    SourceConfig,
+    Storage,
+    StorageConfig,
+)
+from predictionio_tpu.deploy.scheduler import (
+    JobQueue,
+    SchedulerConfig,
+    storage_config_to_json,
+)
+from predictionio_tpu.evalfleet.driver import (
+    EVAL_DRIVER_THREAD,
+    EvalDriver,
+    EvalDriverConfig,
+)
+from predictionio_tpu.evalfleet.records import EvalRecordStore
+from predictionio_tpu.evalfleet.specs import (
+    EvalSpec,
+    HeldOutRMSE,
+    MAPAtK,
+    NDCGAtK,
+    ParamAxis,
+    PrecisionAtK,
+    combine_partials,
+    expand_points,
+    group_points,
+    metric_finalize,
+    metric_partial,
+    point_fragment,
+    resolve_metric,
+)
+from predictionio_tpu.evalfleet.tuning import (
+    PresetStore,
+    RetrainPreset,
+    apply_preset,
+    offline_prior_multiplier,
+    park_winner,
+    tune,
+)
+from predictionio_tpu.fleet.coordinator import (
+    FleetConfig,
+    FleetMember,
+    measure_write_visibility_skew,
+)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_DIR = os.path.dirname(TESTS_DIR)
+
+GRID_VARIANT = {
+    "id": "grid",
+    "engineFactory": "sample_engine.GridEngineFactory",
+    "datasource": {"params": {"folds": 2, "queries": 4}},
+    "preparator": {"params": {"id": 1}},
+    "algorithms": [{"name": "grid", "params": {"weight": 0.0}}],
+    "serving": {},
+}
+
+WEIGHTS = [0.05, 0.15, 0.25, 0.37, 0.45, 0.55, 0.65, 0.75]
+BEST_INDEX = 3  # weight 0.37 == GridAlgo.BEST_WEIGHT
+
+
+def _grid_spec(weights=WEIGHTS, folds=2, sleep_s=0.0):
+    variant = json.loads(json.dumps(GRID_VARIANT))
+    if sleep_s:
+        variant["datasource"]["params"]["sleep_s"] = sleep_s
+    return EvalSpec(
+        variant=variant,
+        axes=[ParamAxis(path="algorithms.0.params.weight",
+                        values=list(weights))],
+        metric={"class": "sample_engine.GridScore"},
+        folds=folds,
+    )
+
+
+def _scheduler_config(tmp_path, **kw) -> SchedulerConfig:
+    cfg = SchedulerConfig(
+        poll_interval_s=0.1,
+        heartbeat_interval_s=0.2,
+        stale_after_s=1.0,
+        log_dir=str(tmp_path / "job-logs"),
+        child_env={
+            "PYTHONPATH": os.pathsep.join([REPO_DIR, TESTS_DIR]),
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _wait_for(predicate, timeout=60.0, interval=0.1, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _run_shards_inprocess(storage, driver, run, tmp_path):
+    """Execute every pending shard of `run` by calling the eval worker's
+    main() in-process — the subprocess contract without the subprocess."""
+    from predictionio_tpu.evalfleet import worker as eval_worker
+
+    for job_id in list(run.shards):
+        job = driver.queue.get(job_id)
+        spec_path = tmp_path / f"{job_id}.spec.json"
+        result_path = tmp_path / f"{job_id}.result.json"
+        spec_path.write_text(json.dumps({
+            "job_id": job_id,
+            "storage": storage_config_to_json(storage.config),
+            "variant": job.variant,
+            "result_path": str(result_path),
+        }))
+        rc = eval_worker.main(["worker", str(spec_path)])
+        assert rc == 0, f"eval shard {job_id} exited {rc}"
+
+
+@pytest.fixture()
+def mem_storage():
+    return Storage(StorageConfig(
+        sources={"M": SourceConfig("M", "memory", {})},
+        repositories={
+            "METADATA": "M", "EVENTDATA": "M", "MODELDATA": "M",
+        },
+    ))
+
+
+# ---------------------------------------------------------------------------
+# param-space DSL + metric specs
+# ---------------------------------------------------------------------------
+
+
+class TestSpecDSL:
+    def test_expand_points_axis_major_and_isolated(self):
+        spec = EvalSpec(
+            variant=dict(GRID_VARIANT),
+            axes=[
+                ParamAxis("algorithms.0.params.weight", [0.1, 0.2]),
+                ParamAxis("datasource.params.queries", [4, 8, 16]),
+            ],
+        )
+        points = expand_points(spec)
+        assert len(points) == 6
+        # axis-major: first axis varies slowest
+        assert [p["algorithms"][0]["params"]["weight"] for p in points] == [
+            0.1, 0.1, 0.1, 0.2, 0.2, 0.2,
+        ]
+        assert [p["datasource"]["params"]["queries"] for p in points] == [
+            4, 8, 16, 4, 8, 16,
+        ]
+        # deep copies: mutating one point leaks nowhere
+        points[0]["algorithms"][0]["params"]["weight"] = 99
+        assert points[3]["algorithms"][0]["params"]["weight"] == 0.2
+        assert GRID_VARIANT["algorithms"][0]["params"]["weight"] == 0.0
+
+    def test_range_expansion(self):
+        lin = ParamAxis.from_dict({
+            "path": "algorithms.0.params.w",
+            "range": {"from": 0.0, "to": 1.0, "steps": 5},
+        })
+        assert lin.values == pytest.approx([0.0, 0.25, 0.5, 0.75, 1.0])
+        log = ParamAxis.from_dict({
+            "path": "algorithms.0.params.w",
+            "range": {"from": 0.01, "to": 1.0, "steps": 3, "scale": "log"},
+        })
+        assert log.values == pytest.approx([0.01, 0.1, 1.0])
+        with pytest.raises(ValueError):
+            ParamAxis.from_dict({
+                "path": "algorithms.0.params.w",
+                "range": {"from": -1, "to": 1, "steps": 2, "scale": "log"},
+            })
+
+    def test_axis_validation(self):
+        with pytest.raises(ValueError):  # not a stage key
+            ParamAxis.from_dict({"path": "engineFactory", "values": [1]})
+        with pytest.raises(ValueError):  # no values
+            ParamAxis.from_dict({"path": "serving.params.x"})
+        with pytest.raises(ValueError):  # empty values
+            ParamAxis.from_dict({"path": "serving.params.x", "values": []})
+
+    def test_set_path_errors(self):
+        spec = EvalSpec(
+            variant=dict(GRID_VARIANT),
+            axes=[ParamAxis("algorithms.5.params.weight", [1])],
+        )
+        with pytest.raises(ValueError):  # list index out of range
+            expand_points(spec)
+
+    def test_group_points_by_grid_compatibility(self):
+        # same datasource/preparator/serving + single same-named algo
+        # → one grid group regardless of algo params
+        spec = _grid_spec(weights=[0.1, 0.2, 0.3], folds=0)
+        assert group_points(expand_points(spec)) == [[0, 1, 2]]
+        # a datasource axis splits the space into incompatible groups
+        spec2 = EvalSpec(
+            variant=dict(GRID_VARIANT),
+            axes=[
+                ParamAxis("algorithms.0.params.weight", [0.1, 0.2]),
+                ParamAxis("datasource.params.queries", [4, 8]),
+            ],
+        )
+        groups = group_points(expand_points(spec2))
+        assert sorted(groups) == [[0, 2], [1, 3]]
+
+    def test_point_fragment_strips_non_stage_keys(self):
+        frag = point_fragment(expand_points(_grid_spec(folds=0))[0])
+        assert set(frag) <= {"datasource", "preparator", "algorithms",
+                             "serving"}
+        assert "engineFactory" not in frag
+
+    def test_spec_roundtrip(self, tmp_path):
+        spec = _grid_spec()
+        path = tmp_path / "eval.json"
+        path.write_text(json.dumps(spec.to_dict()))
+        back = EvalSpec.load(str(path))
+        assert back.to_dict() == spec.to_dict()
+
+    def test_spec_requires_engine_factory(self):
+        with pytest.raises(ValueError):
+            EvalSpec(variant={"id": "x"})
+
+
+class TestMetrics:
+    def test_resolve_by_name_and_class(self):
+        m = resolve_metric("map@5")
+        assert isinstance(m, MAPAtK) and m.k == 5
+        m = resolve_metric({"name": "precision", "k": 3})
+        assert isinstance(m, PrecisionAtK) and m.k == 3
+        m = resolve_metric({"name": "ndcg@7"})
+        assert isinstance(m, NDCGAtK) and m.k == 7
+        m = resolve_metric("rmse")
+        assert isinstance(m, HeldOutRMSE) and not m.higher_is_better
+        m = resolve_metric({"class": "sample_engine.GridScore"})
+        assert m.header() == "GridScore"
+        with pytest.raises(ValueError):
+            resolve_metric("nope")
+        with pytest.raises(ValueError):
+            resolve_metric(42)
+
+    def test_ranking_metrics(self):
+        data = [(None, [(
+            None,
+            {"items": ["a", "b", "x", "c"]},
+            {"items": ["a", "c", "d"]},
+        )])]
+        p = resolve_metric("precision@4").calculate(None, data)
+        assert p == pytest.approx(2 / 4)
+        ap = resolve_metric("map@4").calculate(None, data)
+        # hits at ranks 1 and 4: (1/1 + 2/4) / min(3, 4)
+        assert ap == pytest.approx((1.0 + 0.5) / 3)
+        ndcg = resolve_metric("ndcg@4").calculate(None, data)
+        dcg = 1 / math.log2(2) + 1 / math.log2(5)
+        idcg = sum(1 / math.log2(i + 2) for i in range(3))
+        assert ndcg == pytest.approx(dcg / idcg)
+
+    def test_rmse_partials_pool_exactly(self):
+        # pooled RMSE over both folds != mean of per-fold RMSEs; the
+        # partial contract must produce the POOLED value
+        fold_a = [(None, [(None, {"rating": 3.0}, {"rating": 1.0})])]
+        fold_b = [(None, [(None, {"rating": 5.0}, {"rating": 4.0}),
+                          (None, {"rating": 2.0}, {"rating": 2.0})])]
+        m = HeldOutRMSE()
+        parts = [metric_partial(m, None, fold_a),
+                 metric_partial(m, None, fold_b)]
+        total, count = combine_partials(parts)
+        combined = metric_finalize(m, total, count)
+        pooled = m.calculate(None, fold_a + fold_b)
+        assert combined == pytest.approx(pooled, abs=1e-12)
+        per_fold_mean = (m.calculate(None, fold_a)
+                         + m.calculate(None, fold_b)) / 2
+        assert abs(combined - per_fold_mean) > 1e-6
+
+    def test_average_metric_partials_match_full_calculation(self):
+        data = [
+            (None, [(None, {"items": ["a"]}, {"items": ["a", "b"]})]),
+            (None, [(None, {"items": ["b", "c"]}, {"items": ["c"]})]),
+        ]
+        m = resolve_metric("precision@2")
+        parts = [metric_partial(m, None, [fold]) for fold in data]
+        total, count = combine_partials(parts)
+        assert metric_finalize(m, total, count) == pytest.approx(
+            m.calculate(None, data), abs=1e-12
+        )
+
+
+# ---------------------------------------------------------------------------
+# durable records: idempotency, fold merge, lineage, GC
+# ---------------------------------------------------------------------------
+
+
+class TestEvalRecords:
+    def test_partials_idempotent_and_folds_merge(self, mem_storage):
+        rec = EvalRecordStore(mem_storage)
+        run = rec.create_run("eng", {}, 2, 1, 2, "GridScore")
+        # a requeued shard rewrites the SAME fold field — no duplicate
+        rec.record_partial(run.id, 0, 0, {"sum": 1.0, "count": 2})
+        rec.record_partial(run.id, 0, 0, {"sum": 1.5, "count": 2},
+                           params={"algorithms": []})
+        rec.record_partial(run.id, 0, 1, {"sum": 2.0, "count": 2})
+        results = rec.results(run.id)
+        assert set(results) == {0}
+        partials = rec.point_partials(results[0])
+        assert set(partials) == {"fold_0", "fold_1"}
+        assert partials["fold_0"] == {"sum": 1.5, "count": 2}  # LWW
+        assert results[0]["params"] == {"algorithms": []}
+
+    def test_run_crud_and_filters(self, mem_storage):
+        rec = EvalRecordStore(mem_storage)
+        a = rec.create_run("e1", {}, 1, 1, 1, "m", tenant="acme")
+        time.sleep(0.01)
+        b = rec.create_run("e2", {}, 1, 1, 1, "m")
+        rec.update_run(b.id, status="completed", winner_index=0)
+        got = rec.get_run(b.id)
+        assert got.status == "completed" and got.winner_index == 0
+        assert [r.id for r in rec.list_runs()] == [b.id, a.id]
+        assert [r.id for r in rec.list_runs(engine_id="e1")] == [a.id]
+        assert [r.id for r in rec.list_runs(status="completed")] == [b.id]
+        assert [r.id for r in rec.list_runs(tenant="acme")] == [a.id]
+        assert rec.get_run("eval-nope") is None
+
+    def test_lineage_link(self, mem_storage):
+        rec = EvalRecordStore(mem_storage)
+        run = rec.create_run("eng", {}, 1, 1, 1, "m")
+        rec.link_model_version(run.id, "mv-1", job_id="job-x")
+        rec.link_model_version(run.id, "mv-2", job_id="job-y")
+        got = rec.get_run(run.id)
+        assert set(got.links) == {"mv-1", "mv-2"}
+        assert got.links["mv-1"]["job_id"] == "job-x"
+        assert got.winner_model_version == "mv-2"
+
+    def test_gc_keeps_running_and_newest(self, mem_storage):
+        rec = EvalRecordStore(mem_storage)
+        runs = []
+        for i in range(4):
+            r = rec.create_run(f"e{i}", {}, 1, 1, 1, "m")
+            rec.record_partial(r.id, 0, None, {"sum": 1, "count": 1})
+            runs.append(r)
+            time.sleep(0.01)
+        # oldest two terminal, third running, newest terminal
+        rec.update_run(runs[0].id, status="completed")
+        rec.update_run(runs[1].id, status="failed")
+        rec.update_run(runs[3].id, status="completed")
+        assert rec.gc(keep=2) > 0
+        left = {r.id for r in rec.list_runs()}
+        # the running run survives any GC; oldest terminal beyond keep=2
+        # (runs[0]) is purged with its results
+        assert runs[2].id in left and runs[0].id not in left
+        assert runs[1].id in left and runs[3].id in left
+        assert rec.results(runs[0].id) == {}
+
+    def test_purge_run_drops_results(self, mem_storage):
+        rec = EvalRecordStore(mem_storage)
+        run = rec.create_run("eng", {}, 2, 1, 1, "m")
+        rec.record_partial(run.id, 0, None, {"sum": 1, "count": 1})
+        rec.record_partial(run.id, 1, None, {"sum": 2, "count": 1})
+        assert rec.purge_run(run.id) >= 3
+        assert rec.get_run(run.id) is None
+        assert rec.results(run.id) == {}
+
+
+# ---------------------------------------------------------------------------
+# driver: fan-out, in-process convergence, parity with MetricEvaluator
+# ---------------------------------------------------------------------------
+
+
+class TestEvalDriver:
+    def test_fleet_parity_with_sequential_metric_evaluator(
+        self, fresh_storage, tmp_path
+    ):
+        """Grid-grouped fleet eval (per-fold shards, combinable partials,
+        durable records) reproduces the sequential MetricEvaluator's
+        per-point scores to 1e-5 on the same splits."""
+        spec = _grid_spec(weights=WEIGHTS[:6])
+        driver = EvalDriver(fresh_storage)
+        run = driver.submit(spec)
+        # 6 compatible points → 1 grid group × 2 folds = 2 shards
+        assert run.num_points == 6 and run.num_groups == 1
+        assert len(run.shards) == 2
+        _run_shards_inprocess(fresh_storage, driver, run, tmp_path)
+        run = driver.poll_once(run.id)
+        assert run.status == "completed"
+
+        # sequential reference on the same splits
+        import sample_engine
+        from predictionio_tpu.controller.evaluation import MetricEvaluator
+        from predictionio_tpu.core.base import RuntimeContext, WorkflowParams
+
+        engine = sample_engine.GridEngineFactory().apply()
+        points = expand_points(spec)
+        eps = [engine.params_from_variant_json(p) for p in points]
+        ctx = RuntimeContext(storage=fresh_storage, mesh=None, mode="eval")
+        eval_data = engine.batch_eval(ctx, eps)
+        seq = MetricEvaluator(sample_engine.GridScore()).evaluate(
+            ctx, None, eval_data, WorkflowParams()
+        )
+
+        fleet_scores = driver.scores(run)
+        assert all(s["complete"] for s in fleet_scores)
+        for fleet, ref in zip(fleet_scores, seq.engine_params_scores):
+            assert fleet["score"] == pytest.approx(ref.score, abs=1e-5)
+        assert run.winner_index == seq.best_index == BEST_INDEX
+        assert run.winner_params["algorithms"][0]["params"]["weight"] == \
+            pytest.approx(0.37)
+
+    def test_grid_group_trains_one_program_per_fold(self, fresh_storage):
+        """Every point in a grid-compatible group shares ONE train_grid
+        device program per fold (GridModel.grid_size == group size), and
+        fold_indices narrows the evaluated splits."""
+        import sample_engine
+        from predictionio_tpu.core.base import RuntimeContext
+
+        spec = _grid_spec(weights=WEIGHTS)
+        engine = sample_engine.GridEngineFactory().apply()
+        eps = [engine.params_from_variant_json(p)
+               for p in expand_points(spec)]
+        ctx = RuntimeContext(storage=fresh_storage, mesh=None, mode="eval")
+        out = engine.batch_eval(ctx, eps, fold_indices=[1])
+        assert len(out) == len(eps)
+        for _ep, data in out:
+            assert len(data) == 1  # only fold 1 evaluated
+            info, qpas = data[0]
+            assert info.id == 1
+            for _q, p, _a in qpas:
+                assert p.grid_size == len(eps)
+        with pytest.raises(ValueError):
+            engine.batch_eval(ctx, eps, fold_indices=[5])
+
+    def test_redispatch_and_exhaustion(self, fresh_storage, tmp_path):
+        spec = _grid_spec(weights=[0.1, 0.2], folds=0)
+        driver = EvalDriver(
+            fresh_storage,
+            EvalDriverConfig(poll_interval_s=0.05, redispatch_limit=1),
+        )
+        run = driver.submit(spec)
+        assert len(run.shards) == 1
+        (job_id,) = run.shards
+        queue = JobQueue(fresh_storage)
+        queue.update(job_id, status="failed", last_error="boom")
+        run = driver.poll_once(run.id)
+        # one fresh shard job enqueued; the failed one marked redispatched
+        assert run.status == "running" and len(run.shards) == 2
+        assert run.shards[job_id]["redispatched"] == 1
+        new_id = next(j for j in run.shards if j != job_id)
+        assert queue.get(new_id).status == "queued"
+        # fail the replacement too → budget exhausted → run fails
+        queue.update(new_id, status="failed", last_error="boom again")
+        run = driver.poll_once(run.id)
+        assert run.status == "failed"
+        assert "exhausted" in run.last_error
+        # but completed records still win: a redispatch that landed
+        # between polls would have flipped complete instead
+        _run_shards_inprocess(
+            fresh_storage, driver,
+            type(run)(id=run.id, engine_id=run.engine_id,
+                      shards={new_id: run.shards[new_id]}),
+            tmp_path,
+        )
+        assert all(s["complete"] for s in driver.scores(
+            driver.records.get_run(run.id)))
+
+    def test_driver_thread_start_stop_joins(self, mem_storage):
+        rec = EvalRecordStore(mem_storage)
+        run = rec.create_run("eng", {"metric": "map"}, 0, 0, 1, "MAPAtK@10")
+        driver = EvalDriver(mem_storage,
+                            EvalDriverConfig(poll_interval_s=0.05))
+        driver.start(run.id)
+        _wait_for(
+            lambda: (rec.get_run(run.id) or run).status == "completed",
+            timeout=10, what="empty run to finalize",
+        )
+        driver.stop()
+        assert not any(
+            t.name == EVAL_DRIVER_THREAD and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_status_payload(self, fresh_storage, tmp_path):
+        spec = _grid_spec(weights=[0.3, 0.4], folds=2)
+        driver = EvalDriver(fresh_storage)
+        run = driver.submit(spec)
+        st = driver.status(run.id)
+        assert st["points_total"] == 2 and st["points_done"] == 0
+        assert len(st["shards"]) == 2
+        assert {s["status"] for s in st["shards"]} == {"queued"}
+        _run_shards_inprocess(fresh_storage, driver, run, tmp_path)
+        st = driver.status(run.id)
+        assert st["points_done"] == 2
+        assert all(s["complete"] for s in st["points"])
+        with pytest.raises(KeyError):
+            driver.status("eval-nope")
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: kill -9 an eval worker mid-shard on a 2-worker fleet
+# ---------------------------------------------------------------------------
+
+
+class TestChaosFleetEval:
+    def test_kill9_mid_shard_converges_exactly_once(
+        self, fresh_storage, tmp_path
+    ):
+        """2-worker fleet, 8-point grid, one worker kill -9'd while its
+        shard sleeps inside read_eval: the survivor steals the stale
+        claim, re-runs the shard, and every point converges to exactly
+        one EvalResult (idempotent fold fields, no duplicates)."""
+        spec = _grid_spec(weights=WEIGHTS, folds=2, sleep_s=0.6)
+        members = [
+            FleetMember(
+                fresh_storage,
+                scheduler_config=_scheduler_config(tmp_path / f"w{i}"),
+                fleet_config=FleetConfig(
+                    heartbeat_interval_s=0.1, adaptive_settle=False
+                ),
+            )
+            for i in range(2)
+        ]
+        driver = EvalDriver(
+            fresh_storage, EvalDriverConfig(poll_interval_s=0.2)
+        )
+        queue = JobQueue(fresh_storage)
+        for m in members:
+            m.start()
+        victim = None
+        try:
+            run = driver.submit(spec)
+            assert run.num_points == 8 and len(run.shards) == 2
+
+            def running_jobs():
+                return [j for j in queue.list()
+                        if j.id in run.shards and j.status == "running"]
+
+            _wait_for(lambda: running_jobs(), timeout=30,
+                      what="a shard to start running")
+            # kill -9 the member that owns a running shard
+            owner = running_jobs()[0].worker_id
+            victim = next(m for m in members if m.worker_id == owner)
+            victim.stop(kill_child=True)
+
+            run = driver.wait(run.id, timeout_s=120)
+            assert run.status == "completed", run.last_error
+        finally:
+            for m in members:
+                if m is not victim:
+                    m.stop()
+
+        # exactly-once: one EvalResult per point, each with exactly the
+        # two expected fold fields — re-runs rewrote, never duplicated
+        results = driver.records.results(run.id)
+        assert sorted(results) == list(range(8))
+        for rec in results.values():
+            assert set(driver.records.point_partials(rec)) == {
+                "fold_0", "fold_1"
+            }
+        assert run.winner_index == BEST_INDEX
+        assert run.winner_params["algorithms"][0]["params"]["weight"] == \
+            pytest.approx(0.37)
+        # at least one shard was re-claimed after the kill
+        attempts = [queue.get(j).attempt for j in run.shards]
+        generations = [queue.get(j).generation for j in run.shards]
+        assert max(attempts) >= 1 or max(generations) >= 2
+
+
+# ---------------------------------------------------------------------------
+# the tuning→retrain loop
+# ---------------------------------------------------------------------------
+
+
+class TestTuningLoop:
+    def test_tune_parks_winner_and_next_retrain_trains_it(
+        self, fresh_storage, tmp_path
+    ):
+        """`pio tune` end-to-end: fleet eval → winner parked as retrain
+        preset → the NEXT periodic retrain trains the winning params and
+        stamps the lineage pointer back onto the eval run."""
+        spec = _grid_spec(weights=[0.1, 0.37, 0.7], folds=0)
+        member = FleetMember(
+            fresh_storage,
+            scheduler_config=_scheduler_config(tmp_path),
+            fleet_config=FleetConfig(
+                heartbeat_interval_s=0.1, adaptive_settle=False
+            ),
+        )
+        member.start()
+        try:
+            driver = EvalDriver(
+                fresh_storage, EvalDriverConfig(poll_interval_s=0.2)
+            )
+            run, preset = tune(
+                fresh_storage, spec, timeout_s=90, driver=driver
+            )
+            assert run.status == "completed" and preset is not None
+            assert preset.params["algorithms"][0]["params"]["weight"] == \
+                pytest.approx(0.37)
+            assert PresetStore(fresh_storage).get("grid").run_id == run.id
+
+            # periodic retrain with the ORIGINAL (weight 0.0) variant
+            queue = JobQueue(fresh_storage)
+            job = queue.submit(dict(GRID_VARIANT), period_s=0.2,
+                               timeout_s=60)
+            _wait_for(
+                lambda: queue.get(job.id).status == "completed",
+                timeout=60, what="periodic train job",
+            )
+            # the follow-up job carries the parked winner + lineage marker
+
+            def next_job():
+                return [j for j in queue.list()
+                        if j.id != job.id and j.kind == "train"]
+
+            _wait_for(lambda: next_job(), timeout=10,
+                      what="next periodic job")
+            nxt = next_job()[0]
+            assert nxt.variant["algorithms"][0]["params"]["weight"] == \
+                pytest.approx(0.37)
+            assert nxt.variant["evalRun"] == run.id
+            _wait_for(
+                lambda: queue.get(nxt.id).status == "completed",
+                timeout=60, what="winner retrain job",
+            )
+        finally:
+            member.stop()
+        done = JobQueue(fresh_storage).get(nxt.id)
+        assert done.model_version
+        linked = EvalRecordStore(fresh_storage).get_run(run.id)
+        # lineage pointer: winning params → the ModelVersion they trained
+        # (a further period may have linked again; membership is the
+        # invariant, winner_model_version tracks the newest link)
+        assert done.model_version in linked.links
+        assert linked.winner_model_version
+        assert linked.links[done.model_version]["job_id"] == nxt.id
+
+    def test_preset_tenant_scoping(self, mem_storage):
+        store = PresetStore(mem_storage)
+        store.park(RetrainPreset(engine_id="e", params={"serving": {}},
+                                 run_id="eval-g"))
+        store.park(RetrainPreset(engine_id="e", params={"serving": {}},
+                                 tenant="acme", run_id="eval-t"))
+        assert store.get("e").run_id == "eval-g"
+        assert store.get("e", tenant="acme").run_id == "eval-t"
+        # unknown tenant falls back to the global preset
+        assert store.get("e", tenant="other").run_id == "eval-g"
+        assert store.clear("e", tenant="acme") > 0
+        assert store.get("e", tenant="acme").run_id == "eval-g"
+
+    def test_apply_preset_overlay_and_marker(self, mem_storage):
+        variant = dict(GRID_VARIANT)
+        # no preset → identity
+        assert apply_preset(mem_storage, variant, "grid") is variant
+        PresetStore(mem_storage).park(RetrainPreset(
+            engine_id="grid",
+            params={"algorithms": [{"name": "grid",
+                                    "params": {"weight": 0.37}}]},
+            run_id="eval-w",
+        ))
+        merged = apply_preset(mem_storage, variant, "grid")
+        assert merged["algorithms"][0]["params"]["weight"] == 0.37
+        assert merged["evalRun"] == "eval-w"
+        # non-searched stages untouched
+        assert merged["datasource"] == variant["datasource"]
+        assert merged["engineFactory"] == variant["engineFactory"]
+        # the original variant is not mutated
+        assert "evalRun" not in variant
+
+    def test_park_winner_requires_completed_run(self, mem_storage):
+        rec = EvalRecordStore(mem_storage)
+        run = rec.create_run("e", {}, 1, 1, 1, "m")
+        with pytest.raises(ValueError):
+            park_winner(mem_storage, run)
+
+
+class TestOfflinePrior:
+    def _runs(self, storage, cand_score, live_score,
+              metric="map@5", live_metric=None):
+        rec = EvalRecordStore(storage)
+        live_run = rec.create_run("e", {"metric": live_metric or metric},
+                                  1, 1, 1,
+                                  resolve_metric(live_metric or metric)
+                                  .header())
+        rec.update_run(live_run.id, status="completed",
+                       winner_score=live_score)
+        rec.link_model_version(live_run.id, "mv-live")
+        time.sleep(0.01)
+        cand_run = rec.create_run("e", {"metric": metric}, 1, 1, 1,
+                                  resolve_metric(metric).header())
+        rec.update_run(cand_run.id, status="completed",
+                       winner_score=cand_score)
+        rec.link_model_version(cand_run.id, "mv-cand")
+        return rec
+
+    def test_worse_candidate_stretches_bake(self, mem_storage, monkeypatch):
+        monkeypatch.setenv("PIO_TUNE_STRICT_BAKE", "3.0")
+        self._runs(mem_storage, cand_score=0.2, live_score=0.8)
+        mult, reason = offline_prior_multiplier(
+            mem_storage, "e", "mv-cand", "mv-live"
+        )
+        assert mult == 3.0 and "worse than live" in reason
+
+    def test_better_or_equal_candidate_keeps_bake(self, mem_storage):
+        self._runs(mem_storage, cand_score=0.9, live_score=0.8)
+        assert offline_prior_multiplier(
+            mem_storage, "e", "mv-cand", "mv-live"
+        ) == (1.0, None)
+
+    def test_missing_evidence_is_neutral(self, mem_storage):
+        # no runs at all / no live version → never blocks
+        assert offline_prior_multiplier(
+            mem_storage, "e", "mv-cand", "mv-live"
+        ) == (1.0, None)
+        assert offline_prior_multiplier(
+            mem_storage, "e", "mv-cand", None
+        ) == (1.0, None)
+
+    def test_metric_mismatch_is_neutral(self, mem_storage):
+        self._runs(mem_storage, cand_score=0.2, live_score=0.8,
+                   metric="map@5", live_metric="ndcg@5")
+        assert offline_prior_multiplier(
+            mem_storage, "e", "mv-cand", "mv-live"
+        ) == (1.0, None)
+
+    def test_flag_off_disables_prior(self, mem_storage, monkeypatch):
+        monkeypatch.setenv("PIO_TUNE_PRIOR", "0")
+        self._runs(mem_storage, cand_score=0.2, live_score=0.8)
+        assert offline_prior_multiplier(
+            mem_storage, "e", "mv-cand", "mv-live"
+        ) == (1.0, None)
+
+
+# ---------------------------------------------------------------------------
+# adaptive CAS claim settle window
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveSettle:
+    def test_pinned_env_wins(self, mem_storage, monkeypatch):
+        monkeypatch.setenv("PIO_CAS_SETTLE_S", "0.75")
+        m = FleetMember(mem_storage)
+        m._adapt_claim_settle()
+        assert m.scheduler.config.claim_settle_s == 0.75
+
+    def test_bad_pin_keeps_default(self, mem_storage, monkeypatch):
+        monkeypatch.setenv("PIO_CAS_SETTLE_S", "fast")
+        m = FleetMember(mem_storage)
+        before = m.scheduler.config.claim_settle_s
+        m._adapt_claim_settle()
+        assert m.scheduler.config.claim_settle_s == before
+
+    def test_adaptive_clamps_to_floor(self, mem_storage, monkeypatch):
+        monkeypatch.delenv("PIO_CAS_SETTLE_S", raising=False)
+        # in-memory visibility skew is ~0 → the floor clamp holds
+        m = FleetMember(mem_storage)
+        m._adapt_claim_settle()
+        assert m.scheduler.config.claim_settle_s == pytest.approx(0.02)
+
+    def test_adaptive_clamps_to_ceiling(self, mem_storage, monkeypatch):
+        monkeypatch.delenv("PIO_CAS_SETTLE_S", raising=False)
+        from predictionio_tpu.fleet import coordinator as coord
+
+        monkeypatch.setattr(
+            coord, "measure_write_visibility_skew", lambda s: 100.0
+        )
+        m = FleetMember(mem_storage)
+        m._adapt_claim_settle()
+        assert m.scheduler.config.claim_settle_s == pytest.approx(2.0)
+
+    def test_disabled_keeps_configured_default(self, mem_storage,
+                                               monkeypatch):
+        monkeypatch.delenv("PIO_CAS_SETTLE_S", raising=False)
+        m = FleetMember(
+            mem_storage, fleet_config=FleetConfig(adaptive_settle=False)
+        )
+        before = m.scheduler.config.claim_settle_s
+        m._adapt_claim_settle()
+        assert m.scheduler.config.claim_settle_s == before
+
+    def test_probe_measures_and_cleans_up(self, mem_storage):
+        from predictionio_tpu.deploy.registry import LifecycleRecordStore
+
+        skew = measure_write_visibility_skew(mem_storage, probes=2)
+        assert skew >= 0.0
+        store = LifecycleRecordStore(mem_storage)
+        assert store.fold("pio_settle_probe") == {}
+
+
+# ---------------------------------------------------------------------------
+# surfacing: admin GET /evals
+# ---------------------------------------------------------------------------
+
+
+class TestAdminEvals:
+    @pytest.fixture()
+    def admin(self, fresh_storage):
+        from predictionio_tpu.tools.admin import AdminServer
+
+        srv = AdminServer(fresh_storage, ip="127.0.0.1", port=0)
+        port = srv.start()
+        yield fresh_storage, port
+        srv.stop()
+
+    def _get(self, port, path):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null")
+
+    def test_list_and_detail(self, admin):
+        storage, port = admin
+        driver = EvalDriver(storage)
+        run = driver.submit(_grid_spec(weights=[0.3, 0.4], folds=2),
+                            tenant="acme")
+        status, listing = self._get(port, "/evals")
+        assert status == 200
+        assert [r["id"] for r in listing] == [run.id]
+        assert listing[0]["tenant"] == "acme"
+        status, listing = self._get(port, "/evals?tenant=other")
+        assert status == 200 and listing == []
+        status, detail = self._get(port, f"/evals/{run.id}")
+        assert status == 200
+        assert detail["points_total"] == 2
+        assert len(detail["shards"]) == 2
+        assert self._get(port, "/evals/eval-nope")[0] == 404
